@@ -1,0 +1,208 @@
+"""BabyBear prime field arithmetic as uint32 JAX ops.
+
+This is the scalar substrate for the TPU STARK prover (the equivalent of the
+field arithmetic that the reference's zkVM SDKs run on CUDA; see SURVEY.md §2.6
+and /root/reference/crates/prover — the reference delegates BabyBear NTT /
+Poseidon2 / FRI to SP1's GPU kernels, we implement them natively for TPU).
+
+Design notes (TPU-first):
+  * Elements live in uint32 lanes in **Montgomery form** (R = 2^32).  The VPU
+    has native 32-bit integer multiply (low 32 bits, wrapping); the missing
+    `mulhi` is emulated with four 16x16 partial products.  One field mul is
+    ~11 VPU multiplies — entirely element-wise, so XLA fuses chains of field
+    ops into single kernels and the MXU stays free for the matmul-form NTT.
+  * All functions are shape-polymorphic and jit-safe (no data-dependent
+    control flow; exponents are static Python ints unrolled at trace time).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------
+# Field constants (computed with Python bignums at import time)
+# ---------------------------------------------------------------------------
+
+P = 2013265921  # 15 * 2^27 + 1
+TWO_ADICITY = 27
+GENERATOR = 31  # multiplicative generator of F_p^*
+
+_R = (1 << 32) % P          # Montgomery radix R = 2^32 mod p
+_R2 = (_R * _R) % P         # R^2 mod p  (to_mont multiplier)
+_NP = (-pow(P, -1, 1 << 32)) % (1 << 32)  # -p^{-1} mod 2^32
+
+# order-2^27 root of unity and its inverse
+_ROOT = pow(GENERATOR, (P - 1) >> TWO_ADICITY, P)
+_ROOT_INV = pow(_ROOT, P - 2, P)
+
+U32 = jnp.uint32
+P_U32 = np.uint32(P)
+NP_U32 = np.uint32(_NP)
+R_U32 = np.uint32(_R)
+R2_U32 = np.uint32(_R2)
+
+MONT_ONE = np.uint32(_R)   # 1 in Montgomery form
+MONT_ZERO = np.uint32(0)
+
+
+def _u32(x):
+    return jnp.asarray(x, dtype=U32)
+
+
+# ---------------------------------------------------------------------------
+# 32x32 -> 64 multiply emulation (TPU has wrapping 32-bit mul, no mulhi)
+# ---------------------------------------------------------------------------
+
+def mulhi_u32(a, b):
+    """High 32 bits of the 64-bit product of two uint32 arrays."""
+    a = _u32(a)
+    b = _u32(b)
+    mask = np.uint32(0xFFFF)
+    a_lo = a & mask
+    a_hi = a >> 16
+    b_lo = b & mask
+    b_hi = b >> 16
+    ll = a_lo * b_lo          # < 2^32, exact in uint32
+    lh = a_lo * b_hi          # < 2^32
+    hl = a_hi * b_lo          # < 2^32
+    hh = a_hi * b_hi          # < 2^32
+    # carry out of bits [16,32) of the full product
+    mid = (ll >> 16) + (lh & mask) + (hl & mask)   # <= 3*(2^16-1): fits
+    return hh + (lh >> 16) + (hl >> 16) + (mid >> 16)
+
+
+def mullo_u32(a, b):
+    return _u32(a) * _u32(b)  # uint32 wraps mod 2^32
+
+
+# ---------------------------------------------------------------------------
+# Montgomery arithmetic
+# ---------------------------------------------------------------------------
+
+def mont_mul(a, b):
+    """Montgomery product: returns a*b*R^{-1} mod p, inputs/outputs < p."""
+    a = _u32(a)
+    b = _u32(b)
+    lo = a * b
+    hi = mulhi_u32(a, b)
+    m = lo * NP_U32
+    mp_hi = mulhi_u32(m, P_U32)
+    # x + m*p == 0 (mod 2^32); carry into the high word iff lo != 0
+    carry = (lo != 0).astype(U32)
+    t = hi + mp_hi + carry        # < 2p, no uint32 overflow since p < 2^31
+    return jnp.where(t >= P_U32, t - P_U32, t)
+
+
+def mont_sqr(a):
+    return mont_mul(a, a)
+
+
+def add(a, b):
+    s = _u32(a) + _u32(b)
+    return jnp.where(s >= P_U32, s - P_U32, s)
+
+
+def sub(a, b):
+    a = _u32(a)
+    b = _u32(b)
+    return jnp.where(a >= b, a - b, a + P_U32 - b)
+
+
+def neg(a):
+    a = _u32(a)
+    return jnp.where(a == 0, a, P_U32 - a)
+
+
+def to_mont(a):
+    """Canonical uint32 (< p) -> Montgomery form."""
+    return mont_mul(a, R2_U32)
+
+
+def from_mont(a):
+    """Montgomery form -> canonical uint32 (< p)."""
+    return mont_mul(a, np.uint32(1))
+
+
+def mont_pow(a, e: int):
+    """a^e for a *static* Python-int exponent (unrolled square & multiply)."""
+    if e < 0:
+        raise ValueError("negative exponent; use mont_inv")
+    result = jnp.full_like(_u32(a), MONT_ONE)
+    base = _u32(a)
+    while e:
+        if e & 1:
+            result = mont_mul(result, base)
+        e >>= 1
+        if e:
+            base = mont_sqr(base)
+    return result
+
+
+def mont_inv(a):
+    """Field inverse via Fermat (a^{p-2}); a must be nonzero."""
+    return mont_pow(a, P - 2)
+
+
+def batch_mont_inv(a):
+    """Montgomery-trick batch inverse along a flat array (one mont_inv total).
+
+    Mirrors the classic prefix-product trick; O(n) muls + one inversion.
+    Implemented with cumulative products (log-depth under XLA).
+    """
+    a = _u32(a)
+    flat = a.reshape(-1)
+    # prefix products p_i = a_0 * ... * a_i (associative scan)
+    import jax
+    prefix = jax.lax.associative_scan(mont_mul, flat)
+    total_inv = mont_inv(prefix[-1])
+    # suffix pass
+    def body(carry, xs):
+        p_prev, ai = xs
+        inv_i = mont_mul(carry, p_prev)
+        carry = mont_mul(carry, ai)
+        return carry, inv_i
+    p_shift = jnp.concatenate([jnp.array([MONT_ONE], dtype=U32), prefix[:-1]])
+    # walk from the end backwards
+    carry = total_inv
+    _, invs = jax.lax.scan(body, carry, (p_shift[::-1], flat[::-1]))
+    return invs[::-1].reshape(a.shape)
+
+
+# ---------------------------------------------------------------------------
+# Roots of unity / domain helpers (host-side bignum, device arrays out)
+# ---------------------------------------------------------------------------
+
+def root_of_unity(log_n: int) -> int:
+    """Canonical (non-Montgomery) primitive 2^log_n-th root of unity."""
+    if log_n > TWO_ADICITY:
+        raise ValueError(f"2-adicity exceeded: {log_n} > {TWO_ADICITY}")
+    return pow(_ROOT, 1 << (TWO_ADICITY - log_n), P)
+
+
+def pow_host(base: int, e: int) -> int:
+    return pow(base, e, P)
+
+
+def inv_host(a: int) -> int:
+    return pow(a, P - 2, P)
+
+
+def powers_host(base: int, n: int) -> np.ndarray:
+    """[1, base, base^2, ...] canonical, as numpy uint32 (host precompute)."""
+    out = np.empty(n, dtype=np.uint32)
+    acc = 1
+    for i in range(n):
+        out[i] = acc
+        acc = (acc * base) % P
+    return out
+
+
+def to_mont_host(a: np.ndarray | int):
+    """Host-side canonical -> Montgomery (numpy)."""
+    return ((np.asarray(a, dtype=np.uint64) * _R) % P).astype(np.uint32)
+
+
+def from_mont_host(a: np.ndarray | int):
+    rinv = pow(_R, P - 2, P)
+    return ((np.asarray(a, dtype=np.uint64) * rinv) % P).astype(np.uint32)

@@ -1,0 +1,89 @@
+"""Poseidon2 Merkle tree commitment over BabyBear vectors.
+
+Equivalent of the trace-commitment Merkle hashing inside the reference's zkVM
+provers (SURVEY.md §2.6 "Poseidon2 Merkle hashing").  The device builds every
+tree level as one batched compression call (perfect VPU vectorization); proofs
+(authentication paths) are opened host-side from the level arrays.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import babybear as bb
+from . import poseidon2 as p2
+
+DIGEST_WIDTH = p2.RATE  # 8 limbs
+
+
+def commit_levels(leaves):
+    """Build a Merkle tree over `leaves` (n, w) Montgomery field elements.
+
+    n must be a power of two.  Returns a list of level digest arrays,
+    levels[0] = leaf digests (n, 8) ... levels[-1] = root (1, 8).
+    """
+    n = leaves.shape[0]
+    if n & (n - 1):
+        raise ValueError("leaf count must be a power of two")
+    digests = p2.hash_leaves(leaves)
+    levels = [digests]
+    while digests.shape[0] > 1:
+        digests = p2.compress(digests[0::2], digests[1::2])
+        levels.append(digests)
+    return levels
+
+
+def root(levels):
+    return levels[-1][0]
+
+
+def open_path(levels, index: int):
+    """Host-side: sibling digests bottom-up for leaf `index`."""
+    path = []
+    idx = index
+    for level in levels[:-1]:
+        path.append(np.asarray(level[idx ^ 1]))
+        idx >>= 1
+    return path
+
+
+def verify_path(root_digest, index: int, leaf_digest, path,
+                depth: int | None = None) -> bool:
+    """Host-side verification with the numpy reference permutation.
+
+    Inputs are device digests in Montgomery form; since the permutation is
+    built only from adds and mont-muls by mont-form constants, it commutes
+    with the Montgomery map — we convert to canonical once and run the
+    canonical reference.
+
+    `depth` (log2 of the leaf count) binds the path length; without it an
+    inner-node digest would verify as a "leaf" with a truncated path.
+    """
+    if depth is not None and len(path) != depth:
+        return False
+    cur = [int(x) for x in bb.from_mont_host(np.asarray(leaf_digest))]
+    root_c = [int(x) for x in bb.from_mont_host(np.asarray(root_digest))]
+    idx = index
+    for sib in path:
+        sib = [int(x) for x in bb.from_mont_host(np.asarray(sib))]
+        if idx & 1:
+            left, right = sib, cur
+        else:
+            left, right = cur, sib
+        state = p2.permute_ref(left + right)
+        cur = [(state[i] + left[i]) % bb.P for i in range(DIGEST_WIDTH)]
+        idx >>= 1
+    return cur == root_c
+
+
+def hash_leaf_ref(leaf) -> list[int]:
+    """Numpy reference of p2.hash_leaves for a single canonical-int row."""
+    vals = [int(x) % bb.P for x in leaf]
+    pad = (-len(vals)) % p2.RATE
+    vals = vals + [0] * pad
+    state = [0] * p2.WIDTH
+    for i in range(0, len(vals), p2.RATE):
+        for j in range(p2.RATE):
+            state[j] = (state[j] + vals[i + j]) % bb.P
+        state = p2.permute_ref(state)
+    return state[:p2.RATE]

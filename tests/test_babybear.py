@@ -1,0 +1,86 @@
+"""BabyBear field ops vs host bignum reference."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ethrex_tpu.ops import babybear as bb
+
+RNG = np.random.default_rng(0)
+
+
+def _rand(n):
+    return RNG.integers(0, bb.P, size=n, dtype=np.uint32)
+
+
+def test_mulhi():
+    a = _rand(1000)
+    b = _rand(1000)
+    expect = ((a.astype(np.uint64) * b.astype(np.uint64)) >> 32).astype(np.uint32)
+    got = np.asarray(bb.mulhi_u32(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_mont_roundtrip():
+    a = _rand(1000)
+    m = bb.to_mont(jnp.asarray(a))
+    back = np.asarray(bb.from_mont(m))
+    np.testing.assert_array_equal(back, a)
+
+
+def test_mont_mul_matches_bignum():
+    a = _rand(1000)
+    b = _rand(1000)
+    am = bb.to_mont(jnp.asarray(a))
+    bm = bb.to_mont(jnp.asarray(b))
+    got = np.asarray(bb.from_mont(bb.mont_mul(am, bm)))
+    expect = ((a.astype(np.uint64) * b.astype(np.uint64)) % bb.P).astype(np.uint32)
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_add_sub_neg():
+    a = _rand(1000)
+    b = _rand(1000)
+    np.testing.assert_array_equal(
+        np.asarray(bb.add(jnp.asarray(a), jnp.asarray(b))),
+        ((a.astype(np.uint64) + b) % bb.P).astype(np.uint32),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(bb.sub(jnp.asarray(a), jnp.asarray(b))),
+        ((a.astype(np.int64) - b + bb.P) % bb.P).astype(np.uint32),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(bb.neg(jnp.asarray(a))),
+        ((bb.P - a.astype(np.int64)) % bb.P).astype(np.uint32),
+    )
+
+
+def test_pow_and_inv():
+    a = _rand(64)
+    am = bb.to_mont(jnp.asarray(a))
+    got = np.asarray(bb.from_mont(bb.mont_pow(am, 12345)))
+    expect = np.array([pow(int(x), 12345, bb.P) for x in a], dtype=np.uint32)
+    np.testing.assert_array_equal(got, expect)
+
+    nz = np.where(a == 0, 1, a).astype(np.uint32)
+    nm = bb.to_mont(jnp.asarray(nz))
+    inv = bb.from_mont(bb.mont_inv(nm))
+    prod = np.asarray(
+        bb.from_mont(bb.mont_mul(nm, bb.to_mont(jnp.asarray(inv))))
+    )
+    np.testing.assert_array_equal(prod, np.ones_like(prod))
+
+
+def test_batch_inv():
+    a = _rand(257)
+    a = np.where(a == 0, 1, a).astype(np.uint32)
+    am = bb.to_mont(jnp.asarray(a))
+    inv = bb.batch_mont_inv(am)
+    prod = np.asarray(bb.from_mont(bb.mont_mul(am, inv)))
+    np.testing.assert_array_equal(prod, np.ones_like(prod))
+
+
+def test_root_of_unity():
+    for log_n in (1, 4, 10, 27):
+        w = bb.root_of_unity(log_n)
+        assert pow(w, 1 << log_n, bb.P) == 1
+        assert pow(w, 1 << (log_n - 1), bb.P) != 1

@@ -1,0 +1,83 @@
+"""NTT / LDE vs a naive O(n^2) host DFT."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ethrex_tpu.ops import babybear as bb
+from ethrex_tpu.ops import ntt
+
+RNG = np.random.default_rng(1)
+
+
+def _naive_dft(x, root):
+    n = len(x)
+    w = [pow(root, i, bb.P) for i in range(n)]
+    return np.array(
+        [sum(int(x[j]) * w[(i * j) % n] for j in range(n)) % bb.P for i in range(n)],
+        dtype=np.uint32,
+    )
+
+
+def test_ntt_matches_naive():
+    for log_n in (1, 3, 6):
+        n = 1 << log_n
+        x = RNG.integers(0, bb.P, size=n, dtype=np.uint32)
+        root = bb.root_of_unity(log_n)
+        expect = _naive_dft(x, root)
+        got = np.asarray(bb.from_mont(ntt.ntt(bb.to_mont(jnp.asarray(x)))))
+        np.testing.assert_array_equal(got, expect)
+
+
+def test_ntt_roundtrip_batched():
+    x = RNG.integers(0, bb.P, size=(5, 256), dtype=np.uint32)
+    xm = bb.to_mont(jnp.asarray(x))
+    back = np.asarray(bb.from_mont(ntt.intt(ntt.ntt(xm))))
+    np.testing.assert_array_equal(back, x)
+
+
+def test_coset_lde_extends_polynomial():
+    # LDE of degree<n evals must agree with direct evaluation on the coset
+    log_n, log_blowup = 4, 2
+    n = 1 << log_n
+    coeffs = RNG.integers(0, bb.P, size=n, dtype=np.uint32)
+
+    def horner(cs, x):
+        acc = 0
+        for c in reversed([int(v) for v in cs]):
+            acc = (acc * x + c) % bb.P
+        return acc
+
+    root = bb.root_of_unity(log_n)
+    evals = np.array(
+        [horner(coeffs, pow(root, i, bb.P)) for i in range(n)], dtype=np.uint32
+    )
+    got = np.asarray(
+        bb.from_mont(ntt.coset_lde(bb.to_mont(jnp.asarray(evals)), log_blowup))
+    )
+    big_root = bb.root_of_unity(log_n + log_blowup)
+    shift = bb.GENERATOR
+    expect = np.array(
+        [
+            horner(coeffs, shift * pow(big_root, i, bb.P) % bb.P)
+            for i in range(n << log_blowup)
+        ],
+        dtype=np.uint32,
+    )
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_eval_poly_at():
+    coeffs = RNG.integers(0, bb.P, size=33, dtype=np.uint32)
+    pt = 123456789
+    got = int(
+        bb.from_mont(
+            ntt.eval_poly_at(
+                bb.to_mont(jnp.asarray(coeffs)),
+                bb.to_mont(jnp.asarray(np.uint32(pt))),
+            )
+        )
+    )
+    acc = 0
+    for c in reversed([int(v) for v in coeffs]):
+        acc = (acc * pt + c) % bb.P
+    assert got == acc
