@@ -1,0 +1,53 @@
+"""Headline benchmark: flagship STARK prove-core throughput on TPU.
+
+Runs the fully-jitted prover step (trace LDE -> Poseidon2 Merkle commit ->
+DEEP combination -> FRI fold/commit chain) on one chip and reports trace
+cells (rows x columns) proven per second.
+
+vs_baseline anchors against the reference's SP1-CUDA prover on an RTX 4090
+(BASELINE.md: 7.9M-gas block in 143 s).  SP1 executes ~1M zkVM cycles/s on
+that hardware for ethrex blocks, and each cycle occupies one row of a
+~100-column trace family => ~1e8 trace cells/s.  That anchor is an estimate
+(documented, refined in later rounds when the EVM AIR lands and we can
+compare per-block wall-clock directly).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+LOG_N = 13
+WIDTH = 32
+BASELINE_CELLS_PER_SEC = 1.0e8
+
+
+def main() -> None:
+    import jax
+
+    from ethrex_tpu.parallel.core import build_prove_step
+
+    fn, args = build_prove_step(log_n=LOG_N, width=WIDTH, log_blowup=2,
+                                log_final_size=5, mesh=None)
+    # warm-up / compile
+    jax.block_until_ready(fn(*args))
+    runs = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        runs.append(time.perf_counter() - t0)
+    wall = min(runs)
+    cells = (1 << LOG_N) * WIDTH
+    value = cells / wall
+    print(json.dumps({
+        "metric": "stark_prove_core_trace_cells_per_sec",
+        "value": round(value, 1),
+        "unit": "cells/s",
+        "vs_baseline": round(value / BASELINE_CELLS_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""Fibonacci AIR — the smallest end-to-end model for the STARK pipeline.
+
+Trace: n rows x 2 cols [a_i, b_i] with a' = b, b' = a + b.
+Public inputs: [a_0, b_0, b_{n-1}].
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import babybear as bb
+from ..stark.air import Air
+
+
+class FibonacciAir(Air):
+    width = 2
+    max_degree = 1
+    num_pub_inputs = 3
+
+    def constraints(self, local, nxt, ops):
+        a, b = local
+        an, bn = nxt
+        return [
+            ops.sub(an, b),                # a' = b
+            ops.sub(bn, ops.add(a, b)),    # b' = a + b
+        ]
+
+    def boundaries(self, pub_inputs, n: int):
+        a0, b0, b_last = pub_inputs
+        return [(0, 0, a0), (0, 1, b0), (n - 1, 1, b_last)]
+
+
+def generate_trace(n: int, a0: int = 0, b0: int = 1) -> np.ndarray:
+    trace = np.zeros((n, 2), dtype=np.uint32)
+    a, b = a0 % bb.P, b0 % bb.P
+    for i in range(n):
+        trace[i] = (a, b)
+        a, b = b, (a + b) % bb.P
+    return trace
+
+
+def public_inputs(trace: np.ndarray) -> list[int]:
+    return [int(trace[0, 0]), int(trace[0, 1]), int(trace[-1, 1])]

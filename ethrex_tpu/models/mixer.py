@@ -1,0 +1,55 @@
+"""Mixer AIR: a width-W degree-2 nonlinear recurrence.
+
+A synthetic-but-nontrivial AIR used as the flagship compute shape for
+benchmarking and multi-chip sharding (wide trace, quadratic constraints) —
+the stand-in for the zkVM's CPU AIR until the EVM AIR lands (SURVEY.md §7
+step 5: "univariate STARK for a toy AIR -> the real VM AIR").
+
+Transition: nxt[i] = local[i]^2 + local[(i+1) % W].
+Boundary: row 0 equals the public seed; public output is col 0 of last row.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import babybear as bb
+from ..stark.air import Air
+
+
+class MixerAir(Air):
+    max_degree = 2
+
+    def __init__(self, width: int = 16):
+        self.width = width
+        self.num_pub_inputs = width + 1
+
+    def constraints(self, local, nxt, ops):
+        w = self.width
+        return [
+            ops.sub(nxt[i], ops.add(ops.mul(local[i], local[i]),
+                                    local[(i + 1) % w]))
+            for i in range(w)
+        ]
+
+    def boundaries(self, pub_inputs, n: int):
+        # pub_inputs = seed (w values) + [output]
+        w = self.width
+        assert len(pub_inputs) == w + 1
+        out = [(0, i, pub_inputs[i]) for i in range(w)]
+        out.append((n - 1, 0, pub_inputs[w]))
+        return out
+
+
+def generate_trace(n: int, width: int = 16, seed: int = 1) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    trace = np.zeros((n, width), dtype=np.uint64)
+    trace[0] = rng.integers(0, bb.P, size=width)
+    for i in range(1, n):
+        prev = trace[i - 1]
+        trace[i] = (prev * prev + np.roll(prev, -1)) % bb.P
+    return trace.astype(np.uint32)
+
+
+def public_inputs(trace: np.ndarray) -> list[int]:
+    return [int(v) for v in trace[0]] + [int(trace[-1, 0])]

@@ -147,26 +147,35 @@ def mont_inv(a):
 def batch_mont_inv(a):
     """Montgomery-trick batch inverse along a flat array (one mont_inv total).
 
-    Mirrors the classic prefix-product trick; O(n) muls + one inversion.
-    Implemented with cumulative products (log-depth under XLA).
+    inv(a_i) = total_inv * prefix_excl_i * suffix_excl_i, with both exclusive
+    products computed as log-depth associative scans (XLA-friendly; no
+    sequential lax.scan on the hot path).
     """
+    import jax
+
     a = _u32(a)
     flat = a.reshape(-1)
-    # prefix products p_i = a_0 * ... * a_i (associative scan)
-    import jax
-    prefix = jax.lax.associative_scan(mont_mul, flat)
+    prefix = jax.lax.associative_scan(mont_mul, flat)           # inclusive
+    suffix = jax.lax.associative_scan(mont_mul, flat, reverse=True)
+    one = jnp.array([MONT_ONE], dtype=U32)
+    prefix_excl = jnp.concatenate([one, prefix[:-1]])
+    suffix_excl = jnp.concatenate([suffix[1:], one])
     total_inv = mont_inv(prefix[-1])
-    # suffix pass
-    def body(carry, xs):
-        p_prev, ai = xs
-        inv_i = mont_mul(carry, p_prev)
-        carry = mont_mul(carry, ai)
-        return carry, inv_i
-    p_shift = jnp.concatenate([jnp.array([MONT_ONE], dtype=U32), prefix[:-1]])
-    # walk from the end backwards
-    carry = total_inv
-    _, invs = jax.lax.scan(body, carry, (p_shift[::-1], flat[::-1]))
-    return invs[::-1].reshape(a.shape)
+    invs = mont_mul(mont_mul(prefix_excl, suffix_excl), total_inv)
+    return invs.reshape(a.shape)
+
+
+def sum_mod(x, axis: int = -1):
+    """Mod-p sum along `axis` via log-depth pairwise folding (uint32-safe)."""
+    x = jnp.moveaxis(_u32(x), axis, -1)
+    while x.shape[-1] > 1:
+        n = x.shape[-1]
+        if n & 1:
+            pad = [(0, 0)] * (x.ndim - 1) + [(0, 1)]
+            x = jnp.pad(x, pad)
+            n += 1
+        x = add(x[..., : n // 2], x[..., n // 2:])
+    return x[..., 0]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,78 @@
+"""Fiat-Shamir transcript: duplex Poseidon2 sponge over BabyBear (host side).
+
+The transcript is inherently sequential (a few dozen absorb/sample calls per
+proof), so it runs on the host with the reference permutation; prover and
+verifier share this exact code, which is what makes the protocol
+non-interactive and deterministic.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import babybear as bb
+from . import poseidon2 as p2
+
+
+class Challenger:
+    def __init__(self, domain: bytes = b"ethrex-tpu/stark/v1"):
+        self._state = [0] * p2.WIDTH
+        self._absorb_pos = 0
+        self._squeeze_pos = p2.RATE  # force permute before first sample
+        # bind the domain tag
+        seed = p2._sample_field_elems(domain, p2.RATE)
+        self.absorb_elems([int(x) for x in seed])
+
+    # -- absorbing ---------------------------------------------------------
+    def absorb_elems(self, elems):
+        """Absorb canonical base-field ints."""
+        for e in elems:
+            if self._absorb_pos == p2.RATE:
+                self._state = p2.permute_ref(self._state)
+                self._absorb_pos = 0
+            self._state[self._absorb_pos] = (
+                self._state[self._absorb_pos] + int(e)
+            ) % bb.P
+            self._absorb_pos += 1
+        self._squeeze_pos = p2.RATE
+
+    def absorb_digest(self, digest):
+        """Absorb a device Merkle digest (Montgomery uint32[8])."""
+        canon = bb.from_mont_host(np.asarray(digest))
+        self.absorb_elems(int(x) for x in canon)
+
+    def absorb_ext(self, x):
+        self.absorb_elems(x)
+
+    def absorb_int(self, v: int):
+        """Absorb an unbounded non-negative int as 27-bit limbs."""
+        limbs = []
+        v = int(v)
+        while True:
+            limbs.append(v & ((1 << 27) - 1))
+            v >>= 27
+            if not v:
+                break
+        self.absorb_elems([len(limbs)] + limbs)
+
+    # -- sampling ----------------------------------------------------------
+    def sample(self) -> int:
+        """Sample one canonical base-field element."""
+        if self._squeeze_pos >= p2.RATE or self._absorb_pos > 0:
+            self._state = p2.permute_ref(self._state)
+            self._absorb_pos = 0
+            self._squeeze_pos = 0
+        out = self._state[self._squeeze_pos]
+        self._squeeze_pos += 1
+        return out
+
+    def sample_ext(self) -> tuple:
+        return tuple(self.sample() for _ in range(4))
+
+    def sample_bits(self, bits: int) -> int:
+        """Sample a uniform-ish integer in [0, 2^bits), bits <= 27."""
+        assert bits <= 27
+        return self.sample() & ((1 << bits) - 1)
+
+    def sample_indices(self, bits: int, n: int) -> list[int]:
+        return [self.sample_bits(bits) for _ in range(n)]

@@ -1,0 +1,255 @@
+"""Quartic extension field F_p[x]/(x^4 - 11) over BabyBear.
+
+FRI/STARK challenges and DEEP combinations live here (~124-bit field) — the
+same role the extension field plays inside the reference's zkVM STARK SDKs
+(SURVEY.md §2.6).  Device representation: trailing axis of 4 uint32 Montgomery
+base-field coordinates.  Host representation: 4-tuples of canonical ints (the
+independent verifier never touches JAX).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import babybear as bb
+
+W = 11  # x^4 = W; standard quartic non-residue choice for BabyBear
+DEG = 4
+
+_W_M = np.uint32(int(bb.to_mont_host(W)))
+
+
+# ---------------------------------------------------------------------------
+# Device ops — arrays of shape (..., 4), Montgomery
+# ---------------------------------------------------------------------------
+
+def from_base(a):
+    """Embed base-field array (...,) -> ext (..., 4)."""
+    z = jnp.zeros(a.shape + (3,), dtype=jnp.uint32)
+    return jnp.concatenate([a[..., None], z], axis=-1)
+
+
+def add(a, b):
+    return bb.add(a, b)
+
+
+def sub(a, b):
+    return bb.sub(a, b)
+
+
+def neg(a):
+    return bb.neg(a)
+
+
+def mul(a, b):
+    """Schoolbook quartic multiply with x^4 = W reduction."""
+    a0, a1, a2, a3 = (a[..., i] for i in range(4))
+    b0, b1, b2, b3 = (b[..., i] for i in range(4))
+    m = bb.mont_mul
+    add_ = bb.add
+
+    def wmul(x):
+        return m(x, _W_M)
+
+    c0 = add_(m(a0, b0), wmul(add_(add_(m(a1, b3), m(a2, b2)), m(a3, b1))))
+    c1 = add_(add_(m(a0, b1), m(a1, b0)), wmul(add_(m(a2, b3), m(a3, b2))))
+    c2 = add_(add_(m(a0, b2), m(a1, b1)), add_(m(a2, b0), wmul(m(a3, b3))))
+    c3 = add_(add_(m(a0, b3), m(a1, b2)), add_(m(a2, b1), m(a3, b0)))
+    return jnp.stack([c0, c1, c2, c3], axis=-1)
+
+
+def scalar_mul(a, s):
+    """Multiply ext (..., 4) by base-field scalar/array s (...,)."""
+    return bb.mont_mul(a, s[..., None])
+
+
+def ext_pow(a, e: int):
+    result = from_base(jnp.full(a.shape[:-1], bb.MONT_ONE, dtype=jnp.uint32))
+    base = a
+    while e:
+        if e & 1:
+            result = mul(result, base)
+        e >>= 1
+        if e:
+            base = mul(base, base)
+    return result
+
+
+def ext_powers(point, n: int):
+    """[1, z, z^2, ..., z^{n-1}] as (n, 4), log-depth (associative scan)."""
+    import jax
+
+    tiled = jnp.tile(point[None, :], (n, 1))
+    incl = jax.lax.associative_scan(mul, tiled)  # z^1 .. z^n
+    return jnp.concatenate([one_like((1,)), incl[:-1]], axis=0)
+
+
+def eval_base_poly_at_ext(coeffs, point):
+    """Evaluate base-coefficient polys at an ext point.
+
+    coeffs: (..., n) base Montgomery; point: (4,) ext Montgomery.
+    Returns (..., 4).  Uses a log-depth powers scan + mod-sum reduction
+    instead of sequential Horner (prover-side opening at zeta).
+    """
+    n = coeffs.shape[-1]
+    pows = ext_powers(point, n)                      # (n, 4)
+    terms = bb.mont_mul(pows, coeffs[..., None])     # (..., n, 4)
+    return bb.sum_mod(terms, axis=-2)
+
+
+def eval_ext_poly_at_ext(coeffs, point):
+    """Same, for ext-coefficient polys: coeffs (..., n, 4), point (4,)."""
+    n = coeffs.shape[-2]
+    pows = ext_powers(point, n)
+    terms = mul(jnp.broadcast_to(pows, coeffs.shape), coeffs)
+    return bb.sum_mod(terms, axis=-2)
+
+
+def ext_inv_device(a):
+    """Inverse of ext elements (..., 4) via a^{p^4-2} is overkill; use the
+    norm trick: N(a) = a * a^p * a^{p^2} * a^{p^3} lies in the base field,
+    so a^{-1} = (a^p * a^{p^2} * a^{p^3}) * N(a)^{-1}.  Frobenius x -> x^p
+    acts coordinate-wise: (x^j)^p = W^{j(p-1)/4 * ...}; we implement it as
+    multiplication of coordinate j by fr_j = W^{j*(p-1)/4} powers.
+    """
+    p = bb.P
+    # x^p = x^{4k+1} = x * (x^4)^k = x * W^k with k=(p-1)/4
+    k = (p - 1) // 4
+    fr = [pow(W, (j * k) % (p - 1), p) for j in range(4)]  # frobenius coeffs
+    fr1 = jnp.asarray(bb.to_mont_host(np.array(fr, dtype=np.uint32)))
+    fr2 = jnp.asarray(bb.to_mont_host(
+        np.array([(fr[j] * fr[j]) % p for j in range(4)], dtype=np.uint32)))
+    fr3 = jnp.asarray(bb.to_mont_host(
+        np.array([(fr[j] * fr[j] % p) * fr[j] % p for j in range(4)],
+                 dtype=np.uint32)))
+    ap = bb.mont_mul(a, fr1)
+    ap2 = bb.mont_mul(a, fr2)
+    ap3 = bb.mont_mul(a, fr3)
+    conj = mul(mul(ap, ap2), ap3)
+    norm = mul(a, conj)  # base-field valued: coords 1..3 are zero
+    inv_norm = bb.mont_inv(norm[..., 0])
+    return scalar_mul(conj, inv_norm)
+
+
+def batch_inv(a):
+    """Batch ext inverse over leading axes via exclusive prefix/suffix scans.
+
+    a: (..., 4), all elements nonzero.
+    """
+    import jax
+
+    flat = a.reshape(-1, 4)
+    prefix = jax.lax.associative_scan(mul, flat)
+    suffix = jax.lax.associative_scan(mul, flat, reverse=True)
+    one = one_like((1,))
+    prefix_excl = jnp.concatenate([one, prefix[:-1]], axis=0)
+    suffix_excl = jnp.concatenate([suffix[1:], one], axis=0)
+    total_inv = ext_inv_device(prefix[-1])
+    invs = mul(mul(prefix_excl, suffix_excl), total_inv[None, :])
+    return invs.reshape(a.shape)
+
+
+def one_like(shape=()):
+    out = np.zeros(shape + (4,), dtype=np.uint32)
+    out[..., 0] = bb.MONT_ONE
+    return jnp.asarray(out)
+
+
+# ---------------------------------------------------------------------------
+# Host ops — canonical int 4-tuples (verifier side)
+# ---------------------------------------------------------------------------
+
+ZERO_H = (0, 0, 0, 0)
+ONE_H = (1, 0, 0, 0)
+
+
+def h_from_base(a: int):
+    return (int(a) % bb.P, 0, 0, 0)
+
+
+def h_add(a, b):
+    return tuple((x + y) % bb.P for x, y in zip(a, b))
+
+
+def h_sub(a, b):
+    return tuple((x - y) % bb.P for x, y in zip(a, b))
+
+
+def h_neg(a):
+    return tuple((-x) % bb.P for x in a)
+
+
+def h_mul(a, b):
+    p = bb.P
+    a0, a1, a2, a3 = a
+    b0, b1, b2, b3 = b
+    c0 = (a0 * b0 + W * (a1 * b3 + a2 * b2 + a3 * b1)) % p
+    c1 = (a0 * b1 + a1 * b0 + W * (a2 * b3 + a3 * b2)) % p
+    c2 = (a0 * b2 + a1 * b1 + a2 * b0 + W * a3 * b3) % p
+    c3 = (a0 * b3 + a1 * b2 + a2 * b1 + a3 * b0) % p
+    return (c0, c1, c2, c3)
+
+
+def h_scalar_mul(a, s: int):
+    return tuple(x * s % bb.P for x in a)
+
+
+def h_pow(a, e: int):
+    result = ONE_H
+    base = a
+    while e:
+        if e & 1:
+            result = h_mul(result, base)
+        e >>= 1
+        if e:
+            base = h_mul(base, base)
+    return result
+
+
+def h_inv(a):
+    """Inverse by solving the 4x4 multiplication-matrix system mod p."""
+    if a == ZERO_H:
+        raise ZeroDivisionError("ext zero has no inverse")
+    p = bb.P
+    # columns of M are a * x^j reduced mod (x^4 - W)
+    cols = []
+    cur = a
+    for _ in range(4):
+        cols.append(cur)
+        # multiply by x: (c0,c1,c2,c3) -> (W*c3, c0, c1, c2)
+        cur = (W * cur[3] % p, cur[0], cur[1], cur[2])
+    m = [[cols[j][i] for j in range(4)] for i in range(4)]
+    rhs = [1, 0, 0, 0]
+    # Gaussian elimination mod p
+    for col in range(4):
+        piv = next(r for r in range(col, 4) if m[r][col] % p != 0)
+        m[col], m[piv] = m[piv], m[col]
+        rhs[col], rhs[piv] = rhs[piv], rhs[col]
+        inv = pow(m[col][col], p - 2, p)
+        m[col] = [x * inv % p for x in m[col]]
+        rhs[col] = rhs[col] * inv % p
+        for r in range(4):
+            if r != col and m[r][col]:
+                f = m[r][col]
+                m[r] = [(x - f * y) % p for x, y in zip(m[r], m[col])]
+                rhs[r] = (rhs[r] - f * rhs[col]) % p
+    return tuple(rhs)
+
+
+def h_div(a, b):
+    return h_mul(a, h_inv(b))
+
+
+# ---------------------------------------------------------------------------
+# Conversions
+# ---------------------------------------------------------------------------
+
+def to_host(a) -> tuple:
+    """Device ext element (4,) Montgomery -> canonical host tuple."""
+    return tuple(int(x) for x in bb.from_mont_host(np.asarray(a)))
+
+
+def to_device(a) -> jnp.ndarray:
+    """Canonical host tuple -> device (4,) Montgomery."""
+    return jnp.asarray(bb.to_mont_host(np.asarray(a, dtype=np.uint32)))

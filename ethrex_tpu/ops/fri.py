@@ -1,0 +1,222 @@
+"""FRI low-degree test over the BabyBear quartic extension.
+
+The fold/commit phases are batched device work (each layer is one jitted
+fold + one Merkle build); the query phase and verification are host-side
+canonical arithmetic.  This replaces the FRI stage the reference gets from
+its zkVM SDKs' CUDA provers (SURVEY.md §2.6, §5).
+
+Codeword convention: evaluations of an ext-field polynomial over the
+multiplicative coset shift*<g> of size N in natural order (index i holds
+f(shift * g^i)).  One fold step pairs index i with i + N/2 (g^{N/2} = -1):
+
+    f'(y_i) = (f(x_i) + f(-x_i))/2 + beta * (f(x_i) - f(-x_i)) / (2 x_i)
+
+with y_i = x_i^2, giving the codeword of f' over coset shift^2*<g^2>.
+
+Merkle leaves pair (f[i], f[i+N/2]) as 8 base limbs so each query opens one
+leaf per layer.  Transcript order per layer: absorb root, sample beta.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import babybear as bb
+from . import ext
+from . import merkle
+from . import ntt as _ntt
+from .challenger import Challenger
+
+_INV2 = int(bb.inv_host(2))
+
+
+@functools.lru_cache(maxsize=None)
+def _fold_inv_points(log_n: int, shift: int) -> np.ndarray:
+    """Montgomery inverses of the first half of the coset domain points."""
+    n = 1 << log_n
+    g_inv = bb.inv_host(bb.root_of_unity(log_n))
+    s_inv = bb.inv_host(shift % bb.P)
+    pows = bb.powers_host(g_inv, n // 2)
+    return bb.to_mont_host((pows.astype(np.uint64) * s_inv) % bb.P)
+
+
+@jax.jit
+def _fold(codeword, beta, inv_pts, inv2):
+    half = codeword.shape[0] // 2
+    lo = codeword[:half]
+    hi = codeword[half:]
+    s = ext.scalar_mul(ext.add(lo, hi), inv2)
+    d = ext.scalar_mul(ext.sub(lo, hi), bb.mont_mul(inv2, inv_pts))
+    return ext.add(s, ext.mul(jnp.broadcast_to(beta, d.shape), d))
+
+
+@jax.jit
+def _pair_leaves(codeword):
+    half = codeword.shape[0] // 2
+    return jnp.concatenate([codeword[:half], codeword[half:]], axis=-1)
+
+
+@dataclasses.dataclass
+class FriParams:
+    log_blowup: int = 2
+    num_queries: int = 40
+    log_final_size: int = 5   # stop folding at codeword length 32
+    shift: int = bb.GENERATOR
+
+
+@dataclasses.dataclass
+class FriProof:
+    roots: list            # canonical digests, one per committed layer
+    final_coeffs: list     # canonical ext tuples, len = final codeword size
+    queries: list          # per query, per layer: {"values": [lo, hi], "path"}
+
+
+class FriProver:
+    """Holds per-layer state so queries can be opened after index sampling."""
+
+    def __init__(self, params: FriParams):
+        self.params = params
+
+    def commit_phase(self, codeword, challenger: Challenger):
+        p = self.params
+        log_n = codeword.shape[0].bit_length() - 1
+        shift = p.shift % bb.P
+        inv2 = jnp.asarray(np.uint32(int(bb.to_mont_host(_INV2))))
+        self.layers = []   # (canonical_np_codeword, canonical_np_levels)
+        self.roots = []
+        while log_n > p.log_final_size:
+            leaves = _pair_leaves(codeword)
+            levels = merkle.commit_levels(leaves)
+            # one bulk device->host transfer per layer (codeword + levels)
+            cw_np, levels_np = jax.device_get((codeword, tuple(levels)))
+            levels_c = [bb.from_mont_host(l) for l in levels_np]
+            root = levels_c[-1][0]
+            challenger.absorb_elems(int(x) for x in root)
+            self.layers.append((bb.from_mont_host(cw_np), levels_c))
+            self.roots.append([int(x) for x in root])
+            beta = ext.to_device(challenger.sample_ext())
+            inv_pts = jnp.asarray(_fold_inv_points(log_n, shift))
+            codeword = _fold(codeword, beta, inv_pts, inv2)
+            shift = (shift * shift) % bb.P
+            log_n -= 1
+        coeffs_dev = _ntt.coset_intt(codeword.T, shift=shift).T
+        coeffs = bb.from_mont_host(np.asarray(coeffs_dev))
+        self.final_coeffs = [tuple(int(v) for v in row) for row in coeffs]
+        deg_bound = (1 << p.log_final_size) >> p.log_blowup
+        for row in self.final_coeffs[deg_bound:]:
+            if row != (0, 0, 0, 0):
+                raise ValueError("FRI final polynomial exceeds degree bound "
+                                 "(input codeword was not low-degree)")
+        for row in self.final_coeffs:
+            challenger.absorb_ext(row)
+        return self.roots, self.final_coeffs
+
+    def open_queries(self, indices) -> list:
+        out = []
+        for q in indices:
+            per_layer = []
+            idx = q
+            for canon, levels_c in self.layers:
+                half = canon.shape[0] // 2
+                idx %= half
+                lo = tuple(int(v) for v in canon[idx])
+                hi = tuple(int(v) for v in canon[idx + half])
+                path = merkle.open_path_canonical(levels_c, idx)
+                per_layer.append({"values": [lo, hi], "path": path})
+            out.append(per_layer)
+        return out
+
+    def prove(self, codeword, challenger: Challenger):
+        """Full FRI round.  Returns (FriProof, query_indices); the caller
+        (the STARK prover) opens its own commitments at the same indices."""
+        self.commit_phase(codeword, challenger)
+        n0 = self.layers[0][0].shape[0]
+        bits = (n0 // 2).bit_length() - 1
+        indices = challenger.sample_indices(bits, self.params.num_queries)
+        queries = self.open_queries(indices)
+        return FriProof(self.roots, self.final_coeffs, queries), indices
+
+
+def verify(proof: FriProof, log_n0: int, challenger: Challenger,
+           params: FriParams):
+    """Host-side FRI verification (canonical arithmetic only).
+
+    Returns (query_indices, layer0_values) where layer0_values[i] =
+    (pair_index, lo, hi) accepted for query i — the STARK verifier
+    cross-checks these against trace-derived DEEP values.
+    Raises ValueError on failure.
+    """
+    p_ = params
+    num_layers = log_n0 - p_.log_final_size
+    if len(proof.roots) != num_layers:
+        raise ValueError("FRI: wrong number of layer roots")
+
+    # transcript: per layer absorb root then sample beta (mirrors the prover)
+    betas = []
+    shifts = []
+    shift = p_.shift % bb.P
+    for root in proof.roots:
+        challenger.absorb_elems(root)
+        betas.append(challenger.sample_ext())
+        shifts.append(shift)
+        shift = (shift * shift) % bb.P
+    final_shift = shift
+    final_size = 1 << p_.log_final_size
+    if len(proof.final_coeffs) != final_size:
+        raise ValueError("FRI: wrong final coefficient count")
+    deg_bound = final_size >> p_.log_blowup
+    for row in proof.final_coeffs[deg_bound:]:
+        if tuple(row) != (0, 0, 0, 0):
+            raise ValueError("FRI: final polynomial exceeds degree bound")
+    for row in proof.final_coeffs:
+        challenger.absorb_ext(row)
+
+    bits = log_n0 - 1
+    indices = challenger.sample_indices(bits, p_.num_queries)
+    if len(proof.queries) != p_.num_queries:
+        raise ValueError("FRI: wrong query count")
+
+    inv2 = bb.inv_host(2)
+    layer0_values = []
+    for q, per_layer in zip(indices, proof.queries):
+        if len(per_layer) != num_layers:
+            raise ValueError("FRI: wrong layer count in query")
+        carried = None
+        raw = q  # index of the folded value inside the current layer
+        for k, opening in enumerate(per_layer):
+            log_nk = log_n0 - k
+            half = 1 << (log_nk - 1)
+            idx = raw % half
+            lo, hi = (tuple(int(v) for v in x) for x in opening["values"])
+            if not merkle.verify_opening(
+                proof.roots[k], idx, list(lo) + list(hi), opening["path"],
+                log_nk - 1,
+            ):
+                raise ValueError(f"FRI: bad merkle opening at layer {k}")
+            if carried is not None:
+                got = lo if raw < half else hi
+                if got != carried:
+                    raise ValueError(f"FRI: fold mismatch entering layer {k}")
+            if k == 0:
+                layer0_values.append((idx, lo, hi))
+            x = shifts[k] * pow(bb.root_of_unity(log_nk), idx, bb.P) % bb.P
+            s = ext.h_scalar_mul(ext.h_add(lo, hi), inv2)
+            d = ext.h_scalar_mul(
+                ext.h_sub(lo, hi), inv2 * bb.inv_host(x) % bb.P
+            )
+            carried = ext.h_add(s, ext.h_mul(betas[k], d))
+            raw = idx
+        # `carried` is the value at index `raw` of the final codeword
+        log_nf = log_n0 - num_layers
+        x_f = final_shift * pow(bb.root_of_unity(log_nf), raw, bb.P) % bb.P
+        acc = ext.ZERO_H
+        for c in reversed(proof.final_coeffs):
+            acc = ext.h_add(ext.h_mul(acc, ext.h_from_base(x_f)), tuple(c))
+        if acc != carried:
+            raise ValueError("FRI: final polynomial mismatch")
+    return indices, layer0_values

@@ -16,21 +16,31 @@ from . import poseidon2 as p2
 DIGEST_WIDTH = p2.RATE  # 8 limbs
 
 
-def commit_levels(leaves):
-    """Build a Merkle tree over `leaves` (n, w) Montgomery field elements.
+import jax
 
-    n must be a power of two.  Returns a list of level digest arrays,
-    levels[0] = leaf digests (n, 8) ... levels[-1] = root (1, 8).
-    """
-    n = leaves.shape[0]
-    if n & (n - 1):
-        raise ValueError("leaf count must be a power of two")
+
+@jax.jit
+def _build_levels(leaves):
     digests = p2.hash_leaves(leaves)
     levels = [digests]
     while digests.shape[0] > 1:
         digests = p2.compress(digests[0::2], digests[1::2])
         levels.append(digests)
-    return levels
+    return tuple(levels)
+
+
+def commit_levels(leaves):
+    """Build a Merkle tree over `leaves` (n, w) Montgomery field elements.
+
+    n must be a power of two.  Returns a list of level digest arrays,
+    levels[0] = leaf digests (n, 8) ... levels[-1] = root (1, 8).
+    One jitted call per leaf shape (a single device dispatch — vital when the
+    device sits behind a network tunnel).
+    """
+    n = leaves.shape[0]
+    if n & (n - 1):
+        raise ValueError("leaf count must be a power of two")
+    return list(_build_levels(leaves))
 
 
 def root(levels):
@@ -43,6 +53,16 @@ def open_path(levels, index: int):
     idx = index
     for level in levels[:-1]:
         path.append(np.asarray(level[idx ^ 1]))
+        idx >>= 1
+    return path
+
+
+def open_path_canonical(levels_c, index: int) -> list[list[int]]:
+    """Sibling walk over canonical numpy level arrays -> wire-format path."""
+    path = []
+    idx = index
+    for level in levels_c[:-1]:
+        path.append([int(x) for x in level[idx ^ 1]])
         idx >>= 1
     return path
 
@@ -63,17 +83,49 @@ def verify_path(root_digest, index: int, leaf_digest, path,
         return False
     cur = [int(x) for x in bb.from_mont_host(np.asarray(leaf_digest))]
     root_c = [int(x) for x in bb.from_mont_host(np.asarray(root_digest))]
+    path_c = [[int(x) for x in bb.from_mont_host(np.asarray(sib))]
+              for sib in path]
+    return fold_path_canonical(index, cur, path_c) == root_c
+
+
+def compress_ref(left, right) -> list[int]:
+    """Canonical host 2-to-1 compression (matches p2.compress)."""
+    state = p2.permute_ref(list(left) + list(right))
+    return [(state[i] + left[i]) % bb.P for i in range(DIGEST_WIDTH)]
+
+
+def fold_path_canonical(index: int, leaf_digest, path):
+    """Fold a canonical leaf digest up a canonical path to a root digest."""
+    cur = list(leaf_digest)
     idx = index
     for sib in path:
-        sib = [int(x) for x in bb.from_mont_host(np.asarray(sib))]
+        sib = [int(x) for x in sib]
         if idx & 1:
-            left, right = sib, cur
+            cur = compress_ref(sib, cur)
         else:
-            left, right = cur, sib
-        state = p2.permute_ref(left + right)
-        cur = [(state[i] + left[i]) % bb.P for i in range(DIGEST_WIDTH)]
+            cur = compress_ref(cur, sib)
         idx >>= 1
-    return cur == root_c
+    return cur
+
+
+def verify_opening(root_c, index: int, leaf_values_c, path_c, depth: int) -> bool:
+    """Fully canonical opening check: hash leaf values, fold, compare.
+
+    root_c / path_c / leaf_values_c are canonical ints (what proofs carry on
+    the wire); `depth` binds the path length.  Malformed input (wrong sibling
+    width, non-int limbs) returns False — never raises — since this runs on
+    untrusted proof data.
+    """
+    try:
+        if len(path_c) != depth or len(root_c) != DIGEST_WIDTH:
+            return False
+        if any(len(sib) != DIGEST_WIDTH for sib in path_c):
+            return False
+        digest = hash_leaf_ref(leaf_values_c)
+        folded = fold_path_canonical(index, digest, path_c)
+        return folded == [int(x) % bb.P for x in root_c]
+    except (TypeError, ValueError):
+        return False
 
 
 def hash_leaf_ref(leaf) -> list[int]:

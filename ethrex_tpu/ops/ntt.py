@@ -106,6 +106,33 @@ def coset_lde(x, log_blowup: int, shift: int = bb.GENERATOR):
     return ntt(coeffs)
 
 
+@functools.lru_cache(maxsize=None)
+def _coset_inv_powers(log_n: int, shift: int) -> np.ndarray:
+    return bb.to_mont_host(bb.powers_host(bb.inv_host(shift), 1 << log_n))
+
+
+@functools.partial(jax.jit, static_argnames=("shift",))
+def coset_intt(x, shift: int = bb.GENERATOR):
+    """Evaluations over the coset shift*H (natural order) -> coefficients."""
+    n = x.shape[-1]
+    log_n = n.bit_length() - 1
+    coeffs = ntt(x, inverse=True)
+    inv_sh = jnp.asarray(_coset_inv_powers(log_n, shift % bb.P))
+    return bb.mont_mul(coeffs, inv_sh)
+
+
+@functools.partial(jax.jit, static_argnames=("n_out", "shift"))
+def coset_evals_from_coeffs(coeffs, n_out: int, shift: int = bb.GENERATOR):
+    """Coefficient vector (..., m), m <= n_out -> evals on coset shift*H',
+    |H'| = n_out, natural order."""
+    m = coeffs.shape[-1]
+    log_out = n_out.bit_length() - 1
+    sh = jnp.asarray(_coset_powers(log_out, shift % bb.P))[:m]
+    coeffs = bb.mont_mul(coeffs, sh)
+    pad = [(0, 0)] * (coeffs.ndim - 1) + [(0, n_out - m)]
+    return ntt(jnp.pad(coeffs, pad))
+
+
 def eval_poly_at(coeffs, point):
     """Horner evaluation of a coefficient vector (Montgomery) at a scalar.
 

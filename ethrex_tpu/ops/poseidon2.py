@@ -180,12 +180,8 @@ def _external_linear(state):
 
 
 def _sum_width(state):
-    """Mod-p sum over the trailing width-16 axis via a tree of adds."""
-    x = state
-    for _ in range(4):  # 16 -> 8 -> 4 -> 2 -> 1
-        h = x.shape[-1] // 2
-        x = bb.add(x[..., :h], x[..., h:])
-    return x[..., 0]
+    """Mod-p sum over the trailing width-16 axis."""
+    return bb.sum_mod(state, axis=-1)
 
 
 import jax
@@ -193,24 +189,32 @@ import jax
 
 @jax.jit
 def permute(state):
-    """Poseidon2 permutation. state: (..., 16) uint32 Montgomery form."""
+    """Poseidon2 permutation. state: (..., 16) uint32 Montgomery form.
+
+    Rounds run under lax.fori_loop (constants indexed dynamically) so the
+    traced graph stays small — this permutation is inlined many times inside
+    the fully-jitted prover step and an unrolled version blows up XLA
+    compile time.
+    """
     ext_rc = jnp.asarray(_EXT_RC_M)
     int_rc = jnp.asarray(_INT_RC_M)
     mu = jnp.asarray(_DIAG_MU_M)
-    s = _external_linear(state)
-    for r in range(_HALF_F):
+
+    def ext_round(r, s):
         s = bb.add(s, ext_rc[r])
         s = _sbox(s)
-        s = _external_linear(s)
-    for r in range(ROUNDS_P):
+        return _external_linear(s)
+
+    def int_round(r, s):
         s0 = _sbox(bb.add(s[..., 0], int_rc[r]))
         s = jnp.concatenate([s0[..., None], s[..., 1:]], axis=-1)
         tot = _sum_width(s)
-        s = bb.add(tot[..., None], bb.mont_mul(s, mu))
-    for r in range(_HALF_F, ROUNDS_F):
-        s = bb.add(s, ext_rc[r])
-        s = _sbox(s)
-        s = _external_linear(s)
+        return bb.add(tot[..., None], bb.mont_mul(s, mu))
+
+    s = _external_linear(state)
+    s = jax.lax.fori_loop(0, _HALF_F, ext_round, s)
+    s = jax.lax.fori_loop(0, ROUNDS_P, int_round, s)
+    s = jax.lax.fori_loop(_HALF_F, ROUNDS_F, ext_round, s)
     return s
 
 

@@ -1,0 +1,134 @@
+"""The flagship device compute step: trace -> LDE -> Merkle commit -> DEEP
+combination -> FRI fold chain, as ONE jitted program, with optional mesh
+sharding annotations so XLA inserts the ICI collectives (all-to-all for the
+LDE->hash transpose, gathers for the Merkle/fold tails).
+
+This is the deterministic device core of the STARK prover: Fiat-Shamir
+challenges are *inputs* (the interactive prover in stark/prover.py samples
+them between phases; the driver's `entry()`/`dryrun_multichip` compile this
+whole step as one program — SURVEY.md §5 "shard the STARK trace across the
+slice").
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops import babybear as bb
+from ..ops import ext
+from ..ops import ntt
+from ..ops import poseidon2 as p2
+from ..ops.fri import _fold_inv_points, _INV2
+from . import mesh as mesh_lib
+
+
+def _domain_points_m(log_size: int, shift: int) -> np.ndarray:
+    g = bb.root_of_unity(log_size)
+    pts = bb.powers_host(g, 1 << log_size).astype(np.uint64)
+    return bb.to_mont_host((pts * (shift % bb.P)) % bb.P)
+
+
+def build_prove_step(log_n: int, width: int, log_blowup: int = 2,
+                     log_final_size: int = 5, mesh=None):
+    """Returns (step_fn, example_args).  step_fn(trace_cols, zeta, gamma,
+    betas) -> (trace_root, fri_roots, final_codeword), fully jittable.
+
+    trace_cols: (width, n) uint32 Montgomery.  zeta/gamma: (4,) ext.
+    betas: (L, 4) ext FRI challenges.
+    """
+    n = 1 << log_n
+    N = n << log_blowup
+    log_N = log_n + log_blowup
+    L = log_N - log_final_size
+    shift = bb.GENERATOR
+    pts_m = jnp.asarray(_domain_points_m(log_N, shift))
+    inv2 = jnp.asarray(np.uint32(int(bb.to_mont_host(_INV2))))
+    fold_invs = []
+    s = shift
+    for k in range(L):
+        fold_invs.append(jnp.asarray(_fold_inv_points(log_N - k, s)))
+        s = (s * s) % bb.P
+
+    axis = mesh_lib.AXIS
+
+    def shard(x, spec):
+        if mesh is None:
+            return x
+        # stop constraining once the sharded dim is smaller than the mesh
+        dim = x.shape[list(spec).index(axis)] if axis in spec else None
+        if dim is not None and dim < len(mesh.devices.flat):
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(*spec)))
+
+    # levels larger than this are unrolled (and sharded); the small tail runs
+    # as a fixed-buffer fori_loop (wasted lanes, tiny absolute cost) to keep
+    # the traced graph size O(1) instead of O(log N) permutations per tree
+    tail_size = 256
+
+    def commit_root(leaves):
+        digests = p2.hash_leaves(leaves)
+        digests = shard(digests, (axis, None))
+        while digests.shape[0] > tail_size:
+            digests = p2.compress(digests[0::2], digests[1::2])
+            digests = shard(digests, (axis, None))
+        m = digests.shape[0]
+        if m == 1:
+            return digests[0]
+
+        def level(_, buf):
+            d = p2.compress(buf[0::2], buf[1::2])
+            return jnp.concatenate([d, buf[m // 2:]], axis=0)
+
+        buf = jax.lax.fori_loop(0, m.bit_length() - 1, level, digests)
+        return buf[0]
+
+    def step(trace_cols, zeta, gamma, betas):
+        trace_cols = shard(trace_cols, (axis, None))
+        # 1. column-parallel LDE (NTT along rows, local per column)
+        lde_cols = ntt.coset_lde(trace_cols, log_blowup, shift=shift)
+        lde_rows = shard(lde_cols.T, (axis, None))  # transpose => all-to-all
+        # 2. row-parallel Merkle commit
+        troot = commit_root(lde_rows)
+        # 3. DEEP-style combination at zeta (row-parallel ext arithmetic)
+        tcoeffs = ntt.intt(trace_cols)
+        tz = ext.eval_base_poly_at_ext(tcoeffs, zeta)          # (w, 4)
+        x_m = jnp.concatenate(
+            [bb.sub(pts_m, jnp.broadcast_to(zeta[0], (N,)))[:, None],
+             jnp.broadcast_to(bb.neg(zeta[1:]), (N, 3))], axis=-1)
+        inv_xz = ext.batch_inv(x_m)
+        gpow = ext.ext_powers(gamma, width)                    # (w, 4)
+        diff = ext.sub(ext.from_base(lde_rows), tz[None])      # (N, w, 4)
+        comb = bb.sum_mod(ext.mul(diff, gpow[None]), axis=1)   # (N, 4)
+        cw = ext.mul(comb, inv_xz)
+        cw = shard(cw, (axis, None))
+        # 4. FRI fold chain, committing each layer
+        fri_roots = []
+        for k in range(L):
+            half = cw.shape[0] // 2
+            leaves = jnp.concatenate([cw[:half], cw[half:]], axis=-1)
+            leaves = shard(leaves, (axis, None))
+            fri_roots.append(commit_root(leaves))
+            lo, hi = cw[:half], cw[half:]
+            s_ = ext.scalar_mul(ext.add(lo, hi), inv2)
+            d_ = ext.scalar_mul(ext.sub(lo, hi),
+                                bb.mont_mul(inv2, fold_invs[k]))
+            cw = ext.add(s_, ext.mul(jnp.broadcast_to(betas[k], d_.shape), d_))
+            cw = shard(cw, (axis, None))
+        return troot, tuple(fri_roots), cw
+
+    rng = np.random.default_rng(0)
+    trace = rng.integers(0, bb.P, size=(width, n), dtype=np.uint32)
+    example_args = (
+        bb.to_mont(jnp.asarray(trace)),
+        ext.to_device(tuple(int(x) for x in rng.integers(0, bb.P, 4))),
+        ext.to_device(tuple(int(x) for x in rng.integers(0, bb.P, 4))),
+        jnp.stack([ext.to_device(tuple(int(x) for x in rng.integers(0, bb.P, 4)))
+                   for _ in range(L)]),
+    )
+    return jax.jit(step), example_args

@@ -1,0 +1,41 @@
+"""Device-mesh helpers for the distributed prover.
+
+Multi-chip scaling follows the JAX recipe (SURVEY.md §2.9 table: the
+reference's NCCL/MPI-style backends map to XLA collectives over ICI/DCN):
+pick a mesh, annotate shardings, let XLA insert collectives.  Single axis
+"shard" for round 1 (FRI/LDE row sharding + column sharding for the NTT);
+later rounds add a second axis for prover-fleet batch parallelism.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS = "shard"
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devs)}; "
+                "set --xla_force_host_platform_device_count for CPU testing"
+            )
+        devs = devs[:n_devices]
+    return Mesh(devs, (AXIS,))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (row) axis across the mesh."""
+    return NamedSharding(mesh, P(AXIS))
+
+
+def col_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (column-block) axis of a (w, n) matrix."""
+    return NamedSharding(mesh, P(AXIS, None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,83 @@
+"""AIR (algebraic intermediate representation) interface for the TPU STARK.
+
+An AIR describes a computation as a trace matrix plus polynomial constraints.
+Constraints are written once against an abstract field-ops object and
+evaluated in two worlds:
+
+  * on device, over the whole LDE domain at once (base-field uint32 arrays,
+    Montgomery form) — the prover's quotient construction;
+  * on host, at the single out-of-domain point zeta (quartic-extension
+    canonical tuples) — the verifier's consistency check.
+
+This mirrors the AIR/chip abstraction inside the reference's zkVM SDKs
+(SURVEY.md §2.6); the reference itself treats the zkVM as a black box.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..ops import babybear as bb
+from ..ops import ext
+
+
+class DeviceOps:
+    """Base-field ops over (N,) uint32 Montgomery arrays."""
+
+    def const(self, v: int):
+        return jnp.asarray(np.uint32(int(bb.to_mont_host(int(v) % bb.P))))
+
+    add = staticmethod(bb.add)
+    sub = staticmethod(bb.sub)
+    mul = staticmethod(bb.mont_mul)
+
+    def neg(self, a):
+        return bb.neg(a)
+
+
+class HostExtOps:
+    """Quartic-extension ops over canonical 4-tuples."""
+
+    def const(self, v: int):
+        return ext.h_from_base(v)
+
+    add = staticmethod(ext.h_add)
+    sub = staticmethod(ext.h_sub)
+    mul = staticmethod(ext.h_mul)
+
+    def neg(self, a):
+        return ext.h_neg(a)
+
+
+class Air:
+    """Subclass and define width / max_degree / constraints / boundaries."""
+
+    width: int = 0
+    max_degree: int = 2      # max multiplicative degree of any constraint
+    num_pub_inputs: int = 0  # boundary STRUCTURE must not depend on values
+
+    def constraints(self, local, nxt, ops):
+        """local/nxt: per-column field values (lists of length `width`).
+
+        Must return a list of constraint evaluations that vanish on every
+        transition row (all rows but the last) of a valid trace.  Pure
+        field-op compositions only — evaluated both on device arrays and on
+        host ext tuples.
+        """
+        raise NotImplementedError
+
+    def boundaries(self, pub_inputs, n: int):
+        """Return [(row, col, value)] assertions binding public inputs."""
+        raise NotImplementedError
+
+    def cache_key(self) -> tuple:
+        """Structural identity for compiled-program caching.  Override if a
+        subclass has extra parameters that change the constraint system."""
+        return (type(self), self.width, self.max_degree, self.num_pub_inputs)
+
+    @property
+    def num_constraints(self) -> int:
+        ops = HostExtOps()
+        zero = [ext.ZERO_H] * self.width
+        return len(self.constraints(zero, zero, ops))

@@ -1,0 +1,278 @@
+"""DEEP-FRI STARK prover: all heavy phases are batched device (TPU) work.
+
+Pipeline per proof (SURVEY.md §7 step 5; replaces the CUDA STARK inside the
+reference's SP1 backend, /root/reference/crates/prover/src/backend/sp1.rs):
+
+  1. commit trace LDE               (NTT + Poseidon2 Merkle, device)
+  2. alpha <- transcript; build + commit the constraint quotient (device)
+  3. zeta <- transcript; open trace/quotient at zeta, zeta*g (device)
+  4. gamma <- transcript; build the DEEP composition codeword (device)
+  5. FRI fold/commit layers         (device)  + query openings (host)
+
+The transcript (Fiat-Shamir) runs on host between device phases.  Each phase
+is ONE jitted call (cached per AIR + shape) — the device may sit behind a
+network tunnel, so eager per-op dispatch is unaffordable; everything heavy
+lives inside the four phase programs below.
+
+No proof-of-work grinding yet (documented gap).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ops import babybear as bb
+from ..ops import ext
+from ..ops import fri
+from ..ops import merkle
+from ..ops import ntt
+from ..ops.challenger import Challenger
+from .air import Air, DeviceOps
+
+
+@dataclasses.dataclass(frozen=True)
+class StarkParams:
+    log_blowup: int = 2
+    num_queries: int = 40
+    log_final_size: int = 5
+    shift: int = bb.GENERATOR
+
+
+def _domain_points(log_size: int, shift: int) -> np.ndarray:
+    g = bb.root_of_unity(log_size)
+    pts = bb.powers_host(g, 1 << log_size).astype(np.uint64)
+    return ((pts * (shift % bb.P)) % bb.P).astype(np.uint32)
+
+
+def _canon(arr) -> np.ndarray:
+    return bb.from_mont_host(np.asarray(arr))
+
+
+_PHASE_CACHE: dict = {}
+
+
+def _phases(air: Air, log_n: int, lb: int, shift: int):
+    """Jitted phase programs, cached by *structural* AIR identity.
+
+    Keyed on (type, width, degree, pub-count) rather than object identity so
+    `prove(MixerAir(16), ...)` in a loop reuses compiled programs.  AIRs with
+    extra structure-affecting parameters must reflect them in `cache_key()`.
+    """
+    key = (air.cache_key(), log_n, lb, shift)
+    cached = _PHASE_CACHE.get(key)
+    if cached is not None:
+        return cached
+    built = _build_phases(air, log_n, lb, shift)
+    _PHASE_CACHE[key] = built
+    return built
+
+
+def _build_phases(air: Air, log_n: int, lb: int, shift: int):
+    """Build the jitted phase programs for a given AIR and trace shape.
+
+    Boundary structure (rows/cols) must not depend on public-input *values*
+    (values are traced inputs; structure is baked into the program).
+    """
+    n = 1 << log_n
+    w = air.width
+    B = 1 << lb
+    N = n << lb
+    log_N = log_n + lb
+    g_n = bb.root_of_unity(log_n)
+    K = air.num_constraints
+    bounds_struct = [(r % n, c) for (r, c, _) in
+                     air.boundaries([0] * air.num_pub_inputs, n)]  # structure only
+    nb = len(bounds_struct)
+
+    # host-precomputed divisor evaluation tables (canonical -> Montgomery)
+    pts = _domain_points(log_N, shift).astype(np.int64)
+    x_minus_glast = ((pts - pow(g_n, n - 1, bb.P)) % bb.P).astype(np.uint32)
+    s_n = pow(shift, n, bb.P)
+    uB = pow(bb.root_of_unity(log_N), n, bb.P)
+    xn_minus_1 = np.array(
+        [(s_n * pow(uB, i, bb.P) - 1) % bb.P for i in range(B)],
+        dtype=np.uint32,
+    )
+    bound_divs = [
+        ((pts - pow(g_n, r, bb.P)) % bb.P).astype(np.uint32)
+        for (r, _) in bounds_struct
+    ]
+    div_stack_np = bb.to_mont_host(
+        np.concatenate([xn_minus_1, x_minus_glast] + bound_divs)
+    )
+    pts_m_np = bb.to_mont_host(_domain_points(log_N, shift))
+
+    @jax.jit
+    def phase_commit(cols):
+        lde_cols = ntt.coset_lde(cols, lb, shift=shift)
+        lde_rows = lde_cols.T
+        levels = merkle._build_levels(lde_rows)
+        return lde_cols, lde_rows, levels
+
+    @jax.jit
+    def phase_quotient(lde_cols, alpha, bound_vals):
+        dev = DeviceOps()
+        rolled = jnp.roll(lde_cols, -B, axis=1)
+        local = [lde_cols[j] for j in range(w)]
+        nxt = [rolled[j] for j in range(w)]
+        cons = jnp.stack(air.constraints(local, nxt, dev))        # (K, N)
+        apow = ext.ext_powers(alpha, K + nb)                      # (K+nb, 4)
+        acc = bb.sum_mod(
+            bb.mont_mul(cons[:, :, None], apow[:K, None, :]), axis=0
+        )                                                          # (N, 4)
+        inv_stack = bb.batch_mont_inv(jnp.asarray(div_stack_np))
+        inv_xn1 = jnp.tile(inv_stack[:B], N // B)
+        xm = jnp.asarray(bb.to_mont_host(x_minus_glast))
+        q_acc = ext.scalar_mul(acc, bb.mont_mul(xm, inv_xn1))
+        base_off = B + N
+        for j, (r, c) in enumerate(bounds_struct):
+            diff = bb.sub(lde_cols[c], bound_vals[j])
+            inv_x = inv_stack[base_off + j * N: base_off + (j + 1) * N]
+            q_acc = ext.add(q_acc, bb.mont_mul(
+                bb.mont_mul(diff, inv_x)[:, None], apow[K + j][None, :]
+            ))
+        qc = ntt.coset_intt(q_acc.T, shift=shift).T                # (N, 4)
+        chunks = jnp.stack([qc[i * n:(i + 1) * n] for i in range(B)])
+        q_lde = ntt.coset_evals_from_coeffs(
+            jnp.moveaxis(chunks, -1, 1), N, shift=shift
+        )                                                          # (B, 4, N)
+        q_rows = jnp.moveaxis(q_lde, -1, 0).reshape(N, B * 4)
+        levels = merkle._build_levels(q_rows)
+        return chunks, q_lde, q_rows, levels
+
+    @jax.jit
+    def phase_open(cols, chunks, zeta, zeta_g):
+        tcoeffs = ntt.intt(cols)
+        t_z = ext.eval_base_poly_at_ext(tcoeffs, zeta)
+        t_zg = ext.eval_base_poly_at_ext(tcoeffs, zeta_g)
+        q_z = ext.eval_ext_poly_at_ext(chunks, zeta)
+        return t_z, t_zg, q_z
+
+    @jax.jit
+    def phase_deep(lde_rows, q_lde, t_z, t_zg, q_z, zeta, zeta_g, gamma):
+        pts_m = jnp.asarray(pts_m_np)
+
+        def x_minus(pt):
+            first = bb.sub(pts_m, jnp.broadcast_to(pt[0], (N,)))
+            rest = jnp.broadcast_to(bb.neg(pt[1:]), (N, 3))
+            return jnp.concatenate([first[:, None], rest], axis=-1)
+
+        inv_xz = ext.batch_inv(x_minus(zeta))
+        inv_xzg = ext.batch_inv(x_minus(zeta_g))
+        gpow = ext.ext_powers(gamma, 2 * w + B)
+        rows_ext = ext.from_base(lde_rows)                         # (N, w, 4)
+        d1 = ext.sub(rows_ext, t_z[None])
+        s1 = bb.sum_mod(ext.mul(d1, gpow[None, :w]), axis=1)
+        d2 = ext.sub(rows_ext, t_zg[None])
+        s2 = bb.sum_mod(ext.mul(d2, gpow[None, w:2 * w]), axis=1)
+        q_ext = jnp.moveaxis(q_lde, 1, -1)                         # (B, N, 4)
+        d3 = ext.sub(q_ext, q_z[:, None])
+        s3 = bb.sum_mod(ext.mul(d3, gpow[2 * w:, None]), axis=0)
+        return ext.add(ext.mul(ext.add(s1, s3), inv_xz),
+                       ext.mul(s2, inv_xzg))
+
+    return phase_commit, phase_quotient, phase_open, phase_deep
+
+
+def prove(air: Air, trace: np.ndarray, pub_inputs: list[int],
+          params: StarkParams = StarkParams()) -> dict:
+    n, w = trace.shape
+    if w != air.width:
+        raise ValueError(f"trace width {w} != AIR width {air.width}")
+    log_n = n.bit_length() - 1
+    if 1 << log_n != n:
+        raise ValueError("trace length must be a power of two")
+    lb = params.log_blowup
+    B = 1 << lb
+    if air.max_degree > B:
+        raise ValueError("constraint degree exceeds blowup")
+    if len(pub_inputs) != air.num_pub_inputs:
+        raise ValueError("public input count mismatch")
+    N = n << lb
+    shift = params.shift % bb.P
+    g_n = bb.root_of_unity(log_n)
+    p_commit, p_quotient, p_open, p_deep = _phases(air, log_n, lb, shift)
+
+    ch = Challenger()
+    ch.absorb_elems([n, w, B])
+    ch.absorb_elems([v % bb.P for v in pub_inputs])
+
+    # ---- 1. trace commitment --------------------------------------------
+    cols = bb.to_mont(jnp.asarray(trace.T.astype(np.uint32)))       # (w, n)
+    lde_cols, lde_rows, levels_t = p_commit(cols)
+    trace_root = levels_t[-1][0]
+    ch.absorb_digest(trace_root)
+    alpha = ch.sample_ext()
+
+    # ---- 2. constraint quotient -----------------------------------------
+    bounds = air.boundaries(pub_inputs, n)
+    bound_vals = bb.to_mont(jnp.asarray(
+        np.array([v % bb.P for (_, _, v) in bounds], dtype=np.uint32)))
+    chunks, q_lde, q_rows, levels_q = p_quotient(
+        lde_cols, ext.to_device(alpha), bound_vals)
+    q_root = levels_q[-1][0]
+    ch.absorb_digest(q_root)
+    zeta = ch.sample_ext()
+
+    # ---- 3. out-of-domain openings --------------------------------------
+    zeta_g = ext.h_mul(zeta, ext.h_from_base(g_n))
+    t_z_dev, t_zg_dev, q_z_dev = p_open(
+        cols, chunks, ext.to_device(zeta), ext.to_device(zeta_g))
+    t_at_z = [tuple(int(x) for x in row) for row in _canon(t_z_dev)]
+    t_at_zg = [tuple(int(x) for x in row) for row in _canon(t_zg_dev)]
+    q_at_z = [tuple(int(x) for x in row) for row in _canon(q_z_dev)]
+    for tup in t_at_z + t_at_zg + q_at_z:
+        ch.absorb_ext(tup)
+    gamma = ch.sample_ext()
+
+    # ---- 4. DEEP composition + 5. FRI ------------------------------------
+    F = p_deep(lde_rows, q_lde, t_z_dev, t_zg_dev, q_z_dev,
+               ext.to_device(zeta), ext.to_device(zeta_g),
+               ext.to_device(gamma))
+    fparams = fri.FriParams(
+        log_blowup=lb, num_queries=params.num_queries,
+        log_final_size=params.log_final_size, shift=shift,
+    )
+    fprover = fri.FriProver(fparams)
+    fri_proof, indices = fprover.prove(F, ch)
+
+    # ---- openings of trace/quotient at the query indices -----------------
+    rows_np, q_rows_np, lt_np, lq_np = jax.device_get(
+        (lde_rows, q_rows, tuple(levels_t), tuple(levels_q)))
+    lde_rows_c = bb.from_mont_host(rows_np)
+    q_rows_c = bb.from_mont_host(q_rows_np)
+    levels_t_c = [bb.from_mont_host(l) for l in lt_np]
+    levels_q_c = [bb.from_mont_host(l) for l in lq_np]
+    half = N // 2
+    openings = []
+    for q in indices:
+        entry = {}
+        for name, rows_c, levels_c in (
+            ("trace", lde_rows_c, levels_t_c),
+            ("quotient", q_rows_c, levels_q_c),
+        ):
+            for tag, idx in (("lo", q), ("hi", q + half)):
+                entry[f"{name}_{tag}"] = [int(v) for v in rows_c[idx]]
+                entry[f"{name}_{tag}_path"] = merkle.open_path_canonical(
+                    levels_c, idx)
+        openings.append(entry)
+
+    return {
+        "n": n, "width": w, "log_blowup": lb,
+        "pub_inputs": [int(v) % bb.P for v in pub_inputs],
+        "trace_root": [int(x) for x in _canon(trace_root)],
+        "quotient_root": [int(x) for x in _canon(q_root)],
+        "trace_at_zeta": t_at_z,
+        "trace_at_zeta_g": t_at_zg,
+        "quotient_at_zeta": q_at_z,
+        "fri": {
+            "roots": fri_proof.roots,
+            "final_coeffs": [list(c) for c in fri_proof.final_coeffs],
+            "queries": fri_proof.queries,
+        },
+        "openings": openings,
+    }

@@ -1,0 +1,84 @@
+"""End-to-end STARK prove/verify on the Fibonacci AIR, plus soundness probes."""
+
+import copy
+
+import numpy as np
+import pytest
+
+from ethrex_tpu.models import fibonacci as fib
+from ethrex_tpu.stark import prover, verifier
+from ethrex_tpu.stark.prover import StarkParams
+
+PARAMS = StarkParams(log_blowup=2, num_queries=16, log_final_size=4)
+
+
+def _make_proof(n=64):
+    air = fib.FibonacciAir()
+    trace = fib.generate_trace(n)
+    pub = fib.public_inputs(trace)
+    proof = prover.prove(air, trace, pub, PARAMS)
+    return air, proof
+
+
+AIR, PROOF = None, None
+
+
+def _cached():
+    global AIR, PROOF
+    if PROOF is None:
+        AIR, PROOF = _make_proof()
+    return AIR, copy.deepcopy(PROOF)
+
+
+def test_prove_verify_roundtrip():
+    air, proof = _cached()
+    assert verifier.verify(air, proof, PARAMS)
+
+
+def test_wrong_public_input_rejected():
+    air, proof = _cached()
+    proof["pub_inputs"][2] = (proof["pub_inputs"][2] + 1) % (2**31 - 2**27 + 1)
+    with pytest.raises(verifier.VerificationError):
+        verifier.verify(air, proof, PARAMS)
+
+
+def test_tampered_trace_root_rejected():
+    air, proof = _cached()
+    proof["trace_root"][0] ^= 1
+    with pytest.raises(verifier.VerificationError):
+        verifier.verify(air, proof, PARAMS)
+
+
+def test_tampered_opening_rejected():
+    air, proof = _cached()
+    proof["openings"][0]["trace_lo"][0] ^= 1
+    with pytest.raises(verifier.VerificationError):
+        verifier.verify(air, proof, PARAMS)
+
+
+def test_tampered_fri_final_rejected():
+    air, proof = _cached()
+    proof["fri"]["final_coeffs"][0][0] ^= 1
+    with pytest.raises(verifier.VerificationError):
+        verifier.verify(air, proof, PARAMS)
+
+
+def test_tampered_zeta_opening_rejected():
+    air, proof = _cached()
+    proof["trace_at_zeta"][0] = tuple(
+        (x + 1) % (2**31 - 2**27 + 1) for x in proof["trace_at_zeta"][0]
+    )
+    with pytest.raises(verifier.VerificationError):
+        verifier.verify(air, proof, PARAMS)
+
+
+def test_invalid_trace_rejected():
+    # a trace violating the transition constraint must not produce a proof
+    # that verifies (the quotient is not a polynomial -> identity fails)
+    air = fib.FibonacciAir()
+    trace = fib.generate_trace(64)
+    trace[10, 1] = (int(trace[10, 1]) + 1) % (2**31 - 2**27 + 1)
+    pub = fib.public_inputs(trace)
+    proof = prover.prove(air, trace, pub, PARAMS)
+    with pytest.raises(verifier.VerificationError):
+        verifier.verify(air, proof, PARAMS)
