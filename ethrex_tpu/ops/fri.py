@@ -193,6 +193,8 @@ def verify(proof: FriProof, log_n0: int, challenger: Challenger,
             half = 1 << (log_nk - 1)
             idx = raw % half
             lo, hi = (tuple(int(v) for v in x) for x in opening["values"])
+            if len(lo) != 4 or len(hi) != 4:
+                raise ValueError("FRI: opening values must be 4-limb ext elements")
             if not merkle.verify_opening(
                 proof.roots[k], idx, list(lo) + list(hi), opening["path"],
                 log_nk - 1,

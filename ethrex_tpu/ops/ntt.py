@@ -133,6 +133,13 @@ def coset_evals_from_coeffs(coeffs, n_out: int, shift: int = bb.GENERATOR):
     return ntt(jnp.pad(coeffs, pad))
 
 
+def domain_points(log_size: int, shift: int) -> np.ndarray:
+    """Canonical evaluation-domain points shift * g^i (host numpy)."""
+    g = bb.root_of_unity(log_size)
+    pts = bb.powers_host(g, 1 << log_size).astype(np.uint64)
+    return ((pts * (shift % bb.P)) % bb.P).astype(np.uint32)
+
+
 def eval_poly_at(coeffs, point):
     """Horner evaluation of a coefficient vector (Montgomery) at a scalar.
 

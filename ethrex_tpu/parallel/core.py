@@ -21,16 +21,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops import babybear as bb
 from ..ops import ext
+from ..ops import fri as fri_ops
 from ..ops import ntt
 from ..ops import poseidon2 as p2
 from ..ops.fri import _fold_inv_points, _INV2
 from . import mesh as mesh_lib
-
-
-def _domain_points_m(log_size: int, shift: int) -> np.ndarray:
-    g = bb.root_of_unity(log_size)
-    pts = bb.powers_host(g, 1 << log_size).astype(np.uint64)
-    return bb.to_mont_host((pts * (shift % bb.P)) % bb.P)
 
 
 def build_prove_step(log_n: int, width: int, log_blowup: int = 2,
@@ -46,7 +41,7 @@ def build_prove_step(log_n: int, width: int, log_blowup: int = 2,
     log_N = log_n + log_blowup
     L = log_N - log_final_size
     shift = bb.GENERATOR
-    pts_m = jnp.asarray(_domain_points_m(log_N, shift))
+    pts_m = jnp.asarray(bb.to_mont_host(ntt.domain_points(log_N, shift)))
     inv2 = jnp.asarray(np.uint32(int(bb.to_mont_host(_INV2))))
     fold_invs = []
     s = shift
@@ -107,18 +102,12 @@ def build_prove_step(log_n: int, width: int, log_blowup: int = 2,
         comb = bb.sum_mod(ext.mul(diff, gpow[None]), axis=1)   # (N, 4)
         cw = ext.mul(comb, inv_xz)
         cw = shard(cw, (axis, None))
-        # 4. FRI fold chain, committing each layer
+        # 4. FRI fold chain, committing each layer (reuses ops/fri kernels)
         fri_roots = []
         for k in range(L):
-            half = cw.shape[0] // 2
-            leaves = jnp.concatenate([cw[:half], cw[half:]], axis=-1)
-            leaves = shard(leaves, (axis, None))
+            leaves = shard(fri_ops._pair_leaves(cw), (axis, None))
             fri_roots.append(commit_root(leaves))
-            lo, hi = cw[:half], cw[half:]
-            s_ = ext.scalar_mul(ext.add(lo, hi), inv2)
-            d_ = ext.scalar_mul(ext.sub(lo, hi),
-                                bb.mont_mul(inv2, fold_invs[k]))
-            cw = ext.add(s_, ext.mul(jnp.broadcast_to(betas[k], d_.shape), d_))
+            cw = fri_ops._fold(cw, betas[k], fold_invs[k], inv2)
             cw = shard(cw, (axis, None))
         return troot, tuple(fri_roots), cw
 

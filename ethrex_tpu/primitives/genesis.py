@@ -1,0 +1,178 @@
+"""Genesis file parsing + fork schedule (parity with the reference's
+crates/common/types/genesis.rs and config/networks.rs)."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+
+from .account import Account
+from .block import BlockHeader, ZERO_HASH, ZERO_NONCE
+
+
+class Fork(enum.IntEnum):
+    FRONTIER = 0
+    HOMESTEAD = 1
+    TANGERINE = 2
+    SPURIOUS_DRAGON = 3
+    BYZANTIUM = 4
+    CONSTANTINOPLE = 5
+    PETERSBURG = 6
+    ISTANBUL = 7
+    BERLIN = 8
+    LONDON = 9
+    PARIS = 10
+    SHANGHAI = 11
+    CANCUN = 12
+    PRAGUE = 13
+
+
+_BLOCK_FORKS = [
+    ("homesteadBlock", Fork.HOMESTEAD),
+    ("eip150Block", Fork.TANGERINE),
+    ("eip155Block", Fork.SPURIOUS_DRAGON),
+    ("byzantiumBlock", Fork.BYZANTIUM),
+    ("constantinopleBlock", Fork.CONSTANTINOPLE),
+    ("petersburgBlock", Fork.PETERSBURG),
+    ("istanbulBlock", Fork.ISTANBUL),
+    ("berlinBlock", Fork.BERLIN),
+    ("londonBlock", Fork.LONDON),
+    ("mergeNetsplitBlock", Fork.PARIS),
+]
+_TIME_FORKS = [
+    ("shanghaiTime", Fork.SHANGHAI),
+    ("cancunTime", Fork.CANCUN),
+    ("pragueTime", Fork.PRAGUE),
+]
+
+
+@dataclasses.dataclass
+class ChainConfig:
+    chain_id: int = 1
+    block_forks: dict = dataclasses.field(default_factory=dict)  # Fork -> blk
+    time_forks: dict = dataclasses.field(default_factory=dict)   # Fork -> ts
+    terminal_total_difficulty: int | None = None
+
+    @classmethod
+    def from_json(cls, cfg: dict) -> "ChainConfig":
+        c = cls(chain_id=int(cfg.get("chainId", 1)))
+        for key, fork in _BLOCK_FORKS:
+            if cfg.get(key) is not None:
+                c.block_forks[fork] = int(cfg[key])
+        for key, fork in _TIME_FORKS:
+            if cfg.get(key) is not None:
+                c.time_forks[fork] = int(cfg[key])
+        if cfg.get("terminalTotalDifficulty") is not None:
+            c.terminal_total_difficulty = int(cfg["terminalTotalDifficulty"])
+        return c
+
+    def fork_at(self, block_number: int, timestamp: int) -> Fork:
+        active = Fork.FRONTIER
+        for fork, blk in self.block_forks.items():
+            if block_number >= blk and fork > active:
+                active = fork
+        # PARIS activates via TTD; treat configured TTD==0 or a configured
+        # merge netsplit block as merged (dev/test networks)
+        if (self.terminal_total_difficulty == 0
+                and Fork.PARIS > active):
+            active = Fork.PARIS
+        for fork, ts in self.time_forks.items():
+            if timestamp >= ts and fork > active:
+                active = fork
+        return active
+
+    def is_active(self, fork: Fork, block_number: int, timestamp: int) -> bool:
+        return self.fork_at(block_number, timestamp) >= fork
+
+
+@dataclasses.dataclass
+class Genesis:
+    config: ChainConfig
+    alloc: dict            # address(bytes20) -> Account
+    coinbase: bytes = b"\x00" * 20
+    difficulty: int = 0
+    extra_data: bytes = b""
+    gas_limit: int = 30_000_000
+    nonce: int = 0
+    mix_hash: bytes = ZERO_HASH
+    timestamp: int = 0
+    base_fee_per_gas: int | None = None
+    excess_blob_gas: int | None = None
+    blob_gas_used: int | None = None
+
+    @classmethod
+    def from_json(cls, obj: dict | str) -> "Genesis":
+        if isinstance(obj, str):
+            obj = json.loads(obj)
+        config = ChainConfig.from_json(obj.get("config", {}))
+        alloc = {}
+        for addr_hex, info in obj.get("alloc", {}).items():
+            addr = bytes.fromhex(addr_hex.removeprefix("0x").zfill(40))
+            storage = {
+                int(k, 16): int(v, 16)
+                for k, v in info.get("storage", {}).items()
+            }
+            alloc[addr] = Account.new(
+                nonce=_num(info.get("nonce", 0)),
+                balance=_num(info.get("balance", 0)),
+                code=_hexb(info.get("code", "")),
+                storage=storage,
+            )
+        return cls(
+            config=config, alloc=alloc,
+            coinbase=_hexb(obj.get("coinbase", "0x" + "00" * 20)),
+            difficulty=_num(obj.get("difficulty", 0)),
+            extra_data=_hexb(obj.get("extraData", "")),
+            gas_limit=_num(obj.get("gasLimit", 30_000_000)),
+            nonce=_num(obj.get("nonce", 0)),
+            mix_hash=_hexb(obj.get("mixHash", "0x" + "00" * 32)) or ZERO_HASH,
+            timestamp=_num(obj.get("timestamp", 0)),
+            base_fee_per_gas=_opt_num(obj.get("baseFeePerGas")),
+            excess_blob_gas=_opt_num(obj.get("excessBlobGas")),
+            blob_gas_used=_opt_num(obj.get("blobGasUsed")),
+        )
+
+    def header(self, state_root: bytes) -> BlockHeader:
+        from .account import EMPTY_TRIE_ROOT
+
+        fork = self.config.fork_at(0, self.timestamp)
+        h = BlockHeader(
+            coinbase=self.coinbase, state_root=state_root,
+            difficulty=self.difficulty, number=0, gas_limit=self.gas_limit,
+            gas_used=0, timestamp=self.timestamp, extra_data=self.extra_data,
+            prev_randao=self.mix_hash,
+            nonce=self.nonce.to_bytes(8, "big") if self.nonce else ZERO_NONCE,
+        )
+        if fork >= Fork.LONDON:
+            h.base_fee_per_gas = (self.base_fee_per_gas
+                                  if self.base_fee_per_gas is not None
+                                  else 1_000_000_000)
+        if fork >= Fork.SHANGHAI:
+            h.withdrawals_root = EMPTY_TRIE_ROOT
+        if fork >= Fork.CANCUN:
+            h.blob_gas_used = self.blob_gas_used or 0
+            h.excess_blob_gas = self.excess_blob_gas or 0
+            h.parent_beacon_block_root = ZERO_HASH
+        if fork >= Fork.PRAGUE:
+            from ..crypto.keccak import keccak256  # EIP-7685 empty hash is
+            import hashlib                          # sha256 of empty
+            h.requests_hash = hashlib.sha256(b"").digest()
+        return h
+
+
+def _num(v) -> int:
+    if isinstance(v, int):
+        return v
+    v = str(v)
+    return int(v, 16) if v.startswith("0x") else int(v or "0")
+
+
+def _opt_num(v):
+    return None if v is None else _num(v)
+
+
+def _hexb(v) -> bytes:
+    if not v:
+        return b""
+    return bytes.fromhex(str(v).removeprefix("0x"))

@@ -42,10 +42,7 @@ class StarkParams:
     shift: int = bb.GENERATOR
 
 
-def _domain_points(log_size: int, shift: int) -> np.ndarray:
-    g = bb.root_of_unity(log_size)
-    pts = bb.powers_host(g, 1 << log_size).astype(np.uint64)
-    return ((pts * (shift % bb.P)) % bb.P).astype(np.uint32)
+_domain_points = ntt.domain_points
 
 
 def _canon(arr) -> np.ndarray:
@@ -101,9 +98,11 @@ def _build_phases(air: Air, log_n: int, lb: int, shift: int):
         ((pts - pow(g_n, r, bb.P)) % bb.P).astype(np.uint32)
         for (r, _) in bounds_struct
     ]
-    div_stack_np = bb.to_mont_host(
+    # divisor inverses depend only on structure: invert ONCE at build time
+    # (one device batch inversion), not inside the per-proof jitted phase
+    inv_stack_np = np.asarray(bb.batch_mont_inv(jnp.asarray(bb.to_mont_host(
         np.concatenate([xn_minus_1, x_minus_glast] + bound_divs)
-    )
+    ))))
     pts_m_np = bb.to_mont_host(_domain_points(log_N, shift))
 
     @jax.jit
@@ -124,7 +123,7 @@ def _build_phases(air: Air, log_n: int, lb: int, shift: int):
         acc = bb.sum_mod(
             bb.mont_mul(cons[:, :, None], apow[:K, None, :]), axis=0
         )                                                          # (N, 4)
-        inv_stack = bb.batch_mont_inv(jnp.asarray(div_stack_np))
+        inv_stack = jnp.asarray(inv_stack_np)
         inv_xn1 = jnp.tile(inv_stack[:B], N // B)
         xm = jnp.asarray(bb.to_mont_host(x_minus_glast))
         q_acc = ext.scalar_mul(acc, bb.mont_mul(xm, inv_xn1))

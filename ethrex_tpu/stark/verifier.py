@@ -27,6 +27,18 @@ def _fail(msg: str):
 
 
 def verify(air: Air, proof: dict, params: StarkParams = StarkParams()):
+    """Verify an untrusted proof dict.  Returns True or raises
+    VerificationError — structural garbage (missing keys, wrong types) is
+    converted to VerificationError, never an unhandled crash."""
+    try:
+        return _verify(air, proof, params)
+    except VerificationError:
+        raise
+    except (KeyError, TypeError, IndexError, ValueError, AttributeError) as e:
+        raise VerificationError(f"malformed proof: {type(e).__name__}: {e}")
+
+
+def _verify(air: Air, proof: dict, params: StarkParams):
     n = proof["n"]
     w = proof["width"]
     lb = proof["log_blowup"]

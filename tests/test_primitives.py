@@ -1,0 +1,190 @@
+"""RLP, keccak, secp256k1, transaction/block/receipt round-trips."""
+
+import pytest
+
+from ethrex_tpu.crypto import secp256k1
+from ethrex_tpu.crypto.keccak import keccak256, _keccak256_py
+from ethrex_tpu.primitives import rlp
+from ethrex_tpu.primitives.block import Block, BlockBody, BlockHeader, Withdrawal
+from ethrex_tpu.primitives.genesis import ChainConfig, Fork, Genesis
+from ethrex_tpu.primitives.receipt import Log, Receipt
+from ethrex_tpu.primitives.transaction import (
+    TYPE_BLOB, TYPE_DYNAMIC_FEE, TYPE_SET_CODE, Transaction,
+)
+
+
+def test_keccak_vectors():
+    assert keccak256(b"").hex() == (
+        "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470")
+    assert keccak256(b"abc").hex() == (
+        "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45")
+    for n in (0, 1, 135, 136, 137, 300, 1000):
+        data = bytes(range(256)) * 4
+        assert keccak256(data[:n]) == _keccak256_py(data[:n])
+
+
+def test_rlp_spec_vectors():
+    assert rlp.encode(b"dog") == b"\x83dog"
+    assert rlp.encode([b"cat", b"dog"]) == b"\xc8\x83cat\x83dog"
+    assert rlp.encode(b"") == b"\x80"
+    assert rlp.encode(0) == b"\x80"
+    assert rlp.encode(15) == b"\x0f"
+    assert rlp.encode(1024) == b"\x82\x04\x00"
+    assert rlp.encode([]) == b"\xc0"
+    assert rlp.encode([[], [[]], [[], [[]]]]).hex() == "c7c0c1c0c3c0c1c0"
+    long = b"Lorem ipsum dolor sit amet, consectetur adipisicing elit"
+    assert rlp.encode(long) == b"\xb8\x38" + long
+
+
+def test_rlp_roundtrip_and_errors():
+    cases = [b"", b"\x00", b"x" * 55, b"y" * 56, b"z" * 300,
+             [b"a", [b"b", b"c"], b""], [[b""] * 60]]
+    for c in cases:
+        assert rlp.decode(rlp.encode(c)) == c
+    with pytest.raises(rlp.RLPError):
+        rlp.decode(b"")
+    with pytest.raises(rlp.RLPError):
+        rlp.decode(b"\x81\x05")  # non-canonical single byte
+    with pytest.raises(rlp.RLPError):
+        rlp.decode(b"\x83ab")    # truncated
+    with pytest.raises(rlp.RLPError):
+        rlp.decode(rlp.encode(b"hi") + b"\x00")  # trailing bytes
+
+
+def test_secp256k1_sign_recover():
+    secret = 0xB71C71A67E1177AD4E901695E1B4B9EE17AE16C6668D313EAC2F96DBCDA3F291
+    pub = secp256k1.pubkey_from_secret(secret)
+    assert secp256k1.is_on_curve(pub)
+    addr = secp256k1.pubkey_to_address(pub)
+    msg = keccak256(b"test message")
+    r, s, rec = secp256k1.sign(msg, secret)
+    assert s <= secp256k1.N // 2
+    assert secp256k1.verify(msg, r, s, pub)
+    assert secp256k1.recover_address(msg, r, s, rec) == addr
+    assert secp256k1.recover_address(msg, r, s, rec ^ 1) != addr
+    assert secp256k1.recover(msg, 0, s, rec) is None
+
+
+SECRET = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
+
+
+def _signed(tx: Transaction) -> Transaction:
+    return tx.sign(SECRET)
+
+
+def test_legacy_tx_roundtrip_and_sender():
+    tx = _signed(Transaction(
+        tx_type=0, chain_id=1, nonce=7, gas_price=20 * 10**9,
+        gas_limit=21000, to=bytes.fromhex("aa" * 20), value=10**18,
+    ))
+    enc = tx.encode_canonical()
+    dec = Transaction.decode_canonical(enc)
+    assert dec.nonce == 7 and dec.chain_id == 1
+    expected = secp256k1.pubkey_to_address(
+        secp256k1.pubkey_from_secret(SECRET))
+    assert dec.sender() == expected
+    assert dec.hash == tx.hash
+
+
+def test_eip1559_blob_setcode_roundtrip():
+    addr = secp256k1.pubkey_to_address(secp256k1.pubkey_from_secret(SECRET))
+    txs = [
+        Transaction(tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=1,
+                    max_priority_fee_per_gas=2, max_fee_per_gas=100,
+                    gas_limit=50000, to=bytes.fromhex("bb" * 20), value=5,
+                    data=b"\x01\x02",
+                    access_list=[(bytes.fromhex("cc" * 20), [1, 2])]),
+        Transaction(tx_type=TYPE_BLOB, chain_id=1337, nonce=2,
+                    max_priority_fee_per_gas=2, max_fee_per_gas=100,
+                    gas_limit=50000, to=bytes.fromhex("bb" * 20),
+                    max_fee_per_blob_gas=7,
+                    blob_versioned_hashes=[b"\x01" + b"\x00" * 31]),
+        Transaction(tx_type=TYPE_SET_CODE, chain_id=1337, nonce=3,
+                    max_priority_fee_per_gas=2, max_fee_per_gas=100,
+                    gas_limit=50000, to=bytes.fromhex("bb" * 20),
+                    authorization_list=[{
+                        "chain_id": 1337, "address": bytes.fromhex("dd" * 20),
+                        "nonce": 0, "y_parity": 0, "r": 5, "s": 6}]),
+    ]
+    for tx in txs:
+        _signed(tx)
+        dec = Transaction.decode_canonical(tx.encode_canonical())
+        assert dec.sender() == addr, f"type {tx.tx_type}"
+        assert dec.encode_canonical() == tx.encode_canonical()
+
+
+def test_block_header_roundtrip():
+    h = BlockHeader(number=5, gas_limit=30_000_000, timestamp=1000,
+                    base_fee_per_gas=7, withdrawals_root=b"\x11" * 32,
+                    blob_gas_used=0, excess_blob_gas=0,
+                    parent_beacon_block_root=b"\x22" * 32)
+    dec = BlockHeader.decode(h.encode())
+    assert dec == h
+    assert len(h.hash) == 32
+    # non-contiguous optionals must fail
+    bad = BlockHeader(number=5, withdrawals_root=b"\x11" * 32)
+    with pytest.raises(ValueError):
+        bad.encode()
+
+
+def test_block_roundtrip():
+    tx = _signed(Transaction(tx_type=TYPE_DYNAMIC_FEE, chain_id=1, nonce=0,
+                             max_fee_per_gas=10, gas_limit=21000,
+                             to=b"\xaa" * 20, value=1))
+    legacy = _signed(Transaction(tx_type=0, chain_id=1, nonce=1,
+                                 gas_price=10, gas_limit=21000,
+                                 to=b"\xbb" * 20, value=2))
+    block = Block(
+        BlockHeader(number=1, base_fee_per_gas=7),
+        BlockBody(transactions=[tx, legacy],
+                  withdrawals=[Withdrawal(1, 2, b"\xcc" * 20, 3)]),
+    )
+    dec = Block.decode(block.encode())
+    assert dec.header == block.header
+    assert [t.hash for t in dec.body.transactions] == [tx.hash, legacy.hash]
+    assert dec.body.withdrawals[0].amount == 3
+
+
+def test_receipt_roundtrip_and_bloom():
+    log = Log(address=b"\xaa" * 20, topics=[b"\x01" * 32], data=b"xy")
+    rec = Receipt(tx_type=2, succeeded=True, cumulative_gas_used=21000,
+                  logs=[log])
+    dec = Receipt.decode(rec.encode())
+    assert dec.succeeded and dec.cumulative_gas_used == 21000
+    assert dec.logs[0].address == log.address
+    bloom = rec.bloom
+    assert bloom != b"\x00" * 256
+    # failed receipt
+    rec2 = Receipt(tx_type=0, succeeded=False, cumulative_gas_used=1)
+    assert not Receipt.decode(rec2.encode()).succeeded
+
+
+def test_chain_config_fork_schedule():
+    cfg = ChainConfig.from_json({
+        "chainId": 1337, "homesteadBlock": 0, "berlinBlock": 0,
+        "londonBlock": 10, "terminalTotalDifficulty": 0,
+        "shanghaiTime": 100, "cancunTime": 200, "pragueTime": 300,
+    })
+    assert cfg.fork_at(0, 0) == Fork.PARIS  # TTD=0 => merged from genesis
+    assert cfg.fork_at(20, 50) == Fork.PARIS
+    assert cfg.fork_at(20, 150) == Fork.SHANGHAI
+    assert cfg.fork_at(20, 250) == Fork.CANCUN
+    assert cfg.fork_at(20, 350) == Fork.PRAGUE
+
+
+def test_genesis_parse():
+    g = Genesis.from_json({
+        "config": {"chainId": 7, "cancunTime": 0,
+                   "terminalTotalDifficulty": 0, "shanghaiTime": 0},
+        "alloc": {
+            "0x" + "ab" * 20: {"balance": "0xde0b6b3a7640000",
+                               "nonce": "0x1"},
+        },
+        "gasLimit": "0x1c9c380",
+        "timestamp": "0x0",
+    })
+    acct = g.alloc[bytes.fromhex("ab" * 20)]
+    assert acct.state.balance == 10**18
+    assert acct.state.nonce == 1
+    h = g.header(state_root=b"\x00" * 32)
+    assert h.blob_gas_used == 0 and h.withdrawals_root is not None

@@ -1,0 +1,141 @@
+"""MPT tests: spec vectors, random consistency, proofs, witness tries."""
+
+import random
+
+import pytest
+
+from ethrex_tpu.crypto.keccak import keccak256
+from ethrex_tpu.primitives import rlp
+from ethrex_tpu.primitives.account import EMPTY_TRIE_ROOT
+from ethrex_tpu.trie.trie import (
+    MissingNode, Trie, bytes_to_nibbles, hp_decode, hp_encode,
+    trie_root_from_items, verify_proof,
+)
+
+
+def test_hex_prefix_roundtrip():
+    for nibbles, leaf in [((), True), ((1,), False),
+                          ((1, 2, 3), True), ((0, 0, 0, 0), False),
+                          (tuple(range(16)), True)]:
+        enc = hp_encode(nibbles, leaf)
+        assert hp_decode(enc) == (nibbles, leaf)
+
+
+def test_empty_root():
+    assert Trie().root_hash() == EMPTY_TRIE_ROOT
+    assert keccak256(rlp.encode(b"")) == EMPTY_TRIE_ROOT
+
+
+def test_known_ethereum_vector():
+    # canonical MPT test vector (ethereum/tests trietest: do/dog/doge/horse)
+    t = Trie()
+    for k, v in [(b"do", b"verb"), (b"dog", b"puppy"), (b"doge", b"coin"),
+                 (b"horse", b"stallion")]:
+        t.insert(k, v)
+    assert t.root_hash().hex() == (
+        "5991bb8c6514148a29db676a14ac506cd2cd5775ace63c30a4fe457715e9ac84")
+
+
+def test_insert_get_remove_random():
+    rng = random.Random(42)
+    t = Trie()
+    ref = {}
+    for _ in range(500):
+        k = rng.randbytes(rng.randint(1, 8))
+        v = rng.randbytes(rng.randint(1, 40))
+        t.insert(k, v)
+        ref[k] = v
+    for k, v in ref.items():
+        assert t.get(k) == v
+    # removal of half the keys
+    keys = sorted(ref)
+    for k in keys[::2]:
+        t.remove(k)
+        del ref[k]
+    for k in keys:
+        assert t.get(k) == ref.get(k)
+    # root must equal a freshly built trie over the same final content
+    fresh = Trie()
+    for k, v in ref.items():
+        fresh.insert(k, v)
+    assert t.root_hash() == fresh.root_hash()
+    # insertion order must not matter
+    shuffled = list(ref.items())
+    rng.shuffle(shuffled)
+    t2 = Trie()
+    for k, v in shuffled:
+        t2.insert(k, v)
+    assert t2.root_hash() == t.root_hash()
+
+
+def test_remove_everything_returns_empty_root():
+    t = Trie()
+    items = [(bytes([i]), b"v%d" % i) for i in range(50)]
+    for k, v in items:
+        t.insert(k, v)
+    for k, _ in items:
+        t.remove(k)
+    assert t.root_hash() == EMPTY_TRIE_ROOT
+
+
+def test_proofs():
+    t = Trie()
+    ref = {}
+    rng = random.Random(1)
+    for i in range(100):
+        k = keccak256(bytes([i]))
+        v = rng.randbytes(30)
+        t.insert(k, v)
+        ref[k] = v
+    root = t.root_hash()
+    for k in list(ref)[:10]:
+        proof = t.get_proof(k)
+        ok, value = verify_proof(root, k, proof)
+        assert ok and value == ref[k]
+    # proof for an absent key proves absence
+    absent = keccak256(b"nope")
+    proof = t.get_proof(absent)
+    ok, value = verify_proof(root, absent, proof)
+    assert ok and value is None
+    # tampered proof fails
+    proof2 = t.get_proof(list(ref)[0])
+    tampered = [proof2[0][:-1] + bytes([proof2[0][-1] ^ 1])] + proof2[1:]
+    ok, _ = verify_proof(root, list(ref)[0], tampered)
+    assert not ok
+
+
+def test_witness_trie_from_nodes():
+    t = Trie()
+    rng = random.Random(2)
+    ref = {}
+    for i in range(200):
+        k = keccak256(bytes([i]))
+        v = rng.randbytes(20)
+        t.insert(k, v)
+        ref[k] = v
+    root = t.commit()
+    # witness = union of proofs for a few touched keys
+    touched = list(ref)[:5]
+    nodes = {}
+    for k in touched:
+        for enc in t.get_proof(k):
+            nodes[keccak256(enc)] = enc
+    wt = Trie.from_nodes(root, nodes)
+    for k in touched:
+        assert wt.get(k) == ref[k]
+    # an untouched key walks into a pruned subtree
+    with pytest.raises(MissingNode):
+        for k in ref:
+            if k not in touched:
+                wt.get(k)
+    # mutation of a touched key + re-hash matches the full trie's result
+    wt.insert(touched[0], b"new-value")
+    t.insert(touched[0], b"new-value")
+    assert wt.root_hash() == t.root_hash()
+
+
+def test_trie_root_from_items():
+    items = [(rlp.encode(i), b"tx%d" % i) for i in range(10)]
+    r1 = trie_root_from_items(items)
+    r2 = trie_root_from_items(list(reversed(items)))
+    assert r1 == r2 != EMPTY_TRIE_ROOT
