@@ -1,0 +1,45 @@
+"""Fork choice application (parity with the reference's
+crates/blockchain/fork_choice.rs apply_fork_choice)."""
+
+from __future__ import annotations
+
+from ..storage.store import Store
+
+
+class ForkChoiceError(Exception):
+    pass
+
+
+def apply_fork_choice(store: Store, head_hash: bytes,
+                      safe_hash: bytes = b"", finalized_hash: bytes = b""):
+    """Make head_hash canonical: walk back to the first ancestor already on
+    the canonical chain, rewrite the canonical index, update head/safe/
+    finalized markers.  Returns the new head header."""
+    head = store.get_header(head_hash)
+    if head is None:
+        raise ForkChoiceError("unknown head block")
+    for name, h in (("safe", safe_hash), ("finalized", finalized_hash)):
+        if h and store.get_header(h) is None:
+            raise ForkChoiceError(f"unknown {name} block")
+
+    # collect the branch from head back to a canonical ancestor
+    branch = []
+    cursor = head
+    while store.canonical_hash(cursor.number) != cursor.hash:
+        branch.append(cursor)
+        parent = store.get_header(cursor.parent_hash)
+        if parent is None:
+            raise ForkChoiceError("detached branch")
+        cursor = parent
+    # drop any stale canonical entries above the new head
+    old_head = store.head_header()
+    for number in range(head.number + 1, old_head.number + 1):
+        store.canonical.pop(number, None)
+    for header in branch:
+        store.set_canonical(header.number, header.hash)
+    store.set_head(head_hash)
+    if safe_hash:
+        store.meta["safe"] = safe_hash
+    if finalized_hash:
+        store.meta["finalized"] = finalized_hash
+    return head

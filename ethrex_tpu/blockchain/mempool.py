@@ -1,0 +1,113 @@
+"""Transaction pool (parity with the reference's
+crates/blockchain/mempool.rs: per-account queues, tip ordering, replacement,
+blob support; simplified admission rules for round 1)."""
+
+from __future__ import annotations
+
+import threading
+
+from ..primitives.transaction import TYPE_BLOB, Transaction
+
+MIN_REPLACEMENT_BUMP = 10  # percent
+
+
+class MempoolError(Exception):
+    pass
+
+
+class Mempool:
+    def __init__(self, capacity: int = 10_000):
+        self.capacity = capacity
+        self.by_hash: dict[bytes, Transaction] = {}
+        self.by_sender: dict[bytes, dict[int, Transaction]] = {}
+        self.blobs_bundles: dict[bytes, object] = {}  # tx_hash -> bundle
+        self.lock = threading.RLock()
+
+    def add_transaction(self, tx: Transaction, sender_nonce: int,
+                        sender_balance: int, base_fee: int,
+                        blobs_bundle=None) -> bytes:
+        sender = tx.sender()
+        if sender is None:
+            raise MempoolError("invalid signature")
+        if tx.nonce < sender_nonce:
+            raise MempoolError("nonce too low")
+        if tx.gas_limit * tx.max_fee() + tx.value > sender_balance:
+            raise MempoolError("insufficient funds")
+        if tx.tx_type == TYPE_BLOB and blobs_bundle is None:
+            raise MempoolError("blob tx requires blobs bundle")
+        with self.lock:
+            if len(self.by_hash) >= self.capacity:
+                raise MempoolError("mempool full")
+            queue = self.by_sender.setdefault(sender, {})
+            existing = queue.get(tx.nonce)
+            if existing is not None:
+                bump = existing.max_fee() * (100 + MIN_REPLACEMENT_BUMP) // 100
+                if tx.max_fee() < bump:
+                    raise MempoolError("replacement underpriced")
+                self.by_hash.pop(existing.hash, None)
+                self.blobs_bundles.pop(existing.hash, None)
+            queue[tx.nonce] = tx
+            self.by_hash[tx.hash] = tx
+            if blobs_bundle is not None:
+                self.blobs_bundles[tx.hash] = blobs_bundle
+            return tx.hash
+
+    def remove_transaction(self, tx_hash: bytes):
+        with self.lock:
+            tx = self.by_hash.pop(tx_hash, None)
+            if tx is None:
+                return
+            self.blobs_bundles.pop(tx_hash, None)
+            sender = tx.sender()
+            queue = self.by_sender.get(sender)
+            if queue and queue.get(tx.nonce) is tx:
+                del queue[tx.nonce]
+                if not queue:
+                    del self.by_sender[sender]
+
+    def get_transaction(self, tx_hash: bytes) -> Transaction | None:
+        return self.by_hash.get(tx_hash)
+
+    def pending(self, base_fee: int, get_nonce) -> list[Transaction]:
+        """Executable txs in inclusion order: highest effective tip first,
+        but never breaking per-sender nonce order — a heap over each
+        sender's *next* executable tx (the reference's fill_transactions
+        ordering, crates/blockchain/payload.rs)."""
+        import heapq
+
+        with self.lock:
+            chains: dict[bytes, list[Transaction]] = {}
+            for sender, queue in self.by_sender.items():
+                nonce = get_nonce(sender)
+                run = []
+                while nonce in queue:
+                    tx = queue[nonce]
+                    if tx.effective_gas_price(base_fee) is None:
+                        break
+                    run.append(tx)
+                    nonce += 1
+                if run:
+                    chains[sender] = run
+            heap = []
+            for seq, (sender, run) in enumerate(chains.items()):
+                tip = run[0].effective_gas_price(base_fee) - base_fee
+                heapq.heappush(heap, (-tip, seq, sender, 0))
+            out = []
+            while heap:
+                _, seq, sender, idx = heapq.heappop(heap)
+                run = chains[sender]
+                out.append(run[idx])
+                if idx + 1 < len(run):
+                    tip = run[idx + 1].effective_gas_price(base_fee) - base_fee
+                    heapq.heappush(heap, (-tip, seq, sender, idx + 1))
+            return out
+
+    def content(self) -> dict:
+        with self.lock:
+            return {
+                sender: dict(queue)
+                for sender, queue in self.by_sender.items()
+            }
+
+    def __len__(self):
+        return len(self.by_hash)

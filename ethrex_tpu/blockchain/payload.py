@@ -1,0 +1,128 @@
+"""Payload building: create a block skeleton, fill it from the mempool,
+finalize roots (parity with the reference's crates/blockchain/payload.rs
+create_payload/build_payload/fill_transactions/finalize_payload)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..primitives.block import (Block, BlockBody, BlockHeader, ZERO_HASH,
+                                ZERO_NONCE)
+from ..primitives.genesis import Fork
+from ..primitives.receipt import Receipt, logs_bloom
+from ..evm import gas as G
+from ..evm.db import StateDB
+from ..evm.executor import InvalidTransaction, execute_tx
+from ..evm.vm import BlockEnv
+from .blockchain import (Blockchain, compute_receipts_root,
+                         compute_requests_hash, compute_tx_root,
+                         compute_withdrawals_root, next_base_fee)
+
+
+@dataclasses.dataclass
+class PayloadBuildResult:
+    block: Block
+    receipts: list
+    state_db: StateDB
+    fees_collected: int = 0
+
+
+def create_payload_header(parent: BlockHeader, config, *, timestamp: int,
+                          coinbase: bytes, prev_randao: bytes = ZERO_HASH,
+                          gas_limit: int | None = None,
+                          extra_data: bytes = b"") -> BlockHeader:
+    fork = config.fork_at(parent.number + 1, timestamp)
+    h = BlockHeader(
+        parent_hash=parent.hash, coinbase=coinbase,
+        number=parent.number + 1,
+        gas_limit=gas_limit or parent.gas_limit,
+        timestamp=timestamp, extra_data=extra_data,
+        prev_randao=prev_randao, nonce=ZERO_NONCE, difficulty=0,
+    )
+    if fork >= Fork.LONDON:
+        h.base_fee_per_gas = next_base_fee(parent)
+    if fork >= Fork.SHANGHAI:
+        h.withdrawals_root = None  # filled at finalize
+    if fork >= Fork.CANCUN:
+        h.excess_blob_gas = G.calc_excess_blob_gas(
+            parent.excess_blob_gas or 0, parent.blob_gas_used or 0)
+    return h
+
+
+def build_payload(chain: Blockchain, parent: BlockHeader,
+                  header: BlockHeader, txs: list, withdrawals: list,
+                  parent_beacon_block_root: bytes = ZERO_HASH,
+                  mempool=None) -> PayloadBuildResult:
+    """Execute txs on top of parent and finalize a full block.
+
+    txs: ordered candidate transactions; invalid ones are skipped (and
+    dropped from `mempool` if given) rather than failing the build.
+    """
+    config = chain.config
+    fork = config.fork_at(header.number, header.timestamp)
+    env = BlockEnv(
+        number=header.number, coinbase=header.coinbase,
+        timestamp=header.timestamp, gas_limit=header.gas_limit,
+        prev_randao=header.prev_randao,
+        base_fee=header.base_fee_per_gas or 0,
+        excess_blob_gas=header.excess_blob_gas or 0,
+        parent_beacon_block_root=parent_beacon_block_root,
+    )
+    state = chain.store.state_db(parent.state_root)
+    chain._pre_tx_system_ops(state, env, dataclasses.replace(
+        header, parent_beacon_block_root=parent_beacon_block_root), fork)
+
+    receipts = []
+    included = []
+    gas_used = 0
+    blob_gas = 0
+    fees = 0
+    for tx in txs:
+        if gas_used + tx.gas_limit > header.gas_limit:
+            continue
+        tx_blob_gas = G.BLOB_GAS_PER_BLOB * len(tx.blob_versioned_hashes)
+        if blob_gas + tx_blob_gas > G.MAX_BLOB_GAS_PER_BLOCK:
+            continue
+        try:
+            result = execute_tx(tx, state, env, config)
+        except InvalidTransaction:
+            if mempool is not None:
+                mempool.remove_transaction(tx.hash)
+            continue
+        gas_used += result.gas_used
+        blob_gas += tx_blob_gas
+        tip = (tx.effective_gas_price(env.base_fee) or 0) - env.base_fee
+        fees += result.gas_used * tip
+        included.append(tx)
+        receipts.append(Receipt(
+            tx_type=tx.tx_type, succeeded=result.success,
+            cumulative_gas_used=gas_used, logs=result.logs))
+
+    for wd in withdrawals or []:
+        if wd.amount:
+            state.begin_tx()
+            state.add_balance(wd.address, wd.amount * 10**9)
+            state.finalize_tx()
+    requests = chain._post_tx_requests(state, env, receipts, fork)
+
+    header = dataclasses.replace(header)
+    header.gas_used = gas_used
+    header.tx_root = compute_tx_root(included)
+    header.receipts_root = compute_receipts_root(receipts)
+    header.bloom = logs_bloom([l for r in receipts for l in r.logs])
+    if fork >= Fork.SHANGHAI:
+        header.withdrawals_root = compute_withdrawals_root(withdrawals or [])
+    if fork >= Fork.CANCUN:
+        header.blob_gas_used = blob_gas
+        header.parent_beacon_block_root = parent_beacon_block_root
+    if fork >= Fork.PRAGUE:
+        header.requests_hash = compute_requests_hash(requests)
+    header.state_root = chain.store.apply_account_updates(
+        parent.state_root, state)
+    body = BlockBody(
+        transactions=included, uncles=[],
+        withdrawals=list(withdrawals or [])
+        if fork >= Fork.SHANGHAI else None,
+    )
+    return PayloadBuildResult(block=Block(header, body), receipts=receipts,
+                              state_db=state, fees_collected=fees)

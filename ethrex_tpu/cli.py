@@ -1,0 +1,81 @@
+"""ethrex-tpu CLI (parity target: cmd/ethrex/cli.rs — the L1 node entry
+point; L2 subcommands arrive with the sequencer)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+
+from .node import Node
+from .primitives.genesis import Genesis
+from .rpc.server import RpcServer
+
+DEV_GENESIS = {
+    "config": {
+        "chainId": 1337,
+        "homesteadBlock": 0, "eip150Block": 0, "eip155Block": 0,
+        "byzantiumBlock": 0, "constantinopleBlock": 0, "petersburgBlock": 0,
+        "istanbulBlock": 0, "berlinBlock": 0, "londonBlock": 0,
+        "mergeNetsplitBlock": 0, "terminalTotalDifficulty": 0,
+        "shanghaiTime": 0, "cancunTime": 0,
+    },
+    "alloc": {
+        # dev account (well-known test key
+        # 0x45a915e4d060149eb4365960e6a7a45f334393093061116b197e3240065ff2d8)
+        "0xa94f5374fce5edbc8e2a8697c15331677e6ebf0b": {
+            "balance": "0xd3c21bcecceda1000000"},
+    },
+    "gasLimit": "0x1c9c380",
+    "baseFeePerGas": "0x7",
+    "timestamp": "0x0",
+}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="ethrex-tpu", description="TPU-native Ethereum L1/L2 node")
+    parser.add_argument("--dev", action="store_true",
+                        help="dev mode: auto-produce blocks from the mempool")
+    parser.add_argument("--network", "--genesis", dest="genesis",
+                        help="path to a genesis JSON file")
+    parser.add_argument("--http.addr", dest="http_addr", default="127.0.0.1")
+    parser.add_argument("--http.port", dest="http_port", type=int,
+                        default=8545)
+    parser.add_argument("--block-time", dest="block_time", type=float,
+                        default=1.0, help="dev block production interval (s)")
+    parser.add_argument("--coinbase", default="0x" + "00" * 20)
+    args = parser.parse_args(argv)
+
+    if args.genesis:
+        with open(args.genesis) as f:
+            genesis = Genesis.from_json(json.load(f))
+    elif args.dev:
+        genesis = Genesis.from_json(DEV_GENESIS)
+    else:
+        print("either --dev or --network <genesis.json> is required",
+              file=sys.stderr)
+        return 1
+
+    coinbase = bytes.fromhex(args.coinbase.removeprefix("0x"))
+    node = Node(genesis, coinbase=coinbase)
+    server = RpcServer(node, args.http_addr, args.http_port).start()
+    print(f"genesis hash: 0x{node.genesis_header.hash.hex()}")
+    print(f"JSON-RPC listening on http://{args.http_addr}:{server.port}")
+    if args.dev:
+        node.start_dev_producer(args.block_time)
+        print(f"dev producer running (block time {args.block_time}s)")
+
+    try:
+        signal.pause()
+    except (KeyboardInterrupt, AttributeError):
+        pass
+    finally:
+        node.stop()
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,21 +26,31 @@ def _load_native():
     with _lock:
         if _lib is not None:
             return _lib
-        try:
-            if not os.path.exists(_SO_PATH) or (
-                os.path.getmtime(_SRC_PATH) > os.path.getmtime(_SO_PATH)
-            ):
-                subprocess.run(
-                    ["gcc", "-O3", "-shared", "-fPIC", "-o", _SO_PATH,
-                     _SRC_PATH],
-                    check=True, capture_output=True,
-                )
+        def build():
+            subprocess.run(
+                ["gcc", "-O3", "-shared", "-fPIC", "-o", _SO_PATH, _SRC_PATH],
+                check=True, capture_output=True,
+            )
+
+        def load():
             lib = ctypes.CDLL(_SO_PATH)
             lib.keccak256.argtypes = [
                 ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p
             ]
             lib.keccak256.restype = None
-            _lib = lib
+            return lib
+
+        try:
+            if not os.path.exists(_SO_PATH) or (
+                os.path.getmtime(_SRC_PATH) > os.path.getmtime(_SO_PATH)
+            ):
+                build()
+            try:
+                _lib = load()
+            except OSError:
+                # stale/foreign binary (different arch) — rebuild once
+                build()
+                _lib = load()
         except (OSError, subprocess.CalledProcessError):
             _lib = False  # sentinel: fall back to Python
         return _lib

@@ -1,0 +1,144 @@
+"""Gas schedule (Berlin through Prague; parity with the reference's
+crates/vm/levm/src/gas_cost.rs — re-derived from the EIPs)."""
+
+from __future__ import annotations
+
+# base opcode costs
+ZERO = 0
+BASE = 2
+VERYLOW = 3
+LOW = 5
+MID = 8
+HIGH = 10
+JUMPDEST = 1
+
+KECCAK256 = 30
+KECCAK256_WORD = 6
+COPY_WORD = 3
+LOG = 375
+LOG_DATA = 8
+LOG_TOPIC = 375
+EXP = 10
+EXP_BYTE = 50
+MEMORY = 3
+QUAD_DIVISOR = 512
+BLOCKHASH = 20
+
+# EIP-2929
+COLD_ACCOUNT_ACCESS = 2600
+WARM_ACCESS = 100
+COLD_SLOAD = 2100
+
+# SSTORE (EIP-2200/3529)
+SSTORE_SET = 20000
+SSTORE_RESET = 2900        # 5000 - COLD_SLOAD
+SSTORE_CLEARS_REFUND = 4800  # EIP-3529
+SSTORE_SENTRY = 2300
+
+# calls
+CALL_VALUE = 9000
+CALL_STIPEND = 2300
+NEW_ACCOUNT = 25000
+
+# create
+CREATE = 32000
+CODE_DEPOSIT_BYTE = 200
+INITCODE_WORD = 2          # EIP-3860
+MAX_CODE_SIZE = 24576
+MAX_INITCODE_SIZE = 49152
+
+SELFDESTRUCT = 5000
+
+# transaction
+TX_BASE = 21000
+TX_CREATE = 32000
+TX_DATA_ZERO = 4
+TX_DATA_NONZERO = 16       # EIP-2028
+TX_ACCESS_LIST_ADDR = 2400
+TX_ACCESS_LIST_SLOT = 1900
+TX_FLOOR_TOKEN_COST = 10   # EIP-7623 (Prague)
+PER_EMPTY_ACCOUNT_AUTH = 25000  # EIP-7702
+PER_AUTH_BASE = 12500
+
+# blobs (EIP-4844)
+BLOB_GAS_PER_BLOB = 131072
+TARGET_BLOB_GAS_PER_BLOCK = 393216
+MIN_BLOB_BASE_FEE = 1
+BLOB_BASE_FEE_UPDATE_FRACTION = 3338477
+MAX_BLOB_GAS_PER_BLOCK = 786432
+
+
+def memory_cost(size_words: int) -> int:
+    return MEMORY * size_words + size_words * size_words // QUAD_DIVISOR
+
+
+def memory_expansion(current_size: int, new_size: int) -> int:
+    """Cost to expand memory from current to new byte size (word-aligned)."""
+    if new_size <= current_size:
+        return 0
+    cur_w = (current_size + 31) // 32
+    new_w = (new_size + 31) // 32
+    return memory_cost(new_w) - memory_cost(cur_w)
+
+
+def copy_cost(length: int) -> int:
+    return COPY_WORD * ((length + 31) // 32)
+
+
+def keccak_cost(length: int) -> int:
+    return KECCAK256 + KECCAK256_WORD * ((length + 31) // 32)
+
+
+def exp_cost(exponent: int) -> int:
+    if exponent == 0:
+        return EXP
+    return EXP + EXP_BYTE * ((exponent.bit_length() + 7) // 8)
+
+
+def init_code_cost(length: int) -> int:
+    return INITCODE_WORD * ((length + 31) // 32)
+
+
+def tx_data_cost(data: bytes) -> tuple[int, int]:
+    """Returns (standard_cost, tokens) — tokens feed the EIP-7623 floor."""
+    zeros = data.count(0)
+    nonzeros = len(data) - zeros
+    tokens = zeros + nonzeros * 4
+    return TX_DATA_ZERO * zeros + TX_DATA_NONZERO * nonzeros, tokens
+
+
+def intrinsic_gas(tx, fork_prague: bool) -> tuple[int, int]:
+    """Returns (intrinsic, floor) gas. floor only binds in Prague+ (EIP-7623)."""
+    data_cost, tokens = tx_data_cost(tx.data)
+    gas = TX_BASE + data_cost
+    if tx.is_create:
+        gas += TX_CREATE + init_code_cost(len(tx.data))
+    for _, slots in tx.access_list:
+        gas += TX_ACCESS_LIST_ADDR + TX_ACCESS_LIST_SLOT * len(slots)
+    gas += PER_EMPTY_ACCOUNT_AUTH * len(tx.authorization_list)
+    floor = TX_BASE + TX_FLOOR_TOKEN_COST * tokens if fork_prague else 0
+    return gas, floor
+
+
+def fake_exponential(factor: int, numerator: int, denominator: int) -> int:
+    """EIP-4844 blob base fee exponential approximation."""
+    i = 1
+    output = 0
+    acc = factor * denominator
+    while acc > 0:
+        output += acc
+        acc = acc * numerator // (denominator * i)
+        i += 1
+    return output // denominator
+
+
+def blob_base_fee(excess_blob_gas: int) -> int:
+    return fake_exponential(MIN_BLOB_BASE_FEE, excess_blob_gas,
+                            BLOB_BASE_FEE_UPDATE_FRACTION)
+
+
+def calc_excess_blob_gas(parent_excess: int, parent_used: int) -> int:
+    total = parent_excess + parent_used
+    if total < TARGET_BLOB_GAS_PER_BLOCK:
+        return 0
+    return total - TARGET_BLOB_GAS_PER_BLOCK

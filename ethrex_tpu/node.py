@@ -1,0 +1,96 @@
+"""Node: wires store + blockchain + mempool + RPC + dev block producer
+(parity with the reference's cmd/ethrex init flow, initializers.rs init_l1,
+minus p2p which arrives with the sync rounds)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .blockchain.blockchain import Blockchain
+from .blockchain.fork_choice import apply_fork_choice
+from .blockchain.mempool import Mempool, MempoolError
+from .blockchain.payload import build_payload, create_payload_header
+from .evm.executor import InvalidTransaction
+from .primitives.genesis import Genesis
+from .storage.store import Store
+
+
+class Node:
+    def __init__(self, genesis: Genesis, coinbase: bytes = b"\x00" * 20):
+        self.store = Store()
+        self.genesis_header = self.store.init_genesis(genesis)
+        self.config = genesis.config
+        self.chain = Blockchain(self.store, self.config)
+        self.mempool = Mempool()
+        self.coinbase = coinbase
+        self._producer_thread = None
+        self._stop = threading.Event()
+        self.lock = threading.RLock()
+
+    # ------------------------------------------------------------------
+    def head_state_root(self) -> bytes:
+        return self.store.head_header().state_root
+
+    def pending_nonce(self, address: bytes) -> int:
+        acct = self.store.account_state(self.head_state_root(), address)
+        nonce = acct.nonce if acct else 0
+        queue = self.mempool.by_sender.get(address, {})
+        while nonce in queue:
+            nonce += 1
+        return nonce
+
+    def submit_transaction(self, tx) -> bytes:
+        sender = tx.sender()
+        if sender is None:
+            raise InvalidTransaction("invalid signature")
+        if tx.chain_id is not None and tx.chain_id != self.config.chain_id:
+            raise InvalidTransaction("wrong chain id")
+        root = self.head_state_root()
+        acct = self.store.account_state(root, sender)
+        nonce = acct.nonce if acct else 0
+        balance = acct.balance if acct else 0
+        base_fee = self.store.head_header().base_fee_per_gas or 0
+        try:
+            return self.mempool.add_transaction(tx, nonce, balance, base_fee)
+        except MempoolError as e:
+            raise InvalidTransaction(str(e))
+
+    # ------------------------------------------------------------------
+    def produce_block(self, timestamp: int | None = None):
+        """Dev-mode block production: mempool -> payload -> import."""
+        with self.lock:
+            parent = self.store.head_header()
+            ts = timestamp or max(int(time.time()), parent.timestamp + 1)
+            header = create_payload_header(
+                parent, self.config, timestamp=ts, coinbase=self.coinbase)
+            base_fee = header.base_fee_per_gas or 0
+            root = parent.state_root
+
+            def get_nonce(sender):
+                acct = self.store.account_state(root, sender)
+                return acct.nonce if acct else 0
+
+            txs = self.mempool.pending(base_fee, get_nonce)
+            result = build_payload(self.chain, parent, header, txs, [],
+                                   mempool=self.mempool)
+            self.chain.add_block(result.block)
+            apply_fork_choice(self.store, result.block.hash)
+            for tx in result.block.body.transactions:
+                self.mempool.remove_transaction(tx.hash)
+            return result.block
+
+    def start_dev_producer(self, block_time: float = 1.0):
+        def loop():
+            while not self._stop.wait(block_time):
+                try:
+                    if len(self.mempool):
+                        self.produce_block()
+                except Exception as e:  # noqa: BLE001 — keep producing
+                    print(f"block production failed: {e}")
+
+        self._producer_thread = threading.Thread(target=loop, daemon=True)
+        self._producer_thread.start()
+
+    def stop(self):
+        self._stop.set()

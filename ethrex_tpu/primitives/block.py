@@ -125,8 +125,10 @@ class BlockBody:
     def to_fields(self) -> list:
         txs = []
         for tx in self.transactions:
-            enc = tx.encode_canonical()
-            txs.append(rlp.decode(enc) if tx.tx_type == 0 else enc)
+            if tx.tx_type == 0:
+                txs.append(tx._payload_fields(for_signing=False))
+            else:
+                txs.append(tx.encode_canonical())
         f = [txs, self.uncles]
         if self.withdrawals is not None:
             f.append([wd.to_fields() for wd in self.withdrawals])

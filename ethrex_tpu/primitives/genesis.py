@@ -68,12 +68,19 @@ class ChainConfig:
         return c
 
     def fork_at(self, block_number: int, timestamp: int) -> Fork:
+        """Resolve the active fork.
+
+        LIMITATION: for networks with a nonzero terminalTotalDifficulty and
+        no mergeNetsplitBlock (mainnet-style), the merge point cannot be
+        derived without total-difficulty tracking, so post-merge
+        pre-Shanghai blocks resolve to LONDON; set "mergeNetsplitBlock" in
+        the config to pin the merge block explicitly.  TTD==0 (dev nets) is
+        treated as merged from genesis.
+        """
         active = Fork.FRONTIER
         for fork, blk in self.block_forks.items():
             if block_number >= blk and fork > active:
                 active = fork
-        # PARIS activates via TTD; treat configured TTD==0 or a configured
-        # merge netsplit block as merged (dev/test networks)
         if (self.terminal_total_difficulty == 0
                 and Fork.PARIS > active):
             active = Fork.PARIS
@@ -155,9 +162,8 @@ class Genesis:
             h.excess_blob_gas = self.excess_blob_gas or 0
             h.parent_beacon_block_root = ZERO_HASH
         if fork >= Fork.PRAGUE:
-            from ..crypto.keccak import keccak256  # EIP-7685 empty hash is
-            import hashlib                          # sha256 of empty
-            h.requests_hash = hashlib.sha256(b"").digest()
+            import hashlib
+            h.requests_hash = hashlib.sha256(b"").digest()  # empty requests
         return h
 
 

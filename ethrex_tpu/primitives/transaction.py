@@ -68,9 +68,12 @@ class Transaction:
     r: int = 0
     s: int = 0
 
-    # cached
-    _sender: bytes | None = dataclasses.field(default=None, repr=False)
-    _hash: bytes | None = dataclasses.field(default=None, repr=False)
+    # caches (excluded from equality: two equal txs must compare equal
+    # regardless of which has computed hash/sender)
+    _sender: bytes | None = dataclasses.field(
+        default=None, repr=False, compare=False)
+    _hash: bytes | None = dataclasses.field(
+        default=None, repr=False, compare=False)
 
     # ---------------- encoding ----------------
     def _fee_fields(self):
@@ -223,20 +226,26 @@ class Transaction:
         self._hash = None
         return self
 
-    def recovery_id(self) -> int:
+    def recovery_id(self) -> int | None:
+        """None = consensus-invalid v encoding."""
         if self.tx_type != TYPE_LEGACY:
-            return self.v
+            return self.v if self.v in (0, 1) else None
         if self.v in (27, 28):
             return self.v - 27
-        return (self.v - 35) % 2
+        if self.v >= 35:
+            return (self.v - 35) % 2
+        return None
 
     def sender(self) -> bytes | None:
         if self._sender is None:
             # EIP-2: reject high-s for all included txs (homestead onward)
             if self.s > secp256k1.N // 2:
                 return None
+            rec = self.recovery_id()
+            if rec is None:
+                return None
             self._sender = secp256k1.recover_address(
-                self.signing_hash(), self.r, self.s, self.recovery_id()
+                self.signing_hash(), self.r, self.s, rec
             )
         return self._sender
 

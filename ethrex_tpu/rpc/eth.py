@@ -1,0 +1,291 @@
+"""eth_* / net_* / web3_* JSON-RPC handlers (parity target: the reference's
+crates/networking/rpc eth namespace; SURVEY.md §2.5)."""
+
+from __future__ import annotations
+
+from ..primitives.transaction import Transaction
+from ..evm.executor import InvalidTransaction
+from ..evm.vm import EVM, BlockEnv, Message
+from .serializers import (block_to_json, hb, hx, parse_bytes, parse_quantity,
+                          receipt_to_json, tx_to_json)
+
+
+class RpcError(Exception):
+    def __init__(self, code: int, message: str, data=None):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+        self.data = data
+
+
+class EthApi:
+    """Implements the eth namespace against a Node (node.py)."""
+
+    def __init__(self, node):
+        self.node = node
+
+    # ---------------- helpers ----------------
+    def _resolve_block(self, tag) -> "Block":
+        store = self.node.store
+        if tag is None:
+            tag = "latest"
+        if isinstance(tag, dict):
+            if "blockHash" in tag:
+                blk = store.get_block(parse_bytes(tag["blockHash"]))
+            else:
+                blk = store.get_canonical_block(
+                    parse_quantity(tag["blockNumber"]))
+        elif tag in ("latest", "pending", "safe", "finalized"):
+            key = {"latest": "head", "pending": "head",
+                   "safe": "safe", "finalized": "finalized"}[tag]
+            blk = store.get_block(store.meta[key])
+        elif tag == "earliest":
+            blk = store.get_canonical_block(0)
+        else:
+            blk = store.get_canonical_block(parse_quantity(tag))
+        if blk is None:
+            raise RpcError(-38001, "unknown block")
+        return blk
+
+    def _state_root(self, tag) -> bytes:
+        return self._resolve_block(tag).header.state_root
+
+    # ---------------- basic ----------------
+    def chain_id(self):
+        return hx(self.node.config.chain_id)
+
+    def block_number(self):
+        return hx(self.node.store.latest_number())
+
+    def get_balance(self, address, tag="latest"):
+        acct = self.node.store.account_state(
+            self._state_root(tag), parse_bytes(address))
+        return hx(acct.balance if acct else 0)
+
+    def get_transaction_count(self, address, tag="latest"):
+        if tag == "pending":
+            n = self.node.pending_nonce(parse_bytes(address))
+            return hx(n)
+        acct = self.node.store.account_state(
+            self._state_root(tag), parse_bytes(address))
+        return hx(acct.nonce if acct else 0)
+
+    def get_code(self, address, tag="latest"):
+        acct = self.node.store.account_state(
+            self._state_root(tag), parse_bytes(address))
+        if acct is None:
+            return "0x"
+        return hb(self.node.store.code.get(acct.code_hash, b""))
+
+    def get_storage_at(self, address, slot, tag="latest"):
+        value = self.node.store.storage_at(
+            self._state_root(tag), parse_bytes(address),
+            parse_quantity(slot))
+        return hb(value.to_bytes(32, "big"))
+
+    def gas_price(self):
+        head = self.node.store.head_header()
+        return hx((head.base_fee_per_gas or 0) + 10**9)
+
+    def max_priority_fee_per_gas(self):
+        return hx(10**9)
+
+    def syncing(self):
+        return False
+
+    # ---------------- blocks / txs ----------------
+    def get_block_by_number(self, tag, full=False):
+        try:
+            return block_to_json(self._resolve_block(tag), full)
+        except RpcError:
+            return None
+
+    def get_block_by_hash(self, block_hash, full=False):
+        blk = self.node.store.get_block(parse_bytes(block_hash))
+        return block_to_json(blk, full) if blk else None
+
+    def get_transaction_by_hash(self, tx_hash):
+        store = self.node.store
+        loc = store.tx_index.get(parse_bytes(tx_hash))
+        if loc is None:
+            tx = self.node.mempool.get_transaction(parse_bytes(tx_hash))
+            return tx_to_json(tx) if tx else None
+        blk = store.get_block(loc[0])
+        return tx_to_json(blk.body.transactions[loc[1]], loc[0],
+                          blk.header.number, loc[1])
+
+    def get_transaction_receipt(self, tx_hash):
+        store = self.node.store
+        loc = store.tx_index.get(parse_bytes(tx_hash))
+        if loc is None:
+            return None
+        blk = store.get_block(loc[0])
+        receipts = store.get_receipts(loc[0])
+        idx = loc[1]
+        rec = receipts[idx]
+        tx = blk.body.transactions[idx]
+        prev = receipts[idx - 1].cumulative_gas_used if idx else 0
+        log_base = sum(len(r.logs) for r in receipts[:idx])
+        eff = tx.effective_gas_price(blk.header.base_fee_per_gas or 0) or 0
+        return receipt_to_json(rec, tx, blk, idx, eff, prev, log_base)
+
+    def get_block_receipts(self, tag):
+        blk = self._resolve_block(tag)
+        receipts = self.node.store.get_receipts(blk.hash) or []
+        out = []
+        prev = 0
+        log_base = 0
+        for i, (rec, tx) in enumerate(zip(receipts, blk.body.transactions)):
+            eff = tx.effective_gas_price(blk.header.base_fee_per_gas or 0) or 0
+            out.append(receipt_to_json(rec, tx, blk, i, eff, prev, log_base))
+            prev = rec.cumulative_gas_used
+            log_base += len(rec.logs)
+        return out
+
+    def get_logs(self, flt):
+        from_b = self._resolve_block(flt.get("fromBlock", "latest"))
+        to_b = self._resolve_block(flt.get("toBlock", "latest"))
+        want_addr = flt.get("address")
+        if isinstance(want_addr, str):
+            want_addr = [want_addr]
+        want_addr = ({parse_bytes(a) for a in want_addr}
+                     if want_addr else None)
+        topics = flt.get("topics") or []
+        out = []
+        store = self.node.store
+        for num in range(from_b.header.number, to_b.header.number + 1):
+            blk = store.get_canonical_block(num)
+            if blk is None:
+                continue
+            receipts = store.get_receipts(blk.hash) or []
+            log_base = 0
+            for i, (rec, tx) in enumerate(
+                    zip(receipts, blk.body.transactions)):
+                for j, log in enumerate(rec.logs):
+                    if want_addr and log.address not in want_addr:
+                        continue
+                    if not _topics_match(log.topics, topics):
+                        continue
+                    out.append({
+                        "address": hb(log.address),
+                        "topics": [hb(t) for t in log.topics],
+                        "data": hb(log.data),
+                        "blockHash": hb(blk.hash),
+                        "blockNumber": hx(num),
+                        "transactionHash": hb(tx.hash),
+                        "transactionIndex": hx(i),
+                        "logIndex": hx(log_base + j),
+                        "removed": False,
+                    })
+                log_base += len(rec.logs)
+        return out
+
+    # ---------------- execution ----------------
+    def _call_msg(self, call, tag):
+        blk = self._resolve_block(tag)
+        header = blk.header
+        state = self.node.store.state_db(header.state_root)
+        state.begin_tx()
+        env = BlockEnv(
+            number=header.number, coinbase=header.coinbase,
+            timestamp=header.timestamp, gas_limit=header.gas_limit,
+            prev_randao=header.prev_randao,
+            base_fee=header.base_fee_per_gas or 0,
+            excess_blob_gas=header.excess_blob_gas or 0,
+        )
+        sender = parse_bytes(call.get("from", "0x" + "00" * 20))
+        to = parse_bytes(call["to"]) if call.get("to") else b""
+        gas = parse_quantity(call.get("gas", hex(header.gas_limit)))
+        value = parse_quantity(call.get("value", "0x0"))
+        data = parse_bytes(call.get("data") or call.get("input") or "0x")
+        evm = EVM(state, env, self.node.config, origin=sender)
+        if to:
+            code, code_src = evm.resolve_code(to)
+            msg = Message(caller=sender, to=to, code_address=code_src,
+                          value=value, data=data, gas=gas, code=code)
+            from ..evm import precompiles
+            if to in precompiles.PRECOMPILES:
+                msg.code_address = to
+        else:
+            msg = Message(caller=sender, to=b"", code_address=b"",
+                          value=value, data=b"", gas=gas, is_create=True,
+                          code=data)
+        return evm.execute_message(msg)
+
+    def call(self, call, tag="latest"):
+        ok, _, output = self._call_msg(call, tag)
+        if not ok:
+            raise RpcError(3, "execution reverted", hb(output))
+        return hb(output)
+
+    def estimate_gas(self, call, tag="latest"):
+        # binary search over gas like the reference's estimate flow
+        blk = self._resolve_block(tag)
+        hi = parse_quantity(call.get("gas", hex(blk.header.gas_limit)))
+        lo = 0  # frame-level gas; the tx intrinsic cost is added at the end
+        call = dict(call)
+
+        def ok_with(gas):
+            call["gas"] = hex(gas)
+            ok, _, _ = self._call_msg(call, tag)
+            return ok
+
+        if not ok_with(hi):
+            raise RpcError(3, "execution reverted")
+        while lo + 1 < hi:
+            mid = (lo + hi) // 2
+            if ok_with(mid):
+                hi = mid
+            else:
+                lo = mid
+        # add the intrinsic cost the message path doesn't charge
+        data = parse_bytes(call.get("data") or call.get("input") or "0x")
+        from ..evm import gas as G
+        intrinsic = G.TX_BASE + G.tx_data_cost(data)[0]
+        return hx(hi + intrinsic)
+
+    def send_raw_transaction(self, raw):
+        from ..primitives.rlp import RLPError
+
+        try:
+            tx = Transaction.decode_canonical(parse_bytes(raw))
+        except (RLPError, ValueError) as e:
+            raise RpcError(-32602, f"invalid raw transaction: {e}")
+        try:
+            self.node.submit_transaction(tx)
+        except InvalidTransaction as e:
+            raise RpcError(-32000, str(e))
+        return hb(tx.hash)
+
+    def fee_history(self, count, newest, percentiles=None):
+        count = parse_quantity(count)
+        newest_b = self._resolve_block(newest)
+        base_fees = []
+        ratios = []
+        start = max(0, newest_b.header.number - count + 1)
+        for num in range(start, newest_b.header.number + 1):
+            blk = self.node.store.get_canonical_block(num)
+            base_fees.append(hx(blk.header.base_fee_per_gas or 0))
+            ratios.append(blk.header.gas_used / blk.header.gas_limit
+                          if blk.header.gas_limit else 0.0)
+        from ..blockchain.blockchain import next_base_fee
+        base_fees.append(hx(next_base_fee(newest_b.header)))
+        return {
+            "oldestBlock": hx(start),
+            "baseFeePerGas": base_fees,
+            "gasUsedRatio": ratios,
+            "reward": [[hx(10**9)] * len(percentiles or [])
+                       for _ in range(len(ratios))],
+        }
+
+
+def _topics_match(log_topics, want) -> bool:
+    for i, t in enumerate(want):
+        if t is None:
+            continue
+        if i >= len(log_topics):
+            return False
+        options = t if isinstance(t, list) else [t]
+        if "0x" + log_topics[i].hex() not in options:
+            return False
+    return True

@@ -1,0 +1,156 @@
+"""JSON serialization of chain objects (RPC wire format)."""
+
+from __future__ import annotations
+
+from ..primitives.block import Block, BlockHeader
+from ..primitives.receipt import Receipt
+from ..primitives.transaction import Transaction
+
+
+def hx(v: int) -> str:
+    return hex(v)
+
+
+def hb(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def parse_quantity(v) -> int:
+    if isinstance(v, int):
+        return v
+    return int(v, 16)
+
+
+def parse_bytes(v: str) -> bytes:
+    return bytes.fromhex(v.removeprefix("0x"))
+
+
+def header_to_json(h: BlockHeader, block_hash: bytes | None = None) -> dict:
+    out = {
+        "parentHash": hb(h.parent_hash),
+        "sha3Uncles": hb(h.uncles_hash),
+        "miner": hb(h.coinbase),
+        "stateRoot": hb(h.state_root),
+        "transactionsRoot": hb(h.tx_root),
+        "receiptsRoot": hb(h.receipts_root),
+        "logsBloom": hb(h.bloom),
+        "difficulty": hx(h.difficulty),
+        "number": hx(h.number),
+        "gasLimit": hx(h.gas_limit),
+        "gasUsed": hx(h.gas_used),
+        "timestamp": hx(h.timestamp),
+        "extraData": hb(h.extra_data),
+        "mixHash": hb(h.prev_randao),
+        "nonce": hb(h.nonce),
+        "hash": hb(block_hash or h.hash),
+    }
+    if h.base_fee_per_gas is not None:
+        out["baseFeePerGas"] = hx(h.base_fee_per_gas)
+    if h.withdrawals_root is not None:
+        out["withdrawalsRoot"] = hb(h.withdrawals_root)
+    if h.blob_gas_used is not None:
+        out["blobGasUsed"] = hx(h.blob_gas_used)
+    if h.excess_blob_gas is not None:
+        out["excessBlobGas"] = hx(h.excess_blob_gas)
+    if h.parent_beacon_block_root is not None:
+        out["parentBeaconBlockRoot"] = hb(h.parent_beacon_block_root)
+    if h.requests_hash is not None:
+        out["requestsHash"] = hb(h.requests_hash)
+    return out
+
+
+def tx_to_json(tx: Transaction, block_hash=None, block_number=None,
+               index=None) -> dict:
+    out = {
+        "type": hx(tx.tx_type),
+        "nonce": hx(tx.nonce),
+        "gas": hx(tx.gas_limit),
+        "value": hx(tx.value),
+        "input": hb(tx.data),
+        "to": hb(tx.to) if tx.to else None,
+        "hash": hb(tx.hash),
+        "from": hb(tx.sender() or b"\x00" * 20),
+        "v": hx(tx.v), "r": hx(tx.r), "s": hx(tx.s),
+    }
+    if tx.chain_id is not None:
+        out["chainId"] = hx(tx.chain_id)
+    if tx.tx_type in (0, 1):
+        out["gasPrice"] = hx(tx.gas_price)
+    else:
+        out["maxFeePerGas"] = hx(tx.max_fee_per_gas)
+        out["maxPriorityFeePerGas"] = hx(tx.max_priority_fee_per_gas)
+    if tx.tx_type >= 1:
+        out["accessList"] = [
+            {"address": hb(a), "storageKeys":
+             [hb(s.to_bytes(32, "big")) for s in slots]}
+            for a, slots in tx.access_list]
+    if tx.tx_type == 3:
+        out["maxFeePerBlobGas"] = hx(tx.max_fee_per_blob_gas)
+        out["blobVersionedHashes"] = [hb(h) for h in tx.blob_versioned_hashes]
+    if block_hash is not None:
+        out["blockHash"] = hb(block_hash)
+        out["blockNumber"] = hx(block_number)
+        out["transactionIndex"] = hx(index)
+    return out
+
+
+def block_to_json(block: Block, full_txs: bool = False) -> dict:
+    h = block.hash
+    out = header_to_json(block.header, h)
+    if full_txs:
+        out["transactions"] = [
+            tx_to_json(tx, h, block.header.number, i)
+            for i, tx in enumerate(block.body.transactions)]
+    else:
+        out["transactions"] = [hb(tx.hash)
+                               for tx in block.body.transactions]
+    out["uncles"] = []
+    if block.body.withdrawals is not None:
+        out["withdrawals"] = [{
+            "index": hx(w.index), "validatorIndex": hx(w.validator_index),
+            "address": hb(w.address), "amount": hx(w.amount),
+        } for w in block.body.withdrawals]
+    out["size"] = hx(len(block.encode()))
+    return out
+
+
+def receipt_to_json(rec: Receipt, tx: Transaction, block: Block,
+                    index: int, gas_price: int, prev_cumulative: int = 0,
+                    log_index_base: int = 0) -> dict:
+    h = block.hash
+    logs = []
+    contract = None
+    if tx.is_create:
+        from ..crypto.keccak import keccak256
+        from ..primitives import rlp as _rlp
+        contract = hb(keccak256(
+            _rlp.encode([tx.sender() or b"\x00" * 20, tx.nonce]))[12:])
+    return_obj = {
+        "transactionHash": hb(tx.hash),
+        "transactionIndex": hx(index),
+        "blockHash": hb(h),
+        "blockNumber": hx(block.header.number),
+        "from": hb(tx.sender() or b"\x00" * 20),
+        "to": hb(tx.to) if tx.to else None,
+        "cumulativeGasUsed": hx(rec.cumulative_gas_used),
+        "gasUsed": hx(rec.cumulative_gas_used - prev_cumulative),
+        "contractAddress": contract,
+        "logs": logs,
+        "logsBloom": hb(rec.bloom),
+        "type": hx(rec.tx_type),
+        "status": "0x1" if rec.succeeded else "0x0",
+        "effectiveGasPrice": hx(gas_price),
+    }
+    for i, log in enumerate(rec.logs):
+        logs.append({
+            "address": hb(log.address),
+            "topics": [hb(t) for t in log.topics],
+            "data": hb(log.data),
+            "blockHash": hb(h),
+            "blockNumber": hx(block.header.number),
+            "transactionHash": hb(tx.hash),
+            "transactionIndex": hx(index),
+            "logIndex": hx(log_index_base + i),
+            "removed": False,
+        })
+    return return_obj

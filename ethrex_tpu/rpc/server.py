@@ -1,0 +1,135 @@
+"""JSON-RPC HTTP server + method routing (parity target: the reference's
+crates/networking/rpc/rpc.rs start_api; threaded stdlib HTTP server is the
+round-1 transport, the C++ server replaces it behind the same handlers)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .eth import EthApi, RpcError
+
+
+class RpcServer:
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 8545):
+        self.node = node
+        self.eth = EthApi(node)
+        self.host = host
+        self.port = port
+        self._httpd: ThreadingHTTPServer | None = None
+        self.methods = self._build_methods()
+
+    def _build_methods(self):
+        e = self.eth
+        node = self.node
+        return {
+            "eth_chainId": lambda: e.chain_id(),
+            "eth_blockNumber": lambda: e.block_number(),
+            "eth_getBalance": e.get_balance,
+            "eth_getTransactionCount": e.get_transaction_count,
+            "eth_getCode": e.get_code,
+            "eth_getStorageAt": e.get_storage_at,
+            "eth_gasPrice": lambda: e.gas_price(),
+            "eth_maxPriorityFeePerGas": lambda: e.max_priority_fee_per_gas(),
+            "eth_syncing": lambda: e.syncing(),
+            "eth_getBlockByNumber": e.get_block_by_number,
+            "eth_getBlockByHash": e.get_block_by_hash,
+            "eth_getTransactionByHash": e.get_transaction_by_hash,
+            "eth_getTransactionReceipt": e.get_transaction_receipt,
+            "eth_getBlockReceipts": e.get_block_receipts,
+            "eth_getLogs": e.get_logs,
+            "eth_call": e.call,
+            "eth_estimateGas": e.estimate_gas,
+            "eth_sendRawTransaction": e.send_raw_transaction,
+            "eth_feeHistory": e.fee_history,
+            "net_version": lambda: str(node.config.chain_id),
+            "net_listening": lambda: True,
+            "net_peerCount": lambda: "0x0",
+            "web3_clientVersion": lambda: "ethrex-tpu/0.1.0",
+            "txpool_content": lambda: _txpool_content(node),
+            "ethrex_produceBlock": lambda: _produce(node),
+        }
+
+    def handle(self, request: dict):
+        if "method" not in request:
+            return _err(None, -32600, "invalid request")
+        rid = request.get("id")
+        method = request["method"]
+        params = request.get("params") or []
+        fn = self.methods.get(method)
+        if fn is None:
+            return _err(rid, -32601, f"method {method} not found")
+        try:
+            result = fn(*params)
+            return {"jsonrpc": "2.0", "id": rid, "result": result}
+        except RpcError as ex:
+            return _err(rid, ex.code, ex.message, ex.data)
+        except TypeError as ex:
+            return _err(rid, -32602, f"invalid params: {ex}")
+        except Exception as ex:  # noqa: BLE001 — RPC boundary
+            return _err(rid, -32603, f"internal error: {ex}")
+
+    # ------------------------------------------------------------------
+    def start(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                try:
+                    req = json.loads(body)
+                except json.JSONDecodeError:
+                    resp = _err(None, -32700, "parse error")
+                else:
+                    if isinstance(req, list):
+                        resp = [server.handle(r) for r in req]
+                    else:
+                        resp = server.handle(req)
+                data = json.dumps(resp).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        thread = threading.Thread(target=self._httpd.serve_forever,
+                                  daemon=True)
+        thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+
+def _err(rid, code, message, data=None):
+    error = {"code": code, "message": message}
+    if data is not None:
+        error["data"] = data
+    return {"jsonrpc": "2.0", "id": rid, "error": error}
+
+
+def _txpool_content(node):
+    from .serializers import tx_to_json
+    content = node.mempool.content()
+    return {
+        "pending": {
+            "0x" + sender.hex(): {
+                str(nonce): tx_to_json(tx) for nonce, tx in queue.items()
+            } for sender, queue in content.items()
+        },
+        "queued": {},
+    }
+
+
+def _produce(node):
+    block = node.produce_block()
+    return "0x" + block.hash.hex()

@@ -1,0 +1,231 @@
+"""Store: chain + state persistence facade (parity with the reference's
+crates/storage/store.rs over StorageBackend traits; in-memory backend first,
+the RocksDB-style persistent backend slots in behind the same interface).
+
+Layout mirrors the reference's tables (SURVEY.md §2.2): headers, bodies,
+receipts, canonical index, trie nodes (one shared node db for the account
+trie and all storage tries, keyed by node hash), code by hash.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+from ..crypto.keccak import keccak256
+from ..primitives import rlp
+from ..primitives.account import AccountState, EMPTY_CODE_HASH, EMPTY_TRIE_ROOT
+from ..primitives.block import Block, BlockHeader
+from ..primitives.genesis import Genesis
+from ..evm.db import StateDB, VmDatabase
+from ..trie.trie import Trie
+
+
+class StorageBackend:
+    """KV-table backend interface (InMemory now; persistent later)."""
+
+    def table(self, name: str) -> dict:
+        raise NotImplementedError
+
+
+class InMemoryBackend(StorageBackend):
+    def __init__(self):
+        self._tables: dict[str, dict] = {}
+
+    def table(self, name: str) -> dict:
+        return self._tables.setdefault(name, {})
+
+
+class Store:
+    def __init__(self, backend: StorageBackend | None = None):
+        self.backend = backend or InMemoryBackend()
+        b = self.backend
+        self.headers = b.table("headers")          # hash -> BlockHeader
+        self.bodies = b.table("bodies")            # hash -> BlockBody
+        self.receipts = b.table("receipts")        # hash -> list[Receipt]
+        self.canonical = b.table("canonical")      # number -> hash
+        self.tx_index = b.table("tx_index")        # tx_hash -> (blk_hash, idx)
+        self.nodes = b.table("trie_nodes")         # node_hash -> encoded
+        self.code = b.table("code")                # code_hash -> bytes
+        self.meta = b.table("meta")                # misc: head, genesis...
+        self.lock = threading.RLock()
+        self.genesis_config = None
+
+    # ---------------- genesis ----------------
+    def init_genesis(self, genesis: Genesis) -> BlockHeader:
+        with self.lock:
+            self.genesis_config = genesis.config
+            state = Trie.from_nodes(EMPTY_TRIE_ROOT, self.nodes, share=True)
+            for addr, acct in genesis.alloc.items():
+                storage_root = EMPTY_TRIE_ROOT
+                if acct.storage:
+                    st = Trie.from_nodes(EMPTY_TRIE_ROOT, self.nodes,
+                                         share=True)
+                    for slot, value in acct.storage.items():
+                        if value:
+                            st.insert(keccak256(slot.to_bytes(32, "big")),
+                                      rlp.encode(value))
+                    storage_root = st.commit()
+                if acct.code:
+                    self.code[acct.state.code_hash] = acct.code
+                st8 = dataclasses.replace(acct.state,
+                                          storage_root=storage_root)
+                state.insert(keccak256(addr), st8.encode())
+            root = state.commit()
+            header = genesis.header(root)
+            block_hash = header.hash
+            self.headers[block_hash] = header
+            from ..primitives.block import BlockBody
+            self.bodies[block_hash] = BlockBody(
+                withdrawals=[] if header.withdrawals_root is not None
+                else None)
+            self.receipts[block_hash] = []
+            self.canonical[0] = block_hash
+            self.meta["head"] = block_hash
+            self.meta["safe"] = block_hash
+            self.meta["finalized"] = block_hash
+            self.meta["genesis"] = block_hash
+            return header
+
+    # ---------------- chain data ----------------
+    def add_block(self, block: Block, receipts: list):
+        with self.lock:
+            h = block.hash
+            self.headers[h] = block.header
+            self.bodies[h] = block.body
+            self.receipts[h] = receipts
+            for i, tx in enumerate(block.body.transactions):
+                self.tx_index[tx.hash] = (h, i)
+
+    def set_canonical(self, number: int, block_hash: bytes):
+        with self.lock:
+            self.canonical[number] = block_hash
+
+    def set_head(self, block_hash: bytes):
+        with self.lock:
+            self.meta["head"] = block_hash
+
+    def head_header(self) -> BlockHeader:
+        return self.headers[self.meta["head"]]
+
+    def get_header(self, block_hash: bytes) -> BlockHeader | None:
+        return self.headers.get(block_hash)
+
+    def get_body(self, block_hash: bytes):
+        return self.bodies.get(block_hash)
+
+    def get_block(self, block_hash: bytes) -> Block | None:
+        h = self.headers.get(block_hash)
+        b = self.bodies.get(block_hash)
+        if h is None or b is None:
+            return None
+        return Block(h, b)
+
+    def canonical_hash(self, number: int) -> bytes | None:
+        return self.canonical.get(number)
+
+    def get_canonical_block(self, number: int) -> Block | None:
+        h = self.canonical.get(number)
+        return self.get_block(h) if h else None
+
+    def get_receipts(self, block_hash: bytes):
+        return self.receipts.get(block_hash)
+
+    def latest_number(self) -> int:
+        return self.head_header().number
+
+    # ---------------- state access ----------------
+    def state_source(self, state_root: bytes) -> "StoreSource":
+        return StoreSource(self, state_root)
+
+    def state_db(self, state_root: bytes) -> StateDB:
+        return StateDB(self.state_source(state_root))
+
+    def account_state(self, state_root: bytes,
+                      address: bytes) -> AccountState | None:
+        trie = Trie.from_nodes(state_root, self.nodes, share=True)
+        raw = trie.get(keccak256(address))
+        return AccountState.decode(raw) if raw else None
+
+    def storage_at(self, state_root: bytes, address: bytes,
+                   slot: int) -> int:
+        acct = self.account_state(state_root, address)
+        if acct is None or acct.storage_root == EMPTY_TRIE_ROOT:
+            return 0
+        st = Trie.from_nodes(acct.storage_root, self.nodes, share=True)
+        raw = st.get(keccak256(slot.to_bytes(32, "big")))
+        return rlp.decode_int(rlp.decode(raw)) if raw else 0
+
+    # ---------------- state write-back ----------------
+    def apply_account_updates(self, parent_root: bytes,
+                              state_db: StateDB) -> bytes:
+        """Write dirty accounts/slots from an executed block into the tries;
+        returns the new state root (the merkleize step of the reference's
+        add_block pipeline, blockchain.rs apply_account_updates_batch)."""
+        with self.lock:
+            trie = Trie.from_nodes(parent_root, self.nodes, share=True)
+            for addr in sorted(state_db.dirty_accounts):
+                cached = state_db.accounts[addr]
+                key = keccak256(addr)
+                if not cached.exists or cached.is_empty:
+                    # EIP-161 state clearing / destroyed accounts
+                    trie.remove(key)
+                    continue
+                raw = trie.get(key)
+                prev = AccountState.decode(raw) if raw else AccountState()
+                storage_root = (EMPTY_TRIE_ROOT if cached.storage_cleared
+                                else prev.storage_root)
+                slots = state_db.dirty_storage.get(addr, ())
+                if slots or cached.storage_cleared:
+                    st = Trie.from_nodes(storage_root, self.nodes, share=True)
+                    for slot in sorted(slots):
+                        value = cached.storage.get(slot, 0)
+                        skey = keccak256(slot.to_bytes(32, "big"))
+                        if value:
+                            st.insert(skey, rlp.encode(value))
+                        else:
+                            st.remove(skey)
+                    storage_root = st.commit()
+                if (cached.code is not None
+                        and cached.code_hash != EMPTY_CODE_HASH):
+                    self.code[cached.code_hash] = cached.code
+                new_state = AccountState(
+                    nonce=cached.nonce, balance=cached.balance,
+                    storage_root=storage_root, code_hash=cached.code_hash)
+                trie.insert(key, new_state.encode())
+            return trie.commit()
+
+
+class StoreSource(VmDatabase):
+    """VmDatabase over the Store's tries at a fixed state root."""
+
+    def __init__(self, store: Store, state_root: bytes):
+        self.store = store
+        self.state_root = state_root
+        self._trie = Trie.from_nodes(state_root, store.nodes, share=True)
+        self._storage_tries: dict[bytes, Trie] = {}
+
+    def get_account_state(self, address: bytes):
+        raw = self._trie.get(keccak256(address))
+        return AccountState.decode(raw) if raw else None
+
+    def get_code(self, code_hash: bytes) -> bytes:
+        if code_hash == EMPTY_CODE_HASH:
+            return b""
+        return self.store.code.get(code_hash, b"")
+
+    def get_storage(self, address: bytes, slot: int) -> int:
+        st = self._storage_tries.get(address)
+        if st is None:
+            acct = self.get_account_state(address)
+            if acct is None:
+                return 0
+            st = Trie.from_nodes(acct.storage_root, self.store.nodes,
+                                 share=True)
+            self._storage_tries[address] = st
+        raw = st.get(keccak256(slot.to_bytes(32, "big")))
+        return rlp.decode_int(rlp.decode(raw)) if raw else 0
+
+    def get_block_hash(self, number: int) -> bytes:
+        h = self.store.canonical_hash(number)
+        return h if h else b"\x00" * 32

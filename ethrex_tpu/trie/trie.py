@@ -71,9 +71,12 @@ class Trie:
     # construction from a node set (witness / proof)
     # ------------------------------------------------------------------
     @classmethod
-    def from_nodes(cls, root_hash: bytes, nodes: list[bytes] | dict) -> "Trie":
+    def from_nodes(cls, root_hash: bytes, nodes: list[bytes] | dict,
+                   share: bool = False) -> "Trie":
+        """share=True uses the given dict as the live backing store (the
+        node database of a Store) instead of copying it."""
         if isinstance(nodes, dict):
-            store = dict(nodes)
+            store = nodes if share else dict(nodes)
         else:
             store = {keccak256(n): bytes(n) for n in nodes}
         t = cls(store)
@@ -189,7 +192,7 @@ class Trie:
         if kind == "leaf":
             if node[1] == path:
                 return ("leaf", path, value)
-            return self._split(node[1], node[2], path, value, leaf=True)
+            return self._split(node[1], node[2], path, value)
         if kind == "ext":
             epath = node[1]
             common = _common_prefix(epath, path)
@@ -201,10 +204,9 @@ class Trie:
             ext_rest = epath[common + 1:]
             sub = node[2] if not ext_rest else ("ext", ext_rest, node[2])
             children[epath[common]] = sub
-            branch = ("branch", children, b"")
             if common < len(path):
                 children[path[common]] = ("leaf", path[common + 1:], value)
-                bvalue = b""
+                branch = ("branch", children, b"")
             else:
                 branch = ("branch", children, value)
             if common:
@@ -219,7 +221,7 @@ class Trie:
         children[idx] = self._insert(child, path[1:], value)
         return ("branch", children, bval)
 
-    def _split(self, lpath, lvalue, path, value, leaf: bool):
+    def _split(self, lpath, lvalue, path, value):
         common = _common_prefix(lpath, path)
         children = [None] * 16
         bval = b""

@@ -1,0 +1,177 @@
+"""End-to-end chain tests: genesis -> build payload -> add_block -> state."""
+
+import pytest
+
+from ethrex_tpu.crypto import secp256k1
+from ethrex_tpu.primitives.block import Withdrawal
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.primitives.transaction import TYPE_DYNAMIC_FEE, Transaction
+from ethrex_tpu.blockchain.blockchain import Blockchain, InvalidBlock
+from ethrex_tpu.blockchain.fork_choice import apply_fork_choice
+from ethrex_tpu.blockchain.mempool import Mempool, MempoolError
+from ethrex_tpu.blockchain.payload import build_payload, create_payload_header
+from ethrex_tpu.storage.store import Store
+
+SECRET = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
+SENDER = secp256k1.pubkey_to_address(secp256k1.pubkey_from_secret(SECRET))
+COINBASE = bytes.fromhex("ee" * 20)
+OTHER = bytes.fromhex("aa" * 20)
+
+GENESIS_JSON = {
+    "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+               "shanghaiTime": 0, "cancunTime": 0},
+    "alloc": {"0x" + SENDER.hex(): {"balance": hex(10**21)}},
+    "gasLimit": hex(30_000_000),
+    "baseFeePerGas": hex(7),
+    "timestamp": "0x0",
+}
+
+
+def _setup():
+    store = Store()
+    genesis = Genesis.from_json(GENESIS_JSON)
+    gh = store.init_genesis(genesis)
+    chain = Blockchain(store, genesis.config)
+    return store, chain, gh
+
+
+def _tx(nonce, to=OTHER, value=1000, gas_limit=21000, prio=2):
+    return Transaction(
+        tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=nonce,
+        max_priority_fee_per_gas=prio, max_fee_per_gas=10**10,
+        gas_limit=gas_limit, to=to, value=value,
+    ).sign(SECRET)
+
+
+def _build_and_add(chain, store, parent, txs, timestamp=None,
+                   withdrawals=None):
+    header = create_payload_header(
+        parent, chain.config, timestamp=timestamp or parent.timestamp + 12,
+        coinbase=COINBASE)
+    result = build_payload(chain, parent, header, txs, withdrawals or [])
+    # re-import through the full validation path on a fresh state
+    chain.add_block(result.block)
+    apply_fork_choice(store, result.block.hash)
+    return result.block
+
+
+def test_genesis_state():
+    store, chain, gh = _setup()
+    assert store.latest_number() == 0
+    acct = store.account_state(gh.state_root, SENDER)
+    assert acct.balance == 10**21
+
+
+def test_single_block_transfers():
+    store, chain, gh = _setup()
+    txs = [_tx(i, value=1000 + i) for i in range(5)]
+    block = _build_and_add(chain, store, gh, txs)
+    assert store.latest_number() == 1
+    assert block.header.gas_used == 21000 * 5
+    root = block.header.state_root
+    assert store.account_state(root, OTHER).balance == sum(
+        1000 + i for i in range(5))
+    assert store.account_state(root, SENDER).nonce == 5
+    # coinbase collected tips
+    assert store.account_state(root, COINBASE).balance == 21000 * 5 * 2
+    # receipts stored
+    receipts = store.get_receipts(block.hash)
+    assert len(receipts) == 5 and all(r.succeeded for r in receipts)
+
+
+def test_multi_block_chain_and_contract():
+    store, chain, gh = _setup()
+    # deploy a counter: runtime increments slot 0 on every call
+    # runtime: SLOAD(0); PUSH1 1; ADD; PUSH0; SSTORE; STOP
+    runtime = bytes.fromhex("5f54600101 5f55 00".replace(" ", ""))
+    initcode = bytes.fromhex(
+        "67" + runtime.hex().ljust(16, "0") + "5f5260086018f3")
+    deploy = Transaction(
+        tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=0,
+        max_priority_fee_per_gas=2, max_fee_per_gas=10**10,
+        gas_limit=200_000, to=b"", value=0, data=initcode,
+    ).sign(SECRET)
+    b1 = _build_and_add(chain, store, gh, [deploy])
+    receipts = store.get_receipts(b1.hash)
+    assert receipts[0].succeeded
+    # created address = keccak(rlp([sender, 0]))[12:]
+    from ethrex_tpu.crypto.keccak import keccak256
+    from ethrex_tpu.primitives import rlp as _rlp
+    created = keccak256(_rlp.encode([SENDER, 0]))[12:]
+    # call it twice over two blocks
+    call1 = _tx(1, to=created, value=0, gas_limit=100_000)
+    b2 = _build_and_add(chain, store, b1.header, [call1])
+    call2 = _tx(2, to=created, value=0, gas_limit=100_000)
+    b3 = _build_and_add(chain, store, b2.header, [call2])
+    assert store.storage_at(b3.header.state_root, created, 0) == 2
+    assert store.storage_at(b2.header.state_root, created, 0) == 1
+
+
+def test_withdrawals_credit_balance():
+    store, chain, gh = _setup()
+    wds = [Withdrawal(index=0, validator_index=1, address=OTHER, amount=3)]
+    block = _build_and_add(chain, store, gh, [], withdrawals=wds)
+    assert store.account_state(
+        block.header.state_root, OTHER).balance == 3 * 10**9
+
+
+def test_bad_state_root_rejected():
+    store, chain, gh = _setup()
+    header = create_payload_header(
+        gh, chain.config, timestamp=12, coinbase=COINBASE)
+    result = build_payload(chain, gh, header, [_tx(0)], [])
+    import dataclasses
+    bad = dataclasses.replace(result.block.header,
+                              state_root=b"\x11" * 32)
+    from ethrex_tpu.primitives.block import Block
+    with pytest.raises(InvalidBlock, match="state root"):
+        chain.add_block(Block(bad, result.block.body))
+
+
+def test_bad_base_fee_rejected():
+    store, chain, gh = _setup()
+    header = create_payload_header(
+        gh, chain.config, timestamp=12, coinbase=COINBASE)
+    result = build_payload(chain, gh, header, [], [])
+    import dataclasses
+    bad = dataclasses.replace(result.block.header, base_fee_per_gas=999)
+    from ethrex_tpu.primitives.block import Block
+    with pytest.raises(InvalidBlock, match="base fee"):
+        chain.add_block(Block(bad, result.block.body))
+
+
+def test_fork_choice_reorg():
+    store, chain, gh = _setup()
+    b1 = _build_and_add(chain, store, gh, [_tx(0)])
+    # competing block at height 1 (different timestamp)
+    header = create_payload_header(
+        gh, chain.config, timestamp=gh.timestamp + 24, coinbase=OTHER)
+    alt = build_payload(chain, gh, header, [], []).block
+    chain.add_block(alt)
+    # still canonical: b1
+    assert store.canonical_hash(1) == b1.hash
+    # reorg to alt
+    apply_fork_choice(store, alt.hash)
+    assert store.canonical_hash(1) == alt.hash
+    assert store.head_header().hash == alt.hash
+
+
+def test_mempool_ordering_and_replacement():
+    pool = Mempool()
+    t0 = _tx(0, prio=1)
+    t1 = _tx(1, prio=5)
+    pool.add_transaction(t0, 0, 10**21, 7)
+    pool.add_transaction(t1, 0, 10**21, 7)
+    pending = pool.pending(7, lambda s: 0)
+    # nonce order must win over tip order within a sender
+    assert [t.nonce for t in pending] == [0, 1]
+    # replacement requires a 10% bump
+    cheap = _tx(0, prio=1)
+    with pytest.raises(MempoolError):
+        pool.add_transaction(
+            Transaction(tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=0,
+                        max_priority_fee_per_gas=1,
+                        max_fee_per_gas=10**10, gas_limit=21000, to=OTHER,
+                        value=0).sign(SECRET),
+            0, 10**21, 7)
+    assert len(pool) == 2

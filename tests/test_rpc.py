@@ -1,0 +1,115 @@
+"""JSON-RPC integration: real HTTP server, real requests (the reference's
+test/tests/rpc pattern with the in-memory store as the universal fake)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from ethrex_tpu.crypto import secp256k1
+from ethrex_tpu.node import Node
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.primitives.transaction import TYPE_DYNAMIC_FEE, Transaction
+from ethrex_tpu.rpc.server import RpcServer
+
+SECRET = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
+SENDER = secp256k1.pubkey_to_address(secp256k1.pubkey_from_secret(SECRET))
+OTHER = bytes.fromhex("aa" * 20)
+
+GENESIS = {
+    "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+               "shanghaiTime": 0, "cancunTime": 0},
+    "alloc": {"0x" + SENDER.hex(): {"balance": hex(10**21)}},
+    "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7", "timestamp": "0x0",
+}
+
+
+@pytest.fixture(scope="module")
+def rpc():
+    node = Node(Genesis.from_json(GENESIS))
+    server = RpcServer(node, port=0).start()
+    url = f"http://127.0.0.1:{server.port}"
+
+    def call(method, *params):
+        payload = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                              "params": list(params)}).encode()
+        req = urllib.request.Request(
+            url, data=payload, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    yield call, node
+    server.stop()
+    node.stop()
+
+
+def test_basic_queries(rpc):
+    call, node = rpc
+    assert call("eth_chainId")["result"] == "0x539"
+    assert call("eth_blockNumber")["result"] == "0x0"
+    bal = call("eth_getBalance", "0x" + SENDER.hex(), "latest")["result"]
+    assert int(bal, 16) == 10**21
+    blk = call("eth_getBlockByNumber", "0x0", False)["result"]
+    assert blk["number"] == "0x0"
+    assert call("net_version")["result"] == "1337"
+    assert call("web3_clientVersion")["result"].startswith("ethrex-tpu")
+
+
+def test_send_tx_produce_block_receipt(rpc):
+    call, node = rpc
+    tx = Transaction(
+        tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=0,
+        max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+        gas_limit=21000, to=OTHER, value=4242,
+    ).sign(SECRET)
+    resp = call("eth_sendRawTransaction",
+                "0x" + tx.encode_canonical().hex())
+    assert resp["result"] == "0x" + tx.hash.hex()
+    # pending nonce reflects the queued tx
+    assert call("eth_getTransactionCount", "0x" + SENDER.hex(),
+                "pending")["result"] == "0x1"
+    # mine it
+    call("ethrex_produceBlock")
+    assert call("eth_blockNumber")["result"] == "0x1"
+    rec = call("eth_getTransactionReceipt",
+               "0x" + tx.hash.hex())["result"]
+    assert rec["status"] == "0x1"
+    assert int(rec["gasUsed"], 16) == 21000
+    assert int(call("eth_getBalance", "0x" + OTHER.hex(),
+                    "latest")["result"], 16) == 4242
+    full = call("eth_getBlockByNumber", "0x1", True)["result"]
+    assert full["transactions"][0]["hash"] == "0x" + tx.hash.hex()
+
+
+def test_eth_call_and_estimate(rpc):
+    call, node = rpc
+    # deploy a contract returning 7: runtime 60075f5260205ff3
+    runtime = "60075f5260205ff3"
+    initcode = "67" + runtime + "5f5260086018f3"
+    nonce = int(call("eth_getTransactionCount", "0x" + SENDER.hex(),
+                     "latest")["result"], 16)
+    tx = Transaction(
+        tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=nonce,
+        max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+        gas_limit=200_000, to=b"", data=bytes.fromhex(initcode),
+    ).sign(SECRET)
+    call("eth_sendRawTransaction", "0x" + tx.encode_canonical().hex())
+    call("ethrex_produceBlock")
+    rec = call("eth_getTransactionReceipt", "0x" + tx.hash.hex())["result"]
+    assert rec["status"] == "0x1"
+    addr = rec["contractAddress"]
+    assert call("eth_getCode", addr, "latest")["result"] == "0x" + runtime
+    out = call("eth_call", {"to": addr}, "latest")["result"]
+    assert int(out, 16) == 7
+    est = call("eth_estimateGas", {"to": addr}, "latest")["result"]
+    assert 21000 <= int(est, 16) < 30000
+
+
+def test_error_paths(rpc):
+    call, node = rpc
+    assert "error" in call("eth_fooBar")
+    assert call("eth_fooBar")["error"]["code"] == -32601
+    # bad raw tx
+    assert "error" in call("eth_sendRawTransaction", "0x00ff")
+    # unknown block
+    assert call("eth_getBlockByNumber", "0x999", False)["result"] is None
