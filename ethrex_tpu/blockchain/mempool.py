@@ -26,6 +26,10 @@ class Mempool:
     def add_transaction(self, tx: Transaction, sender_nonce: int,
                         sender_balance: int, base_fee: int,
                         blobs_bundle=None) -> bytes:
+        from ..primitives.transaction import TYPE_PRIVILEGED
+
+        if tx.tx_type == TYPE_PRIVILEGED:
+            raise MempoolError("privileged txs bypass the mempool")
         sender = tx.sender()
         if sender is None:
             raise MempoolError("invalid signature")

@@ -8,6 +8,7 @@ import dataclasses
 
 from ..primitives.block import (Block, BlockBody, BlockHeader, ZERO_HASH,
                                 ZERO_NONCE)
+from ..primitives.transaction import TYPE_PRIVILEGED
 from ..primitives.genesis import Fork
 from ..primitives.receipt import Receipt, logs_bloom
 from ..evm import gas as G
@@ -91,8 +92,9 @@ def build_payload(chain: Blockchain, parent: BlockHeader,
             continue
         gas_used += result.gas_used
         blob_gas += tx_blob_gas
-        tip = (tx.effective_gas_price(env.base_fee) or 0) - env.base_fee
-        fees += result.gas_used * tip
+        if tx.tx_type != TYPE_PRIVILEGED:
+            tip = (tx.effective_gas_price(env.base_fee) or 0) - env.base_fee
+            fees += result.gas_used * tip
         included.append(tx)
         receipts.append(Receipt(
             tx_type=tx.tx_type, succeeded=result.success,

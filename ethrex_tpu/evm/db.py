@@ -36,6 +36,40 @@ class VmDatabase:
         raise NotImplementedError
 
 
+class TrieSource(VmDatabase):
+    """Shared trie-backed account/storage resolution over a node table.
+
+    Subclasses supply the node table + code/header lookup; the MPT walk,
+    slot hashing, and RLP decoding live here once so the node's StoreSource
+    and the guest's WitnessSource can never diverge.
+    """
+
+    def __init__(self, nodes: dict, state_root: bytes):
+        from ..trie.trie import Trie
+
+        self.nodes = nodes
+        self._trie = Trie.from_nodes(state_root, nodes, share=True)
+        self._storage_tries: dict[bytes, object] = {}
+
+    def get_account_state(self, address: bytes):
+        raw = self._trie.get(keccak256(address))
+        return AccountState.decode(raw) if raw else None
+
+    def get_storage(self, address: bytes, slot: int) -> int:
+        from ..primitives import rlp
+        from ..trie.trie import Trie
+
+        st = self._storage_tries.get(address)
+        if st is None:
+            acct = self.get_account_state(address)
+            if acct is None:
+                return 0
+            st = Trie.from_nodes(acct.storage_root, self.nodes, share=True)
+            self._storage_tries[address] = st
+        raw = st.get(keccak256(slot.to_bytes(32, "big")))
+        return rlp.decode_int(rlp.decode(raw)) if raw else 0
+
+
 class InMemorySource(VmDatabase):
     def __init__(self, accounts: dict | None = None,
                  block_hashes: dict | None = None):

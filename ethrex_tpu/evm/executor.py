@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from ..crypto.keccak import keccak256
 from ..primitives.genesis import ChainConfig, Fork
-from ..primitives.transaction import TYPE_BLOB, Transaction
+from ..primitives.transaction import TYPE_BLOB, TYPE_PRIVILEGED, Transaction
 from . import gas as G
 from . import precompiles
 from .db import StateDB
@@ -110,9 +110,31 @@ def _apply_authorizations(tx: Transaction, state: StateDB,
     return refund
 
 
+def execute_privileged_tx(tx: Transaction, state: StateDB, block: BlockEnv,
+                          config: ChainConfig) -> TxResult:
+    """L1-originated deposit/message: mint value, run the call gas-free
+    (authorization is the L1 inclusion proof, checked by the committer)."""
+    state.begin_tx()
+    sender = tx.from_addr
+    state.add_balance(sender, tx.value)      # bridge mint
+    state.increment_nonce(sender)
+    evm = EVM(state, block, config, origin=sender)
+    code, code_src = evm.resolve_code(tx.to) if tx.to else (b"", b"")
+    msg = Message(caller=sender, to=tx.to, code_address=code_src,
+                  value=tx.value, data=tx.data,
+                  gas=max(tx.gas_limit, 21000) - G.TX_BASE, code=code)
+    ok, _, output = evm.execute_message(msg)
+    logs = list(state.logs) if ok else []
+    state.finalize_tx()
+    return TxResult(success=ok, gas_used=G.TX_BASE, output=output,
+                    logs=logs, error=None if ok else "deposit call reverted")
+
+
 def execute_tx(tx: Transaction, state: StateDB, block: BlockEnv,
                config: ChainConfig) -> TxResult:
     """Execute one transaction against the state (mutating it)."""
+    if tx.tx_type == TYPE_PRIVILEGED:
+        return execute_privileged_tx(tx, state, block, config)
     fork = config.fork_at(block.number, block.timestamp)
     sender = tx.sender()
     if sender is None:

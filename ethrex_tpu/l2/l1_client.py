@@ -1,0 +1,144 @@
+"""L1 settlement client interface + in-memory simulator.
+
+The interface mirrors what the sequencer needs from the OnChainProposer /
+CommonBridge contracts (reference: crates/l2/contracts/src/l1/*.sol and the
+EthClient call sites in l1_committer.rs / l1_proof_sender.rs / l1_watcher.rs).
+`InMemoryL1` enforces the same state-machine rules (sequential commitment,
+commit-before-verify, verification requires all configured prover types) so
+the full pipeline runs hermetically; an HTTP EthClient against a real L1
+implements the same interface in the deployment rounds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+from ..crypto.keccak import keccak256
+
+
+class L1Error(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class Deposit:
+    l1_tx_hash: bytes
+    recipient: bytes
+    amount: int
+    data: bytes = b""
+    gas_limit: int = 200_000
+    index: int = 0
+
+
+def make_deposit_tx(chain_id: int, deposit: Deposit):
+    """Deterministic privileged tx for an L1 deposit — shared by the L2
+    watcher and the L1 commitment check, so the L1 can recompute and verify
+    exactly which privileged txs a batch may contain."""
+    from ..primitives.transaction import TYPE_PRIVILEGED, Transaction
+
+    return Transaction(
+        tx_type=TYPE_PRIVILEGED, chain_id=chain_id, nonce=deposit.index,
+        from_addr=deposit.recipient, to=deposit.recipient,
+        value=deposit.amount, gas_limit=deposit.gas_limit,
+        data=deposit.data,
+    )
+
+
+class L1Client:
+    def commit_batch(self, number: int, new_state_root: bytes,
+                     commitment: bytes,
+                     privileged_tx_hashes: list[bytes] = ()) -> bytes:
+        raise NotImplementedError
+
+    def verify_batches(self, first: int, last: int,
+                       proofs: dict[str, bytes]) -> bytes:
+        raise NotImplementedError
+
+    def last_committed_batch(self) -> int:
+        raise NotImplementedError
+
+    def last_verified_batch(self) -> int:
+        raise NotImplementedError
+
+    def get_deposits(self, since_index: int) -> list[Deposit]:
+        raise NotImplementedError
+
+
+class InMemoryL1(L1Client):
+    """OnChainProposer/CommonBridge semantics without an actual chain."""
+
+    def __init__(self, needed_prover_types: list[str],
+                 l2_chain_id: int | None = None):
+        self.needed = list(needed_prover_types)
+        self.l2_chain_id = l2_chain_id
+        self.commitments: dict[int, tuple[bytes, bytes]] = {}
+        self.verified_up_to = 0
+        self.deposits: list[Deposit] = []
+        self.consumed_deposits = 0
+        self.lock = threading.RLock()
+
+    # ---- OnChainProposer ----
+    def commit_batch(self, number, new_state_root, commitment,
+                     privileged_tx_hashes=()) -> bytes:
+        with self.lock:
+            if number != len(self.commitments) + 1:
+                raise L1Error(
+                    f"batch {number} out of order "
+                    f"(expected {len(self.commitments) + 1})")
+            # privileged txs must correspond 1:1, in order, to the bridge's
+            # next unconsumed deposits (reference: OnChainProposer checks
+            # the privileged tx digest against CommonBridge's queue)
+            cursor = self.consumed_deposits
+            for h in privileged_tx_hashes:
+                if cursor >= len(self.deposits):
+                    raise L1Error("privileged tx without matching deposit")
+                if self.l2_chain_id is not None:
+                    expected = make_deposit_tx(
+                        self.l2_chain_id, self.deposits[cursor]).hash
+                    if h != expected:
+                        raise L1Error(
+                            f"privileged tx {h.hex()} does not match "
+                            f"deposit {cursor}")
+                cursor += 1
+            self.consumed_deposits = cursor
+            self.commitments[number] = (new_state_root, commitment)
+            return keccak256(b"commit" + number.to_bytes(8, "big")
+                             + commitment)
+
+    def verify_batches(self, first, last, proofs) -> bytes:
+        with self.lock:
+            if first != self.verified_up_to + 1:
+                raise L1Error("verification must be contiguous")
+            if last > len(self.commitments):
+                raise L1Error("cannot verify uncommitted batches")
+            for t in self.needed:
+                if t not in proofs or not proofs[t]:
+                    raise L1Error(f"missing {t} proof")
+            self.verified_up_to = last
+            return keccak256(b"verify" + first.to_bytes(8, "big")
+                             + last.to_bytes(8, "big"))
+
+    def last_committed_batch(self) -> int:
+        return len(self.commitments)
+
+    def last_verified_batch(self) -> int:
+        return self.verified_up_to
+
+    # ---- CommonBridge ----
+    def deposit(self, recipient: bytes, amount: int, data: bytes = b"",
+                gas_limit: int = 200_000):
+        """L1-side user action (tests drive this)."""
+        with self.lock:
+            idx = len(self.deposits)
+            d = Deposit(
+                l1_tx_hash=keccak256(b"deposit" + idx.to_bytes(8, "big")
+                                     + recipient),
+                recipient=recipient, amount=amount, data=data,
+                gas_limit=gas_limit, index=idx)
+            self.deposits.append(d)
+            return d
+
+    def get_deposits(self, since_index: int) -> list[Deposit]:
+        with self.lock:
+            return self.deposits[since_index:]

@@ -1,0 +1,119 @@
+"""Proof coordinator: TCP server assigning batches to pull-based provers
+(parity with the reference's ProofCoordinator actor,
+crates/l2/sequencer/proof_coordinator.rs — per-(batch, prover_type)
+assignment map with timeout reassignment, version gating, duplicate-proof
+no-op storage).
+"""
+
+from __future__ import annotations
+
+import socketserver
+import threading
+import time
+
+from ..prover import protocol
+from .rollup_store import RollupStore
+
+ASSIGNMENT_TIMEOUT = 600.0  # seconds, like the reference's 10 minutes
+
+
+class ProofCoordinator:
+    def __init__(self, rollup_store: RollupStore,
+                 needed_types: list[str] | None = None,
+                 commit_hash: str = protocol.PROTOCOL_VERSION,
+                 host: str = "127.0.0.1", port: int = 0,
+                 proof_format: str = protocol.FORMAT_STARK):
+        self.rollup = rollup_store
+        self.needed_types = needed_types or [protocol.PROVER_TPU]
+        self.commit_hash = commit_hash
+        self.proof_format = proof_format
+        # (batch_number, prover_type) -> assignment deadline
+        self.assignments: dict[tuple[int, str], float] = {}
+        self.lock = threading.RLock()
+        self.host = host
+        self.port = port
+        self._server: socketserver.ThreadingTCPServer | None = None
+
+    # ------------------------------------------------------------------
+    def next_batch_to_assign(self, prover_type: str) -> int | None:
+        """Lowest batch with a stored prover input, no proof of this type,
+        and no live assignment (reference: next_batch_to_assign:149-215)."""
+        if prover_type not in self.needed_types:
+            return None
+        now = time.monotonic()
+        with self.lock:
+            candidates = sorted({
+                num for (num, ver) in self.rollup.prover_inputs
+                if ver == self.commit_hash
+            })
+            for num in candidates:
+                if self.rollup.get_proof(num, prover_type) is not None:
+                    continue
+                deadline = self.assignments.get((num, prover_type))
+                if deadline is not None and deadline > now:
+                    continue
+                self.assignments[(num, prover_type)] = \
+                    now + ASSIGNMENT_TIMEOUT
+                return num
+        return None
+
+    def handle_request(self, msg: dict) -> dict:
+        mtype = msg.get("type")
+        if mtype == protocol.INPUT_REQUEST:
+            if msg.get("commit_hash") != self.commit_hash:
+                return {"type": protocol.VERSION_MISMATCH,
+                        "expected": self.commit_hash}
+            prover_type = msg.get("prover_type")
+            if prover_type not in self.needed_types:
+                return {"type": protocol.TYPE_NOT_NEEDED}
+            batch = self.next_batch_to_assign(prover_type)
+            if batch is None:
+                return {"type": protocol.TYPE_NOT_NEEDED}
+            program_input = self.rollup.get_prover_input(
+                batch, self.commit_hash)
+            return {"type": protocol.INPUT_RESPONSE, "batch_id": batch,
+                    "input": program_input, "format": self.proof_format}
+        if mtype == protocol.PROOF_SUBMIT:
+            batch = msg.get("batch_id")
+            prover_type = msg.get("prover_type")
+            proof = msg.get("proof")
+            if not isinstance(batch, int) or \
+                    prover_type not in self.needed_types \
+                    or not isinstance(proof, dict):
+                return {"type": protocol.ERROR, "message": "bad submit"}
+            self.rollup.store_proof(batch, prover_type, proof)
+            with self.lock:
+                self.assignments.pop((batch, prover_type), None)
+            return {"type": protocol.SUBMIT_ACK, "batch_id": batch}
+        return {"type": protocol.ERROR, "message": f"unknown type {mtype}"}
+
+    # ------------------------------------------------------------------
+    def start(self):
+        coordinator = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        msg = protocol.recv_msg_file(self.rfile)
+                    except (ValueError, ConnectionError):
+                        break
+                    if msg is None:
+                        break
+                    resp = coordinator.handle_request(msg)
+                    protocol.send_msg(self.connection, resp)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def stop(self):
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()

@@ -1,0 +1,80 @@
+"""Rollup store: batches, prover inputs, proofs (parity with the reference's
+StoreRollup, crates/l2/storage/src/store.rs — in-memory backend first)."""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+
+@dataclasses.dataclass
+class Batch:
+    number: int
+    first_block: int
+    last_block: int
+    state_root: bytes
+    commitment: bytes = b""        # commitment tx data hash (L1)
+    committed: bool = False
+    verified: bool = False
+
+
+class RollupStore:
+    def __init__(self):
+        self.batches: dict[int, Batch] = {}
+        self.prover_inputs: dict[tuple[int, str], dict] = {}
+        #   (batch_number, commit_hash_version) -> ProgramInput json
+        self.proofs: dict[tuple[int, str], dict] = {}
+        #   (batch_number, prover_type) -> proof
+        self.lock = threading.RLock()
+
+    # ---------------- batches ----------------
+    def store_batch(self, batch: Batch):
+        with self.lock:
+            self.batches[batch.number] = batch
+
+    def get_batch(self, number: int) -> Batch | None:
+        return self.batches.get(number)
+
+    def latest_batch_number(self) -> int:
+        with self.lock:
+            return max(self.batches) if self.batches else 0
+
+    def set_committed(self, number: int, commitment: bytes):
+        with self.lock:
+            b = self.batches[number]
+            b.committed = True
+            b.commitment = commitment
+
+    def set_verified(self, number: int):
+        with self.lock:
+            self.batches[number].verified = True
+
+    # ---------------- prover inputs ----------------
+    def store_prover_input(self, batch_number: int, version: str,
+                           program_input_json: dict):
+        with self.lock:
+            self.prover_inputs[(batch_number, version)] = program_input_json
+
+    def get_prover_input(self, batch_number: int, version: str):
+        return self.prover_inputs.get((batch_number, version))
+
+    # ---------------- proofs ----------------
+    def store_proof(self, batch_number: int, prover_type: str, proof: dict):
+        with self.lock:
+            key = (batch_number, prover_type)
+            if key in self.proofs:
+                return  # duplicate submissions are a no-op (ref behavior)
+            self.proofs[key] = proof
+
+    def get_proof(self, batch_number: int, prover_type: str):
+        return self.proofs.get((batch_number, prover_type))
+
+    def delete_proof(self, batch_number: int, prover_type: str):
+        """Invalid proofs are deleted so the batch is re-proven
+        (reference: distributed_proving.md:70-72)."""
+        with self.lock:
+            self.proofs.pop((batch_number, prover_type), None)
+
+    def batch_fully_proven(self, batch_number: int,
+                           needed_types: list[str]) -> bool:
+        return all((batch_number, t) in self.proofs for t in needed_types)

@@ -1,0 +1,191 @@
+"""L2 sequencer: the actor set from the reference's
+crates/l2/sequencer/mod.rs:47 start_l2 — BlockProducer, L1Committer,
+ProofCoordinator (own module), L1ProofSender, L1Watcher, StateUpdater —
+re-expressed as timer-driven components over the Node + RollupStore +
+L1Client.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+from ..crypto.keccak import keccak256
+from ..guest.execution import ProgramInput
+from ..guest.witness import generate_witness
+from ..node import Node
+from ..primitives.transaction import TYPE_PRIVILEGED, Transaction
+from ..prover import protocol
+from .l1_client import L1Client
+from .proof_coordinator import ProofCoordinator
+from .rollup_store import Batch, RollupStore
+
+
+@dataclasses.dataclass
+class SequencerConfig:
+    block_time: float = 1.0
+    commit_interval: float = 2.0
+    proof_send_interval: float = 2.0
+    watcher_interval: float = 1.0
+    needed_prover_types: tuple = (protocol.PROVER_TPU,)
+    commit_hash: str = protocol.PROTOCOL_VERSION
+
+
+class Sequencer:
+    """Wires all L2 actors (reference: start_l2)."""
+
+    def __init__(self, node: Node, l1: L1Client,
+                 config: SequencerConfig | None = None):
+        self.node = node
+        self.l1 = l1
+        self.cfg = config or SequencerConfig()
+        self.rollup = RollupStore()
+        self.coordinator = ProofCoordinator(
+            self.rollup, needed_types=list(self.cfg.needed_prover_types),
+            commit_hash=self.cfg.commit_hash)
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._deposit_cursor = 0
+        self.pending_privileged: list[Transaction] = []
+        self.last_batched_block = 0
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------------
+    # BlockProducer (reference: block_producer.rs produce_block)
+    # ------------------------------------------------------------------
+    def produce_block(self):
+        with self._lock:
+            forced = list(self.pending_privileged)
+            block = self.node.produce_block(forced_txs=forced)
+            included = {tx.hash for tx in block.body.transactions}
+            self.pending_privileged = [
+                tx for tx in self.pending_privileged
+                if tx.hash not in included]
+            return block
+
+    # ------------------------------------------------------------------
+    # L1Watcher (reference: l1_watcher.rs — deposits -> privileged txs)
+    # ------------------------------------------------------------------
+    def watch_l1(self):
+        from .l1_client import make_deposit_tx
+
+        with self._lock:
+            deposits = self.l1.get_deposits(self._deposit_cursor)
+            for dep in deposits:
+                tx = make_deposit_tx(self.node.config.chain_id, dep)
+                self.pending_privileged.append(tx)
+                self._deposit_cursor += 1
+
+    # ------------------------------------------------------------------
+    # L1Committer (reference: l1_committer.rs commit_next_batch_to_l1)
+    # ------------------------------------------------------------------
+    def commit_next_batch(self) -> Batch | None:
+        head = self.node.store.latest_number()
+        first = self.last_batched_block + 1
+        if head < first:
+            return None
+        blocks = [self.node.store.get_canonical_block(n)
+                  for n in range(first, head + 1)]
+        if any(b is None for b in blocks):
+            return None
+        number = self.rollup.latest_batch_number() + 1
+        witness = generate_witness(self.node.chain, blocks)
+        program_input = ProgramInput(blocks=blocks, witness=witness,
+                                     config=self.node.config)
+        state_root = blocks[-1].header.state_root
+        privileged_hashes = [
+            tx.hash for b in blocks for tx in b.body.transactions
+            if tx.tx_type == TYPE_PRIVILEGED]
+        commitment = keccak256(
+            b"batch" + number.to_bytes(8, "big") + state_root
+            + b"".join(b.hash for b in blocks)
+            + b"".join(privileged_hashes))
+        # L1 first: only persist the batch once the commitment is accepted,
+        # otherwise a transient L1 failure would desync the batch counter
+        self.l1.commit_batch(number, state_root, commitment,
+                             privileged_hashes)
+        batch = Batch(number=number, first_block=first,
+                      last_block=head, state_root=state_root,
+                      commitment=commitment)
+        self.rollup.store_batch(batch)
+        self.rollup.store_prover_input(number, self.cfg.commit_hash,
+                                       program_input.to_json())
+        self.rollup.set_committed(number, commitment)
+        self.last_batched_block = head
+        return batch
+
+    # ------------------------------------------------------------------
+    # L1ProofSender (reference: l1_proof_sender.rs — consecutive proven
+    # batches -> one verifyBatches tx)
+    # ------------------------------------------------------------------
+    def send_proofs(self) -> tuple[int, int] | None:
+        first = self.l1.last_verified_batch() + 1
+        last = first - 1
+        needed = list(self.cfg.needed_prover_types)
+        while self.rollup.get_batch(last + 1) is not None \
+                and self.rollup.get_batch(last + 1).committed \
+                and self.rollup.batch_fully_proven(last + 1, needed):
+            last += 1
+        if last < first:
+            return None
+        proofs = {}
+        for t in needed:
+            # submit the last batch's proof bytes per type (the L1 verifier
+            # checks each batch's proof; the simulator checks presence)
+            from ..prover.backend import get_backend
+            backend = get_backend(t)
+            all_ok = all(
+                backend.verify(self.rollup.get_proof(n, t))
+                for n in range(first, last + 1))
+            if not all_ok:
+                # invalid proof: delete so the fleet re-proves (reference:
+                # distributed_proving.md:70-72)
+                for n in range(first, last + 1):
+                    if not backend.verify(self.rollup.get_proof(n, t)):
+                        self.rollup.delete_proof(n, t)
+                return None
+            proofs[t] = backend.to_proof_bytes(
+                self.rollup.get_proof(last, t))
+        self.l1.verify_batches(first, last, proofs)
+        for n in range(first, last + 1):
+            self.rollup.set_verified(n)
+        return (first, last)
+
+    # ------------------------------------------------------------------
+    # StateUpdater (reference: state_updater.rs)
+    # ------------------------------------------------------------------
+    def update_state(self):
+        committed = self.l1.last_committed_batch()
+        verified = self.l1.last_verified_batch()
+        for n, batch in list(self.rollup.batches.items()):
+            if n <= committed and not batch.committed:
+                batch.committed = True
+            if n <= verified and not batch.verified:
+                batch.verified = True
+
+    # ------------------------------------------------------------------
+    def start(self):
+        self.coordinator.start()
+
+        def loop(interval, fn):
+            def run():
+                while not self._stop.wait(interval):
+                    try:
+                        fn()
+                    except Exception as e:  # noqa: BLE001 — actors survive
+                        print(f"sequencer actor error ({fn.__name__}): {e}")
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+        loop(self.cfg.block_time, self.produce_block)
+        loop(self.cfg.commit_interval, self.commit_next_batch)
+        loop(self.cfg.proof_send_interval, self.send_proofs)
+        loop(self.cfg.watcher_interval, self.watch_l1)
+        loop(self.cfg.watcher_interval, self.update_state)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self.coordinator.stop()

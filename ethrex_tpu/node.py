@@ -41,6 +41,13 @@ class Node:
         return nonce
 
     def submit_transaction(self, tx) -> bytes:
+        from .primitives.transaction import TYPE_PRIVILEGED
+
+        if tx.tx_type == TYPE_PRIVILEGED:
+            # only the L1 watcher may create privileged txs — an unsigned
+            # 0x7E tx over RPC would be an arbitrary unauthenticated mint
+            raise InvalidTransaction(
+                "privileged transactions cannot be submitted directly")
         sender = tx.sender()
         if sender is None:
             raise InvalidTransaction("invalid signature")
@@ -57,8 +64,11 @@ class Node:
             raise InvalidTransaction(str(e))
 
     # ------------------------------------------------------------------
-    def produce_block(self, timestamp: int | None = None):
-        """Dev-mode block production: mempool -> payload -> import."""
+    def produce_block(self, timestamp: int | None = None,
+                      forced_txs: list | None = None):
+        """Block production: forced (privileged) txs + mempool -> payload ->
+        import.  `forced_txs` are included ahead of the mempool (the L2
+        deposit path)."""
         with self.lock:
             parent = self.store.head_header()
             ts = timestamp or max(int(time.time()), parent.timestamp + 1)
@@ -71,7 +81,8 @@ class Node:
                 acct = self.store.account_state(root, sender)
                 return acct.nonce if acct else 0
 
-            txs = self.mempool.pending(base_fee, get_nonce)
+            txs = list(forced_txs or []) \
+                + self.mempool.pending(base_fee, get_nonce)
             result = build_payload(self.chain, parent, header, txs, [],
                                    mempool=self.mempool)
             self.chain.add_block(result.block)

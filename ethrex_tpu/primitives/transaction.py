@@ -22,6 +22,9 @@ TYPE_ACCESS_LIST = 0x01
 TYPE_DYNAMIC_FEE = 0x02
 TYPE_BLOB = 0x03
 TYPE_SET_CODE = 0x04
+# L2 privileged transaction (L1-originated deposit/message; no signature —
+# authorized by inclusion on L1, like the reference's PrivilegedL2Transaction)
+TYPE_PRIVILEGED = 0x7E
 
 
 def _addr(b) -> bytes:
@@ -67,6 +70,7 @@ class Transaction:
     v: int = 0                      # legacy: full v; typed: y_parity
     r: int = 0
     s: int = 0
+    from_addr: bytes = b""          # privileged txs: explicit sender
 
     # caches (excluded from equality: two equal txs must compare equal
     # regardless of which has computed hash/sender)
@@ -83,6 +87,9 @@ class Transaction:
 
     def _payload_fields(self, for_signing: bool) -> list:
         t = self.tx_type
+        if t == TYPE_PRIVILEGED:
+            return [self.chain_id or 0, self.nonce, self.from_addr,
+                    self.to, self.value, self.gas_limit, self.data]
         if t == TYPE_LEGACY:
             f = [self.nonce, self.gas_price, self.gas_limit, self.to,
                  self.value, self.data]
@@ -168,6 +175,15 @@ class Transaction:
 
     @classmethod
     def _decode_typed(cls, t: int, f: list) -> "Transaction":
+        if t == TYPE_PRIVILEGED:
+            if len(f) != 7:
+                raise rlp.RLPError("privileged tx must have 7 fields")
+            return cls(
+                tx_type=t, chain_id=rlp.decode_int(f[0]),
+                nonce=rlp.decode_int(f[1]), from_addr=bytes(f[2]),
+                to=_addr(f[3]), value=rlp.decode_int(f[4]),
+                gas_limit=rlp.decode_int(f[5]), data=bytes(f[6]),
+            )
         base_len = {TYPE_ACCESS_LIST: 8, TYPE_DYNAMIC_FEE: 9,
                     TYPE_BLOB: 11, TYPE_SET_CODE: 10}.get(t)
         if base_len is None:
@@ -237,6 +253,8 @@ class Transaction:
         return None
 
     def sender(self) -> bytes | None:
+        if self.tx_type == TYPE_PRIVILEGED:
+            return self.from_addr
         if self._sender is None:
             # EIP-2: reject high-s for all included txs (homestead onward)
             if self.s > secp256k1.N // 2:

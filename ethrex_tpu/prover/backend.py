@@ -1,0 +1,61 @@
+"""ProverBackend interface + registry (parity with the reference's
+ProverBackend trait, crates/prover/src/backend/mod.rs:81-147 — prover_type /
+execute / prove / verify / to_proof_bytes)."""
+
+from __future__ import annotations
+
+from ..guest.execution import ProgramInput, ProgramOutput, execution_program
+from . import protocol
+
+
+class ProverBackend:
+    prover_type: str = ""
+
+    def execute(self, program_input: ProgramInput) -> ProgramOutput:
+        """Run the guest program natively (no proof)."""
+        return execution_program(program_input)
+
+    def prove(self, program_input: ProgramInput, proof_format: str) -> dict:
+        raise NotImplementedError
+
+    def verify(self, proof: dict) -> bool:
+        raise NotImplementedError
+
+    def to_proof_bytes(self, proof: dict) -> bytes:
+        import json
+
+        return json.dumps(proof, separators=(",", ":")).encode()
+
+
+class ExecBackend(ProverBackend):
+    """The 'fake prover': executes natively, returns an empty proof —
+    unblocks full-pipeline integration exactly like the reference's exec
+    backend (crates/prover/src/backend/exec.rs)."""
+
+    prover_type = protocol.PROVER_EXEC
+
+    def prove(self, program_input: ProgramInput, proof_format: str) -> dict:
+        output = self.execute(program_input)
+        return {
+            "backend": self.prover_type,
+            "format": proof_format,
+            "output": "0x" + output.encode().hex(),
+            "proof": None,
+        }
+
+    def verify(self, proof: dict) -> bool:
+        return proof.get("backend") == self.prover_type \
+            and "output" in proof
+
+
+def get_backend(name: str) -> ProverBackend:
+    from .tpu_backend import TpuBackend
+
+    backends = {
+        protocol.PROVER_EXEC: ExecBackend,
+        protocol.PROVER_TPU: TpuBackend,
+    }
+    cls = backends.get(name)
+    if cls is None:
+        raise ValueError(f"unknown prover backend {name!r}")
+    return cls()

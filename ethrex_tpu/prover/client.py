@@ -1,0 +1,89 @@
+"""Prover pull-client: poll coordinator endpoints, prove, submit (parity
+with the reference's Prover actor, crates/prover/src/prover.rs:66-242 —
+request -> prove -> submit, version-gated, self-rescheduling).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from ..guest.execution import ProgramInput
+from . import protocol
+from .backend import ProverBackend, get_backend
+
+
+class ProverClient:
+    def __init__(self, backend: ProverBackend | str,
+                 endpoints: list[tuple[str, int]],
+                 commit_hash: str = protocol.PROTOCOL_VERSION,
+                 poll_interval: float = 1.0):
+        self.backend = (get_backend(backend) if isinstance(backend, str)
+                        else backend)
+        self.endpoints = endpoints
+        self.commit_hash = commit_hash
+        self.poll_interval = poll_interval
+        self._stop = threading.Event()
+        self.proved: list[int] = []   # batch ids proven (observability)
+
+    # ------------------------------------------------------------------
+    def poll_once(self) -> int:
+        """One pass over all endpoints; returns number of batches proven."""
+        proven = 0
+        for host, port in self.endpoints:
+            try:
+                proven += self._poll_endpoint(host, port)
+            except (ConnectionError, OSError, ValueError):
+                continue
+        return proven
+
+    def _poll_endpoint(self, host: str, port: int) -> int:
+        with socket.create_connection((host, port), timeout=30) as sock:
+            protocol.send_msg(sock, {
+                "type": protocol.INPUT_REQUEST,
+                "commit_hash": self.commit_hash,
+                "prover_type": self.backend.prover_type,
+            })
+            resp = protocol.recv_msg(sock)
+            rtype = resp.get("type")
+            if rtype == protocol.VERSION_MISMATCH:
+                raise ValueError(
+                    f"prover version mismatch: need {resp.get('expected')}")
+            if rtype != protocol.INPUT_RESPONSE:
+                return 0
+            batch_id = resp["batch_id"]
+            program_input = ProgramInput.from_json(resp["input"])
+            proof = self.backend.prove(program_input, resp["format"])
+            protocol.send_msg(sock, {
+                "type": protocol.PROOF_SUBMIT,
+                "batch_id": batch_id,
+                "prover_type": self.backend.prover_type,
+                "proof": proof,
+            })
+            ack = protocol.recv_msg(sock)
+            if ack.get("type") == protocol.SUBMIT_ACK:
+                self.proved.append(batch_id)
+                return 1
+            return 0
+
+    # ------------------------------------------------------------------
+    def run_forever(self):
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 — prover must keep polling
+                print(f"prover poll error: {e}")
+
+    def start(self) -> "ProverClient":
+        threading.Thread(target=self.run_forever, daemon=True).start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+
+def start_prover(backend_name: str, endpoints: list[tuple[str, int]],
+                 **kwargs) -> ProverClient:
+    """Entry point (reference: start_prover, prover.rs:242)."""
+    return ProverClient(backend_name, endpoints, **kwargs).start()

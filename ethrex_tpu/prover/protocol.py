@@ -1,0 +1,57 @@
+"""Prover <-> coordinator wire protocol: newline-delimited JSON over TCP
+(parity with the reference's ProofData<I> protocol,
+crates/common/types/prover.rs:119-159 — the plugin seam the TPU prover
+slots into; message names kept equivalent).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+
+# message types (the reference's ProofData variants)
+INPUT_REQUEST = "InputRequest"          # {commit_hash, prover_type}
+INPUT_RESPONSE = "InputResponse"        # {batch_id, input, format}
+VERSION_MISMATCH = "VersionMismatch"    # {expected}
+TYPE_NOT_NEEDED = "ProverTypeNotNeeded"
+PROOF_SUBMIT = "ProofSubmit"            # {batch_id, prover_type, proof}
+SUBMIT_ACK = "ProofSubmitACK"           # {batch_id}
+ERROR = "Error"                         # {message}
+
+# proof formats (reference: ProofFormat — Compressed STARK vs Groth16 wrap)
+FORMAT_STARK = "stark"
+FORMAT_GROTH16 = "groth16"
+
+# prover types (reference: ProverType {Exec, SP1, RISC0, ...} + TPU)
+PROVER_EXEC = "exec"
+PROVER_TPU = "tpu"
+
+PROTOCOL_VERSION = "ethrex-tpu/prover/v1"
+
+
+def send_msg(sock: socket.socket, msg: dict):
+    data = json.dumps(msg, separators=(",", ":")).encode() + b"\n"
+    sock.sendall(data)
+
+
+def recv_msg(sock: socket.socket, max_size: int = 256 * 1024 * 1024) -> dict:
+    buf = bytearray()
+    while True:
+        chunk = sock.recv(65536)
+        if not chunk:
+            if not buf:
+                raise ConnectionError("peer closed")
+            break
+        buf.extend(chunk)
+        if buf.endswith(b"\n"):
+            break
+        if len(buf) > max_size:
+            raise ConnectionError("message too large")
+    return json.loads(buf.decode())
+
+
+def recv_msg_file(rfile, max_size: int = 256 * 1024 * 1024) -> dict | None:
+    line = rfile.readline(max_size)
+    if not line:
+        return None
+    return json.loads(line.decode())

@@ -1,0 +1,88 @@
+"""TPU prover backend: the `--prover tpu` seam (SURVEY.md north star).
+
+Round-1 scope: the guest program runs natively on the host, and the TPU
+produces an **output-binding STARK** — a real DEEP-FRI proof (device LDE +
+Poseidon2 Merkle + FRI) over a Mixer trace seeded with the ProgramOutput
+digest, verified by the independent host verifier.  This exercises the full
+coordinator -> TPU -> proof-store pipeline with real TPU proving work.
+
+What it does NOT yet prove: the EVM execution itself.  That requires the VM
+AIR (the reference delegates this to its zkVM SDKs; our equivalent is the
+round-2+ arithmetization of guest/execution.py).  The proof here binds the
+claimed ProgramOutput into a verified STARK via public inputs — equivalent
+trust to the reference's exec backend, plus end-to-end TPU kernels.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..crypto.keccak import keccak256
+from ..guest.execution import ProgramInput
+from ..models.mixer import MixerAir
+from ..ops import babybear as bb
+from ..stark import prover as stark_prover
+from ..stark import verifier as stark_verifier
+from ..stark.prover import StarkParams
+from . import protocol
+from .backend import ProverBackend
+
+TRACE_ROWS = 256
+WIDTH = 16
+PARAMS = StarkParams(log_blowup=2, num_queries=40, log_final_size=5)
+
+
+def output_to_limbs(output_bytes: bytes) -> list[int]:
+    """ProgramOutput.encode() -> 16 BabyBear limbs via keccak expansion."""
+    h1 = keccak256(b"ethrex-tpu/output-binding/1" + output_bytes)
+    h2 = keccak256(b"ethrex-tpu/output-binding/2" + output_bytes)
+    limbs = []
+    for h in (h1, h2):
+        for i in range(8):
+            limbs.append(int.from_bytes(h[4 * i:4 * i + 3], "big"))  # 24-bit
+    return limbs
+
+
+def _binding_trace(seed_limbs: list[int]) -> np.ndarray:
+    trace = np.zeros((TRACE_ROWS, WIDTH), dtype=np.uint64)
+    trace[0] = seed_limbs
+    for i in range(1, TRACE_ROWS):
+        prev = trace[i - 1]
+        trace[i] = (prev * prev + np.roll(prev, -1)) % bb.P
+    return trace.astype(np.uint32)
+
+
+class TpuBackend(ProverBackend):
+    prover_type = protocol.PROVER_TPU
+
+    def __init__(self):
+        self.air = MixerAir(width=WIDTH)
+
+    def prove(self, program_input: ProgramInput, proof_format: str) -> dict:
+        output = self.execute(program_input)
+        encoded = output.encode()
+        limbs = output_to_limbs(encoded)
+        trace = _binding_trace(limbs)
+        pub = limbs + [int(trace[-1, 0])]
+        stark = stark_prover.prove(self.air, trace, pub, PARAMS)
+        return {
+            "backend": self.prover_type,
+            "format": proof_format,
+            "output": "0x" + encoded.hex(),
+            "proof": stark,
+        }
+
+    def verify(self, proof: dict) -> bool:
+        if proof.get("backend") != self.prover_type:
+            return False
+        try:
+            encoded = bytes.fromhex(proof["output"][2:])
+            stark = proof["proof"]
+            limbs = output_to_limbs(encoded)
+            # the proof's public inputs must match the claimed output
+            if stark["pub_inputs"][:WIDTH] != limbs:
+                return False
+            return stark_verifier.verify(self.air, stark, PARAMS)
+        except (KeyError, ValueError, TypeError,
+                stark_verifier.VerificationError):
+            return False

@@ -17,7 +17,7 @@ from ..primitives import rlp
 from ..primitives.account import AccountState, EMPTY_CODE_HASH, EMPTY_TRIE_ROOT
 from ..primitives.block import Block, BlockHeader
 from ..primitives.genesis import Genesis
-from ..evm.db import StateDB, VmDatabase
+from ..evm.db import StateDB, TrieSource, VmDatabase
 from ..trie.trie import Trie
 
 
@@ -157,75 +157,83 @@ class Store:
         return rlp.decode_int(rlp.decode(raw)) if raw else 0
 
     # ---------------- state write-back ----------------
-    def apply_account_updates(self, parent_root: bytes,
-                              state_db: StateDB) -> bytes:
+    def apply_account_updates(self, parent_root: bytes, state_db: StateDB,
+                              nodes: dict | None = None) -> bytes:
         """Write dirty accounts/slots from an executed block into the tries;
         returns the new state root (the merkleize step of the reference's
-        add_block pipeline, blockchain.rs apply_account_updates_batch)."""
+        add_block pipeline, blockchain.rs apply_account_updates_batch).
+
+        `nodes` overrides the node table (witness recording / stateless
+        execution use a recording or witness-only table)."""
         with self.lock:
-            trie = Trie.from_nodes(parent_root, self.nodes, share=True)
-            for addr in sorted(state_db.dirty_accounts):
-                cached = state_db.accounts[addr]
-                key = keccak256(addr)
-                if not cached.exists or cached.is_empty:
-                    # EIP-161 state clearing / destroyed accounts
-                    trie.remove(key)
-                    continue
-                raw = trie.get(key)
-                prev = AccountState.decode(raw) if raw else AccountState()
-                storage_root = (EMPTY_TRIE_ROOT if cached.storage_cleared
-                                else prev.storage_root)
-                slots = state_db.dirty_storage.get(addr, ())
-                if slots or cached.storage_cleared:
-                    st = Trie.from_nodes(storage_root, self.nodes, share=True)
-                    for slot in sorted(slots):
-                        value = cached.storage.get(slot, 0)
-                        skey = keccak256(slot.to_bytes(32, "big"))
-                        if value:
-                            st.insert(skey, rlp.encode(value))
-                        else:
-                            st.remove(skey)
-                    storage_root = st.commit()
-                if (cached.code is not None
-                        and cached.code_hash != EMPTY_CODE_HASH):
-                    self.code[cached.code_hash] = cached.code
-                new_state = AccountState(
-                    nonce=cached.nonce, balance=cached.balance,
-                    storage_root=storage_root, code_hash=cached.code_hash)
-                trie.insert(key, new_state.encode())
-            return trie.commit()
+            return apply_updates_to_tries(
+                nodes if nodes is not None else self.nodes,
+                self.code, parent_root, state_db)
 
 
-class StoreSource(VmDatabase):
-    """VmDatabase over the Store's tries at a fixed state root."""
+def apply_updates_to_tries(node_table: dict, code_table, parent_root: bytes,
+                           state_db: StateDB) -> bytes:
+    """Shared merkleize step: dirty StateDB -> trie updates -> new root.
+    Used by the Store (node path) and the stateless guest program."""
+    trie = Trie.from_nodes(parent_root, node_table, share=True)
+    for addr in sorted(state_db.dirty_accounts):
+        cached = state_db.accounts[addr]
+        key = keccak256(addr)
+        if not cached.exists or cached.is_empty:
+            # EIP-161 state clearing / destroyed accounts
+            trie.remove(key)
+            continue
+        raw = trie.get(key)
+        prev = AccountState.decode(raw) if raw else AccountState()
+        storage_root = (EMPTY_TRIE_ROOT if cached.storage_cleared
+                        else prev.storage_root)
+        slots = state_db.dirty_storage.get(addr, ())
+        if slots or cached.storage_cleared:
+            st = Trie.from_nodes(storage_root, node_table, share=True)
+            for slot in sorted(slots):
+                value = cached.storage.get(slot, 0)
+                skey = keccak256(slot.to_bytes(32, "big"))
+                if value:
+                    st.insert(skey, rlp.encode(value))
+                else:
+                    st.remove(skey)
+            storage_root = st.commit()
+        if (cached.code is not None
+                and cached.code_hash != EMPTY_CODE_HASH):
+            code_table[cached.code_hash] = cached.code
+        new_state = AccountState(
+            nonce=cached.nonce, balance=cached.balance,
+            storage_root=storage_root, code_hash=cached.code_hash)
+        trie.insert(key, new_state.encode())
+    return trie.commit()
 
-    def __init__(self, store: Store, state_root: bytes):
+
+class StoreSource(TrieSource):
+    """VmDatabase over the Store's tries at a fixed state root.
+
+    `nodes` overrides the node table (recording table for witness
+    generation); `on_code` / `on_block_hash` are optional observation hooks.
+    """
+
+    def __init__(self, store: Store, state_root: bytes,
+                 nodes: dict | None = None, on_code=None, on_block_hash=None):
+        super().__init__(nodes if nodes is not None else store.nodes,
+                         state_root)
         self.store = store
         self.state_root = state_root
-        self._trie = Trie.from_nodes(state_root, store.nodes, share=True)
-        self._storage_tries: dict[bytes, Trie] = {}
-
-    def get_account_state(self, address: bytes):
-        raw = self._trie.get(keccak256(address))
-        return AccountState.decode(raw) if raw else None
+        self.on_code = on_code
+        self.on_block_hash = on_block_hash
 
     def get_code(self, code_hash: bytes) -> bytes:
         if code_hash == EMPTY_CODE_HASH:
             return b""
-        return self.store.code.get(code_hash, b"")
-
-    def get_storage(self, address: bytes, slot: int) -> int:
-        st = self._storage_tries.get(address)
-        if st is None:
-            acct = self.get_account_state(address)
-            if acct is None:
-                return 0
-            st = Trie.from_nodes(acct.storage_root, self.store.nodes,
-                                 share=True)
-            self._storage_tries[address] = st
-        raw = st.get(keccak256(slot.to_bytes(32, "big")))
-        return rlp.decode_int(rlp.decode(raw)) if raw else 0
+        code = self.store.code.get(code_hash, b"")
+        if code and self.on_code:
+            self.on_code(code_hash, code)
+        return code
 
     def get_block_hash(self, number: int) -> bytes:
         h = self.store.canonical_hash(number)
+        if h and self.on_block_hash:
+            self.on_block_hash(number, h)
         return h if h else b"\x00" * 32

@@ -75,10 +75,10 @@ class Trie:
                    share: bool = False) -> "Trie":
         """share=True uses the given dict as the live backing store (the
         node database of a Store) instead of copying it."""
-        if isinstance(nodes, dict):
-            store = nodes if share else dict(nodes)
-        else:
+        if isinstance(nodes, (list, tuple)):
             store = {keccak256(n): bytes(n) for n in nodes}
+        else:  # dict-like (incl. recording wrappers)
+            store = nodes if share else dict(nodes)
         t = cls(store)
         if root_hash == EMPTY_TRIE_ROOT:
             t._root = None

@@ -1,0 +1,113 @@
+"""Witness generation + stateless guest execution round-trip."""
+
+import pytest
+
+from ethrex_tpu.crypto import secp256k1
+from ethrex_tpu.guest.execution import (ProgramInput, ProgramOutput,
+                                        StatelessExecutionError,
+                                        execution_program)
+from ethrex_tpu.guest.witness import ExecutionWitness, generate_witness
+from ethrex_tpu.node import Node
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.primitives.transaction import TYPE_DYNAMIC_FEE, Transaction
+
+SECRET = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
+SENDER = secp256k1.pubkey_to_address(secp256k1.pubkey_from_secret(SECRET))
+OTHER = bytes.fromhex("aa" * 20)
+
+GENESIS = {
+    "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+               "shanghaiTime": 0, "cancunTime": 0},
+    "alloc": {"0x" + SENDER.hex(): {"balance": hex(10**21)}},
+    "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7", "timestamp": "0x0",
+}
+
+
+def _make_chain_with_blocks():
+    node = Node(Genesis.from_json(GENESIS))
+    # block 1: transfers; block 2: contract deploy; block 3: contract calls
+    nonce = 0
+
+    def tx(to, value=0, data=b"", gas=100_000):
+        nonlocal nonce
+        t = Transaction(
+            tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=nonce,
+            max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+            gas_limit=gas, to=to, value=value, data=data,
+        ).sign(SECRET)
+        nonce += 1
+        return t
+
+    blocks = []
+    for tx_batch in (
+        [tx(OTHER, value=100), tx(OTHER, value=200)],
+        # counter contract: sload(0)+1 -> sstore(0)
+        [tx(b"", data=bytes.fromhex(
+            "67" + "5f546001015f55".ljust(16, "0") + "5f5260086018f3"))],
+    ):
+        for t in tx_batch:
+            node.submit_transaction(t)
+        blocks.append(node.produce_block())
+    from ethrex_tpu.crypto.keccak import keccak256
+    from ethrex_tpu.primitives import rlp
+    created = keccak256(rlp.encode([SENDER, 2]))[12:]
+    for t in [tx(created), tx(created)]:
+        node.submit_transaction(t)
+    blocks.append(node.produce_block())
+    return node, blocks
+
+
+def test_witness_roundtrip_stateless_execution():
+    node, blocks = _make_chain_with_blocks()
+    witness = generate_witness(node.chain, blocks)
+    assert witness.nodes and witness.block_headers
+    # serialize over the wire and back (the coordinator->prover path)
+    pi = ProgramInput(blocks=blocks, witness=witness, config=node.config)
+    pi2 = ProgramInput.from_json(pi.to_json())
+    out = execution_program(pi2)
+    assert out.final_state_root == blocks[-1].header.state_root
+    assert out.last_block_hash == blocks[-1].hash
+    assert out.first_block_number == 1
+    assert out.last_block_number == 3
+    # output encoding round-trip
+    assert ProgramOutput.decode(out.encode()) == out
+
+
+def test_stateless_rejects_tampered_block():
+    node, blocks = _make_chain_with_blocks()
+    witness = generate_witness(node.chain, blocks)
+    import dataclasses
+    from ethrex_tpu.primitives.block import Block
+    bad_header = dataclasses.replace(blocks[-1].header,
+                                     state_root=b"\x42" * 32)
+    tampered = blocks[:-1] + [Block(bad_header, blocks[-1].body)]
+    pi = ProgramInput(blocks=tampered, witness=witness, config=node.config)
+    with pytest.raises(StatelessExecutionError):
+        execution_program(pi)
+
+
+def test_stateless_rejects_incomplete_witness():
+    node, blocks = _make_chain_with_blocks()
+    witness = generate_witness(node.chain, blocks)
+    # drop the parent state root node itself — unquestionably required
+    from ethrex_tpu.crypto.keccak import keccak256
+    root = witness.block_headers[-1].state_root
+    pruned = ExecutionWitness(
+        nodes=[n for n in witness.nodes if keccak256(n) != root],
+        codes=witness.codes,
+        block_headers=witness.block_headers,
+        first_block_number=witness.first_block_number,
+    )
+    assert len(pruned.nodes) == len(witness.nodes) - 1
+    pi = ProgramInput(blocks=blocks, witness=pruned, config=node.config)
+    with pytest.raises(StatelessExecutionError):
+        execution_program(pi)
+
+
+def test_stateless_rejects_wrong_parent():
+    node, blocks = _make_chain_with_blocks()
+    witness = generate_witness(node.chain, blocks[1:])
+    # hand it blocks starting one earlier than the witness expects
+    pi = ProgramInput(blocks=blocks, witness=witness, config=node.config)
+    with pytest.raises(StatelessExecutionError):
+        execution_program(pi)
