@@ -648,7 +648,8 @@ def _mstore8(evm, f):
 def _sload(evm, f):
     slot = f.pop()
     warm = evm.state.warm_slot(f.msg.to, slot)
-    f.use_gas(G.WARM_ACCESS if warm else G.COLD_SLOAD + G.WARM_ACCESS)
+    # EIP-2929: cold SLOAD costs 2100 TOTAL (not 2100 + warm 100)
+    f.use_gas(G.WARM_ACCESS if warm else G.COLD_SLOAD)
     f.push(evm.state.get_storage(f.msg.to, slot))
 
 
@@ -683,8 +684,8 @@ def _sstore(evm, f):
             if original == 0:
                 evm.state.add_refund(G.SSTORE_SET - G.WARM_ACCESS)
             else:
-                evm.state.add_refund(
-                    G.SSTORE_RESET + G.COLD_SLOAD - G.WARM_ACCESS)
+                # EIP-3529: SSTORE_RESET(2900) - WARM_ACCESS(100) = 2800
+                evm.state.add_refund(G.SSTORE_RESET - G.WARM_ACCESS)
     f.use_gas(cost)
     evm.state.set_storage(addr, slot, value)
 

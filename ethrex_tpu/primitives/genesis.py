@@ -56,15 +56,15 @@ class ChainConfig:
 
     @classmethod
     def from_json(cls, cfg: dict) -> "ChainConfig":
-        c = cls(chain_id=int(cfg.get("chainId", 1)))
+        c = cls(chain_id=_num(cfg.get("chainId", 1)))
         for key, fork in _BLOCK_FORKS:
             if cfg.get(key) is not None:
-                c.block_forks[fork] = int(cfg[key])
+                c.block_forks[fork] = _num(cfg[key])
         for key, fork in _TIME_FORKS:
             if cfg.get(key) is not None:
-                c.time_forks[fork] = int(cfg[key])
+                c.time_forks[fork] = _num(cfg[key])
         if cfg.get("terminalTotalDifficulty") is not None:
-            c.terminal_total_difficulty = int(cfg["terminalTotalDifficulty"])
+            c.terminal_total_difficulty = _num(cfg["terminalTotalDifficulty"])
         return c
 
     def fork_at(self, block_number: int, timestamp: int) -> Fork:

@@ -1,0 +1,277 @@
+"""Engine API: engine_newPayload / forkchoiceUpdated / getPayload + JWT
+(parity with the reference's crates/networking/rpc/engine/{payload.rs,
+fork_choice.rs} and authentication.rs)."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+
+from ..blockchain.blockchain import InvalidBlock
+from ..blockchain.fork_choice import ForkChoiceError, apply_fork_choice
+from ..blockchain.payload import build_payload, create_payload_header
+from ..primitives.block import (Block, BlockBody, BlockHeader, Withdrawal,
+                                EMPTY_UNCLE_HASH)
+from ..primitives.transaction import Transaction
+from .eth import RpcError
+from .serializers import hb, hx, parse_bytes, parse_quantity
+
+VALID = "VALID"
+INVALID = "INVALID"
+SYNCING = "SYNCING"
+
+
+# ---------------------------------------------------------------------------
+# JWT (HS256, stdlib only)
+# ---------------------------------------------------------------------------
+
+def _b64url_decode(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def _b64url_encode(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).decode().rstrip("=")
+
+
+def jwt_encode(secret: bytes, claims: dict | None = None) -> str:
+    header = _b64url_encode(json.dumps(
+        {"alg": "HS256", "typ": "JWT"}).encode())
+    payload = _b64url_encode(json.dumps(
+        claims or {"iat": int(time.time())}).encode())
+    signing = f"{header}.{payload}".encode()
+    sig = _b64url_encode(hmac.new(secret, signing, hashlib.sha256).digest())
+    return f"{header}.{payload}.{sig}"
+
+
+def jwt_verify(secret: bytes, token: str, max_drift: int = 60) -> bool:
+    try:
+        header_b64, payload_b64, sig_b64 = token.split(".")
+        signing = f"{header_b64}.{payload_b64}".encode()
+        expected = hmac.new(secret, signing, hashlib.sha256).digest()
+        if not hmac.compare_digest(expected, _b64url_decode(sig_b64)):
+            return False
+        claims = json.loads(_b64url_decode(payload_b64))
+        iat = int(claims.get("iat", 0))
+        return abs(time.time() - iat) <= max_drift
+    except (ValueError, KeyError, TypeError):
+        return False
+
+
+# ---------------------------------------------------------------------------
+# payload <-> block conversion
+# ---------------------------------------------------------------------------
+
+def payload_to_block(p: dict, parent_beacon_block_root: str | None,
+                     requests_hash: bytes | None = None) -> Block:
+    txs = [Transaction.decode_canonical(parse_bytes(t))
+           for t in p.get("transactions", [])]
+    withdrawals = None
+    if p.get("withdrawals") is not None:
+        withdrawals = [
+            Withdrawal(parse_quantity(w["index"]),
+                       parse_quantity(w["validatorIndex"]),
+                       parse_bytes(w["address"]),
+                       parse_quantity(w["amount"]))
+            for w in p["withdrawals"]]
+    from ..blockchain.blockchain import (compute_tx_root,
+                                         compute_withdrawals_root)
+    header = BlockHeader(
+        parent_hash=parse_bytes(p["parentHash"]),
+        uncles_hash=EMPTY_UNCLE_HASH,
+        coinbase=parse_bytes(p["feeRecipient"]),
+        state_root=parse_bytes(p["stateRoot"]),
+        tx_root=compute_tx_root(txs),
+        receipts_root=parse_bytes(p["receiptsRoot"]),
+        bloom=parse_bytes(p["logsBloom"]),
+        difficulty=0,
+        number=parse_quantity(p["blockNumber"]),
+        gas_limit=parse_quantity(p["gasLimit"]),
+        gas_used=parse_quantity(p["gasUsed"]),
+        timestamp=parse_quantity(p["timestamp"]),
+        extra_data=parse_bytes(p["extraData"]),
+        prev_randao=parse_bytes(p["prevRandao"]),
+        base_fee_per_gas=parse_quantity(p["baseFeePerGas"]),
+    )
+    if withdrawals is not None:
+        header.withdrawals_root = compute_withdrawals_root(withdrawals)
+    if p.get("blobGasUsed") is not None:
+        header.blob_gas_used = parse_quantity(p["blobGasUsed"])
+        header.excess_blob_gas = parse_quantity(p["excessBlobGas"])
+    if parent_beacon_block_root is not None:
+        header.parent_beacon_block_root = parse_bytes(
+            parent_beacon_block_root)
+    if requests_hash is not None:
+        header.requests_hash = requests_hash
+    body = BlockBody(transactions=txs, uncles=[], withdrawals=withdrawals)
+    block = Block(header, body)
+    if block.hash != parse_bytes(p["blockHash"]):
+        raise RpcError(-32602, "block hash mismatch")
+    return block
+
+
+def block_to_payload(block: Block) -> dict:
+    h = block.header
+    out = {
+        "parentHash": hb(h.parent_hash),
+        "feeRecipient": hb(h.coinbase),
+        "stateRoot": hb(h.state_root),
+        "receiptsRoot": hb(h.receipts_root),
+        "logsBloom": hb(h.bloom),
+        "prevRandao": hb(h.prev_randao),
+        "blockNumber": hx(h.number),
+        "gasLimit": hx(h.gas_limit),
+        "gasUsed": hx(h.gas_used),
+        "timestamp": hx(h.timestamp),
+        "extraData": hb(h.extra_data),
+        "baseFeePerGas": hx(h.base_fee_per_gas or 0),
+        "blockHash": hb(block.hash),
+        "transactions": [hb(tx.encode_canonical())
+                         for tx in block.body.transactions],
+    }
+    if block.body.withdrawals is not None:
+        out["withdrawals"] = [{
+            "index": hx(w.index), "validatorIndex": hx(w.validator_index),
+            "address": hb(w.address), "amount": hx(w.amount)}
+            for w in block.body.withdrawals]
+    if h.blob_gas_used is not None:
+        out["blobGasUsed"] = hx(h.blob_gas_used)
+        out["excessBlobGas"] = hx(h.excess_blob_gas)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# engine namespace
+# ---------------------------------------------------------------------------
+
+class EngineApi:
+    def __init__(self, node):
+        self.node = node
+        self.payloads: dict[str, dict] = {}
+        self._payload_counter = 0
+
+    def exchange_capabilities(self, caps):
+        # per spec the response must NOT include exchangeCapabilities itself
+        return [
+            "engine_newPayloadV3", "engine_newPayloadV4",
+            "engine_forkchoiceUpdatedV3", "engine_getPayloadV3",
+            "engine_getPayloadV4",
+        ]
+
+    def new_payload_v3(self, payload, blob_hashes=None,
+                       parent_beacon_block_root=None,
+                       execution_requests=None):
+        try:
+            requests_hash = None
+            if execution_requests is not None:
+                from ..blockchain.blockchain import compute_requests_hash
+
+                requests_hash = compute_requests_hash(
+                    [parse_bytes(r) for r in execution_requests])
+            block = payload_to_block(payload, parent_beacon_block_root,
+                                     requests_hash)
+        except (RpcError, KeyError, ValueError) as e:
+            return {"status": INVALID, "latestValidHash": None,
+                    "validationError": str(e)}
+        # blob hash consistency
+        want = [h for tx in block.body.transactions
+                for h in tx.blob_versioned_hashes]
+        got = [parse_bytes(h) for h in (blob_hashes or [])]
+        if want != got:
+            return {"status": INVALID, "latestValidHash": None,
+                    "validationError": "blob versioned hashes mismatch"}
+        store = self.node.store
+        if store.get_header(block.header.parent_hash) is None:
+            return {"status": SYNCING, "latestValidHash": None,
+                    "validationError": None}
+        if store.get_header(block.hash) is not None:
+            return {"status": VALID, "latestValidHash": hb(block.hash),
+                    "validationError": None}
+        try:
+            self.node.chain.add_block(block)
+        except InvalidBlock as e:
+            parent = store.get_header(block.header.parent_hash)
+            return {"status": INVALID,
+                    "latestValidHash": hb(parent.hash) if parent else None,
+                    "validationError": str(e)}
+        return {"status": VALID, "latestValidHash": hb(block.hash),
+                "validationError": None}
+
+    new_payload_v4 = new_payload_v3
+
+    def forkchoice_updated_v3(self, state, attrs=None):
+        head = parse_bytes(state["headBlockHash"])
+        safe = parse_bytes(state.get("safeBlockHash", "0x" + "00" * 32))
+        final = parse_bytes(state.get("finalizedBlockHash",
+                                      "0x" + "00" * 32))
+        store = self.node.store
+        if store.get_header(head) is None:
+            return {"payloadStatus": {"status": SYNCING,
+                                      "latestValidHash": None,
+                                      "validationError": None},
+                    "payloadId": None}
+        try:
+            apply_fork_choice(
+                store, head,
+                safe if safe != b"\x00" * 32 else b"",
+                final if final != b"\x00" * 32 else b"")
+        except ForkChoiceError as e:
+            raise RpcError(-38002, f"invalid forkchoice state: {e}")
+        payload_id = None
+        if attrs:
+            payload_id = self._start_payload(head, attrs)
+        return {"payloadStatus": {"status": VALID,
+                                  "latestValidHash": hb(head),
+                                  "validationError": None},
+                "payloadId": payload_id}
+
+    def _start_payload(self, head: bytes, attrs: dict) -> str:
+        parent = self.node.store.get_header(head)
+        withdrawals = [
+            Withdrawal(parse_quantity(w["index"]),
+                       parse_quantity(w["validatorIndex"]),
+                       parse_bytes(w["address"]),
+                       parse_quantity(w["amount"]))
+            for w in attrs.get("withdrawals", [])]
+        header = create_payload_header(
+            parent, self.node.config,
+            timestamp=parse_quantity(attrs["timestamp"]),
+            coinbase=parse_bytes(attrs["suggestedFeeRecipient"]),
+            prev_randao=parse_bytes(attrs["prevRandao"]),
+        )
+        root = parent.state_root
+
+        def get_nonce(sender):
+            acct = self.node.store.account_state(root, sender)
+            return acct.nonce if acct else 0
+
+        txs = self.node.mempool.pending(header.base_fee_per_gas or 0,
+                                        get_nonce)
+        result = build_payload(
+            self.node.chain, parent, header, txs, withdrawals,
+            parent_beacon_block_root=parse_bytes(
+                attrs.get("parentBeaconBlockRoot", "0x" + "00" * 32)),
+            mempool=self.node.mempool)
+        self._payload_counter += 1
+        payload_id = "0x" + self._payload_counter.to_bytes(8, "big").hex()
+        fees = result.fees_collected
+        while len(self.payloads) >= 64:   # bound memory: evict oldest
+            self.payloads.pop(next(iter(self.payloads)))
+        self.payloads[payload_id] = {
+            "executionPayload": block_to_payload(result.block),
+            "blockValue": hx(fees),
+            "blobsBundle": {"commitments": [], "proofs": [], "blobs": []},
+            "shouldOverrideBuilder": False,
+            "executionRequests": [],
+        }
+        return payload_id
+
+    def get_payload_v3(self, payload_id):
+        payload = self.payloads.get(payload_id)
+        if payload is None:
+            raise RpcError(-38001, "unknown payload")
+        return payload
+
+    get_payload_v4 = get_payload_v3

@@ -12,13 +12,28 @@ from .eth import EthApi, RpcError
 
 
 class RpcServer:
-    def __init__(self, node, host: str = "127.0.0.1", port: int = 8545):
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 8545,
+                 jwt_secret: bytes | None = None, engine: bool = False):
         self.node = node
         self.eth = EthApi(node)
         self.host = host
         self.port = port
+        self.jwt_secret = jwt_secret
         self._httpd: ThreadingHTTPServer | None = None
         self.methods = self._build_methods()
+        if engine:
+            from .engine import EngineApi
+
+            api = EngineApi(node)
+            self.engine_api = api
+            self.methods.update({
+                "engine_exchangeCapabilities": api.exchange_capabilities,
+                "engine_newPayloadV3": api.new_payload_v3,
+                "engine_newPayloadV4": api.new_payload_v4,
+                "engine_forkchoiceUpdatedV3": api.forkchoice_updated_v3,
+                "engine_getPayloadV3": api.get_payload_v3,
+                "engine_getPayloadV4": api.get_payload_v4,
+            })
 
     def _build_methods(self):
         e = self.eth
@@ -76,6 +91,16 @@ class RpcServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_POST(self):
+                if server.jwt_secret is not None:
+                    from .engine import jwt_verify
+
+                    auth = self.headers.get("Authorization", "")
+                    token = auth.removeprefix("Bearer ").strip()
+                    if not token or not jwt_verify(server.jwt_secret, token):
+                        self.send_response(401)
+                        self.end_headers()
+                        self.wfile.write(b"unauthorized")
+                        return
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
                 try:

@@ -174,14 +174,21 @@ class Store:
 def apply_updates_to_tries(node_table: dict, code_table, parent_root: bytes,
                            state_db: StateDB) -> bytes:
     """Shared merkleize step: dirty StateDB -> trie updates -> new root.
-    Used by the Store (node path) and the stateless guest program."""
+    Used by the Store (node path) and the stateless guest program.
+
+    Inserts are applied BEFORE deletes (per trie): a delete after an insert
+    into the same branch avoids collapse paths that would need sibling
+    nodes a pruned witness doesn't carry (same ordering rule as the
+    reference's guest state application, block_execution_witness.rs:541).
+    """
     trie = Trie.from_nodes(parent_root, node_table, share=True)
+    account_deletes = []
     for addr in sorted(state_db.dirty_accounts):
         cached = state_db.accounts[addr]
         key = keccak256(addr)
         if not cached.exists or cached.is_empty:
             # EIP-161 state clearing / destroyed accounts
-            trie.remove(key)
+            account_deletes.append(key)
             continue
         raw = trie.get(key)
         prev = AccountState.decode(raw) if raw else AccountState()
@@ -190,13 +197,26 @@ def apply_updates_to_tries(node_table: dict, code_table, parent_root: bytes,
         slots = state_db.dirty_storage.get(addr, ())
         if slots or cached.storage_cleared:
             st = Trie.from_nodes(storage_root, node_table, share=True)
+            slot_deletes = []
             for slot in sorted(slots):
-                value = cached.storage.get(slot, 0)
+                # read through the StateDB: a reverted tx's journal undo can
+                # pop the cache entry, and the raw cache default of 0 would
+                # wrongly delete a live slot
+                value = state_db.get_storage(addr, slot)
+                if not cached.storage_cleared:
+                    # skip net-zero writes: a removal of a never-present key
+                    # (or rewrite of an unchanged one) walks trie paths a
+                    # pruned witness legitimately omits
+                    pre = state_db.source.get_storage(addr, slot)
+                    if value == pre:
+                        continue
                 skey = keccak256(slot.to_bytes(32, "big"))
                 if value:
                     st.insert(skey, rlp.encode(value))
                 else:
-                    st.remove(skey)
+                    slot_deletes.append(skey)
+            for skey in slot_deletes:
+                st.remove(skey)
             storage_root = st.commit()
         if (cached.code is not None
                 and cached.code_hash != EMPTY_CODE_HASH):
@@ -205,6 +225,8 @@ def apply_updates_to_tries(node_table: dict, code_table, parent_root: bytes,
             nonce=cached.nonce, balance=cached.balance,
             storage_root=storage_root, code_hash=cached.code_hash)
         trie.insert(key, new_state.encode())
+    for key in account_deletes:
+        trie.remove(key)
     return trie.commit()
 
 

@@ -1,0 +1,168 @@
+"""ethrex-replay equivalent: execute (and later prove) real-network blocks
+from a cached witness (reference: tooling's replay flow + the
+fixtures/cache/rpc_prover format — {"blocks": [json], "witness": {state,
+keys, codes, headers}, "network"}).
+
+Usage:
+    python -m ethrex_tpu.utils.replay <cache.json> --genesis <genesis.json>
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..guest.execution import ProgramInput, execution_program
+from ..guest.witness import ExecutionWitness
+from ..primitives.block import (Block, BlockBody, BlockHeader, Withdrawal)
+from ..primitives.genesis import ChainConfig
+from ..primitives.transaction import Transaction
+
+
+from ..rpc.serializers import parse_bytes, parse_quantity
+
+
+def _hx(v) -> int:
+    """parse_quantity tolerating None (absent optional RPC fields)."""
+    return 0 if v is None else parse_quantity(v)
+
+
+def _hb(v) -> bytes:
+    """parse_bytes tolerating None / '0x'."""
+    return b"" if not v or v == "0x" else parse_bytes(v)
+
+
+def header_from_rpc_json(h: dict) -> BlockHeader:
+    hdr = BlockHeader(
+        parent_hash=_hb(h["parentHash"]),
+        uncles_hash=_hb(h["sha3Uncles"]),
+        coinbase=_hb(h["miner"]),
+        state_root=_hb(h["stateRoot"]),
+        tx_root=_hb(h["transactionsRoot"]),
+        receipts_root=_hb(h["receiptsRoot"]),
+        bloom=_hb(h["logsBloom"]),
+        difficulty=_hx(h["difficulty"]),
+        number=_hx(h["number"]),
+        gas_limit=_hx(h["gasLimit"]),
+        gas_used=_hx(h["gasUsed"]),
+        timestamp=_hx(h["timestamp"]),
+        extra_data=_hb(h["extraData"]),
+        prev_randao=_hb(h["mixHash"]),
+        nonce=_hb(h["nonce"]).rjust(8, b"\x00"),
+    )
+    if h.get("baseFeePerGas") is not None:
+        hdr.base_fee_per_gas = _hx(h["baseFeePerGas"])
+    if h.get("withdrawalsRoot") is not None:
+        hdr.withdrawals_root = _hb(h["withdrawalsRoot"])
+    if h.get("blobGasUsed") is not None:
+        hdr.blob_gas_used = _hx(h["blobGasUsed"])
+    if h.get("excessBlobGas") is not None:
+        hdr.excess_blob_gas = _hx(h["excessBlobGas"])
+    if h.get("parentBeaconBlockRoot") is not None:
+        hdr.parent_beacon_block_root = _hb(h["parentBeaconBlockRoot"])
+    if h.get("requestsHash") is not None:
+        hdr.requests_hash = _hb(h["requestsHash"])
+    return hdr
+
+
+def tx_from_rpc_json(t: dict) -> Transaction:
+    tx_type = _hx(t.get("type", "0x0"))
+    tx = Transaction(
+        tx_type=tx_type,
+        nonce=_hx(t.get("nonce")),
+        gas_limit=_hx(t.get("gas")),
+        to=_hb(t.get("to") or ""),
+        value=_hx(t.get("value")),
+        data=_hb(t.get("input") or t.get("data") or ""),
+        v=_hx(t.get("yParity", t.get("v")) if tx_type else t.get("v")),
+        r=_hx(t.get("r")),
+        s=_hx(t.get("s")),
+    )
+    if t.get("chainId") is not None:
+        tx.chain_id = _hx(t["chainId"])
+    elif tx_type == 0:
+        v = _hx(t.get("v"))
+        tx.chain_id = (v - 35) // 2 if v >= 35 else None
+    if tx_type in (0, 1):
+        tx.gas_price = _hx(t.get("gasPrice"))
+    else:
+        tx.max_priority_fee_per_gas = _hx(t.get("maxPriorityFeePerGas"))
+        tx.max_fee_per_gas = _hx(t.get("maxFeePerGas"))
+    if t.get("accessList"):
+        tx.access_list = [
+            (_hb(e["address"]),
+             [int(k, 16) for k in e.get("storageKeys", [])])
+            for e in t["accessList"]]
+    if tx_type == 3:
+        tx.max_fee_per_blob_gas = _hx(t.get("maxFeePerBlobGas"))
+        tx.blob_versioned_hashes = [
+            _hb(h) for h in t.get("blobVersionedHashes", [])]
+    if tx_type == 4:
+        tx.authorization_list = [{
+            "chain_id": _hx(a.get("chainId")),
+            "address": _hb(a.get("address")),
+            "nonce": _hx(a.get("nonce")),
+            "y_parity": _hx(a.get("yParity", a.get("v"))),
+            "r": _hx(a.get("r")), "s": _hx(a.get("s")),
+        } for a in t.get("authorizationList", [])]
+    return tx
+
+
+def block_from_rpc_json(b: dict) -> Block:
+    header = header_from_rpc_json(b["header"])
+    body = b["body"]
+    txs = [tx_from_rpc_json(t) for t in body.get("transactions", [])]
+    withdrawals = None
+    if body.get("withdrawals") is not None:
+        withdrawals = [Withdrawal(
+            index=_hx(w["index"]), validator_index=_hx(w["validatorIndex"]),
+            address=_hb(w["address"]), amount=_hx(w["amount"]))
+            for w in body["withdrawals"]]
+    return Block(header, BlockBody(transactions=txs, uncles=[],
+                                   withdrawals=withdrawals))
+
+
+def load_cache(path: str, config: ChainConfig) -> ProgramInput:
+    with open(path) as f:
+        cache = json.load(f)
+    blocks = [block_from_rpc_json(b) for b in cache["blocks"]]
+    w = cache["witness"]
+    headers = sorted(
+        (BlockHeader.decode(_hb(h)) for h in w["headers"]),
+        key=lambda h: h.number)
+    witness = ExecutionWitness(
+        nodes=[_hb(n) for n in w["state"]],
+        codes=[_hb(c) for c in w["codes"]],
+        block_headers=headers,
+        first_block_number=blocks[0].header.number,
+    )
+    return ProgramInput(blocks=blocks, witness=witness, config=config)
+
+
+def replay(cache_path: str, genesis_config_path: str) -> dict:
+    with open(genesis_config_path) as f:
+        config = ChainConfig.from_json(json.load(f).get("config", {}))
+    program_input = load_cache(cache_path, config)
+    blk = program_input.blocks[-1].header
+    import time
+    t0 = time.time()
+    output = execution_program(program_input)
+    dt = time.time() - t0
+    return {
+        "block": blk.number,
+        "gas_used": blk.gas_used,
+        "wall_s": round(dt, 3),
+        "mgas_per_s": round(blk.gas_used / dt / 1e6, 3),
+        "final_state_root": "0x" + output.final_state_root.hex(),
+    }
+
+
+if __name__ == "__main__":
+    import sys
+
+    if len(sys.argv) < 2 or "--genesis" not in sys.argv:
+        print("usage: python -m ethrex_tpu.utils.replay <cache.json> "
+              "--genesis <genesis.json>", file=sys.stderr)
+        sys.exit(2)
+    cache = sys.argv[1]
+    genesis = sys.argv[sys.argv.index("--genesis") + 1]
+    print(json.dumps(replay(cache, genesis), indent=2))
