@@ -46,6 +46,12 @@ def main(argv=None):
     parser.add_argument("--block-time", dest="block_time", type=float,
                         default=1.0, help="dev block production interval (s)")
     parser.add_argument("--coinbase", default="0x" + "00" * 20)
+    parser.add_argument("--metrics.port", dest="metrics_port", type=int,
+                        default=0, help="Prometheus /metrics port (0 = off)")
+    parser.add_argument("--authrpc.port", dest="authrpc_port", type=int,
+                        default=0, help="Engine API port (0 = off)")
+    parser.add_argument("--authrpc.jwtsecret", dest="jwt_path",
+                        help="path to a hex-encoded 32-byte JWT secret")
     args = parser.parse_args(argv)
 
     if args.genesis:
@@ -63,6 +69,30 @@ def main(argv=None):
     server = RpcServer(node, args.http_addr, args.http_port).start()
     print(f"genesis hash: 0x{node.genesis_header.hash.hex()}")
     print(f"JSON-RPC listening on http://{args.http_addr}:{server.port}")
+    authrpc = None
+    if args.authrpc_port:
+        if args.jwt_path:
+            with open(args.jwt_path) as f:
+                jwt_secret = bytes.fromhex(
+                    f.read().strip().removeprefix("0x"))
+        else:
+            # never expose an unauthenticated consensus-control endpoint:
+            # generate a secret like the reference does and tell the user
+            import secrets as _secrets
+
+            jwt_secret = _secrets.token_bytes(32)
+            print(f"generated JWT secret (pass to your CL): "
+                  f"{jwt_secret.hex()}")
+        authrpc = RpcServer(node, args.http_addr, args.authrpc_port,
+                            jwt_secret=jwt_secret, engine=True).start()
+        print(f"Engine API listening on http://{args.http_addr}:"
+              f"{authrpc.port}")
+    metrics = None
+    if args.metrics_port:
+        from .utils.metrics import MetricsServer
+
+        metrics = MetricsServer(args.http_addr, args.metrics_port).start()
+        print(f"metrics on http://{args.http_addr}:{metrics.port}/metrics")
     if args.dev:
         node.start_dev_producer(args.block_time)
         print(f"dev producer running (block time {args.block_time}s)")

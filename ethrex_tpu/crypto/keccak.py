@@ -115,6 +115,37 @@ def _keccak256_py(data: bytes) -> bytes:
     return b"".join(st[i].to_bytes(8, "little") for i in range(4))
 
 
+class IncrementalKeccak256:
+    """Streaming keccak-256: absorb incrementally, snapshot digests in O(1)
+    amortized per byte (used by the RLPx egress/ingress frame MACs)."""
+
+    RATE = 136
+
+    def __init__(self):
+        self._state = [0] * 25
+        self._buf = b""
+
+    def update(self, data: bytes):
+        self._buf += data
+        while len(self._buf) >= self.RATE:
+            block = self._buf[:self.RATE]
+            self._buf = self._buf[self.RATE:]
+            for i in range(self.RATE // 8):
+                self._state[i] ^= int.from_bytes(
+                    block[8 * i:8 * i + 8], "little")
+            _f1600(self._state)
+
+    def digest(self) -> bytes:
+        state = list(self._state)
+        block = self._buf + b"\x01" + b"\x00" * (
+            self.RATE - len(self._buf) - 1)
+        block = block[:-1] + bytes([block[-1] | 0x80])
+        for i in range(self.RATE // 8):
+            state[i] ^= int.from_bytes(block[8 * i:8 * i + 8], "little")
+        _f1600(state)
+        return b"".join(state[i].to_bytes(8, "little") for i in range(4))
+
+
 def keccak256(data: bytes) -> bytes:
     lib = _load_native()
     if lib:

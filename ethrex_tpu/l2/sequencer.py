@@ -113,6 +113,9 @@ class Sequencer:
                                        program_input.to_json())
         self.rollup.set_committed(number, commitment)
         self.last_batched_block = head
+        from ..utils.metrics import record_batch
+
+        record_batch(number)
         return batch
 
     # ------------------------------------------------------------------

@@ -83,12 +83,16 @@ class Node:
 
             txs = list(forced_txs or []) \
                 + self.mempool.pending(base_fee, get_nonce)
+            t0 = time.monotonic()
             result = build_payload(self.chain, parent, header, txs, [],
                                    mempool=self.mempool)
             self.chain.add_block(result.block)
             apply_fork_choice(self.store, result.block.hash)
             for tx in result.block.body.transactions:
                 self.mempool.remove_transaction(tx.hash)
+            from .utils.metrics import record_block
+
+            record_block(result.block, time.monotonic() - t0)
             return result.block
 
     def start_dev_producer(self, block_time: float = 1.0):

@@ -1,0 +1,270 @@
+"""discv4 node discovery: packet codec + UDP server + Kademlia table
+(parity target: the reference's crates/networking/p2p/discovery — discv4
+ping/pong/findnode/neighbors with signed packets; discv5 arrives later).
+
+Packet layout (devp2p spec):
+    hash(32) || signature(65: r||s||v) || packet-type(1) || rlp(packet-data)
+    hash = keccak256(signature || type || data)
+    signature = sign(keccak256(type || data))
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import ipaddress
+import socket
+import threading
+import time
+
+from ..crypto import secp256k1
+from ..crypto.keccak import keccak256
+from ..primitives import rlp
+
+PING = 0x01
+PONG = 0x02
+FINDNODE = 0x03
+NEIGHBORS = 0x04
+
+EXPIRATION_SECONDS = 20
+PROTO_VERSION = 4
+
+
+class DiscoveryError(Exception):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Endpoint:
+    ip: str
+    udp_port: int
+    tcp_port: int
+
+    def to_fields(self):
+        return [ipaddress.ip_address(self.ip).packed, self.udp_port,
+                self.tcp_port]
+
+    @classmethod
+    def from_fields(cls, f):
+        return cls(str(ipaddress.ip_address(bytes(f[0]))),
+                   rlp.decode_int(f[1]), rlp.decode_int(f[2]))
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeRecord:
+    node_id: bytes          # 64-byte uncompressed pubkey (no 0x04 prefix)
+    endpoint: Endpoint
+
+    @property
+    def id_hash(self) -> bytes:
+        return keccak256(self.node_id)
+
+
+def pubkey_to_node_id(pub) -> bytes:
+    x, y = pub
+    return x.to_bytes(32, "big") + y.to_bytes(32, "big")
+
+
+def node_id_to_pubkey(node_id: bytes):
+    return (int.from_bytes(node_id[:32], "big"),
+            int.from_bytes(node_id[32:], "big"))
+
+
+# ---------------------------------------------------------------------------
+# packet codec
+# ---------------------------------------------------------------------------
+
+def encode_packet(secret: int, ptype: int, data_fields) -> bytes:
+    data = rlp.encode(data_fields)
+    to_sign = keccak256(bytes([ptype]) + data)
+    r, s, rec = secp256k1.sign(to_sign, secret)
+    sig = r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([rec])
+    body = sig + bytes([ptype]) + data
+    return keccak256(body) + body
+
+
+def decode_packet(datagram: bytes):
+    """Returns (packet_hash, node_id, ptype, fields)."""
+    if len(datagram) < 98:
+        raise DiscoveryError("datagram too short")
+    phash, body = datagram[:32], datagram[32:]
+    if keccak256(body) != phash:
+        raise DiscoveryError("bad packet hash")
+    sig, ptype, data = body[:65], body[65], body[66:]
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:64], "big")
+    rec = sig[64]
+    pub = secp256k1.recover(keccak256(bytes([ptype]) + data), r, s, rec)
+    if pub is None:
+        raise DiscoveryError("bad packet signature")
+    return phash, pubkey_to_node_id(pub), ptype, rlp.decode(data)
+
+
+def make_ping(secret: int, frm: Endpoint, to: Endpoint) -> bytes:
+    return encode_packet(secret, PING, [
+        PROTO_VERSION, frm.to_fields(), to.to_fields(),
+        int(time.time()) + EXPIRATION_SECONDS])
+
+
+def make_pong(secret: int, to: Endpoint, ping_hash: bytes) -> bytes:
+    return encode_packet(secret, PONG, [
+        to.to_fields(), ping_hash,
+        int(time.time()) + EXPIRATION_SECONDS])
+
+
+def make_findnode(secret: int, target_id: bytes) -> bytes:
+    return encode_packet(secret, FINDNODE, [
+        target_id, int(time.time()) + EXPIRATION_SECONDS])
+
+
+def make_neighbors(secret: int, nodes: list[NodeRecord]) -> bytes:
+    return encode_packet(secret, NEIGHBORS, [
+        [n.endpoint.to_fields() + [n.node_id] for n in nodes],
+        int(time.time()) + EXPIRATION_SECONDS])
+
+
+# ---------------------------------------------------------------------------
+# Kademlia table
+# ---------------------------------------------------------------------------
+
+BUCKET_SIZE = 16
+NUM_BUCKETS = 256
+
+
+class KademliaTable:
+    def __init__(self, local_id: bytes):
+        self.local_hash = keccak256(local_id)
+        self.buckets: list[list[NodeRecord]] = [[] for _ in
+                                                range(NUM_BUCKETS)]
+        self.lock = threading.RLock()
+
+    def _bucket_index(self, node: NodeRecord) -> int:
+        dist = int.from_bytes(
+            bytes(a ^ b for a, b in zip(self.local_hash, node.id_hash)),
+            "big")
+        return max(dist.bit_length() - 1, 0)
+
+    def insert(self, node: NodeRecord) -> bool:
+        with self.lock:
+            bucket = self.buckets[self._bucket_index(node)]
+            for existing in bucket:
+                if existing.node_id == node.node_id:
+                    return False
+            if len(bucket) >= BUCKET_SIZE:
+                return False  # eviction policy comes with liveness checks
+            bucket.append(node)
+            return True
+
+    def closest(self, target_id: bytes, count: int = BUCKET_SIZE):
+        target_hash = keccak256(target_id)
+
+        def distance(n: NodeRecord) -> int:
+            return int.from_bytes(
+                bytes(a ^ b for a, b in zip(target_hash, n.id_hash)), "big")
+
+        with self.lock:
+            all_nodes = [n for b in self.buckets for n in b]
+        return sorted(all_nodes, key=distance)[:count]
+
+    def __len__(self):
+        with self.lock:
+            return sum(len(b) for b in self.buckets)
+
+
+# ---------------------------------------------------------------------------
+# UDP discovery server
+# ---------------------------------------------------------------------------
+
+class DiscoveryServer:
+    """Minimal discv4 actor: answers pings/findnode, pings bootnodes,
+    fills the Kademlia table from pong/neighbors."""
+
+    def __init__(self, secret: int, host: str = "127.0.0.1", port: int = 0,
+                 tcp_port: int = 30303):
+        self.secret = secret
+        self.node_id = pubkey_to_node_id(
+            secp256k1.pubkey_from_secret(secret))
+        self.table = KademliaTable(self.node_id)
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind((host, port))
+        self.host, self.port = self.sock.getsockname()
+        self.tcp_port = tcp_port
+        self.endpoint = Endpoint(self.host, self.port, tcp_port)
+        self._stop = threading.Event()
+        self._pending_pings: dict[bytes, tuple[bytes, float]] = {}
+        self.seen_peers: set[bytes] = set()
+
+    # -- outbound ----------------------------------------------------------
+    def ping(self, to: Endpoint):
+        now = time.monotonic()
+        # prune expired pending pings (unbounded growth + stale acceptance)
+        self._pending_pings = {
+            h: (nid, dl) for h, (nid, dl) in self._pending_pings.items()
+            if dl > now}
+        pkt = make_ping(self.secret, self.endpoint, to)
+        self._pending_pings[pkt[:32]] = (b"", now + 60)
+        self.sock.sendto(pkt, (to.ip, to.udp_port))
+
+    def find_node(self, to: Endpoint, target_id: bytes | None = None):
+        pkt = make_findnode(self.secret, target_id or self.node_id)
+        self.sock.sendto(pkt, (to.ip, to.udp_port))
+
+    # -- inbound -----------------------------------------------------------
+    def _handle(self, datagram: bytes, addr):
+        try:
+            phash, node_id, ptype, fields = decode_packet(datagram)
+        except (DiscoveryError, rlp.RLPError):
+            return
+        endpoint = Endpoint(addr[0], addr[1], addr[1])
+        record = NodeRecord(node_id, endpoint)
+        if ptype == PING:
+            exp = rlp.decode_int(fields[3])
+            if exp < time.time():
+                return
+            self.sock.sendto(
+                make_pong(self.secret, endpoint, phash), addr)
+            self.table.insert(record)
+            self.seen_peers.add(node_id)
+        elif ptype == PONG:
+            ping_hash = bytes(fields[1])
+            pending = self._pending_pings.get(ping_hash)
+            if pending is not None and pending[1] > time.monotonic():
+                del self._pending_pings[ping_hash]
+                self.table.insert(record)
+                self.seen_peers.add(node_id)
+        elif ptype == FINDNODE:
+            # endpoint proof: only answer peers that completed ping/pong,
+            # otherwise this is a UDP amplification reflector
+            if node_id not in self.seen_peers:
+                return
+            exp = rlp.decode_int(fields[1])
+            if exp < time.time():
+                return
+            target = bytes(fields[0])
+            closest = self.table.closest(target)
+            # split so each datagram stays under the 1280-byte discv4 max
+            for i in range(0, len(closest), 12):
+                self.sock.sendto(
+                    make_neighbors(self.secret, closest[i:i + 12]), addr)
+        elif ptype == NEIGHBORS:
+            for nf in fields[0]:
+                ep = Endpoint.from_fields(nf[:3])
+                self.table.insert(NodeRecord(bytes(nf[3]), ep))
+
+    def _loop(self):
+        self.sock.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                datagram, addr = self.sock.recvfrom(1500)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            self._handle(datagram, addr)
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self.sock.close()

@@ -1,0 +1,111 @@
+"""Prometheus metrics (parity target: the reference's ethrex-metrics crate,
+crates/blockchain/metrics — text exposition format, stdlib only)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class Metrics:
+    """Process-wide metric registry (counters + gauges + histograms-lite)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.counters: dict[str, float] = {}
+        self.gauges: dict[str, float] = {}
+        self.help: dict[str, str] = {}
+        self.started = time.time()
+
+    def inc(self, name: str, value: float = 1.0, help_text: str = ""):
+        with self.lock:
+            self.counters[name] = self.counters.get(name, 0.0) + value
+            if help_text:
+                self.help[name] = help_text
+
+    def set(self, name: str, value: float, help_text: str = ""):
+        with self.lock:
+            self.gauges[name] = value
+            if help_text:
+                self.help[name] = help_text
+
+    def render(self) -> str:
+        with self.lock:
+            lines = []
+            for name, value in sorted(self.counters.items()):
+                if name in self.help:
+                    lines.append(f"# HELP {name} {self.help[name]}")
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {value}")
+            for name, value in sorted(self.gauges.items()):
+                if name in self.help:
+                    lines.append(f"# HELP {name} {self.help[name]}")
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {value}")
+            lines.append("# TYPE process_uptime_seconds gauge")
+            lines.append(
+                f"process_uptime_seconds {time.time() - self.started}")
+            return "\n".join(lines) + "\n"
+
+
+METRICS = Metrics()  # global registry, like the reference's statics
+
+
+def record_block(block, elapsed: float):
+    METRICS.inc("ethrex_blocks_imported_total", 1,
+                "Blocks imported through add_block")
+    METRICS.inc("ethrex_gas_used_total", block.header.gas_used,
+                "Cumulative gas executed")
+    METRICS.inc("ethrex_transactions_total",
+                len(block.body.transactions), "Transactions executed")
+    METRICS.set("ethrex_head_block", block.header.number,
+                "Current head block number")
+    if elapsed > 0:
+        METRICS.set("ethrex_last_block_mgas_per_s",
+                    block.header.gas_used / elapsed / 1e6,
+                    "Execution throughput of the last imported block")
+
+
+def record_batch(batch_number: int, proving_time: float | None = None):
+    METRICS.set("ethrex_l2_latest_batch", batch_number,
+                "Latest committed L2 batch")
+    if proving_time is not None:
+        METRICS.set("ethrex_l2_batch_proving_seconds", proving_time,
+                    "Wall-clock of the last batch proof")
+
+
+class MetricsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 9090):
+        self.host = host
+        self.port = port
+        self._httpd = None
+
+    def start(self):
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path != "/metrics":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = METRICS.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()

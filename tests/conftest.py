@@ -22,3 +22,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# persistent compilation cache: the STARK phase programs dominate test time
+# on cold runs; cached XLA binaries make re-runs fast
+jax.config.update("jax_compilation_cache_dir", "/tmp/ethrex_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

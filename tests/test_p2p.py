@@ -1,0 +1,137 @@
+"""p2p: discv4 packet codec + two-node UDP discovery; RLPx handshake +
+framing loopback (the reference's no-network test style,
+test/tests/p2p/{discovery,rlpx})."""
+
+import os
+import time
+
+import pytest
+
+from ethrex_tpu.crypto import secp256k1
+from ethrex_tpu.p2p import discv4, rlpx
+from ethrex_tpu.utils.metrics import METRICS, MetricsServer
+
+KEY_A = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
+KEY_B = 0x9E7645D0CFD9C3A04EB7A9DB59A4EB3D504F79363B88FA77A6AD6B2AF3E48B7B % \
+    secp256k1.N
+
+
+def test_discv4_packet_codec():
+    frm = discv4.Endpoint("127.0.0.1", 30301, 30301)
+    to = discv4.Endpoint("127.0.0.1", 30302, 30302)
+    pkt = discv4.make_ping(KEY_A, frm, to)
+    phash, node_id, ptype, fields = discv4.decode_packet(pkt)
+    assert ptype == discv4.PING
+    assert node_id == discv4.pubkey_to_node_id(
+        secp256k1.pubkey_from_secret(KEY_A))
+    # tampered packet rejected
+    bad = pkt[:40] + bytes([pkt[40] ^ 1]) + pkt[41:]
+    with pytest.raises(discv4.DiscoveryError):
+        discv4.decode_packet(bad)
+
+
+def test_discv4_two_node_discovery():
+    a = discv4.DiscoveryServer(KEY_A).start()
+    b = discv4.DiscoveryServer(KEY_B).start()
+    try:
+        a.ping(b.endpoint)
+        deadline = time.time() + 5
+        while time.time() < deadline and (len(a.table) < 1
+                                          or len(b.table) < 1):
+            time.sleep(0.05)
+        assert len(a.table) == 1 and len(b.table) == 1
+        assert b.node_id in a.seen_peers
+        # findnode -> neighbors round trip
+        a.find_node(b.endpoint)
+        time.sleep(0.3)
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_kademlia_table():
+    local = discv4.pubkey_to_node_id(secp256k1.pubkey_from_secret(KEY_A))
+    table = discv4.KademliaTable(local)
+    records = []
+    for i in range(1, 40):
+        nid = discv4.pubkey_to_node_id(secp256k1.pubkey_from_secret(i))
+        rec = discv4.NodeRecord(nid, discv4.Endpoint("10.0.0.1", i, i))
+        table.insert(rec)
+        records.append(rec)
+    assert len(table) > 0
+    closest = table.closest(records[0].node_id, 5)
+    assert closest[0].node_id == records[0].node_id  # itself is closest
+    # duplicate insert is a no-op
+    assert not table.insert(records[0])
+
+
+def test_rlpx_handshake_and_framing():
+    static_a, static_b = KEY_A, KEY_B
+    eph_a = int.from_bytes(os.urandom(32), "big") % secp256k1.N
+    eph_b = int.from_bytes(os.urandom(32), "big") % secp256k1.N
+    nonce_a, nonce_b = os.urandom(32), os.urandom(32)
+    pub_b = secp256k1.pubkey_from_secret(static_b)
+
+    auth = rlpx.make_auth(static_a, eph_a, nonce_a, pub_b)
+    init_pub, eph_pub_a, got_nonce_a = rlpx.parse_auth(static_b, auth)
+    assert init_pub == secp256k1.pubkey_from_secret(static_a)
+    assert eph_pub_a == secp256k1.pubkey_from_secret(eph_a)
+    assert got_nonce_a == nonce_a
+
+    ack = rlpx.make_ack(eph_b, nonce_b, init_pub)
+    eph_pub_b, got_nonce_b = rlpx.parse_ack(static_a, ack)
+    assert eph_pub_b == secp256k1.pubkey_from_secret(eph_b)
+    assert got_nonce_b == nonce_b
+
+    sec_a = rlpx.derive_secrets(True, eph_a, eph_pub_b, nonce_a, nonce_b,
+                                auth, ack)
+    sec_b = rlpx.derive_secrets(False, eph_b, eph_pub_a, nonce_b, nonce_a,
+                                auth, ack)
+    assert sec_a.aes == sec_b.aes and sec_a.mac == sec_b.mac
+
+    # framed hello exchange both directions
+    hello = rlpx.make_hello_payload("ethrex-tpu/0.1.0", b"\x01" * 64)
+    frame = sec_a.seal_frame(0, hello)
+    msg_id, payload = sec_b.open_frame(frame)
+    assert msg_id == 0
+    parsed = rlpx.parse_hello_payload(payload)
+    assert parsed["client_id"] == "ethrex-tpu/0.1.0"
+    assert ("eth", 68) in parsed["capabilities"]
+    # second frame continues the MAC/cipher streams
+    f2 = sec_b.seal_frame(16, b"\x05\x03")
+    mid2, p2 = sec_a.open_frame(f2)
+    assert mid2 == 16 and p2 == b"\x05\x03"
+    # tampered frame rejected
+    f3 = sec_a.seal_frame(1, b"xyz")
+    bad = f3[:16] + bytes([f3[16] ^ 1]) + f3[17:]
+    with pytest.raises(rlpx.RlpxError):
+        sec_b.open_frame(bad)
+
+
+def test_ecies_roundtrip_and_tamper():
+    secret = KEY_B
+    pub = secp256k1.pubkey_from_secret(secret)
+    msg = b"hello rlpx" * 7
+    ct = rlpx.ecies_encrypt(pub, msg, b"ad")
+    assert rlpx.ecies_decrypt(secret, ct, b"ad") == msg
+    with pytest.raises(rlpx.RlpxError):
+        rlpx.ecies_decrypt(secret, ct, b"other-ad")
+    with pytest.raises(rlpx.RlpxError):
+        rlpx.ecies_decrypt(secret, ct[:-1] + bytes([ct[-1] ^ 1]), b"ad")
+
+
+def test_metrics_endpoint():
+    METRICS.inc("test_metric_total", 3, "a test metric")
+    METRICS.set("test_gauge", 7.5)
+    server = MetricsServer(port=0).start()
+    try:
+        import urllib.request
+
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=5
+        ).read().decode()
+        assert "test_metric_total 3" in body
+        assert "test_gauge 7.5" in body
+        assert "process_uptime_seconds" in body
+    finally:
+        server.stop()
