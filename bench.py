@@ -19,8 +19,8 @@ from __future__ import annotations
 import json
 import time
 
-LOG_N = 13
-WIDTH = 32
+LOG_N = 15
+WIDTH = 64
 BASELINE_CELLS_PER_SEC = 1.0e8
 
 
