@@ -257,6 +257,75 @@ class EthApi:
             raise RpcError(-32000, str(e))
         return hb(tx.hash)
 
+    def get_proof(self, address, slots, tag="latest"):
+        """eth_getProof: account + storage Merkle proofs."""
+        from ..crypto.keccak import keccak256
+        from ..primitives import rlp as _rlp
+        from ..primitives.account import AccountState, EMPTY_TRIE_ROOT
+        from ..trie.trie import Trie
+
+        store = self.node.store
+        root = self._state_root(tag)
+        addr = parse_bytes(address)
+        trie = Trie.from_nodes(root, store.nodes, share=True)
+        key = keccak256(addr)
+        account_proof = [hb(n) for n in trie.get_proof(key)]
+        raw = trie.get(key)
+        acct = AccountState.decode(raw) if raw else AccountState()
+        storage_proofs = []
+        st = None
+        if acct.storage_root != EMPTY_TRIE_ROOT:
+            st = Trie.from_nodes(acct.storage_root, store.nodes, share=True)
+        for slot in slots or []:
+            slot_i = parse_quantity(slot)
+            skey = keccak256(slot_i.to_bytes(32, "big"))
+            if st is None:
+                storage_proofs.append(
+                    {"key": hx(slot_i), "value": "0x0", "proof": []})
+                continue
+            sraw = st.get(skey)
+            value = _rlp.decode_int(_rlp.decode(sraw)) if sraw else 0
+            storage_proofs.append({
+                "key": hx(slot_i), "value": hx(value),
+                "proof": [hb(n) for n in st.get_proof(skey)]})
+        return {
+            "address": hb(addr),
+            "accountProof": account_proof,
+            "balance": hx(acct.balance),
+            "nonce": hx(acct.nonce),
+            "codeHash": hb(acct.code_hash),
+            "storageHash": hb(acct.storage_root),
+            "storageProof": storage_proofs,
+        }
+
+    def debug_execution_witness(self, from_tag, to_tag=None):
+        """debug_executionWitness: witness for a canonical block range
+        (the reference's replay/prover entry point)."""
+        from ..guest.witness import generate_witness
+
+        MAX_RANGE = 128  # bound the synchronous re-execution work per call
+        from_b = self._resolve_block(from_tag)
+        to_b = self._resolve_block(to_tag if to_tag is not None else from_tag)
+        first, last = from_b.header.number, to_b.header.number
+        if first == 0:
+            raise RpcError(-32602, "cannot generate a witness for genesis")
+        if last < first:
+            raise RpcError(-32602, "invalid range: toBlock before fromBlock")
+        if last - first + 1 > MAX_RANGE:
+            raise RpcError(-32602, f"range exceeds {MAX_RANGE} blocks")
+        store = self.node.store
+        # only canonical blocks: a side-chain hash tag must not silently
+        # resolve to the canonical block at the same height
+        for b in (from_b, to_b):
+            if store.canonical_hash(b.header.number) != b.hash:
+                raise RpcError(-32602, "block is not canonical")
+        blocks = [store.get_canonical_block(n)
+                  for n in range(first, last + 1)]
+        if any(b is None for b in blocks):
+            raise RpcError(-38001, "unknown block in range")
+        witness = generate_witness(self.node.chain, blocks)
+        return witness.to_json()
+
     def fee_history(self, count, newest, percentiles=None):
         count = parse_quantity(count)
         newest_b = self._resolve_block(newest)

@@ -58,6 +58,8 @@ class RpcServer:
             "eth_estimateGas": e.estimate_gas,
             "eth_sendRawTransaction": e.send_raw_transaction,
             "eth_feeHistory": e.fee_history,
+            "eth_getProof": e.get_proof,
+            "debug_executionWitness": e.debug_execution_witness,
             "net_version": lambda: str(node.config.chain_id),
             "net_listening": lambda: True,
             "net_peerCount": lambda: "0x0",

@@ -105,6 +105,33 @@ def test_eth_call_and_estimate(rpc):
     assert 21000 <= int(est, 16) < 30000
 
 
+def test_get_proof_and_witness(rpc):
+    call, node = rpc
+    # eth_getProof verifies against the state root
+    proof = call("eth_getProof", "0x" + SENDER.hex(), [], "latest")["result"]
+    from ethrex_tpu.crypto.keccak import keccak256
+    from ethrex_tpu.trie.trie import verify_proof
+    root = node.store.head_header().state_root
+    nodes = [bytes.fromhex(p[2:]) for p in proof["accountProof"]]
+    ok, value = verify_proof(root, keccak256(SENDER), nodes)
+    assert ok and value is not None
+    from ethrex_tpu.primitives.account import AccountState
+    acct = AccountState.decode(value)
+    assert acct.balance == int(proof["balance"], 16)
+    # debug_executionWitness -> stateless re-execution round trip over RPC
+    head = node.store.latest_number()
+    assert head >= 1
+    wit_json = call("debug_executionWitness", "0x1", hex(head))["result"]
+    from ethrex_tpu.guest.execution import ProgramInput, execution_program
+    from ethrex_tpu.guest.witness import ExecutionWitness
+    blocks = [node.store.get_canonical_block(n) for n in range(1, head + 1)]
+    pi = ProgramInput(blocks=blocks,
+                      witness=ExecutionWitness.from_json(wit_json),
+                      config=node.config)
+    out = execution_program(pi)
+    assert out.final_state_root == blocks[-1].header.state_root
+
+
 def test_error_paths(rpc):
     call, node = rpc
     assert "error" in call("eth_fooBar")
