@@ -17,7 +17,7 @@ class FibonacciAir(Air):
     max_degree = 1
     num_pub_inputs = 3
 
-    def constraints(self, local, nxt, ops):
+    def constraints(self, local, nxt, periodic, ops):
         a, b = local
         an, bn = nxt
         return [

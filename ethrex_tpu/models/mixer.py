@@ -24,7 +24,7 @@ class MixerAir(Air):
         self.width = width
         self.num_pub_inputs = width + 1
 
-    def constraints(self, local, nxt, ops):
+    def constraints(self, local, nxt, periodic, ops):
         w = self.width
         return [
             ops.sub(nxt[i], ops.add(ops.mul(local[i], local[i]),

@@ -1,0 +1,174 @@
+"""Poseidon2 AIR: the permutation proven in-circuit, one row per round.
+
+This is the first cryptographically real AIR (hash preimage/compression
+binding) and the core building block of the future zkVM AIR's hash/memory
+arguments.  It proves y = P(x) for the SAME Poseidon2 the framework uses
+for Merkle commitments (ops/poseidon2.py) — constants, matrices, rounds all
+identical, verified by tests against permute_ref.
+
+Layout (single permutation, n = 32 rows).  NOTE: chaining k permutations in
+one trace needs an absorb/handoff row in the schedule (the padding
+copy-constraint otherwise pins row 32 to row 31) — that lands together with
+the sponge-mode AIR; today's statement is one compression per proof.
+  row 0      = state after the initial external linear layer
+  row r+1    = round r applied to row r         (r = 0..20)
+  row 21     = P(x) (final state)
+  rows 22-31 = padding (forced copies of row 21)
+
+Periodic columns: [sel_ext, sel_int, ext_rc_0..15, int_rc] — selectors pick
+the round type per row; the x^7 S-box makes max constraint degree 8
+(selector deg 1 + sbox deg 7), so the proof runs at blowup 8.
+
+Public inputs: 16 input limbs + 8 digest limbs, bound via boundary
+constraints at rows 0 and 21; digest = P(x)[:8] + x[:8] (the framework's
+2-to-1 compression feed-forward, ops/poseidon2.compress).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import babybear as bb
+from ..ops import poseidon2 as p2
+from ..stark.air import Air
+
+PERIOD = 32
+ROUNDS = p2.ROUNDS_F + p2.ROUNDS_P  # 21
+_EXT_ROWS_1 = list(range(0, p2._HALF_F))                      # rounds 0-3
+_INT_ROWS = list(range(p2._HALF_F, p2._HALF_F + p2.ROUNDS_P))  # 4-16
+_EXT_ROWS_2 = list(range(p2._HALF_F + p2.ROUNDS_P, ROUNDS))    # 17-20
+
+
+def _m4_generic(x0, x1, x2, x3, ops):
+    """The Poseidon2 M4 evaluation chain over abstract field ops
+    (mirrors ops/poseidon2._m4)."""
+    dbl = lambda v: ops.add(v, v)  # noqa: E731
+    t0 = ops.add(x0, x1)
+    t1 = ops.add(x2, x3)
+    t2 = ops.add(dbl(x1), t1)
+    t3 = ops.add(dbl(x3), t0)
+    t4 = ops.add(dbl(dbl(t1)), t3)
+    t5 = ops.add(dbl(dbl(t0)), t2)
+    t6 = ops.add(t3, t5)
+    t7 = ops.add(t2, t4)
+    return t6, t5, t7, t4
+
+
+def _external_linear_generic(cols, ops):
+    blocks = [_m4_generic(*cols[i:i + 4], ops) for i in range(0, 16, 4)]
+    sums = [ops.add(ops.add(blocks[0][j], blocks[1][j]),
+                    ops.add(blocks[2][j], blocks[3][j])) for j in range(4)]
+    out = []
+    for b in blocks:
+        out.extend(ops.add(b[j], sums[j]) for j in range(4))
+    return out
+
+
+def _sbox_generic(x, ops):
+    x2 = ops.mul(x, x)
+    x4 = ops.mul(x2, x2)
+    return ops.mul(ops.mul(x4, x2), x)
+
+
+class Poseidon2Air(Air):
+    width = p2.WIDTH            # 16
+    max_degree = 8              # selector (1) * sbox (7)
+    num_pub_inputs = 24         # 16 input limbs + 8 digest limbs
+    num_periodic = 2 + 16 + 1   # sel_ext, sel_int, ext rc x16, int rc
+
+    def periodic_columns(self, n: int):
+        if n % PERIOD:
+            raise ValueError("trace length must be a multiple of 32")
+        sel_ext = np.zeros(PERIOD, dtype=np.uint32)
+        sel_int = np.zeros(PERIOD, dtype=np.uint32)
+        for r in _EXT_ROWS_1 + _EXT_ROWS_2:
+            sel_ext[r] = 1
+        for r in _INT_ROWS:
+            sel_int[r] = 1
+        ext_rc = np.zeros((16, PERIOD), dtype=np.uint32)
+        for i, r in enumerate(_EXT_ROWS_1):
+            ext_rc[:, r] = p2.EXT_RC[i]
+        for i, r in enumerate(_EXT_ROWS_2):
+            ext_rc[:, r] = p2.EXT_RC[p2._HALF_F + i]
+        int_rc = np.zeros(PERIOD, dtype=np.uint32)
+        for i, r in enumerate(_INT_ROWS):
+            int_rc[r] = p2.INT_RC[i]
+        return [sel_ext, sel_int] + [ext_rc[j] for j in range(16)] + [int_rc]
+
+    def constraints(self, local, nxt, periodic, ops):
+        sel_ext, sel_int = periodic[0], periodic[1]
+        ext_rc = periodic[2:18]
+        int_rc = periodic[18]
+        one = ops.const(1)
+        sel_none = ops.sub(ops.sub(one, sel_ext), sel_int)
+        # external round: M_E(sbox(s + rc))
+        sboxed = [_sbox_generic(ops.add(local[j], ext_rc[j]), ops)
+                  for j in range(16)]
+        ext_out = _external_linear_generic(sboxed, ops)
+        # internal round: s0 <- sbox(s0 + rc); out = sum(s) + mu_j * s_j
+        s0 = _sbox_generic(ops.add(local[0], int_rc), ops)
+        int_state = [s0] + list(local[1:])
+        tot = int_state[0]
+        for v in int_state[1:]:
+            tot = ops.add(tot, v)
+        mu = [ops.const(int(m)) for m in p2.DIAG_MU]
+        int_out = [ops.add(tot, ops.mul(mu[j], int_state[j]))
+                   for j in range(16)]
+        out = []
+        for j in range(16):
+            c = ops.add(
+                ops.add(
+                    ops.mul(sel_ext, ops.sub(nxt[j], ext_out[j])),
+                    ops.mul(sel_int, ops.sub(nxt[j], int_out[j]))),
+                ops.mul(sel_none, ops.sub(nxt[j], local[j])))
+            out.append(c)
+        return out
+
+    def boundaries(self, pub_inputs, n: int):
+        limbs = [int(v) % bb.P for v in pub_inputs[:16]]
+        digest = [int(v) % bb.P for v in pub_inputs[16:24]]
+        row0 = p2._external_linear_ref(limbs)
+        out = [(0, j, row0[j]) for j in range(16)]
+        # digest = P(x)[:8] + x[:8]  =>  final-state limb = digest - input
+        out += [(ROUNDS, j, (digest[j] - limbs[j]) % bb.P)
+                for j in range(8)]
+        return out
+
+
+def generate_trace(limbs: list[int]) -> np.ndarray:
+    """Round-by-round permutation states for P(limbs), padded to 32 rows."""
+    assert len(limbs) == 16
+    trace = np.zeros((PERIOD, 16), dtype=np.uint32)
+    s = p2._external_linear_ref([int(v) % bb.P for v in limbs])
+    trace[0] = s
+    row = 0
+    for r in range(p2._HALF_F):
+        s = [(x + int(c)) % bb.P for x, c in zip(s, p2.EXT_RC[r])]
+        s = [p2._sbox_ref(x) for x in s]
+        s = p2._external_linear_ref(s)
+        row += 1
+        trace[row] = s
+    for r in range(p2.ROUNDS_P):
+        s0 = p2._sbox_ref((s[0] + int(p2.INT_RC[r])) % bb.P)
+        s = [s0] + s[1:]
+        tot = sum(s) % bb.P
+        s = [(tot + int(m) * x) % bb.P for x, m in zip(s, p2.DIAG_MU)]
+        row += 1
+        trace[row] = s
+    for r in range(p2._HALF_F, p2.ROUNDS_F):
+        s = [(x + int(c)) % bb.P for x, c in zip(s, p2.EXT_RC[r])]
+        s = [p2._sbox_ref(x) for x in s]
+        s = p2._external_linear_ref(s)
+        row += 1
+        trace[row] = s
+    for r in range(row + 1, PERIOD):
+        trace[r] = trace[row]
+    return trace
+
+
+def public_inputs(limbs: list[int]) -> list[int]:
+    """[input limbs, digest] with digest = compress feed-forward."""
+    limbs = [int(v) % bb.P for v in limbs]
+    final = p2.permute_ref(limbs)
+    digest = [(final[j] + limbs[j]) % bb.P for j in range(8)]
+    return limbs + digest

@@ -2,34 +2,31 @@
 
 Round-1 scope: the guest program runs natively on the host, and the TPU
 produces an **output-binding STARK** — a real DEEP-FRI proof (device LDE +
-Poseidon2 Merkle + FRI) over a Mixer trace seeded with the ProgramOutput
-digest, verified by the independent host verifier.  This exercises the full
-coordinator -> TPU -> proof-store pipeline with real TPU proving work.
+Poseidon2 Merkle + FRI) of the in-circuit **Poseidon2 compression** of the
+ProgramOutput digest (models/poseidon2_air.py), verified by the independent
+host verifier.  The bound digest uses the same Poseidon2 as the framework's
+Merkle commitments, so the statement is "I know the 16-limb encoding of the
+claimed batch output whose Poseidon2 compression is this digest".
 
 What it does NOT yet prove: the EVM execution itself.  That requires the VM
 AIR (the reference delegates this to its zkVM SDKs; our equivalent is the
-round-2+ arithmetization of guest/execution.py).  The proof here binds the
-claimed ProgramOutput into a verified STARK via public inputs — equivalent
-trust to the reference's exec backend, plus end-to-end TPU kernels.
+arithmetization of guest/execution.py — the Poseidon2 AIR here is its first
+building block).  Until then the execution-trust level matches the
+reference's exec backend, with real TPU proving work end to end.
 """
 
 from __future__ import annotations
 
-import numpy as np
-
 from ..crypto.keccak import keccak256
 from ..guest.execution import ProgramInput
-from ..models.mixer import MixerAir
-from ..ops import babybear as bb
+from ..models import poseidon2_air as pair
 from ..stark import prover as stark_prover
 from ..stark import verifier as stark_verifier
 from ..stark.prover import StarkParams
 from . import protocol
 from .backend import ProverBackend
 
-TRACE_ROWS = 256
-WIDTH = 16
-PARAMS = StarkParams(log_blowup=2, num_queries=40, log_final_size=5)
+PARAMS = StarkParams(log_blowup=3, num_queries=40, log_final_size=4)
 
 
 def output_to_limbs(output_bytes: bytes) -> list[int]:
@@ -43,27 +40,18 @@ def output_to_limbs(output_bytes: bytes) -> list[int]:
     return limbs
 
 
-def _binding_trace(seed_limbs: list[int]) -> np.ndarray:
-    trace = np.zeros((TRACE_ROWS, WIDTH), dtype=np.uint64)
-    trace[0] = seed_limbs
-    for i in range(1, TRACE_ROWS):
-        prev = trace[i - 1]
-        trace[i] = (prev * prev + np.roll(prev, -1)) % bb.P
-    return trace.astype(np.uint32)
-
-
 class TpuBackend(ProverBackend):
     prover_type = protocol.PROVER_TPU
 
     def __init__(self):
-        self.air = MixerAir(width=WIDTH)
+        self.air = pair.Poseidon2Air()
 
     def prove(self, program_input: ProgramInput, proof_format: str) -> dict:
         output = self.execute(program_input)
         encoded = output.encode()
         limbs = output_to_limbs(encoded)
-        trace = _binding_trace(limbs)
-        pub = limbs + [int(trace[-1, 0])]
+        trace = pair.generate_trace(limbs)
+        pub = pair.public_inputs(limbs)
         stark = stark_prover.prove(self.air, trace, pub, PARAMS)
         return {
             "backend": self.prover_type,
@@ -79,8 +67,8 @@ class TpuBackend(ProverBackend):
             encoded = bytes.fromhex(proof["output"][2:])
             stark = proof["proof"]
             limbs = output_to_limbs(encoded)
-            # the proof's public inputs must match the claimed output
-            if stark["pub_inputs"][:WIDTH] != limbs:
+            # the proof's public inputs must bind the claimed output limbs
+            if [int(v) for v in stark["pub_inputs"][:16]] != limbs:
                 return False
             return stark_verifier.verify(self.air, stark, PARAMS)
         except (KeyError, ValueError, TypeError,

@@ -56,9 +56,11 @@ class Air:
     width: int = 0
     max_degree: int = 2      # max multiplicative degree of any constraint
     num_pub_inputs: int = 0  # boundary STRUCTURE must not depend on values
+    num_periodic: int = 0    # how many periodic columns periodic_columns gives
 
-    def constraints(self, local, nxt, ops):
-        """local/nxt: per-column field values (lists of length `width`).
+    def constraints(self, local, nxt, periodic, ops):
+        """local/nxt: per-column field values (lists of length `width`);
+        periodic: values of this AIR's periodic columns at the same point.
 
         Must return a list of constraint evaluations that vanish on every
         transition row (all rows but the last) of a valid trace.  Pure
@@ -66,6 +68,13 @@ class Air:
         host ext tuples.
         """
         raise NotImplementedError
+
+    def periodic_columns(self, n: int):
+        """Preprocessed columns: list of canonical numpy arrays whose length
+        divides n (selectors, round-constant schedules).  The prover bakes
+        their LDE into the quotient program; the verifier evaluates their
+        interpolants at zeta directly."""
+        return []
 
     def boundaries(self, pub_inputs, n: int):
         """Return [(row, col, value)] assertions binding public inputs."""
@@ -80,4 +89,5 @@ class Air:
     def num_constraints(self) -> int:
         ops = HostExtOps()
         zero = [ext.ZERO_H] * self.width
-        return len(self.constraints(zero, zero, ops))
+        zero_p = [ext.ZERO_H] * self.num_periodic
+        return len(self.constraints(zero, zero, zero_p, ops))

@@ -49,6 +49,18 @@ def _canon(arr) -> np.ndarray:
     return bb.from_mont_host(np.asarray(arr))
 
 
+def _periodic_coeffs(vals: np.ndarray) -> np.ndarray:
+    return ntt.interpolate_host(vals)
+
+
+def _stretch_coeffs(coeffs: np.ndarray, n: int, p_len: int) -> np.ndarray:
+    """Spread period-p coefficients onto the size-n domain:
+    f(x) = g(x^{n/p}) has coeff k*(n/p) = g_k."""
+    out = np.zeros(n, dtype=np.uint32)
+    out[:: n // p_len] = coeffs
+    return out
+
+
 _PHASE_CACHE: dict = {}
 
 
@@ -98,6 +110,19 @@ def _build_phases(air: Air, log_n: int, lb: int, shift: int):
         ((pts - pow(g_n, r, bb.P)) % bb.P).astype(np.uint32)
         for (r, _) in bounds_struct
     ]
+    # periodic (preprocessed) columns: LDE baked in as program constants
+    periodic_np = []
+    for vals in air.periodic_columns(n):
+        vals = np.asarray(vals, dtype=np.uint32) % bb.P
+        p_len = len(vals)
+        if n % p_len:
+            raise ValueError("periodic column length must divide n")
+        coeffs = bb.to_mont_host(_periodic_coeffs(vals))
+        evals = np.asarray(ntt.coset_evals_from_coeffs(
+            jnp.asarray(_stretch_coeffs(coeffs, n, p_len)), N, shift=shift))
+        periodic_np.append(evals)
+    if len(periodic_np) != air.num_periodic:
+        raise ValueError("periodic_columns does not match num_periodic")
     # divisor inverses depend only on structure: invert ONCE at build time
     # (one device batch inversion), not inside the per-proof jitted phase
     inv_stack_np = np.asarray(bb.batch_mont_inv(jnp.asarray(bb.to_mont_host(
@@ -118,7 +143,8 @@ def _build_phases(air: Air, log_n: int, lb: int, shift: int):
         rolled = jnp.roll(lde_cols, -B, axis=1)
         local = [lde_cols[j] for j in range(w)]
         nxt = [rolled[j] for j in range(w)]
-        cons = jnp.stack(air.constraints(local, nxt, dev))        # (K, N)
+        periodic = [jnp.asarray(p) for p in periodic_np]
+        cons = jnp.stack(air.constraints(local, nxt, periodic, dev))  # (K, N)
         apow = ext.ext_powers(alpha, K + nb)                      # (K+nb, 4)
         acc = bb.sum_mod(
             bb.mont_mul(cons[:, :, None], apow[:K, None, :]), axis=0

@@ -9,6 +9,9 @@ SDKs").
 
 from __future__ import annotations
 
+import numpy as np
+
+from ..ops.ntt import interpolate_host
 from ..ops import babybear as bb
 from ..ops import ext
 from ..ops import fri
@@ -20,6 +23,24 @@ from .prover import StarkParams
 
 class VerificationError(Exception):
     pass
+
+
+_INTERP_CACHE: dict = {}
+
+
+def _periodic_interpolants(air: Air, n: int) -> list[list[int]]:
+    """Coefficient vectors of the AIR's periodic columns (O(p^2) host
+    interpolation done once per (AIR structure, n))."""
+    key = (air.cache_key(), n)
+    cached = _INTERP_CACHE.get(key)
+    if cached is None:
+        cached = [
+            [int(v) for v in interpolate_host(
+                np.asarray(vals, dtype=np.uint32) % bb.P)]
+            for vals in air.periodic_columns(n)
+        ]
+        _INTERP_CACHE[key] = cached
+    return cached
 
 
 def _fail(msg: str):
@@ -78,7 +99,16 @@ def _verify(air: Air, proof: dict, params: StarkParams):
 
     # ---- constraint identity at zeta ------------------------------------
     hops = HostExtOps()
-    cons = air.constraints(t_at_z, t_at_zg, hops)
+    # periodic columns: evaluate the cached interpolants at zeta
+    periodic_at_z = []
+    for coeffs in _periodic_interpolants(air, n):
+        p_len = len(coeffs)
+        point = ext.h_pow(zeta, n // p_len)   # f(z) = g(z^{n/p})
+        acc = ext.ZERO_H
+        for c in reversed(coeffs):
+            acc = ext.h_add(ext.h_mul(acc, point), ext.h_from_base(c))
+        periodic_at_z.append(acc)
+    cons = air.constraints(t_at_z, t_at_zg, periodic_at_z, hops)
     bounds = air.boundaries(pub, n)
     zeta_n = ext.h_pow(zeta, n)
     z_trans_num = ext.h_sub(zeta_n, ext.ONE_H)              # zeta^n - 1
