@@ -38,6 +38,9 @@ def main(argv=None):
         prog="ethrex-tpu", description="TPU-native Ethereum L1/L2 node")
     parser.add_argument("--dev", action="store_true",
                         help="dev mode: auto-produce blocks from the mempool")
+    parser.add_argument("--datadir",
+                        help="persist the chain in <datadir>/chain.db "
+                             "(native C++ KV store); default: in-memory")
     parser.add_argument("--network", "--genesis", dest="genesis",
                         help="path to a genesis JSON file")
     parser.add_argument("--http.addr", dest="http_addr", default="127.0.0.1")
@@ -65,7 +68,17 @@ def main(argv=None):
         return 1
 
     coinbase = bytes.fromhex(args.coinbase.removeprefix("0x"))
-    node = Node(genesis, coinbase=coinbase)
+    store = None
+    if args.datadir:
+        import os
+
+        from .storage.persistent import PersistentBackend
+        from .storage.store import Store
+
+        os.makedirs(args.datadir, exist_ok=True)
+        store = Store(PersistentBackend(
+            os.path.join(args.datadir, "chain.db")))
+    node = Node(genesis, coinbase=coinbase, store=store)
     server = RpcServer(node, args.http_addr, args.http_port).start()
     print(f"genesis hash: 0x{node.genesis_header.hash.hex()}")
     print(f"JSON-RPC listening on http://{args.http_addr}:{server.port}")
@@ -102,8 +115,16 @@ def main(argv=None):
     except (KeyboardInterrupt, AttributeError):
         pass
     finally:
+        # durability first: the fsync must not be skipped if a server
+        # teardown step raises
+        node.store.flush()
         node.stop()
-        server.stop()
+        try:
+            server.stop()
+        except OSError:
+            pass
+        if store is not None:
+            store.backend.close()
     return 0
 
 

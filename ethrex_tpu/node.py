@@ -17,8 +17,9 @@ from .storage.store import Store
 
 
 class Node:
-    def __init__(self, genesis: Genesis, coinbase: bytes = b"\x00" * 20):
-        self.store = Store()
+    def __init__(self, genesis: Genesis, coinbase: bytes = b"\x00" * 20,
+                 store: Store | None = None):
+        self.store = store if store is not None else Store()
         self.genesis_header = self.store.init_genesis(genesis)
         self.config = genesis.config
         self.chain = Blockchain(self.store, self.config)

@@ -1,0 +1,301 @@
+"""Persistent storage backend over the native C++ append-only KV store
+(native/kvstore.cpp) — the seat the reference fills with RocksDB
+(crates/storage/backend/rocksdb.rs).
+
+Each table is a dict-like view: reads hit an in-memory cache of decoded
+objects (the "memtable/block-cache" role), writes go write-through to the
+native log.  Objects are serialized with the same RLP codecs the wire
+uses, so a reopened store reconstructs identical state.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from ..primitives import rlp
+from ..primitives.block import BlockBody, BlockHeader
+from ..primitives.receipt import Receipt
+from .store import StorageBackend
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libkvstore.so"))
+_SRC_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "kvstore.cpp"))
+
+_lib = None
+_lock = threading.Lock()
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+
+        def build():
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                 "-o", _SO_PATH, _SRC_PATH],
+                check=True, capture_output=True)
+
+        if not os.path.exists(_SO_PATH) or (
+                os.path.getmtime(_SRC_PATH) > os.path.getmtime(_SO_PATH)):
+            build()
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            build()
+            lib = ctypes.CDLL(_SO_PATH)
+        lib.kv_open.restype = ctypes.c_void_p
+        lib.kv_open.argtypes = [ctypes.c_char_p]
+        lib.kv_put.restype = ctypes.c_int
+        lib.kv_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_char_p, ctypes.c_uint32,
+                               ctypes.c_char_p, ctypes.c_uint32]
+        lib.kv_delete.restype = ctypes.c_int
+        lib.kv_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_char_p, ctypes.c_uint32]
+        lib.kv_get.restype = ctypes.c_int
+        lib.kv_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_char_p, ctypes.c_uint32,
+                               ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                               ctypes.POINTER(ctypes.c_uint32)]
+        lib.kv_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib.kv_flush.restype = ctypes.c_int
+        lib.kv_flush.argtypes = [ctypes.c_void_p]
+        lib.kv_compact.restype = ctypes.c_int
+        lib.kv_compact.argtypes = [ctypes.c_void_p]
+        lib.kv_scan_start.restype = ctypes.c_void_p
+        lib.kv_scan_start.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.kv_scan_next.restype = ctypes.c_int
+        lib.kv_scan_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint32)]
+        lib.kv_scan_end.argtypes = [ctypes.c_void_p]
+        lib.kv_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+# ---------------------------------------------------------------------------
+# per-table key/value codecs (wire-stable RLP encodings)
+# ---------------------------------------------------------------------------
+
+def _ident(b):
+    return bytes(b)
+
+
+def _int_key_enc(n):
+    return int(n).to_bytes(8, "big")
+
+
+def _int_key_dec(b):
+    return int.from_bytes(b, "big")
+
+
+def _header_enc(h):
+    return h.encode()
+
+
+def _header_dec(b):
+    return BlockHeader.decode(b)
+
+
+def _body_enc(body):
+    return rlp.encode(body.to_fields())
+
+
+def _body_dec(b):
+    return BlockBody.from_fields(rlp.decode(b))
+
+
+def _receipts_enc(receipts):
+    return rlp.encode([r.encode() for r in receipts])
+
+
+def _receipts_dec(b):
+    return [Receipt.decode(bytes(item)) for item in rlp.decode(b)]
+
+
+def _txloc_enc(loc):
+    return rlp.encode([loc[0], loc[1]])
+
+
+def _txloc_dec(b):
+    f = rlp.decode(b)
+    return (bytes(f[0]), rlp.decode_int(f[1]))
+
+
+def _meta_key_enc(k):
+    return k.encode() if isinstance(k, str) else bytes(k)
+
+
+_CODECS = {
+    # table: (key_enc, key_dec, val_enc, val_dec)
+    "headers": (_ident, _ident, _header_enc, _header_dec),
+    "bodies": (_ident, _ident, _body_enc, _body_dec),
+    "receipts": (_ident, _ident, _receipts_enc, _receipts_dec),
+    "canonical": (_int_key_enc, _int_key_dec, _ident, _ident),
+    "tx_index": (_ident, _ident, _txloc_enc, _txloc_dec),
+    "trie_nodes": (_ident, _ident, _ident, _ident),
+    "code": (_ident, _ident, _ident, _ident),
+    "meta": (_meta_key_enc, lambda b: b.decode(), _ident, _ident),
+}
+_DEFAULT = (_ident, _ident, _ident, _ident)
+
+
+_MISSING = object()
+
+
+class PersistentTable:
+    """dict-like view over one table: read-through decoded-object cache +
+    write-through to the native log.  Point lookups hit kv_get on cache
+    miss, so opening a store does NOT decode all history; iteration
+    materializes the table on first use (rare paths only)."""
+
+    def __init__(self, backend: "PersistentBackend", name: str):
+        self.backend = backend
+        self.name = name
+        self.name_b = name.encode()
+        ke, kd, ve, vd = _CODECS.get(name, _DEFAULT)
+        self.key_enc, self.key_dec, self.val_enc, self.val_dec = ke, kd, ve, vd
+        self.cache: dict = {}
+        self._deleted: set = set()
+        self._materialized = False
+
+    def _fetch(self, key):
+        """cache -> native store -> _MISSING."""
+        if key in self.cache:
+            return self.cache[key]
+        if key in self._deleted or self._materialized:
+            return _MISSING
+        lib = self.backend.lib
+        kb = self.key_enc(key)
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_uint32()
+        if not lib.kv_get(self.backend.handle, self.name_b, kb, len(kb),
+                          ctypes.byref(out), ctypes.byref(out_len)):
+            return _MISSING
+        raw = ctypes.string_at(out, out_len.value)
+        lib.kv_free(out)
+        value = self.val_dec(raw)
+        self.cache[key] = value
+        return value
+
+    def _materialize(self):
+        if self._materialized:
+            return
+        lib = self.backend.lib
+        it = lib.kv_scan_start(self.backend.handle, self.name_b)
+        k = ctypes.POINTER(ctypes.c_uint8)()
+        v = ctypes.POINTER(ctypes.c_uint8)()
+        kl = ctypes.c_uint32()
+        vl = ctypes.c_uint32()
+        while lib.kv_scan_next(it, ctypes.byref(k), ctypes.byref(kl),
+                               ctypes.byref(v), ctypes.byref(vl)):
+            key_b = ctypes.string_at(k, kl.value)
+            val_b = ctypes.string_at(v, vl.value)
+            lib.kv_free(k)
+            lib.kv_free(v)
+            key = self.key_dec(key_b)
+            if key not in self.cache and key not in self._deleted:
+                self.cache[key] = self.val_dec(val_b)
+        lib.kv_scan_end(it)
+        self._materialized = True
+
+    # -- dict protocol (the subset Store/Trie use) -------------------------
+    def get(self, key, default=None):
+        value = self._fetch(key)
+        return default if value is _MISSING else value
+
+    def __getitem__(self, key):
+        value = self._fetch(key)
+        if value is _MISSING:
+            raise KeyError(key)
+        return value
+
+    def __contains__(self, key):
+        return self._fetch(key) is not _MISSING
+
+    def __setitem__(self, key, value):
+        kb = self.key_enc(key)
+        vb = self.val_enc(value)
+        if not self.backend.lib.kv_put(self.backend.handle, self.name_b,
+                                       kb, len(kb), vb, len(vb)):
+            raise OSError(f"kv_put failed for table {self.name} "
+                          "(disk full or I/O error)")
+        self.cache[key] = value
+        self._deleted.discard(key)
+
+    def pop(self, key, default=None):
+        value = self._fetch(key)
+        if value is _MISSING:
+            return default
+        kb = self.key_enc(key)
+        if not self.backend.lib.kv_delete(self.backend.handle, self.name_b,
+                                          kb, len(kb)):
+            raise OSError(f"kv_delete failed for table {self.name}")
+        self.cache.pop(key, None)
+        self._deleted.add(key)
+        return value
+
+    def setdefault(self, key, default):
+        value = self._fetch(key)
+        if value is not _MISSING:
+            return value
+        self[key] = default
+        return default
+
+    def items(self):
+        self._materialize()
+        return self.cache.items()
+
+    def values(self):
+        self._materialize()
+        return self.cache.values()
+
+    def keys(self):
+        self._materialize()
+        return self.cache.keys()
+
+    def __len__(self):
+        self._materialize()
+        return len(self.cache)
+
+    def __iter__(self):
+        self._materialize()
+        return iter(self.cache)
+
+
+class PersistentBackend(StorageBackend):
+    def __init__(self, path: str):
+        self.lib = _load()
+        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                    exist_ok=True)
+        self.handle = self.lib.kv_open(path.encode())
+        if not self.handle:
+            raise OSError(f"cannot open kv store at {path}")
+        self._tables: dict[str, PersistentTable] = {}
+
+    def table(self, name: str):
+        t = self._tables.get(name)
+        if t is None:
+            t = PersistentTable(self, name)
+            self._tables[name] = t
+        return t
+
+    def flush(self):
+        self.lib.kv_flush(self.handle)
+
+    def compact(self):
+        self.lib.kv_compact(self.handle)
+
+    def close(self):
+        if self.handle:
+            self.lib.kv_close(self.handle)
+            self.handle = None

@@ -22,10 +22,13 @@ from ..trie.trie import Trie
 
 
 class StorageBackend:
-    """KV-table backend interface (InMemory now; persistent later)."""
+    """KV-table backend interface (in-memory, or the native C++ log store)."""
 
     def table(self, name: str) -> dict:
         raise NotImplementedError
+
+    def flush(self):
+        """Durability barrier; no-op for volatile backends."""
 
 
 class InMemoryBackend(StorageBackend):
@@ -34,6 +37,14 @@ class InMemoryBackend(StorageBackend):
 
     def table(self, name: str) -> dict:
         return self._tables.setdefault(name, {})
+
+
+def _config_fingerprint(config) -> bytes:
+    """Stable bytes identifying a ChainConfig (fork schedule + chain id)."""
+    parts = [str(config.chain_id), str(config.terminal_total_difficulty)]
+    parts += [f"{int(f)}:{b}" for f, b in sorted(config.block_forks.items())]
+    parts += [f"t{int(f)}:{t}" for f, t in sorted(config.time_forks.items())]
+    return "|".join(parts).encode()
 
 
 class Store:
@@ -55,6 +66,27 @@ class Store:
     def init_genesis(self, genesis: Genesis) -> BlockHeader:
         with self.lock:
             self.genesis_config = genesis.config
+            existing = self.meta.get("genesis")
+            config_fp = _config_fingerprint(genesis.config)
+            if existing is not None:
+                # reopened persistent store: refuse to resume a DIFFERENT
+                # chain than the supplied genesis describes (the header hash
+                # covers the state/alloc; the fingerprint covers the chain
+                # config, which the header does not encode)
+                expected = Store().init_genesis(genesis).hash
+                if existing != expected:
+                    raise ValueError(
+                        f"stored chain genesis 0x{existing.hex()} does not "
+                        f"match the supplied genesis 0x{expected.hex()}")
+                stored_fp = self.meta.get("config")
+                if stored_fp is not None and stored_fp != config_fp:
+                    raise ValueError(
+                        "stored chain config does not match the supplied "
+                        "genesis config")
+                header = self.headers[existing]
+                if header.number != 0:
+                    raise ValueError("corrupt store: genesis not block 0")
+                return header
             state = Trie.from_nodes(EMPTY_TRIE_ROOT, self.nodes, share=True)
             for addr, acct in genesis.alloc.items():
                 storage_root = EMPTY_TRIE_ROOT
@@ -85,6 +117,7 @@ class Store:
             self.meta["safe"] = block_hash
             self.meta["finalized"] = block_hash
             self.meta["genesis"] = block_hash
+            self.meta["config"] = config_fp
             return header
 
     # ---------------- chain data ----------------
@@ -104,6 +137,10 @@ class Store:
     def set_head(self, block_hash: bytes):
         with self.lock:
             self.meta["head"] = block_hash
+
+    def flush(self):
+        """Durability barrier (persistent backends); no-op in memory."""
+        self.backend.flush()
 
     def head_header(self) -> BlockHeader:
         return self.headers[self.meta["head"]]

@@ -1,0 +1,294 @@
+// Append-only persistent KV store with table namespaces.
+//
+// The native persistence engine behind ethrex_tpu's Store (the seat the
+// reference fills with RocksDB, crates/storage/backend/rocksdb.rs).  Design:
+// a single append-only log of (table, key, value|tombstone) records replayed
+// into an in-memory index on open; kv_compact() rewrites a dense snapshot.
+// Crash safety: records are length-prefixed and CRC'd; a torn tail record is
+// truncated on replay.
+//
+// C ABI (ctypes):
+//   void*  kv_open(const char* path);
+//   int    kv_put(void* h, const char* table, const uint8_t* k, uint32_t kl,
+//                 const uint8_t* v, uint32_t vl);
+//   int    kv_delete(void* h, const char* table, const uint8_t* k, uint32_t kl);
+//   int    kv_get(void* h, const char* table, const uint8_t* k, uint32_t kl,
+//                 uint8_t** out, uint32_t* out_len);   // 1=found
+//   void   kv_free(uint8_t* buf);
+//   int    kv_flush(void* h);
+//   int    kv_compact(void* h);
+//   void*  kv_scan_start(void* h, const char* table);
+//   int    kv_scan_next(void* it, uint8_t** k, uint32_t* kl,
+//                       uint8_t** v, uint32_t* vl);    // 1=have entry
+//   void   kv_scan_end(void* it);
+//   void   kv_close(void* h);
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+#ifndef _WIN32
+#include <unistd.h>
+#endif
+
+namespace {
+
+uint32_t crc32(const uint8_t* data, size_t len, uint32_t seed) {
+    static uint32_t table[256];
+    static bool init = false;
+    if (!init) {
+        for (uint32_t i = 0; i < 256; i++) {
+            uint32_t c = i;
+            for (int j = 0; j < 8; j++)
+                c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+            table[i] = c;
+        }
+        init = true;
+    }
+    uint32_t c = seed ^ 0xFFFFFFFFu;
+    for (size_t i = 0; i < len; i++)
+        c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+    return c ^ 0xFFFFFFFFu;
+}
+
+constexpr uint32_t TOMBSTONE = 0xFFFFFFFFu;
+
+struct Store {
+    std::string path;
+    FILE* log = nullptr;
+    std::map<std::string, std::map<std::string, std::string>> tables;
+    std::mutex mu;
+};
+
+struct ScanIter {
+    std::vector<std::pair<std::string, std::string>> entries;
+    size_t pos = 0;
+};
+
+bool read_exact(FILE* f, void* buf, size_t n) {
+    return fread(buf, 1, n, f) == n;
+}
+
+// record: [crc u32][tlen u8][table][klen u32][key][vlen u32][value]
+// vlen == TOMBSTONE -> delete, no value bytes.  crc covers everything
+// after the crc field.
+bool append_record(FILE* f, const std::string& table, const uint8_t* k,
+                   uint32_t kl, const uint8_t* v, uint32_t vl) {
+    std::vector<uint8_t> rec;
+    uint8_t tlen = (uint8_t)table.size();
+    rec.push_back(tlen);
+    rec.insert(rec.end(), table.begin(), table.end());
+    for (int i = 0; i < 4; i++) rec.push_back((kl >> (8 * i)) & 0xFF);
+    rec.insert(rec.end(), k, k + kl);
+    for (int i = 0; i < 4; i++) rec.push_back((vl >> (8 * i)) & 0xFF);
+    if (vl != TOMBSTONE) rec.insert(rec.end(), v, v + vl);
+    uint32_t crc = crc32(rec.data(), rec.size(), 0);
+    uint8_t crcb[4];
+    for (int i = 0; i < 4; i++) crcb[i] = (crc >> (8 * i)) & 0xFF;
+    if (fwrite(crcb, 1, 4, f) != 4) return false;
+    return fwrite(rec.data(), 1, rec.size(), f) == rec.size();
+}
+
+bool replay(Store* s) {
+    FILE* f = fopen(s->path.c_str(), "rb");
+    if (!f) return true;  // fresh store
+    long valid_end = 0;
+    while (true) {
+        long rec_start = ftell(f);
+        uint8_t crcb[4];
+        if (!read_exact(f, crcb, 4)) break;
+        uint32_t want = crcb[0] | (crcb[1] << 8) | (crcb[2] << 16) |
+                        ((uint32_t)crcb[3] << 24);
+        uint8_t tlen;
+        if (!read_exact(f, &tlen, 1)) break;
+        std::string table(tlen, '\0');
+        if (tlen && !read_exact(f, table.data(), tlen)) break;
+        uint8_t lenb[4];
+        if (!read_exact(f, lenb, 4)) break;
+        uint32_t kl = lenb[0] | (lenb[1] << 8) | (lenb[2] << 16) |
+                      ((uint32_t)lenb[3] << 24);
+        if (kl > (1u << 28)) break;
+        std::string key(kl, '\0');
+        if (kl && !read_exact(f, key.data(), kl)) break;
+        if (!read_exact(f, lenb, 4)) break;
+        uint32_t vl = lenb[0] | (lenb[1] << 8) | (lenb[2] << 16) |
+                      ((uint32_t)lenb[3] << 24);
+        std::string val;
+        if (vl != TOMBSTONE) {
+            if (vl > (1u << 30)) break;
+            val.resize(vl);
+            if (vl && !read_exact(f, val.data(), vl)) break;
+        }
+        // verify crc
+        std::vector<uint8_t> rec;
+        rec.push_back(tlen);
+        rec.insert(rec.end(), table.begin(), table.end());
+        for (int i = 0; i < 4; i++) rec.push_back((kl >> (8 * i)) & 0xFF);
+        rec.insert(rec.end(), key.begin(), key.end());
+        for (int i = 0; i < 4; i++) rec.push_back((vl >> (8 * i)) & 0xFF);
+        rec.insert(rec.end(), val.begin(), val.end());
+        if (crc32(rec.data(), rec.size(), 0) != want) break;
+        if (vl == TOMBSTONE)
+            s->tables[table].erase(key);
+        else
+            s->tables[table][key] = std::move(val);
+        valid_end = ftell(f);
+        (void)rec_start;
+    }
+    fclose(f);
+    // truncate any torn tail so the append log stays consistent
+    FILE* t = fopen(s->path.c_str(), "rb+");
+    if (t) {
+        fseek(t, 0, SEEK_END);
+        if (ftell(t) != valid_end) {
+#ifdef _WIN32
+            (void)valid_end;
+#else
+            if (ftruncate(fileno(t), valid_end) != 0) { /* best effort */ }
+#endif
+        }
+        fclose(t);
+    }
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* kv_open(const char* path) {
+    Store* s = new Store();
+    s->path = path;
+    if (!replay(s)) {
+        delete s;
+        return nullptr;
+    }
+    s->log = fopen(path, "ab");
+    if (!s->log) {
+        delete s;
+        return nullptr;
+    }
+    return s;
+}
+
+int kv_put(void* h, const char* table, const uint8_t* k, uint32_t kl,
+           const uint8_t* v, uint32_t vl) {
+    Store* s = (Store*)h;
+    std::lock_guard<std::mutex> lock(s->mu);
+    if (!s->log || !append_record(s->log, table, k, kl, v, vl)) return 0;
+    // hand the record to the kernel immediately: a SIGKILL'd process must
+    // not lose acknowledged writes (fsync durability stays in kv_flush)
+    fflush(s->log);
+    s->tables[table][std::string((const char*)k, kl)] =
+        std::string((const char*)v, vl);
+    return 1;
+}
+
+int kv_delete(void* h, const char* table, const uint8_t* k, uint32_t kl) {
+    Store* s = (Store*)h;
+    std::lock_guard<std::mutex> lock(s->mu);
+    if (!s->log || !append_record(s->log, table, k, kl, nullptr, TOMBSTONE))
+        return 0;
+    fflush(s->log);
+    s->tables[table].erase(std::string((const char*)k, kl));
+    return 1;
+}
+
+int kv_get(void* h, const char* table, const uint8_t* k, uint32_t kl,
+           uint8_t** out, uint32_t* out_len) {
+    Store* s = (Store*)h;
+    std::lock_guard<std::mutex> lock(s->mu);
+    auto t = s->tables.find(table);
+    if (t == s->tables.end()) return 0;
+    auto it = t->second.find(std::string((const char*)k, kl));
+    if (it == t->second.end()) return 0;
+    *out = (uint8_t*)malloc(it->second.size());
+    memcpy(*out, it->second.data(), it->second.size());
+    *out_len = (uint32_t)it->second.size();
+    return 1;
+}
+
+void kv_free(uint8_t* buf) { free(buf); }
+
+int kv_flush(void* h) {
+    Store* s = (Store*)h;
+    std::lock_guard<std::mutex> lock(s->mu);
+    if (fflush(s->log) != 0) return 0;
+#ifndef _WIN32
+    fsync(fileno(s->log));
+#endif
+    return 1;
+}
+
+int kv_compact(void* h) {
+    Store* s = (Store*)h;
+    std::lock_guard<std::mutex> lock(s->mu);
+    std::string tmp = s->path + ".compact";
+    FILE* f = fopen(tmp.c_str(), "wb");
+    if (!f) return 0;
+    for (auto& [table, entries] : s->tables)
+        for (auto& [k, v] : entries)
+            if (!append_record(f, table, (const uint8_t*)k.data(),
+                               (uint32_t)k.size(), (const uint8_t*)v.data(),
+                               (uint32_t)v.size())) {
+                fclose(f);
+                return 0;
+            }
+    fflush(f);
+#ifndef _WIN32
+    fsync(fileno(f));
+#endif
+    fclose(f);
+    fclose(s->log);
+    s->log = nullptr;
+    if (rename(tmp.c_str(), s->path.c_str()) != 0) {
+        s->log = fopen(s->path.c_str(), "ab");  // keep the store usable
+        return 0;
+    }
+    s->log = fopen(s->path.c_str(), "ab");
+    return s->log != nullptr;
+}
+
+void* kv_scan_start(void* h, const char* table) {
+    Store* s = (Store*)h;
+    std::lock_guard<std::mutex> lock(s->mu);
+    ScanIter* it = new ScanIter();
+    auto t = s->tables.find(table);
+    if (t != s->tables.end())
+        for (auto& [k, v] : t->second) it->entries.emplace_back(k, v);
+    return it;
+}
+
+int kv_scan_next(void* iter, uint8_t** k, uint32_t* kl, uint8_t** v,
+                 uint32_t* vl) {
+    ScanIter* it = (ScanIter*)iter;
+    if (it->pos >= it->entries.size()) return 0;
+    auto& [key, val] = it->entries[it->pos++];
+    *k = (uint8_t*)malloc(key.size());
+    memcpy(*k, key.data(), key.size());
+    *kl = (uint32_t)key.size();
+    *v = (uint8_t*)malloc(val.size());
+    memcpy(*v, val.data(), val.size());
+    *vl = (uint32_t)val.size();
+    return 1;
+}
+
+void kv_scan_end(void* iter) { delete (ScanIter*)iter; }
+
+void kv_close(void* h) {
+    Store* s = (Store*)h;
+    {
+        std::lock_guard<std::mutex> lock(s->mu);
+        if (s->log) {
+            fflush(s->log);
+            fclose(s->log);
+        }
+    }
+    delete s;
+}
+
+}  // extern "C"
