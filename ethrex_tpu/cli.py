@@ -115,10 +115,10 @@ def main(argv=None):
     except (KeyboardInterrupt, AttributeError):
         pass
     finally:
-        # durability first: the fsync must not be skipped if a server
-        # teardown step raises
-        node.store.flush()
+        # order matters: stop writers (join producer), THEN fsync, THEN
+        # close the backend; servers last-but-harmless
         node.stop()
+        node.store.flush()
         try:
             server.stop()
         except OSError:

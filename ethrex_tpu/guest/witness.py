@@ -37,8 +37,12 @@ class RecordingDict:
         return value
 
     def __setitem__(self, key, value):
-        # trie commits during re-execution are not part of the witness
-        self.inner[key] = value
+        # trie commits during re-execution are not part of the witness;
+        # nodes are content-addressed, so skip keys the store already has
+        # (a persistent backend would otherwise append a duplicate record
+        # per recomputed node on every witness request)
+        if key not in self.inner:
+            self.inner[key] = value
 
 
 @dataclasses.dataclass

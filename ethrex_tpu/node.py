@@ -110,3 +110,8 @@ class Node:
 
     def stop(self):
         self._stop.set()
+        # join the producer so nothing writes to the store after stop()
+        # returns (the backend may be closed right after)
+        if self._producer_thread is not None:
+            self._producer_thread.join(timeout=30)
+            self._producer_thread = None

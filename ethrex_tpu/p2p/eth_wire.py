@@ -1,0 +1,160 @@
+"""eth/68 wire protocol messages over RLPx framing (parity target:
+crates/networking/p2p/rlpx/eth/* — status handshake, header/body exchange,
+transaction gossip, new-block announcement).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..primitives import rlp
+from ..primitives.block import Block, BlockBody, BlockHeader
+from ..primitives.transaction import Transaction
+
+ETH_VERSION = 68
+
+# devp2p base protocol (msg ids 0x00-0x0f)
+HELLO = 0x00
+DISCONNECT = 0x01
+PING = 0x02
+PONG = 0x03
+
+# eth subprotocol, offset 0x10
+ETH_OFFSET = 0x10
+STATUS = ETH_OFFSET + 0x00
+NEW_BLOCK_HASHES = ETH_OFFSET + 0x01
+TRANSACTIONS = ETH_OFFSET + 0x02
+GET_BLOCK_HEADERS = ETH_OFFSET + 0x03
+BLOCK_HEADERS = ETH_OFFSET + 0x04
+GET_BLOCK_BODIES = ETH_OFFSET + 0x05
+BLOCK_BODIES = ETH_OFFSET + 0x06
+NEW_BLOCK = ETH_OFFSET + 0x07
+NEW_POOLED_TX_HASHES = ETH_OFFSET + 0x08
+GET_RECEIPTS = ETH_OFFSET + 0x0F
+RECEIPTS = ETH_OFFSET + 0x10
+
+
+@dataclasses.dataclass
+class Status:
+    version: int
+    network_id: int
+    total_difficulty: int
+    head_hash: bytes
+    genesis_hash: bytes
+    fork_id: tuple  # (fork_hash_4b, next_fork)
+
+    def encode(self) -> bytes:
+        return rlp.encode([
+            self.version, self.network_id, self.total_difficulty,
+            self.head_hash, self.genesis_hash,
+            [self.fork_id[0], self.fork_id[1]],
+        ])
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "Status":
+        f = rlp.decode(payload)
+        return cls(
+            version=rlp.decode_int(f[0]),
+            network_id=rlp.decode_int(f[1]),
+            total_difficulty=rlp.decode_int(f[2]),
+            head_hash=bytes(f[3]),
+            genesis_hash=bytes(f[4]),
+            fork_id=(bytes(f[5][0]), rlp.decode_int(f[5][1])),
+        )
+
+
+def encode_get_block_headers(request_id: int, start, limit: int,
+                             skip: int = 0, reverse: bool = False) -> bytes:
+    origin = start if isinstance(start, bytes) else int(start)
+    return rlp.encode([request_id,
+                       [origin, limit, skip, 1 if reverse else 0]])
+
+
+def decode_get_block_headers(payload: bytes):
+    f = rlp.decode(payload)
+    req_id = rlp.decode_int(f[0])
+    origin_raw, limit, skip, reverse = f[1]
+    origin = (bytes(origin_raw) if len(origin_raw) == 32
+              else rlp.decode_int(origin_raw))
+    return (req_id, origin, rlp.decode_int(limit), rlp.decode_int(skip),
+            rlp.decode_int(reverse) == 1)
+
+
+def encode_block_headers(request_id: int, headers) -> bytes:
+    return rlp.encode([request_id, [h.to_fields() for h in headers]])
+
+
+def decode_block_headers(payload: bytes):
+    f = rlp.decode(payload)
+    return (rlp.decode_int(f[0]),
+            [BlockHeader.decode_fields(hf) for hf in f[1]])
+
+
+def encode_get_block_bodies(request_id: int, hashes) -> bytes:
+    return rlp.encode([request_id, [bytes(h) for h in hashes]])
+
+
+def decode_get_block_bodies(payload: bytes):
+    f = rlp.decode(payload)
+    return rlp.decode_int(f[0]), [bytes(h) for h in f[1]]
+
+
+def encode_block_bodies(request_id: int, bodies) -> bytes:
+    return rlp.encode([request_id, [b.to_fields() for b in bodies]])
+
+
+def decode_block_bodies(payload: bytes):
+    f = rlp.decode(payload)
+    return (rlp.decode_int(f[0]),
+            [BlockBody.from_fields(bf) for bf in f[1]])
+
+
+def encode_transactions(txs) -> bytes:
+    fields = []
+    for tx in txs:
+        if tx.tx_type == 0:
+            fields.append(tx._payload_fields(for_signing=False))
+        else:
+            fields.append(tx.encode_canonical())
+    return rlp.encode(fields)
+
+
+def decode_transactions(payload: bytes):
+    out = []
+    for item in rlp.decode(payload):
+        if isinstance(item, list):
+            out.append(Transaction._decode_legacy(item))
+        else:
+            out.append(Transaction.decode_canonical(bytes(item)))
+    return out
+
+
+def encode_new_block(block: Block, total_difficulty: int) -> bytes:
+    return rlp.encode([
+        [block.header.to_fields()] + block.body.to_fields(),
+        total_difficulty,
+    ])
+
+
+def decode_new_block(payload: bytes):
+    f = rlp.decode(payload)
+    block = Block(BlockHeader.decode_fields(f[0][0]),
+                  BlockBody.from_fields(f[0][1:]))
+    return block, rlp.decode_int(f[1])
+
+
+def fork_id_for(config, genesis_hash: bytes, head_number: int,
+                head_time: int) -> tuple:
+    """EIP-2124-shaped fork id (CRC of genesis + passed fork blocks/times).
+
+    Simplified: we hash the genesis + the active fork fingerprint — peers on
+    the same chain/config agree, others mismatch (full CRC32 schedule lands
+    with live-network interop).
+    """
+    import zlib
+
+    from ..storage.store import _config_fingerprint
+
+    acc = zlib.crc32(genesis_hash)
+    acc = zlib.crc32(_config_fingerprint(config), acc)
+    return acc.to_bytes(4, "big"), 0

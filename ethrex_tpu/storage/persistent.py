@@ -41,7 +41,8 @@ def _load():
                 check=True, capture_output=True)
 
         if not os.path.exists(_SO_PATH) or (
-                os.path.getmtime(_SRC_PATH) > os.path.getmtime(_SO_PATH)):
+                os.path.exists(_SRC_PATH)
+                and os.path.getmtime(_SRC_PATH) > os.path.getmtime(_SO_PATH)):
             build()
         try:
             lib = ctypes.CDLL(_SO_PATH)

@@ -32,26 +32,29 @@
 #include <string>
 #include <vector>
 #ifndef _WIN32
+#include <sys/file.h>
 #include <unistd.h>
 #endif
 
 namespace {
 
-uint32_t crc32(const uint8_t* data, size_t len, uint32_t seed) {
-    static uint32_t table[256];
-    static bool init = false;
-    if (!init) {
+struct Crc32Table {
+    uint32_t t[256];
+    Crc32Table() {  // magic static: thread-safe one-time init (C++11)
         for (uint32_t i = 0; i < 256; i++) {
             uint32_t c = i;
             for (int j = 0; j < 8; j++)
                 c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
-            table[i] = c;
+            t[i] = c;
         }
-        init = true;
     }
+};
+
+uint32_t crc32(const uint8_t* data, size_t len, uint32_t seed) {
+    static const Crc32Table table;
     uint32_t c = seed ^ 0xFFFFFFFFu;
     for (size_t i = 0; i < len; i++)
-        c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+        c = table.t[(c ^ data[i]) & 0xFF] ^ (c >> 8);
     return c ^ 0xFFFFFFFFu;
 }
 
@@ -97,6 +100,7 @@ bool replay(Store* s) {
     FILE* f = fopen(s->path.c_str(), "rb");
     if (!f) return true;  // fresh store
     long valid_end = 0;
+    bool crc_mismatch = false;
     while (true) {
         long rec_start = ftell(f);
         uint8_t crcb[4];
@@ -131,7 +135,10 @@ bool replay(Store* s) {
         rec.insert(rec.end(), key.begin(), key.end());
         for (int i = 0; i < 4; i++) rec.push_back((vl >> (8 * i)) & 0xFF);
         rec.insert(rec.end(), val.begin(), val.end());
-        if (crc32(rec.data(), rec.size(), 0) != want) break;
+        if (crc32(rec.data(), rec.size(), 0) != want) {
+            crc_mismatch = true;
+            break;
+        }
         if (vl == TOMBSTONE)
             s->tables[table].erase(key);
         else
@@ -139,7 +146,23 @@ bool replay(Store* s) {
         valid_end = ftell(f);
         (void)rec_start;
     }
+    long file_end = 0;
+    fseek(f, 0, SEEK_END);
+    file_end = ftell(f);
     fclose(f);
+    long dropped = file_end - valid_end;
+    if (dropped > 0) {
+        // a torn final record is expected after a crash; a CRC failure with
+        // a LOT of data after it smells like mid-file corruption — warn
+        // loudly instead of silently rewinding history
+        fprintf(stderr,
+                "kvstore: dropping %ld bytes after offset %ld in %s%s\n",
+                dropped, valid_end, s->path.c_str(),
+                (crc_mismatch && dropped > (1 << 16))
+                    ? " (CRC mismatch mid-file: possible corruption, "
+                      "restore from a snapshot if history is missing)"
+                    : "");
+    }
     // truncate any torn tail so the append log stays consistent
     FILE* t = fopen(s->path.c_str(), "rb+");
     if (t) {
@@ -172,6 +195,16 @@ void* kv_open(const char* path) {
         delete s;
         return nullptr;
     }
+#ifndef _WIN32
+    // exclusive advisory lock: two processes on one datadir would
+    // interleave appends and corrupt the log (RocksDB's LOCK equivalent)
+    if (flock(fileno(s->log), LOCK_EX | LOCK_NB) != 0) {
+        fprintf(stderr, "kvstore: %s is locked by another process\n", path);
+        fclose(s->log);
+        delete s;
+        return nullptr;
+    }
+#endif
     return s;
 }
 
@@ -285,6 +318,9 @@ void kv_close(void* h) {
         std::lock_guard<std::mutex> lock(s->mu);
         if (s->log) {
             fflush(s->log);
+#ifndef _WIN32
+            fsync(fileno(s->log));  // close implies the durability barrier
+#endif
             fclose(s->log);
         }
     }

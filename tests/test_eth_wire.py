@@ -1,0 +1,116 @@
+"""Two real nodes over real RLPx/TCP: handshake, status, full sync,
+new-block propagation, transaction gossip (the reference's p2p test goals
+without docker)."""
+
+import time
+
+import pytest
+
+from ethrex_tpu.crypto import secp256k1
+from ethrex_tpu.node import Node
+from ethrex_tpu.p2p.connection import P2PServer, PeerError, full_sync
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.primitives.transaction import TYPE_DYNAMIC_FEE, Transaction
+
+SECRET = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
+SENDER = secp256k1.pubkey_to_address(secp256k1.pubkey_from_secret(SECRET))
+OTHER = bytes.fromhex("aa" * 20)
+
+GENESIS = {
+    "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+               "shanghaiTime": 0, "cancunTime": 0},
+    "alloc": {"0x" + SENDER.hex(): {"balance": hex(10**21)}},
+    "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7", "timestamp": "0x0",
+}
+
+
+def _tx(nonce, value=100):
+    return Transaction(
+        tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=nonce,
+        max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+        gas_limit=21000, to=OTHER, value=value,
+    ).sign(SECRET)
+
+
+@pytest.fixture()
+def two_nodes():
+    node_a = Node(Genesis.from_json(GENESIS))
+    node_b = Node(Genesis.from_json(GENESIS))
+    srv_a = P2PServer(node_a).start()
+    srv_b = P2PServer(node_b).start()
+    yield node_a, node_b, srv_a, srv_b
+    srv_a.stop()
+    srv_b.stop()
+    node_a.stop()
+    node_b.stop()
+
+
+def test_handshake_and_full_sync(two_nodes):
+    node_a, node_b, srv_a, srv_b = two_nodes
+    # A mines 5 blocks
+    for i in range(5):
+        node_a.submit_transaction(_tx(i))
+        node_a.produce_block()
+    assert node_a.store.latest_number() == 5
+    # B dials A over real TCP/RLPx and full-syncs
+    peer = srv_b.dial(srv_a.host, srv_a.port, srv_a.pub)
+    assert peer.remote_status.head_hash == node_a.store.head_header().hash
+    imported = full_sync(peer, node_b)
+    assert imported == 5
+    assert node_b.store.head_header().hash == node_a.store.head_header().hash
+    root = node_b.store.head_header().state_root
+    assert node_b.store.account_state(root, OTHER).balance == 500
+
+
+def test_new_block_propagation(two_nodes):
+    node_a, node_b, srv_a, srv_b = two_nodes
+    peer = srv_b.dial(srv_a.host, srv_a.port, srv_a.pub)
+    # B mines a block and announces it; A imports
+    node_b.submit_transaction(_tx(0))
+    block = node_b.produce_block()
+    # find A's peer object for the B connection
+    deadline = time.time() + 5
+    while time.time() < deadline and not srv_a.peers:
+        time.sleep(0.05)
+    peer.announce_block(block)
+    deadline = time.time() + 5
+    while time.time() < deadline and node_a.store.latest_number() < 1:
+        time.sleep(0.05)
+    assert node_a.store.head_header().hash == block.hash
+
+
+def test_transaction_gossip(two_nodes):
+    node_a, node_b, srv_a, srv_b = two_nodes
+    srv_b.dial(srv_a.host, srv_a.port, srv_a.pub)
+    deadline = time.time() + 5
+    while time.time() < deadline and not srv_a.peers:
+        time.sleep(0.05)
+    tx = _tx(0, value=777)
+    node_b.submit_transaction(tx)
+    # B gossips its pending tx to A
+    srv_b.peers[0].broadcast_transactions([tx])
+    deadline = time.time() + 5
+    while time.time() < deadline and len(node_a.mempool) < 1:
+        time.sleep(0.05)
+    assert node_a.mempool.get_transaction(tx.hash) is not None
+    # A mines it
+    block = node_a.produce_block()
+    assert any(t.hash == tx.hash for t in block.body.transactions)
+
+
+def test_chain_mismatch_rejected():
+    node_a = Node(Genesis.from_json(GENESIS))
+    other = dict(GENESIS)
+    other["config"] = dict(GENESIS["config"])
+    other["config"]["chainId"] = 999
+    node_c = Node(Genesis.from_json(other))
+    srv_a = P2PServer(node_a).start()
+    srv_c = P2PServer(node_c).start()
+    try:
+        with pytest.raises((PeerError, ConnectionError, OSError)):
+            srv_c.dial(srv_a.host, srv_a.port, srv_a.pub)
+    finally:
+        srv_a.stop()
+        srv_c.stop()
+        node_a.stop()
+        node_c.stop()
