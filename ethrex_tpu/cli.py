@@ -117,13 +117,14 @@ def main(argv=None):
     finally:
         # order matters: stop writers (join producer), THEN fsync, THEN
         # close the backend; servers last-but-harmless
-        node.stop()
+        writers_stopped = node.stop()
         node.store.flush()
         try:
             server.stop()
         except OSError:
             pass
-        if store is not None:
+        if store is not None and writers_stopped:
+            # never close the native handle under a live writer
             store.backend.close()
     return 0
 

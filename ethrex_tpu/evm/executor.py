@@ -111,7 +111,7 @@ def _apply_authorizations(tx: Transaction, state: StateDB,
 
 
 def execute_privileged_tx(tx: Transaction, state: StateDB, block: BlockEnv,
-                          config: ChainConfig) -> TxResult:
+                          config: ChainConfig, tracer=None) -> TxResult:
     """L1-originated deposit/message: mint value, run the call gas-free
     (authorization is the L1 inclusion proof, checked by the committer)."""
     state.begin_tx()
@@ -119,6 +119,7 @@ def execute_privileged_tx(tx: Transaction, state: StateDB, block: BlockEnv,
     state.add_balance(sender, tx.value)      # bridge mint
     state.increment_nonce(sender)
     evm = EVM(state, block, config, origin=sender)
+    evm.tracer = tracer
     code, code_src = evm.resolve_code(tx.to) if tx.to else (b"", b"")
     msg = Message(caller=sender, to=tx.to, code_address=code_src,
                   value=tx.value, data=tx.data,
@@ -131,10 +132,10 @@ def execute_privileged_tx(tx: Transaction, state: StateDB, block: BlockEnv,
 
 
 def execute_tx(tx: Transaction, state: StateDB, block: BlockEnv,
-               config: ChainConfig) -> TxResult:
+               config: ChainConfig, tracer=None) -> TxResult:
     """Execute one transaction against the state (mutating it)."""
     if tx.tx_type == TYPE_PRIVILEGED:
-        return execute_privileged_tx(tx, state, block, config)
+        return execute_privileged_tx(tx, state, block, config, tracer)
     fork = config.fork_at(block.number, block.timestamp)
     sender = tx.sender()
     if sender is None:
@@ -168,6 +169,7 @@ def execute_tx(tx: Transaction, state: StateDB, block: BlockEnv,
 
     evm = EVM(state, block, config, gas_price=eff_price, origin=sender,
               blob_hashes=tx.blob_versioned_hashes)
+    evm.tracer = tracer
     auth_refund = 0
     if tx.authorization_list:
         auth_refund = _apply_authorizations(tx, state, config)

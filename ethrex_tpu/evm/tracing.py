@@ -1,0 +1,74 @@
+"""Execution tracers (parity target: the reference's call tracer,
+crates/vm/levm/src/tracing.rs + rpc debug_traceTransaction callTracer).
+
+The hot dispatch loop stays tracer-free (the reference monomorphizes for
+the same reason); tracers hook only frame enter/exit in execute_message.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class CallFrame:
+    type: str
+    from_addr: bytes
+    to: bytes
+    value: int
+    gas: int
+    gas_used: int = 0
+    input: bytes = b""
+    output: bytes = b""
+    error: str | None = None
+    calls: list = dataclasses.field(default_factory=list)
+
+    def to_json(self) -> dict:
+        out = {
+            "type": self.type,
+            "from": "0x" + self.from_addr.hex(),
+            "to": "0x" + self.to.hex(),
+            "value": hex(self.value),
+            "gas": hex(self.gas),
+            "gasUsed": hex(self.gas_used),
+            "input": "0x" + self.input.hex(),
+        }
+        if self.output:
+            out["output"] = "0x" + self.output.hex()
+        if self.error:
+            out["error"] = self.error
+        if self.calls:
+            out["calls"] = [c.to_json() for c in self.calls]
+        return out
+
+
+class CallTracer:
+    """Builds the geth callTracer tree from frame enter/exit events."""
+
+    def __init__(self):
+        self.root: CallFrame | None = None
+        self._stack: list[CallFrame] = []
+
+    def enter(self, msg):
+        kind = msg.kind or ("CREATE" if msg.is_create else "CALL")
+        frame = CallFrame(
+            type=kind, from_addr=msg.caller, to=msg.to,
+            value=msg.value, gas=msg.gas, input=bytes(msg.data),
+        )
+        if self._stack:
+            self._stack[-1].calls.append(frame)
+        else:
+            self.root = frame
+        self._stack.append(frame)
+
+    def exit(self, ok: bool, gas_left: int, output: bytes):
+        frame = self._stack.pop()
+        frame.gas_used = frame.gas - gas_left
+        frame.output = bytes(output)
+        if not ok:
+            frame.error = ("out of gas or invalid operation"
+                           if gas_left == 0 and not output
+                           else "execution reverted")
+
+    def result(self) -> dict:
+        return self.root.to_json() if self.root else {}

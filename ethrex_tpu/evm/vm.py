@@ -91,6 +91,7 @@ class Message:
     code: bytes = b""
     salt: int | None = None   # CREATE2
     transfers_value: bool = True  # False for DELEGATECALL
+    kind: str = ""            # tracer label: CALL/DELEGATECALL/STATICCALL/...
 
 
 @dataclasses.dataclass
@@ -202,6 +203,7 @@ class EVM:
         self.gas_price = gas_price
         self.origin = origin
         self.blob_hashes = blob_hashes or []
+        self.tracer = None  # optional frame-level tracer (evm/tracing.py)
 
     def fork_at_least(self, fork: Fork) -> bool:
         return self.fork >= fork
@@ -223,6 +225,8 @@ class EVM:
     # ------------------------------------------------------------------
     def execute_message(self, msg: Message) -> tuple[bool, int, bytes]:
         """Returns (success, gas_left, output)."""
+        if self.tracer:
+            self.tracer.enter(msg)
         snap = self.state.snapshot()
         logs_len = len(self.state.logs)
         if msg.is_create:
@@ -232,6 +236,8 @@ class EVM:
         if not ok:
             self.state.revert(snap)
             del self.state.logs[logs_len:]
+        if self.tracer:
+            self.tracer.exit(ok, gas_left, out)
         return ok, gas_left, out
 
     def _transfer(self, frm: bytes, to: bytes, value: int):
@@ -842,21 +848,22 @@ def _do_call(evm, f, *, kind: str):
         msg = Message(caller=f.msg.to, to=addr, code_address=code_src,
                       value=value, data=data, gas=gas + stipend,
                       depth=f.msg.depth + 1, is_static=f.msg.is_static,
-                      code=code)
+                      code=code, kind="CALL")
     elif kind == "callcode":
         msg = Message(caller=f.msg.to, to=f.msg.to, code_address=addr,
                       value=value, data=data, gas=gas + stipend,
                       depth=f.msg.depth + 1, is_static=f.msg.is_static,
-                      code=code, transfers_value=False)
+                      code=code, transfers_value=False, kind="CALLCODE")
     elif kind == "delegatecall":
         msg = Message(caller=f.msg.caller, to=f.msg.to, code_address=addr,
                       value=f.msg.value, data=data, gas=gas,
                       depth=f.msg.depth + 1, is_static=f.msg.is_static,
-                      code=code, transfers_value=False)
+                      code=code, transfers_value=False, kind="DELEGATECALL")
     else:  # staticcall
         msg = Message(caller=f.msg.to, to=addr, code_address=code_src,
                       value=0, data=data, gas=gas,
-                      depth=f.msg.depth + 1, is_static=True, code=code)
+                      depth=f.msg.depth + 1, is_static=True, code=code,
+                      kind="STATICCALL")
     # precompiles execute against the *call target* address
     if addr in precompiles.PRECOMPILES and kind in ("call", "staticcall"):
         msg.code_address = addr
@@ -912,7 +919,7 @@ def _do_create(evm, f, *, is_create2: bool):
     msg = Message(caller=f.msg.to, to=b"", code_address=b"", value=value,
                   data=b"", gas=gas, depth=f.msg.depth + 1,
                   is_static=f.msg.is_static, is_create=True, code=initcode,
-                  salt=salt)
+                  salt=salt, kind="CREATE2" if is_create2 else "CREATE")
     ok, gas_left, output = evm.execute_message(msg)
     f.gas += gas_left
     if ok:

@@ -108,10 +108,15 @@ class Node:
         self._producer_thread = threading.Thread(target=loop, daemon=True)
         self._producer_thread.start()
 
-    def stop(self):
+    def stop(self) -> bool:
+        """Returns True when all writers are stopped (safe to close the
+        backend); False if the producer is still alive after the timeout."""
         self._stop.set()
-        # join the producer so nothing writes to the store after stop()
-        # returns (the backend may be closed right after)
-        if self._producer_thread is not None:
-            self._producer_thread.join(timeout=30)
+        thread = self._producer_thread
+        if thread is not None:
+            thread.join(timeout=30)
+            if thread.is_alive():
+                print("warning: block producer did not stop within 30s")
+                return False
             self._producer_thread = None
+        return True

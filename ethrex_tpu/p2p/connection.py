@@ -46,8 +46,12 @@ class RlpxPeer:
         self._stop = threading.Event()
         self._pending: dict[int, list] = {}
         self._pending_cv = threading.Condition()
+        self._late_ok: set[int] = set()
         self._req_counter = 0
-        self.known_txs: set[bytes] = set()
+        self._req_lock = threading.Lock()
+        # bounded: a long-lived peer must not retain every gossiped hash
+        self.known_txs: dict[bytes, None] = {}
+        self.KNOWN_TX_CAP = 32768
 
     # -- framing over the socket ------------------------------------------
     def send_msg(self, msg_id: int, payload: bytes):
@@ -107,8 +111,14 @@ class RlpxPeer:
 
     # -- request/response -------------------------------------------------
     def _next_request_id(self) -> int:
-        self._req_counter += 1
-        return self._req_counter
+        with self._req_lock:
+            self._req_counter += 1
+            return self._req_counter
+
+    def _mark_known_tx(self, tx_hash: bytes):
+        self.known_txs[tx_hash] = None
+        while len(self.known_txs) > self.KNOWN_TX_CAP:
+            self.known_txs.pop(next(iter(self.known_txs)))  # oldest first
 
     def request(self, msg_id: int, payload: bytes, request_id: int,
                 timeout: float = 10.0):
@@ -117,6 +127,8 @@ class RlpxPeer:
             ok = self._pending_cv.wait_for(
                 lambda: request_id in self._pending, timeout)
             if not ok:
+                # a late response must not leak into _pending forever
+                self._late_ok.add(request_id)
                 raise PeerError("request timed out")
             return self._pending.pop(request_id)
 
@@ -132,7 +144,7 @@ class RlpxPeer:
 
     def broadcast_transactions(self, txs):
         for tx in txs:
-            self.known_txs.add(tx.hash)
+            self._mark_known_tx(tx.hash)
         self.send_msg(eth_wire.TRANSACTIONS,
                       eth_wire.encode_transactions(txs))
 
@@ -181,7 +193,7 @@ class RlpxPeer:
             for tx in eth_wire.decode_transactions(payload):
                 if tx.hash in self.known_txs:
                     continue
-                self.known_txs.add(tx.hash)
+                self._mark_known_tx(tx.hash)
                 try:
                     self.node.submit_transaction(tx)
                 except Exception:  # noqa: BLE001 — invalid gossip is dropped
@@ -198,6 +210,9 @@ class RlpxPeer:
 
     def _resolve(self, request_id: int, value):
         with self._pending_cv:
+            if request_id in self._late_ok:
+                self._late_ok.discard(request_id)  # timed out: drop it
+                return
             self._pending[request_id] = value
             self._pending_cv.notify_all()
 

@@ -326,6 +326,43 @@ class EthApi:
         witness = generate_witness(self.node.chain, blocks)
         return witness.to_json()
 
+    def debug_trace_transaction(self, tx_hash, opts=None):
+        """debug_traceTransaction with the callTracer (default)."""
+        from ..evm.executor import execute_tx
+        from ..evm.tracing import CallTracer
+        from ..evm.vm import BlockEnv
+
+        opts = opts or {}
+        tracer_name = opts.get("tracer", "callTracer")
+        if tracer_name != "callTracer":
+            raise RpcError(-32602, f"unsupported tracer {tracer_name!r}")
+        store = self.node.store
+        loc = store.tx_index.get(parse_bytes(tx_hash))
+        if loc is None:
+            raise RpcError(-32602, "transaction not found")
+        blk = store.get_block(loc[0])
+        header = blk.header
+        parent = store.get_header(header.parent_hash)
+        state = store.state_db(parent.state_root)
+        env = BlockEnv(
+            number=header.number, coinbase=header.coinbase,
+            timestamp=header.timestamp, gas_limit=header.gas_limit,
+            prev_randao=header.prev_randao,
+            base_fee=header.base_fee_per_gas or 0,
+            excess_blob_gas=header.excess_blob_gas or 0,
+            parent_beacon_block_root=header.parent_beacon_block_root
+            or b"\x00" * 32,
+        )
+        fork = self.node.config.fork_at(header.number, header.timestamp)
+        self.node.chain._pre_tx_system_ops(state, env, header, fork)
+        # replay preceding txs untraced, then trace the target
+        for tx in blk.body.transactions[:loc[1]]:
+            execute_tx(tx, state, env, self.node.config)
+        tracer = CallTracer()
+        execute_tx(blk.body.transactions[loc[1]], state, env,
+                   self.node.config, tracer=tracer)
+        return tracer.result()
+
     def fee_history(self, count, newest, percentiles=None):
         count = parse_quantity(count)
         newest_b = self._resolve_block(newest)

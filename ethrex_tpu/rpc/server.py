@@ -60,6 +60,7 @@ class RpcServer:
             "eth_feeHistory": e.fee_history,
             "eth_getProof": e.get_proof,
             "debug_executionWitness": e.debug_execution_witness,
+            "debug_traceTransaction": e.debug_trace_transaction,
             "net_version": lambda: str(node.config.chain_id),
             "net_listening": lambda: True,
             "net_peerCount": lambda: "0x0",

@@ -32,6 +32,7 @@
 #include <string>
 #include <vector>
 #ifndef _WIN32
+#include <fcntl.h>
 #include <sys/file.h>
 #include <unistd.h>
 #endif
@@ -63,6 +64,7 @@ constexpr uint32_t TOMBSTONE = 0xFFFFFFFFu;
 struct Store {
     std::string path;
     FILE* log = nullptr;
+    int lock_fd = -1;
     std::map<std::string, std::map<std::string, std::string>> tables;
     std::mutex mu;
 };
@@ -186,25 +188,34 @@ extern "C" {
 void* kv_open(const char* path) {
     Store* s = new Store();
     s->path = path;
+#ifndef _WIN32
+    // exclusive advisory lock on a sidecar LOCK file, taken BEFORE replay:
+    // replay truncates what it considers a torn tail, which must never run
+    // against a log another process is actively appending to
+    std::string lock_path = s->path + ".lock";
+    s->lock_fd = open(lock_path.c_str(), O_CREAT | O_RDWR, 0644);
+    if (s->lock_fd < 0 || flock(s->lock_fd, LOCK_EX | LOCK_NB) != 0) {
+        fprintf(stderr, "kvstore: %s is locked by another process\n", path);
+        if (s->lock_fd >= 0) close(s->lock_fd);
+        delete s;
+        return nullptr;
+    }
+#endif
     if (!replay(s)) {
+#ifndef _WIN32
+        close(s->lock_fd);
+#endif
         delete s;
         return nullptr;
     }
     s->log = fopen(path, "ab");
     if (!s->log) {
-        delete s;
-        return nullptr;
-    }
 #ifndef _WIN32
-    // exclusive advisory lock: two processes on one datadir would
-    // interleave appends and corrupt the log (RocksDB's LOCK equivalent)
-    if (flock(fileno(s->log), LOCK_EX | LOCK_NB) != 0) {
-        fprintf(stderr, "kvstore: %s is locked by another process\n", path);
-        fclose(s->log);
+        close(s->lock_fd);
+#endif
         delete s;
         return nullptr;
     }
-#endif
     return s;
 }
 
@@ -323,6 +334,9 @@ void kv_close(void* h) {
 #endif
             fclose(s->log);
         }
+#ifndef _WIN32
+        if (s->lock_fd >= 0) close(s->lock_fd);  // releases the flock
+#endif
     }
     delete s;
 }

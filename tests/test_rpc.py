@@ -132,6 +132,51 @@ def test_get_proof_and_witness(rpc):
     assert out.final_state_root == blocks[-1].header.state_root
 
 
+def test_debug_trace_transaction(rpc):
+    call, node = rpc
+    nonce = int(call("eth_getTransactionCount", "0x" + SENDER.hex(),
+                     "latest")["result"], 16)
+    # trace an existing transfer from the earlier test
+    txs = call("eth_getBlockByNumber", "0x1", True)["result"]["transactions"]
+    trace = call("debug_traceTransaction", txs[0]["hash"])["result"]
+    assert trace["type"] == "CALL"
+    assert trace["from"] == txs[0]["from"]
+    assert int(trace["gasUsed"], 16) >= 0
+    # deploy + call with inner CALL to the identity precompile for a tree
+    runtime = "60045f5f5f5f600461fffff15f5260205ff3"
+    initcode = "71" + runtime + "5f526012600ef3"
+    tx2 = Transaction(
+        tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=nonce,
+        max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+        gas_limit=300_000, to=b"", data=bytes.fromhex(initcode),
+    ).sign(SECRET)
+    call("eth_sendRawTransaction", "0x" + tx2.encode_canonical().hex())
+    call("ethrex_produceBlock")
+    rec = call("eth_getTransactionReceipt",
+               "0x" + tx2.hash.hex())["result"]
+    assert rec["status"] == "0x1"
+    addr = rec["contractAddress"]
+    tx3 = Transaction(
+        tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=nonce + 1,
+        max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+        gas_limit=100_000, to=bytes.fromhex(addr[2:]), value=0,
+    ).sign(SECRET)
+    call("eth_sendRawTransaction", "0x" + tx3.encode_canonical().hex())
+    call("ethrex_produceBlock")
+    trace = call("debug_traceTransaction", "0x" + tx3.hash.hex())["result"]
+    assert trace["type"] == "CALL" and trace["to"] == addr
+    assert len(trace.get("calls", [])) == 1
+    inner = trace["calls"][0]
+    assert inner["type"] == "CALL"
+    assert inner["to"] == "0x" + "00" * 19 + "04"  # identity precompile
+    # deploy trace shows CREATE
+    trace2 = call("debug_traceTransaction", "0x" + tx2.hash.hex())["result"]
+    assert trace2["type"] == "CREATE"
+    # unknown tx errors cleanly
+    err = call("debug_traceTransaction", "0x" + "ab" * 32)
+    assert "error" in err
+
+
 def test_error_paths(rpc):
     call, node = rpc
     assert "error" in call("eth_fooBar")
