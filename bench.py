@@ -27,6 +27,12 @@ BASELINE_CELLS_PER_SEC = 1.0e8
 def main() -> None:
     import jax
 
+    # persistent XLA cache: repeated bench runs skip the multi-minute
+    # cold compile (important when the chip sits behind a network tunnel)
+    jax.config.update("jax_compilation_cache_dir",
+                      "/tmp/ethrex_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     from ethrex_tpu.parallel.core import build_prove_step
 
     fn, args = build_prove_step(log_n=LOG_N, width=WIDTH, log_blowup=2,

@@ -8,7 +8,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from .eth import EthApi, RpcError
+from .eth import EthApi, RpcError  # noqa: F401 (RpcError used below)
 
 
 class RpcServer:
@@ -67,6 +67,10 @@ class RpcServer:
             "web3_clientVersion": lambda: "ethrex-tpu/0.1.0",
             "txpool_content": lambda: _txpool_content(node),
             "ethrex_produceBlock": lambda: _produce(node),
+            # L2 namespace (reference: crates/l2/networking/rpc)
+            "ethrex_latestBatch": lambda: _latest_batch(node),
+            "ethrex_getBatchByNumber": lambda n: _get_batch(node, n),
+            "ethrex_health": lambda: _health(node),
         }
 
     def handle(self, request: dict):
@@ -161,3 +165,57 @@ def _txpool_content(node):
 def _produce(node):
     block = node.produce_block()
     return "0x" + block.hash.hex()
+
+
+def _rollup(node):
+    seq = getattr(node, "sequencer", None)
+    if seq is None:
+        raise RpcError(-32000, "node is not running an L2 sequencer")
+    return seq
+
+
+def _batch_json(batch, rollup):
+    from .serializers import hb, hx
+
+    with rollup.lock:  # a half-applied set_committed must not leak out
+        return {
+            "number": hx(batch.number),
+            "firstBlock": hx(batch.first_block),
+            "lastBlock": hx(batch.last_block),
+            "stateRoot": hb(batch.state_root),
+            "commitment": hb(batch.commitment),
+            "committed": batch.committed,
+            "verified": batch.verified,
+        }
+
+
+def _latest_batch(node):
+    seq = _rollup(node)
+    n = seq.rollup.latest_batch_number()
+    batch = seq.rollup.get_batch(n)
+    return _batch_json(batch, seq.rollup) if batch else None
+
+
+def _get_batch(node, n):
+    from .serializers import parse_quantity
+
+    seq = _rollup(node)
+    batch = seq.rollup.get_batch(parse_quantity(n))
+    return _batch_json(batch, seq.rollup) if batch else None
+
+
+def _health(node):
+    p2p = getattr(node, "p2p_server", None)
+    out = {
+        "head": node.store.latest_number(),
+        "mempool": len(node.mempool),
+        "peers": len(p2p.peers) if p2p else 0,
+    }
+    seq = getattr(node, "sequencer", None)
+    if seq is not None:
+        out["l2"] = {
+            "latestBatch": seq.rollup.latest_batch_number(),
+            "lastBatchedBlock": seq.last_batched_block,
+            "pendingPrivileged": len(seq.pending_privileged),
+        }
+    return out

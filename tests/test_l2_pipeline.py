@@ -135,6 +135,40 @@ def test_full_pipeline_tpu_backend():
         seq.stop()
 
 
+def test_l2_rpc_namespace():
+    node, l1, seq = _setup([protocol.PROVER_EXEC])
+    try:
+        node.sequencer = seq
+        from ethrex_tpu.rpc.server import RpcServer
+
+        server = RpcServer(node)
+        # no batches yet
+        r = server.handle({"jsonrpc": "2.0", "id": 1,
+                           "method": "ethrex_latestBatch", "params": []})
+        assert r["result"] is None
+        node.submit_transaction(_transfer(0))
+        seq.produce_block()
+        seq.commit_next_batch()
+        r = server.handle({"jsonrpc": "2.0", "id": 1,
+                           "method": "ethrex_latestBatch", "params": []})
+        assert r["result"]["number"] == "0x1"
+        assert r["result"]["committed"] is True
+        r = server.handle({"jsonrpc": "2.0", "id": 1,
+                           "method": "ethrex_getBatchByNumber",
+                           "params": ["0x1"]})
+        assert r["result"]["lastBlock"] == "0x1"
+        h = server.handle({"jsonrpc": "2.0", "id": 1,
+                           "method": "ethrex_health", "params": []})
+        assert h["result"]["l2"]["latestBatch"] == 1
+        # without a sequencer attached the namespace errors cleanly
+        del node.sequencer
+        r = server.handle({"jsonrpc": "2.0", "id": 1,
+                           "method": "ethrex_latestBatch", "params": []})
+        assert r["error"]["code"] == -32000
+    finally:
+        seq.stop()
+
+
 def test_sequencer_timers_smoke():
     """Actors run on timers end-to-end (fast intervals)."""
     node, l1, _seq = _setup([protocol.PROVER_EXEC])
