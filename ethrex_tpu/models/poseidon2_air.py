@@ -6,10 +6,10 @@ arguments.  It proves y = P(x) for the SAME Poseidon2 the framework uses
 for Merkle commitments (ops/poseidon2.py) — constants, matrices, rounds all
 identical, verified by tests against permute_ref.
 
-Layout (single permutation, n = 32 rows).  NOTE: chaining k permutations in
-one trace needs an absorb/handoff row in the schedule (the padding
-copy-constraint otherwise pins row 32 to row 31) — that lands together with
-the sponge-mode AIR; today's statement is one compression per proof.
+Two AIRs live here:
+  * Poseidon2Air — one permutation (n = 32 rows), compression statement.
+  * Poseidon2SpongeAir — k chained permutations with absorb transitions
+    (duplex sponge), proving ops/poseidon2.hash_leaves in-circuit.
   row 0      = state after the initial external linear layer
   row r+1    = round r applied to row r         (r = 0..20)
   row 21     = P(x) (final state)
@@ -172,3 +172,135 @@ def public_inputs(limbs: list[int]) -> list[int]:
     final = p2.permute_ref(limbs)
     digest = [(final[j] + limbs[j]) % bb.P for j in range(8)]
     return limbs + digest
+
+
+# ---------------------------------------------------------------------------
+# Sponge mode: chains of permutations absorbing 8-limb chunks — proves
+# exactly p2.hash_leaves (the framework's Merkle leaf hash) in-circuit.
+# ---------------------------------------------------------------------------
+
+class Poseidon2SpongeAir(Air):
+    """k chained permutations, n = 32k rows, width 24 (16 state + 8 msg).
+
+    Row layout per period: rows 0..21 the permutation, 22..30 forced
+    copies, row 31 (except the trace's last row) the ABSORB transition:
+        next_state = M_E(state + [msg_chunk, 0^8])
+    which is the duplex-sponge step of ops/poseidon2.hash_leaves (absorb
+    into the rate, then permute — whose first op is the external linear).
+    The 8 message columns are boundary-bound to the public chunks at each
+    absorb row (chunk 0 via the row-0 state boundary).
+
+    Public inputs: 8k message limbs + 8 digest limbs, with
+        digest = hash_leaves(message)  (merkle.hash_leaf_ref equivalently).
+    """
+
+    width = 24
+    max_degree = 8
+    num_periodic = Poseidon2Air.num_periodic + 1  # + sel_absorb
+
+    def __init__(self, num_chunks: int):
+        assert num_chunks >= 1
+        self.num_chunks = num_chunks
+        self.num_pub_inputs = 8 * num_chunks + 8
+
+    def periodic_columns(self, n: int):
+        # FULL-LENGTH columns (period = n): only the first `num_chunks`
+        # periods run permutations/absorbs; the tail periods have all
+        # selectors 0, so sel_none forces plain copies — this lets a
+        # k-chunk sponge live in a power-of-two trace with k arbitrary
+        k = self.num_chunks
+        if n < PERIOD * k:
+            raise ValueError("trace too short for num_chunks")
+        base32 = Poseidon2Air().periodic_columns(PERIOD)
+        out = []
+        for col in base32:
+            full = np.zeros(n, dtype=np.uint32)
+            full[:PERIOD * k] = np.tile(col, k)
+            out.append(full)
+        sel_absorb = np.zeros(n, dtype=np.uint32)
+        for j in range(k - 1):
+            sel_absorb[PERIOD * (j + 1) - 1] = 1
+        return out + [sel_absorb]
+
+    def constraints(self, local, nxt, periodic, ops):
+        state = local[:16]
+        nxt_state = nxt[:16]
+        msg = local[16:24]
+        sel_absorb = periodic[-1]
+        inner = Poseidon2Air.constraints(self, state, nxt_state,
+                                         periodic[:-1], ops)
+        # absorb step: nxt = M_E(state + [msg, 0^8])
+        absorbed = [ops.add(state[j], msg[j]) if j < 8 else state[j]
+                    for j in range(16)]
+        mixed = _external_linear_generic(absorbed, ops)
+        out = []
+        for j in range(16):
+            # inner already contains sel_ext/sel_int/sel_none terms; at the
+            # absorb row all of those selectors are 0, so adding the gated
+            # absorb term keeps each row governed by exactly one rule —
+            # but sel_none = 1 - sel_ext - sel_int ALSO fires at the absorb
+            # row, so subtract its copy term there.
+            copy_term = ops.mul(sel_absorb, ops.sub(nxt_state[j], state[j]))
+            absorb_term = ops.mul(sel_absorb,
+                                  ops.sub(nxt_state[j], mixed[j]))
+            out.append(ops.add(ops.sub(inner[j], copy_term), absorb_term))
+        return out
+
+    def boundaries(self, pub_inputs, n: int):
+        k = self.num_chunks
+        assert n >= PERIOD * k and (n & (n - 1)) == 0
+        chunks = [[int(v) % bb.P for v in pub_inputs[8 * j:8 * j + 8]]
+                  for j in range(k)]
+        digest = [int(v) % bb.P for v in pub_inputs[8 * k:8 * k + 8]]
+        # row 0 = M_E(first absorbed state)
+        state0 = chunks[0] + [0] * 8
+        row0 = p2._external_linear_ref(state0)
+        out = [(0, j, row0[j]) for j in range(16)]
+        # message columns bound at each later absorb row
+        for j in range(1, k):
+            absorb_row = PERIOD * j - 1
+            out += [(absorb_row, 16 + i, chunks[j][i]) for i in range(8)]
+        # digest = rate of the final permutation output (last period row 21)
+        final_out_row = PERIOD * (k - 1) + ROUNDS
+        out += [(final_out_row, i, digest[i]) for i in range(8)]
+        return out
+
+
+def pad_message_limbs(message_limbs) -> list[int]:
+    """Canonical limbs zero-padded to a multiple of the rate (8) — the ONE
+    place the sponge padding rule lives (trace, public inputs, and the
+    prover backend all share it)."""
+    limbs = [int(v) % bb.P for v in message_limbs]
+    return limbs + [0] * ((-len(limbs)) % 8)
+
+
+def generate_sponge_trace(message_limbs: list[int]) -> np.ndarray:
+    """Sponge rows for hash_leaves(message_limbs); pads limbs to chunks of
+    8 and the trace to a power-of-two number of 32-row periods (the tail
+    periods are inert copies of the final state)."""
+    limbs = pad_message_limbs(message_limbs)
+    chunks = [limbs[i:i + 8] for i in range(0, len(limbs), 8)]
+    k = len(chunks)
+    periods = 1 << (k - 1).bit_length() if k > 1 else 1
+    trace = np.zeros((PERIOD * periods, 24), dtype=np.uint32)
+    state = [0] * 16
+    for j, chunk in enumerate(chunks):
+        state = [(state[i] + chunk[i]) % bb.P if i < 8 else state[i]
+                 for i in range(16)]
+        # the permutation rows (reusing the single-perm generator)
+        perm_rows = generate_trace(state)
+        base = PERIOD * j
+        trace[base:base + PERIOD, :16] = perm_rows
+        if j + 1 < len(chunks):
+            trace[base + PERIOD - 1, 16:24] = chunks[j + 1]
+        state = [int(v) for v in perm_rows[ROUNDS]]
+    # inert tail: plain copies of the final state
+    trace[PERIOD * k:, :16] = trace[PERIOD * k - 1, :16]
+    return trace
+
+
+def sponge_public_inputs(message_limbs: list[int]) -> list[int]:
+    from ..ops.merkle import hash_leaf_ref
+
+    limbs = pad_message_limbs(message_limbs)
+    return limbs + hash_leaf_ref(limbs)

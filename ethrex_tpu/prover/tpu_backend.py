@@ -2,22 +2,21 @@
 
 Round-1 scope: the guest program runs natively on the host, and the TPU
 produces an **output-binding STARK** — a real DEEP-FRI proof (device LDE +
-Poseidon2 Merkle + FRI) of the in-circuit **Poseidon2 compression** of the
-ProgramOutput digest (models/poseidon2_air.py), verified by the independent
-host verifier.  The bound digest uses the same Poseidon2 as the framework's
-Merkle commitments, so the statement is "I know the 16-limb encoding of the
-claimed batch output whose Poseidon2 compression is this digest".
+Poseidon2 Merkle + FRI) that the claimed ProgramOutput bytes hash, limb by
+limb **in-circuit through the Poseidon2 sponge**
+(models/poseidon2_air.Poseidon2SpongeAir = exactly ops/poseidon2.hash_leaves,
+the framework's Merkle leaf hash), to the digest in the proof's public
+inputs.  Verified by the independent host verifier.
 
 What it does NOT yet prove: the EVM execution itself.  That requires the VM
 AIR (the reference delegates this to its zkVM SDKs; our equivalent is the
-arithmetization of guest/execution.py — the Poseidon2 AIR here is its first
+arithmetization of guest/execution.py — the sponge AIR here is its hash
 building block).  Until then the execution-trust level matches the
 reference's exec backend, with real TPU proving work end to end.
 """
 
 from __future__ import annotations
 
-from ..crypto.keccak import keccak256
 from ..guest.execution import ProgramInput
 from ..models import poseidon2_air as pair
 from ..stark import prover as stark_prover
@@ -30,29 +29,26 @@ PARAMS = StarkParams(log_blowup=3, num_queries=40, log_final_size=4)
 
 
 def output_to_limbs(output_bytes: bytes) -> list[int]:
-    """ProgramOutput.encode() -> 16 BabyBear limbs via keccak expansion."""
-    h1 = keccak256(b"ethrex-tpu/output-binding/1" + output_bytes)
-    h2 = keccak256(b"ethrex-tpu/output-binding/2" + output_bytes)
-    limbs = []
-    for h in (h1, h2):
-        for i in range(8):
-            limbs.append(int.from_bytes(h[4 * i:4 * i + 3], "big"))  # 24-bit
-    return limbs
+    """ProgramOutput.encode() -> 24-bit BabyBear limbs (raw byte slices —
+    the full output is absorbed by the sponge, no pre-compression)."""
+    padded = output_bytes + b"\x00" * ((-len(output_bytes)) % 3)
+    limbs = [int.from_bytes(padded[i:i + 3], "big")
+             for i in range(0, len(padded), 3)]
+    limbs.append(len(output_bytes))  # length limb: no padding ambiguity
+    return pair.pad_message_limbs(limbs)
 
 
 class TpuBackend(ProverBackend):
     prover_type = protocol.PROVER_TPU
 
-    def __init__(self):
-        self.air = pair.Poseidon2Air()
-
     def prove(self, program_input: ProgramInput, proof_format: str) -> dict:
         output = self.execute(program_input)
         encoded = output.encode()
         limbs = output_to_limbs(encoded)
-        trace = pair.generate_trace(limbs)
-        pub = pair.public_inputs(limbs)
-        stark = stark_prover.prove(self.air, trace, pub, PARAMS)
+        air = pair.Poseidon2SpongeAir(num_chunks=len(limbs) // 8)
+        trace = pair.generate_sponge_trace(limbs)
+        pub = pair.sponge_public_inputs(limbs)
+        stark = stark_prover.prove(air, trace, pub, PARAMS)
         return {
             "backend": self.prover_type,
             "format": proof_format,
@@ -67,10 +63,11 @@ class TpuBackend(ProverBackend):
             encoded = bytes.fromhex(proof["output"][2:])
             stark = proof["proof"]
             limbs = output_to_limbs(encoded)
+            air = pair.Poseidon2SpongeAir(num_chunks=len(limbs) // 8)
             # the proof's public inputs must bind the claimed output limbs
-            if [int(v) for v in stark["pub_inputs"][:16]] != limbs:
+            if [int(v) for v in stark["pub_inputs"][:len(limbs)]] != limbs:
                 return False
-            return stark_verifier.verify(self.air, stark, PARAMS)
+            return stark_verifier.verify(air, stark, PARAMS)
         except (KeyError, ValueError, TypeError,
                 stark_verifier.VerificationError):
             return False

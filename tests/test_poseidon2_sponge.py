@@ -1,0 +1,70 @@
+"""Sponge-mode Poseidon2 AIR: in-circuit hash_leaves over multiple chunks."""
+
+import numpy as np
+import pytest
+
+from ethrex_tpu.models import poseidon2_air as pair
+from ethrex_tpu.ops import babybear as bb
+from ethrex_tpu.ops import ext
+from ethrex_tpu.ops.merkle import hash_leaf_ref
+from ethrex_tpu.stark import prover, verifier
+from ethrex_tpu.stark.air import HostExtOps
+from ethrex_tpu.stark.prover import StarkParams
+
+RNG = np.random.default_rng(21)
+PARAMS = StarkParams(log_blowup=3, num_queries=30, log_final_size=4)
+
+
+def test_sponge_trace_matches_hash_leaves():
+    msg = [int(v) for v in RNG.integers(0, bb.P, 24)]  # 3 chunks
+    trace = pair.generate_sponge_trace(msg)
+    assert trace.shape == (128, 24)  # 3 chunks pad to 4 periods
+    digest = hash_leaf_ref(msg)
+    final_row = pair.PERIOD * 2 + pair.ROUNDS
+    assert [int(v) for v in trace[final_row][:8]] == digest
+
+
+def test_sponge_constraints_vanish():
+    msg = [int(v) for v in RNG.integers(0, bb.P, 16)]  # 2 chunks
+    air = pair.Poseidon2SpongeAir(num_chunks=2)
+    trace = pair.generate_sponge_trace(msg)
+    n = trace.shape[0]
+    periodic_cols = air.periodic_columns(n)
+    hops = HostExtOps()
+    for r in range(n - 1):
+        local = [ext.h_from_base(int(v)) for v in trace[r]]
+        nxt = [ext.h_from_base(int(v)) for v in trace[r + 1]]
+        periodic = [ext.h_from_base(int(col[r % len(col)]))
+                    for col in periodic_cols]
+        cons = air.constraints(local, nxt, periodic, hops)
+        assert all(c == ext.ZERO_H for c in cons), f"row {r}"
+    # tampering the absorb transition breaks a constraint
+    bad = trace.copy()
+    bad[pair.PERIOD, 2] = (int(bad[pair.PERIOD, 2]) + 1) % bb.P
+    r = pair.PERIOD - 1
+    local = [ext.h_from_base(int(v)) for v in bad[r]]
+    nxt = [ext.h_from_base(int(v)) for v in bad[r + 1]]
+    periodic = [ext.h_from_base(int(col[r % len(col)]))
+                for col in periodic_cols]
+    assert any(c != ext.ZERO_H
+               for c in air.constraints(local, nxt, periodic, hops))
+
+
+def test_sponge_prove_verify_and_binding():
+    msg = [int(v) for v in RNG.integers(0, bb.P, 17)]  # pads to 24 -> k=3
+    air = pair.Poseidon2SpongeAir(num_chunks=3)
+    trace = pair.generate_sponge_trace(msg)
+    pub = pair.sponge_public_inputs(msg)
+    assert pub[-8:] == hash_leaf_ref(pub[:-8])
+    proof = prover.prove(air, trace, pub, PARAMS)
+    assert verifier.verify(air, proof, PARAMS)
+    # forged digest rejected
+    bad_pub = list(proof["pub_inputs"])
+    bad_pub[-1] = (bad_pub[-1] + 1) % bb.P
+    with pytest.raises(verifier.VerificationError):
+        verifier.verify(air, {**proof, "pub_inputs": bad_pub}, PARAMS)
+    # forged message chunk rejected
+    bad_pub2 = list(proof["pub_inputs"])
+    bad_pub2[9] = (bad_pub2[9] + 1) % bb.P  # limb in chunk 1
+    with pytest.raises(verifier.VerificationError):
+        verifier.verify(air, {**proof, "pub_inputs": bad_pub2}, PARAMS)
