@@ -142,6 +142,17 @@ class RlpxPeer:
         payload = eth_wire.encode_get_block_bodies(rid, hashes)
         return self.request(eth_wire.GET_BLOCK_BODIES, payload, rid)
 
+    def get_receipts(self, hashes):
+        rid = self._next_request_id()
+        payload = eth_wire.encode_get_receipts(rid, hashes)
+        return self.request(eth_wire.GET_RECEIPTS, payload, rid)
+
+    def announce_pooled_txs(self, txs):
+        for tx in txs:
+            self._mark_known_tx(tx.hash)
+        self.send_msg(eth_wire.NEW_POOLED_TX_HASHES,
+                      eth_wire.encode_new_pooled_tx_hashes(txs))
+
     def broadcast_transactions(self, txs):
         for tx in txs:
             self._mark_known_tx(tx.hash)
@@ -183,6 +194,21 @@ class RlpxPeer:
             bodies = [b for b in bodies if b is not None]
             self.send_msg(eth_wire.BLOCK_BODIES,
                           eth_wire.encode_block_bodies(rid, bodies))
+        elif msg_id == eth_wire.GET_RECEIPTS:
+            rid, hashes = eth_wire.decode_get_receipts(payload)
+            receipts = [store.get_receipts(h) or [] for h in hashes[:1024]]
+            self.send_msg(eth_wire.RECEIPTS,
+                          eth_wire.encode_receipts(rid, receipts))
+        elif msg_id == eth_wire.RECEIPTS:
+            rid, receipts = eth_wire.decode_receipts(payload)
+            self._resolve(rid, receipts)
+        elif msg_id == eth_wire.NEW_POOLED_TX_HASHES:
+            types, sizes, hashes = \
+                eth_wire.decode_new_pooled_tx_hashes(payload)
+            # remember announcements (the fetch-on-demand path arrives with
+            # GetPooledTransactions in a later round)
+            for h in hashes:
+                self._mark_known_tx(h)
         elif msg_id == eth_wire.BLOCK_HEADERS:
             rid, headers = eth_wire.decode_block_headers(payload)
             self._resolve(rid, headers)

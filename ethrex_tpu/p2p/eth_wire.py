@@ -129,6 +129,59 @@ def decode_transactions(payload: bytes):
     return out
 
 
+def encode_get_receipts(request_id: int, hashes) -> bytes:
+    return rlp.encode([request_id, [bytes(h) for h in hashes]])
+
+
+def decode_get_receipts(payload: bytes):
+    f = rlp.decode(payload)
+    return rlp.decode_int(f[0]), [bytes(h) for h in f[1]]
+
+
+def encode_receipts(request_id: int, receipts_per_block) -> bytes:
+    # legacy receipts ride as RLP lists, typed ones as byte strings —
+    # mirroring the tx embedding rule (spec-conformant either way)
+    def embed(r):
+        enc = r.encode()
+        return rlp.decode(enc) if r.tx_type == 0 else enc
+
+    return rlp.encode([
+        request_id,
+        [[embed(r) for r in receipts] for receipts in receipts_per_block],
+    ])
+
+
+def decode_receipts(payload: bytes):
+    from ..primitives.receipt import Receipt
+
+    def parse(item):
+        if isinstance(item, list):                # legacy receipt
+            return Receipt.decode(rlp.encode(item))
+        return Receipt.decode(bytes(item))        # typed receipt
+
+    f = rlp.decode(payload)
+    return (rlp.decode_int(f[0]),
+            [[parse(r) for r in block_receipts]
+             for block_receipts in f[1]])
+
+
+def encode_new_pooled_tx_hashes(txs) -> bytes:
+    """eth/68 announcement: [types, sizes, hashes]."""
+    return rlp.encode([
+        bytes(tx.tx_type for tx in txs),
+        [len(tx.encode_canonical()) for tx in txs],
+        [tx.hash for tx in txs],
+    ])
+
+
+def decode_new_pooled_tx_hashes(payload: bytes):
+    f = rlp.decode(payload)
+    types = bytes(f[0])
+    sizes = [rlp.decode_int(s) for s in f[1]]
+    hashes = [bytes(h) for h in f[2]]
+    return types, sizes, hashes
+
+
 def encode_new_block(block: Block, total_difficulty: int) -> bytes:
     return rlp.encode([
         [block.header.to_fields()] + block.body.to_fields(),

@@ -98,6 +98,29 @@ def test_transaction_gossip(two_nodes):
     assert any(t.hash == tx.hash for t in block.body.transactions)
 
 
+def test_receipts_and_pooled_hashes(two_nodes):
+    node_a, node_b, srv_a, srv_b = two_nodes
+    node_a.submit_transaction(_tx(0))
+    block = node_a.produce_block()
+    peer = srv_b.dial(srv_a.host, srv_a.port, srv_a.pub)
+    receipts = peer.get_receipts([block.hash])
+    assert len(receipts) == 1 and len(receipts[0]) == 1
+    assert receipts[0][0].succeeded
+    assert receipts[0][0].cumulative_gas_used == 21000
+    # unknown hash -> empty list, not an error
+    receipts = peer.get_receipts([b"\x99" * 32])
+    assert receipts == [[]]
+    # pooled-tx-hash announcement is absorbed without error
+    tx = _tx(1)
+    node_b.submit_transaction(tx)
+    peer.announce_pooled_txs([tx])
+    deadline = time.time() + 5
+    while time.time() < deadline and not (
+            srv_a.peers and tx.hash in srv_a.peers[0].known_txs):
+        time.sleep(0.05)
+    assert tx.hash in srv_a.peers[0].known_txs
+
+
 def test_chain_mismatch_rejected():
     node_a = Node(Genesis.from_json(GENESIS))
     other = dict(GENESIS)
