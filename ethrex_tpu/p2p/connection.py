@@ -49,8 +49,13 @@ class RlpxPeer:
         self._late_ok: set[int] = set()
         self._req_counter = 0
         self._req_lock = threading.Lock()
-        # bounded: a long-lived peer must not retain every gossiped hash
+        # bounded sets with DISTINCT roles: known_txs suppresses outbound
+        # re-sends (peer has seen the hash — via our broadcast, their
+        # announcement, or their full tx); _imported gates inbound imports
+        # and is fed ONLY by full transactions (an announcement must never
+        # block a later full delivery — there is no fetch path yet)
         self.known_txs: dict[bytes, None] = {}
+        self._imported: dict[bytes, None] = {}
         self.KNOWN_TX_CAP = 32768
 
     # -- framing over the socket ------------------------------------------
@@ -119,6 +124,11 @@ class RlpxPeer:
         self.known_txs[tx_hash] = None
         while len(self.known_txs) > self.KNOWN_TX_CAP:
             self.known_txs.pop(next(iter(self.known_txs)))  # oldest first
+
+    def _mark_imported(self, tx_hash: bytes):
+        self._imported[tx_hash] = None
+        while len(self._imported) > self.KNOWN_TX_CAP:
+            self._imported.pop(next(iter(self._imported)))
 
     def request(self, msg_id: int, payload: bytes, request_id: int,
                 timeout: float = 10.0):
@@ -217,8 +227,9 @@ class RlpxPeer:
             self._resolve(rid, bodies)
         elif msg_id == eth_wire.TRANSACTIONS:
             for tx in eth_wire.decode_transactions(payload):
-                if tx.hash in self.known_txs:
+                if tx.hash in self._imported:
                     continue
+                self._mark_imported(tx.hash)
                 self._mark_known_tx(tx.hash)
                 try:
                     self.node.submit_transaction(tx)

@@ -142,8 +142,7 @@ def encode_receipts(request_id: int, receipts_per_block) -> bytes:
     # legacy receipts ride as RLP lists, typed ones as byte strings —
     # mirroring the tx embedding rule (spec-conformant either way)
     def embed(r):
-        enc = r.encode()
-        return rlp.decode(enc) if r.tx_type == 0 else enc
+        return r.to_fields() if r.tx_type == 0 else r.encode()
 
     return rlp.encode([
         request_id,
@@ -156,7 +155,7 @@ def decode_receipts(payload: bytes):
 
     def parse(item):
         if isinstance(item, list):                # legacy receipt
-            return Receipt.decode(rlp.encode(item))
+            return Receipt.from_fields(item)
         return Receipt.decode(bytes(item))        # typed receipt
 
     f = rlp.decode(payload)

@@ -49,14 +49,26 @@ class Receipt:
     def bloom(self) -> bytes:
         return logs_bloom(self.logs)
 
-    def encode(self) -> bytes:
-        """Canonical encoding (typed receipts get their type prefix)."""
-        payload = rlp.encode([
+    def to_fields(self) -> list:
+        return [
             b"\x01" if self.succeeded else b"",
             self.cumulative_gas_used,
             self.bloom,
             [log.to_fields() for log in self.logs],
-        ])
+        ]
+
+    @classmethod
+    def from_fields(cls, f: list, tx_type: int = 0) -> "Receipt":
+        return cls(
+            tx_type=tx_type,
+            succeeded=rlp.decode_int(f[0]) == 1,
+            cumulative_gas_used=rlp.decode_int(f[1]),
+            logs=[Log.from_fields(lf) for lf in f[3]],
+        )
+
+    def encode(self) -> bytes:
+        """Canonical encoding (typed receipts get their type prefix)."""
+        payload = rlp.encode(self.to_fields())
         if self.tx_type == 0:
             return payload
         return bytes([self.tx_type]) + payload
@@ -68,10 +80,4 @@ class Receipt:
         if data and data[0] < 0xC0:
             tx_type = data[0]
             data = data[1:]
-        f = rlp.decode(data)
-        return cls(
-            tx_type=tx_type,
-            succeeded=rlp.decode_int(f[0]) == 1,
-            cumulative_gas_used=rlp.decode_int(f[1]),
-            logs=[Log.from_fields(lf) for lf in f[3]],
-        )
+        return cls.from_fields(rlp.decode(data), tx_type)

@@ -1,0 +1,88 @@
+"""Range-proof verification: property tests against real tries."""
+
+import random
+
+import pytest
+
+from ethrex_tpu.crypto.keccak import keccak256
+from ethrex_tpu.trie.trie import Trie
+from ethrex_tpu.trie.verify_range import RangeProofError, verify_range
+
+RNG = random.Random(7)
+
+
+def _build_trie(n=120):
+    t = Trie()
+    items = {}
+    for i in range(n):
+        k = keccak256(b"key%d" % i)
+        v = RNG.randbytes(RNG.randint(4, 40))
+        t.insert(k, v)
+        items[k] = v
+    t.commit()
+    return t, sorted(items.items())
+
+
+def _range_with_proof(t, items, lo, hi):
+    keys = [k for k, _ in items[lo:hi]]
+    values = [v for _, v in items[lo:hi]]
+    proof = {keccak256(n): n
+             for n in t.get_proof(keys[0]) + t.get_proof(keys[-1])}
+    return keys, values, list(proof.values())
+
+
+@pytest.mark.parametrize("lo,hi", [(0, 120), (0, 10), (50, 70), (110, 120),
+                                   (3, 4), (0, 2), (59, 61)])
+def test_valid_ranges_verify(lo, hi):
+    t, items = _build_trie()
+    root = t.root_hash()
+    keys, values, proof = _range_with_proof(t, items, lo, hi)
+    assert verify_range(root, keys, values, proof)
+
+
+def test_tampered_ranges_fail():
+    t, items = _build_trie()
+    root = t.root_hash()
+    keys, values, proof = _range_with_proof(t, items, 40, 80)
+    # omitted key in the middle
+    assert not verify_range(root, keys[:10] + keys[11:],
+                            values[:10] + values[11:], proof)
+    # altered value
+    bad_vals = list(values)
+    bad_vals[5] = bad_vals[5] + b"x"
+    assert not verify_range(root, keys, bad_vals, proof)
+    # injected key inside the range
+    extra = keccak256(b"not-in-trie")
+    if keys[0] < extra < keys[-1]:
+        ik = sorted(keys + [extra])
+        iv = [dict(zip(keys, values)).get(k, b"zz") for k in ik]
+        assert not verify_range(root, ik, iv, proof)
+    # swapped order rejected structurally
+    with pytest.raises(RangeProofError):
+        verify_range(root, [keys[1], keys[0]], values[:2], proof)
+    # incomplete proof (no nodes)
+    with pytest.raises(RangeProofError):
+        verify_range(root, keys, values, [])
+
+
+def test_truncated_tail_is_valid_shorter_range():
+    """Pin the proof-variant semantics: a server-truncated tail with a
+    proof for the NEW last key verifies (the client re-requests from
+    keys[-1] — liveness, not soundness; see verify_range docstring)."""
+    t, items = _build_trie()
+    root = t.root_hash()
+    keys, values, _ = _range_with_proof(t, items, 40, 80)
+    keys, values = keys[:-1], values[:-1]
+    proof = {keccak256(n): n
+             for n in t.get_proof(keys[0]) + t.get_proof(keys[-1])}
+    assert verify_range(root, keys, values, list(proof.values()))
+
+
+def test_many_random_windows():
+    t, items = _build_trie(200)
+    root = t.root_hash()
+    for _ in range(25):
+        lo = RNG.randrange(0, 199)
+        hi = RNG.randrange(lo + 1, 201)
+        keys, values, proof = _range_with_proof(t, items, lo, hi)
+        assert verify_range(root, keys, values, proof), (lo, hi)
