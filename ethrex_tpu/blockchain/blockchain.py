@@ -204,6 +204,33 @@ class Blockchain:
     # ------------------------------------------------------------------
     # import
     # ------------------------------------------------------------------
+    def _validate_block_outcome(self, header: BlockHeader,
+                                outcome: "ExecutionOutcome") -> None:
+        """Post-execution consensus checks shared by the per-block and
+        batch import paths (gas, blob gas, receipts root, bloom, Prague
+        requests) — everything except the state root."""
+        if outcome.gas_used != header.gas_used:
+            raise InvalidBlock(
+                f"gas used mismatch in block {header.number}: "
+                f"{outcome.gas_used} != {header.gas_used}")
+        if header.blob_gas_used is not None \
+                and outcome.blob_gas_used != header.blob_gas_used:
+            raise InvalidBlock(
+                f"blob gas used mismatch in block {header.number}")
+        if compute_receipts_root(outcome.receipts) != header.receipts_root:
+            raise InvalidBlock(
+                f"receipts root mismatch in block {header.number}")
+        bloom = logs_bloom(
+            [log for r in outcome.receipts for log in r.logs])
+        if bloom != header.bloom:
+            raise InvalidBlock(f"logs bloom mismatch in block {header.number}")
+        fork = self.config.fork_at(header.number, header.timestamp)
+        if fork >= Fork.PRAGUE:
+            if compute_requests_hash(outcome.requests) != \
+                    header.requests_hash:
+                raise InvalidBlock(
+                    f"requests hash mismatch in block {header.number}")
+
     def add_block(self, block: Block) -> None:
         header = block.header
         parent = self.store.get_header(header.parent_hash)
@@ -212,23 +239,7 @@ class Blockchain:
         self.validate_header(header, parent)
         self._validate_body_roots(block)
         outcome = self.execute_block(block, parent)
-        if outcome.gas_used != header.gas_used:
-            raise InvalidBlock(
-                f"gas used mismatch: {outcome.gas_used} != {header.gas_used}")
-        if header.blob_gas_used is not None \
-                and outcome.blob_gas_used != header.blob_gas_used:
-            raise InvalidBlock("blob gas used mismatch")
-        receipts_root = compute_receipts_root(outcome.receipts)
-        if receipts_root != header.receipts_root:
-            raise InvalidBlock("receipts root mismatch")
-        bloom = logs_bloom(
-            [log for r in outcome.receipts for log in r.logs])
-        if bloom != header.bloom:
-            raise InvalidBlock("logs bloom mismatch")
-        fork = self.config.fork_at(header.number, header.timestamp)
-        if fork >= Fork.PRAGUE:
-            if compute_requests_hash(outcome.requests) != header.requests_hash:
-                raise InvalidBlock("requests hash mismatch")
+        self._validate_block_outcome(header, outcome)
         new_root = self.store.apply_account_updates(
             parent.state_root, outcome.state_db)
         if new_root != header.state_root:
@@ -236,6 +247,47 @@ class Blockchain:
                 f"state root mismatch: {new_root.hex()} != "
                 f"{header.state_root.hex()}")
         self.store.add_block(block, outcome.receipts)
+
+    def add_blocks_in_batch(self, blocks: list[Block]) -> None:
+        """Bulk import: execute every block against ONE shared state cache
+        and merkleize ONCE at the end (reference: blockchain.rs
+        add_blocks_in_batch — full-sync bulk path).  All header/body rules,
+        receipts roots, blooms and gas are validated per block; the state
+        root is validated for the FINAL block (intermediate roots are
+        implied by determinism — this is the trusted-chunk trade the
+        reference makes for bulk sync throughput)."""
+        from ..storage.store import StoreSource
+
+        if not blocks:
+            return
+        parent = self.store.get_header(blocks[0].header.parent_hash)
+        if parent is None:
+            raise InvalidBlock("unknown parent")
+        overrides = {parent.number: parent.hash}
+        source = StoreSource(self.store, parent.state_root,
+                             header_overrides=overrides)
+        state_db = StateDB(source)
+        prev = parent
+        per_block = []
+        for block in blocks:
+            header = block.header
+            if header.parent_hash != prev.hash:
+                raise InvalidBlock("non-contiguous batch")
+            self.validate_header(header, prev)
+            self._validate_body_roots(block)
+            outcome = self.execute_block(block, prev, state_db)
+            self._validate_block_outcome(header, outcome)
+            per_block.append((block, outcome.receipts))
+            overrides[header.number] = header.hash
+            prev = header
+        new_root = self.store.apply_account_updates(parent.state_root,
+                                                    state_db)
+        if new_root != blocks[-1].header.state_root:
+            raise InvalidBlock(
+                f"final state root mismatch: {new_root.hex()} != "
+                f"{blocks[-1].header.state_root.hex()}")
+        for block, receipts in per_block:
+            self.store.add_block(block, receipts)
 
     def _validate_body_roots(self, block: Block):
         header = block.header

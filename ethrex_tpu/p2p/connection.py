@@ -349,7 +349,8 @@ class P2PServer:
 
 def full_sync(peer: RlpxPeer, node, batch: int = 64) -> int:
     """Header/body full sync from a peer (mini sync/full.rs): fetch forward
-    from our head, import with full validation, follow fork choice."""
+    from our head, bulk-import each chunk (execute all + merkleize once),
+    follow fork choice."""
     from ..blockchain.fork_choice import apply_fork_choice
 
     imported = 0
@@ -362,9 +363,8 @@ def full_sync(peer: RlpxPeer, node, batch: int = 64) -> int:
         bodies = peer.get_block_bodies([h.hash for h in headers])
         if len(bodies) != len(headers):
             raise PeerError("incomplete bodies response")
-        for header, body in zip(headers, bodies):
-            block = Block(header, body)
-            node.chain.add_block(block)
-            apply_fork_choice(node.store, block.hash)
-            imported += 1
+        blocks = [Block(h, b) for h, b in zip(headers, bodies)]
+        node.chain.add_blocks_in_batch(blocks)
+        apply_fork_choice(node.store, blocks[-1].hash)
+        imported += len(blocks)
     return imported

@@ -275,13 +275,16 @@ class StoreSource(TrieSource):
     """
 
     def __init__(self, store: Store, state_root: bytes,
-                 nodes: dict | None = None, on_code=None, on_block_hash=None):
+                 nodes: dict | None = None, on_code=None, on_block_hash=None,
+                 header_overrides: dict | None = None):
         super().__init__(nodes if nodes is not None else store.nodes,
                          state_root)
         self.store = store
         self.state_root = state_root
         self.on_code = on_code
         self.on_block_hash = on_block_hash
+        # number -> hash for blocks not yet canonical (batch import)
+        self.header_overrides = header_overrides or {}
 
     def get_code(self, code_hash: bytes) -> bytes:
         if code_hash == EMPTY_CODE_HASH:
@@ -292,7 +295,8 @@ class StoreSource(TrieSource):
         return code
 
     def get_block_hash(self, number: int) -> bytes:
-        h = self.store.canonical_hash(number)
+        h = self.header_overrides.get(number) \
+            or self.store.canonical_hash(number)
         if h and self.on_block_hash:
             self.on_block_hash(number, h)
         return h if h else b"\x00" * 32

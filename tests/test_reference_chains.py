@@ -46,6 +46,37 @@ def test_genesis_hash_matches_reference():
 
 @pytest.mark.skipif(not os.path.isdir(FIXTURES),
                     reason="reference fixtures not available")
+def test_batch_import_reference_chain():
+    """Bulk path: same chain, one merkleization, same final root; and a
+    tampered batch must be rejected with no store mutation."""
+    import dataclasses
+
+    with open(f"{FIXTURES}/genesis/perf-ci.json") as f:
+        genesis = Genesis.from_json(json.load(f))
+    store = Store()
+    store.init_genesis(genesis)
+    chain = Blockchain(store, genesis.config)
+    blocks = _load_chain(f"{FIXTURES}/blockchain/l2-loadtest.rlp")
+    chain.add_blocks_in_batch(blocks)
+    apply_fork_choice(store, blocks[-1].hash)
+    assert store.head_header().state_root == blocks[-1].header.state_root
+
+    # tampered final root: rejected, nothing stored
+    store2 = Store()
+    store2.init_genesis(genesis)
+    chain2 = Blockchain(store2, genesis.config)
+    bad_last = dataclasses.replace(blocks[-1].header,
+                                   state_root=b"\x13" * 32)
+    from ethrex_tpu.blockchain.blockchain import InvalidBlock
+    from ethrex_tpu.primitives.block import Block as _B
+    with pytest.raises(InvalidBlock):
+        chain2.add_blocks_in_batch(
+            blocks[:-1] + [_B(bad_last, blocks[-1].body)])
+    assert store2.get_header(blocks[0].hash) is None  # no partial writes
+
+
+@pytest.mark.skipif(not os.path.isdir(FIXTURES),
+                    reason="reference fixtures not available")
 def test_import_reference_loadtest_chain():
     with open(f"{FIXTURES}/genesis/perf-ci.json") as f:
         genesis = Genesis.from_json(json.load(f))
