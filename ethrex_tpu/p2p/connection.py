@@ -13,7 +13,7 @@ import threading
 
 from ..crypto import secp256k1
 from ..primitives.block import Block
-from . import eth_wire, rlpx
+from . import eth_wire, rlpx, snap
 
 CLIENT_ID = "ethrex-tpu/0.1.0"
 
@@ -78,13 +78,14 @@ class RlpxPeer:
             secp256k1.pubkey_from_secret(self.node.p2p_secret))
         self.send_msg(eth_wire.HELLO,
                       rlpx.make_hello_payload(CLIENT_ID, node_id,
-                                              (("eth", 68),)))
+                                              (("eth", 68), ("snap", 1))))
         msg_id, payload = self.recv_msg()
         if msg_id != eth_wire.HELLO:
             raise PeerError(f"expected hello, got {msg_id}")
         hello = rlpx.parse_hello_payload(payload)
         if ("eth", 68) not in hello["capabilities"]:
             raise PeerError("peer does not speak eth/68")
+        self.capabilities = set(hello["capabilities"])
         return hello
 
     def exchange_status(self):
@@ -156,6 +157,33 @@ class RlpxPeer:
         rid = self._next_request_id()
         payload = eth_wire.encode_get_receipts(rid, hashes)
         return self.request(eth_wire.GET_RECEIPTS, payload, rid)
+
+    # -- snap/1 client -----------------------------------------------------
+    def _require_snap(self):
+        caps = getattr(self, "capabilities", set())
+        if caps and ("snap", 1) not in caps:
+            raise PeerError("peer does not speak snap/1")
+
+    def snap_get_account_range(self, root: bytes, origin: bytes,
+                               limit: bytes):
+        self._require_snap()
+        rid = self._next_request_id()
+        payload = snap.encode_get_account_range(rid, root, origin, limit)
+        return self.request(snap.GET_ACCOUNT_RANGE, payload, rid)
+
+    def snap_get_storage_range(self, root: bytes, account_hash: bytes,
+                               origin: bytes = b""):
+        self._require_snap()
+        rid = self._next_request_id()
+        payload = snap.encode_get_storage_ranges(rid, root, [account_hash],
+                                                 origin)
+        slots, proofs = self.request(snap.GET_STORAGE_RANGES, payload, rid)
+        return (slots[0] if slots else []), (proofs[0] if proofs else [])
+
+    def snap_get_byte_codes(self, hashes):
+        rid = self._next_request_id()
+        payload = snap.encode_get_byte_codes(rid, hashes)
+        return self.request(snap.GET_BYTE_CODES, payload, rid)
 
     def announce_pooled_txs(self, txs):
         for tx in txs:
@@ -235,6 +263,39 @@ class RlpxPeer:
                     self.node.submit_transaction(tx)
                 except Exception:  # noqa: BLE001 — invalid gossip is dropped
                     pass
+        elif msg_id == snap.GET_ACCOUNT_RANGE:
+            rid, root, origin, limit = \
+                snap.decode_get_account_range(payload)
+            accounts, proof = snap.serve_account_range(
+                store, root, origin, limit)
+            self.send_msg(snap.ACCOUNT_RANGE,
+                          snap.encode_account_range(rid, accounts, proof))
+        elif msg_id == snap.ACCOUNT_RANGE:
+            rid, accounts, proof = snap.decode_account_range(payload)
+            self._resolve(rid, (accounts, proof))
+        elif msg_id == snap.GET_STORAGE_RANGES:
+            rid, root, hashes, origin = \
+                snap.decode_get_storage_ranges(payload)
+            slots_all, proofs_all = [], []
+            for h in hashes[:64]:
+                slots, proof = snap.serve_storage_range(store, root, h,
+                                                        origin)
+                slots_all.append(slots)
+                proofs_all.append(proof)
+            self.send_msg(snap.STORAGE_RANGES, snap.encode_storage_ranges(
+                rid, slots_all, proofs_all))
+        elif msg_id == snap.STORAGE_RANGES:
+            rid, slots, proofs = snap.decode_storage_ranges(payload)
+            self._resolve(rid, (slots, proofs))
+        elif msg_id == snap.GET_BYTE_CODES:
+            rid, hashes = snap.decode_get_byte_codes(payload)
+            codes = [store.code[h] for h in hashes[:1024]
+                     if h in store.code]
+            self.send_msg(snap.BYTE_CODES,
+                          snap.encode_byte_codes(rid, codes))
+        elif msg_id == snap.BYTE_CODES:
+            rid, codes = snap.decode_byte_codes(payload)
+            self._resolve(rid, codes)
         elif msg_id == eth_wire.NEW_BLOCK:
             block, _td = eth_wire.decode_new_block(payload)
             try:
@@ -257,7 +318,12 @@ class RlpxPeer:
         try:
             while not self._stop.is_set():
                 msg_id, payload = self.recv_msg()
-                self._handle(msg_id, payload)
+                try:
+                    self._handle(msg_id, payload)
+                except (ConnectionError, OSError):
+                    raise
+                except Exception:  # noqa: BLE001 — one bad message must
+                    pass           # not kill the whole session
         except (ConnectionError, OSError, rlpx.RlpxError, PeerError):
             pass
 

@@ -348,25 +348,58 @@ class Trie:
 
     def items(self):
         """Iterate (nibble_path, value) pairs (debug / range helpers)."""
+        return list(self.iter_from(b""))
+
+    def iter_from(self, start_key: bytes, max_items: int | None = None):
+        """Ordered (nibble_path, value) list starting at start_key —
+        O(window + depth), no full-trie materialization (snap serving).
+
+        `bound` below is the remaining lower-bound nibble path relative to
+        the current node; () means "emit everything in this subtree".
+        """
         out = []
 
-        def walk(node, prefix):
+        def walk(node, prefix, bound):
+            if max_items is not None and len(out) >= max_items:
+                return
             node = self._resolve(node)
             if node is None:
                 return
             kind = node[0]
             if kind == "leaf":
-                out.append((prefix + node[1], node[2]))
-            elif kind == "ext":
-                walk(node[2], prefix + node[1])
-            else:
-                if node[2]:
-                    out.append((prefix, node[2]))
-                for i, c in enumerate(node[1]):
-                    if c is not None:
-                        walk(c, prefix + (i,))
+                if not bound or tuple(node[1]) >= tuple(bound):
+                    out.append((prefix + node[1], node[2]))
+                return
+            if kind == "ext":
+                p = node[1]
+                if bound:
+                    m = min(len(p), len(bound))
+                    if tuple(p[:m]) < tuple(bound[:m]):
+                        return          # subtree entirely before the bound
+                    if tuple(p[:m]) > tuple(bound[:m]):
+                        sub = ()        # entirely after: emit everything
+                    else:
+                        sub = tuple(bound[len(p):])
+                else:
+                    sub = ()
+                walk(node[2], prefix + p, sub)
+                return
+            # branch: the branch value's key is a strict prefix of any
+            # bounded start key, so it is only emitted when unbounded
+            if node[2] and not bound:
+                out.append((prefix, node[2]))
+            lo = bound[0] if bound else 0
+            for i in range(lo, 16):
+                child = node[1][i]
+                if child is None:
+                    continue
+                walk(child, prefix + (i,),
+                     tuple(bound[1:]) if (bound and i == lo) else ())
+                if max_items is not None and len(out) >= max_items:
+                    return
 
-        walk(self._root, ())
+        walk(self._root, (),
+             bytes_to_nibbles(start_key) if start_key else ())
         return out
 
 
