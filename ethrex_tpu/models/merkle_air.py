@@ -1,0 +1,159 @@
+"""Merkle-path membership AIR: prove in-circuit that a leaf digest is
+included under a public root along a (witness) authentication path, using
+the SAME 2-to-1 compression as the framework's Merkle trees
+(ops/poseidon2.compress = P(l||r)[:8] + l, verified against
+ops/merkle.fold_path_canonical).
+
+This is the opening-verification primitive for FRI recursion and for
+state-commitment openings inside the future zkVM AIR.
+
+Trace (width 33 = 16 state + 8 dig + 8 sib + 1 bit), one 32-row period per
+tree level plus one inert tail period, padded to a power of two:
+  * dig/sib/bit are constant within a period (copy-constrained);
+    dig holds the running digest d_j, sib/bit the level's witness.
+  * input_j = bit ? [sib, d_j] : [d_j, sib]  (bit = 1 when we are the
+    right child, matching fold_path_canonical's idx & 1).
+  * rows 0..21 run P(input_j) (row-0 state bound by a sel_first local
+    constraint to M_E(input_0), later periods by the handoff transition).
+  * handoff (row 32j+31 -> 32j+32, for j < depth):
+      nxt_dig = state + (1-bit)*dig + bit*sib      (the feed-forward)
+      nxt_state = M_E(select(nxt_dig, nxt_sib, nxt_bit))
+  * public inputs: leaf (8, bound to dig at row 0) and root (8, bound to
+    dig in the tail period).  Siblings and direction bits stay witness
+    columns (booleanity-constrained), so the INDEX and PATH are private.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import babybear as bb
+from ..ops import poseidon2 as p2
+from ..stark.air import Air
+from .poseidon2_air import (PERIOD, ROUNDS, Poseidon2Air,
+                            _external_linear_generic, generate_trace)
+
+
+class Poseidon2MerkleAir(Air):
+    width = 33
+    max_degree = 8
+    num_pub_inputs = 16  # leaf digest (8) + root (8)
+    num_periodic = Poseidon2Air.num_periodic + 2  # + sel_absorb, sel_first
+
+    def __init__(self, depth: int):
+        assert depth >= 1
+        self.depth = depth
+        # next power of two STRICTLY greater than depth: guarantees at
+        # least one inert tail period carrying the root for the boundary
+        self.periods = 1 << depth.bit_length()
+
+    def cache_key(self) -> tuple:
+        return (type(self), self.width, self.max_degree,
+                self.num_pub_inputs, self.depth)
+
+    def periodic_columns(self, n: int):
+        assert n == PERIOD * self.periods
+        from .poseidon2_air import tile_periodic_columns
+
+        base, sel_absorb = tile_periodic_columns(n, self.depth,
+                                                 handoffs=self.depth)
+        sel_first = np.zeros(n, dtype=np.uint32)
+        sel_first[0] = 1
+        return base + [sel_absorb, sel_first]
+
+    def _select(self, dig, sib, bit, ops):
+        """input halves: lo = (1-bit)*dig + bit*sib ; hi = the other."""
+        one = ops.const(1)
+        inv = ops.sub(one, bit)
+        lo = [ops.add(ops.mul(inv, dig[i]), ops.mul(bit, sib[i]))
+              for i in range(8)]
+        hi = [ops.add(ops.mul(bit, dig[i]), ops.mul(inv, sib[i]))
+              for i in range(8)]
+        return lo + hi
+
+    def constraints(self, local, nxt, periodic, ops):
+        state = local[:16]
+        nxt_state = nxt[:16]
+        dig, sib, bit = local[16:24], local[24:32], local[32]
+        ndig, nsib, nbit = nxt[16:24], nxt[24:32], nxt[32]
+        sel_absorb, sel_first = periodic[-2], periodic[-1]
+        perm = Poseidon2Air.constraints(self, state, nxt_state,
+                                        periodic[:-2], ops)
+        from .poseidon2_air import splice_handoff
+
+        one = ops.const(1)
+        keep = ops.sub(one, sel_absorb)
+        mixed = _external_linear_generic(
+            self._select(ndig, nsib, nbit, ops), ops)
+        out = splice_handoff(perm, state, nxt_state, mixed, sel_absorb, ops)
+        # row 0: state = M_E(select(dig, sib, bit))  (local constraint)
+        first_mixed = _external_linear_generic(
+            self._select(dig, sib, bit, ops), ops)
+        for j in range(16):
+            out.append(ops.mul(sel_first,
+                               ops.sub(state[j], first_mixed[j])))
+        # digest feed-forward at handoffs; copies elsewhere
+        inv_b = ops.sub(one, bit)
+        for i in range(8):
+            ff = ops.add(state[i],
+                         ops.add(ops.mul(inv_b, dig[i]),
+                                 ops.mul(bit, sib[i])))
+            out.append(ops.add(
+                ops.mul(sel_absorb, ops.sub(ndig[i], ff)),
+                ops.mul(keep, ops.sub(ndig[i], dig[i]))))
+            # sib columns only need in-period stability
+            out.append(ops.mul(keep, ops.sub(nsib[i], sib[i])))
+        out.append(ops.mul(keep, ops.sub(nbit, bit)))
+        out.append(ops.mul(bit, ops.sub(bit, one)))  # booleanity
+        return out
+
+    def boundaries(self, pub_inputs, n: int):
+        leaf = [int(v) % bb.P for v in pub_inputs[:8]]
+        root = [int(v) % bb.P for v in pub_inputs[8:16]]
+        out = [(0, 16 + i, leaf[i]) for i in range(8)]
+        root_row = PERIOD * self.depth  # first row of the inert tail
+        out += [(root_row, 16 + i, root[i]) for i in range(8)]
+        return out
+
+
+def generate_merkle_trace(leaf: list[int], siblings: list[list[int]],
+                          bits: list[int]) -> np.ndarray:
+    """Trace for the compression chain fold(leaf, path) -> root."""
+    depth = len(siblings)
+    assert len(bits) == depth
+    air = Poseidon2MerkleAir(depth)
+    n = PERIOD * air.periods
+    trace = np.zeros((n, 33), dtype=np.uint32)
+    dig = [int(v) % bb.P for v in leaf]
+    for j in range(depth):
+        sib = [int(v) % bb.P for v in siblings[j]]
+        bit = bits[j]
+        if bit:
+            inp = sib + dig
+        else:
+            inp = dig + sib
+        perm_rows = generate_trace(inp)
+        base = PERIOD * j
+        trace[base:base + PERIOD, :16] = perm_rows
+        trace[base:base + PERIOD, 16:24] = dig
+        trace[base:base + PERIOD, 24:32] = sib
+        trace[base:base + PERIOD, 32] = bit
+        dig = [(int(perm_rows[ROUNDS][i]) + inp[i]) % bb.P
+               for i in range(8)]
+    # inert tail: dig carries the root; the final handoff constraint loads
+    # the tail state with M_E(select(root, last_sib, last_bit)) and the
+    # tail rows copy it
+    last_sib = [int(v) for v in trace[PERIOD * depth - 1, 24:32]]
+    last_bit = int(trace[PERIOD * depth - 1, 32])
+    inp = (last_sib + dig) if last_bit else (dig + last_sib)
+    tail_state = p2._external_linear_ref(inp)
+    trace[PERIOD * depth:, :16] = tail_state
+    trace[PERIOD * depth:, 16:24] = dig
+    trace[PERIOD * depth:, 24:32] = last_sib
+    trace[PERIOD * depth:, 32] = last_bit
+    return trace
+
+
+def merkle_public_inputs(leaf: list[int], root: list[int]) -> list[int]:
+    return ([int(v) % bb.P for v in leaf]
+            + [int(v) % bb.P for v in root])

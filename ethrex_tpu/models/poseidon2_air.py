@@ -179,6 +179,42 @@ def public_inputs(limbs: list[int]) -> list[int]:
 # exactly p2.hash_leaves (the framework's Merkle leaf hash) in-circuit.
 # ---------------------------------------------------------------------------
 
+def tile_periodic_columns(n: int, active_periods: int,
+                          handoffs: int | None = None):
+    """Full-length schedule columns: the single-permutation period-32 base
+    columns tiled over the first `active_periods` periods (zeros after),
+    plus a sel_absorb column marking the first `handoffs` inter-period
+    handoff rows (default: between active periods only; the Merkle AIR
+    also hands off INTO its inert tail).  Shared by the sponge and
+    Merkle-path AIRs."""
+    if n < PERIOD * active_periods:
+        raise ValueError("trace too short for the active period count")
+    base32 = Poseidon2Air().periodic_columns(PERIOD)
+    out = []
+    for col in base32:
+        full = np.zeros(n, dtype=np.uint32)
+        full[:PERIOD * active_periods] = np.tile(col, active_periods)
+        out.append(full)
+    sel_absorb = np.zeros(n, dtype=np.uint32)
+    count = active_periods - 1 if handoffs is None else handoffs
+    for j in range(count):
+        sel_absorb[PERIOD * (j + 1) - 1] = 1
+    return out, sel_absorb
+
+
+def splice_handoff(perm_cons, state, nxt_state, mixed, sel_absorb, ops):
+    """Replace the permutation constraints' sel_none copy with a gated
+    handoff at absorb rows: nxt_state = mixed there, copies elsewhere.
+    (sel_none = 1 - sel_ext - sel_int also fires at the handoff row, so
+    its copy term is subtracted before the gated handoff term is added.)"""
+    out = []
+    for j in range(16):
+        copy_term = ops.mul(sel_absorb, ops.sub(nxt_state[j], state[j]))
+        handoff = ops.mul(sel_absorb, ops.sub(nxt_state[j], mixed[j]))
+        out.append(ops.add(ops.sub(perm_cons[j], copy_term), handoff))
+    return out
+
+
 class Poseidon2SpongeAir(Air):
     """k chained permutations, n = 32k rows, width 24 (16 state + 8 msg).
 
@@ -208,19 +244,8 @@ class Poseidon2SpongeAir(Air):
         # periods run permutations/absorbs; the tail periods have all
         # selectors 0, so sel_none forces plain copies — this lets a
         # k-chunk sponge live in a power-of-two trace with k arbitrary
-        k = self.num_chunks
-        if n < PERIOD * k:
-            raise ValueError("trace too short for num_chunks")
-        base32 = Poseidon2Air().periodic_columns(PERIOD)
-        out = []
-        for col in base32:
-            full = np.zeros(n, dtype=np.uint32)
-            full[:PERIOD * k] = np.tile(col, k)
-            out.append(full)
-        sel_absorb = np.zeros(n, dtype=np.uint32)
-        for j in range(k - 1):
-            sel_absorb[PERIOD * (j + 1) - 1] = 1
-        return out + [sel_absorb]
+        base, sel_absorb = tile_periodic_columns(n, self.num_chunks)
+        return base + [sel_absorb]
 
     def constraints(self, local, nxt, periodic, ops):
         state = local[:16]
@@ -233,18 +258,8 @@ class Poseidon2SpongeAir(Air):
         absorbed = [ops.add(state[j], msg[j]) if j < 8 else state[j]
                     for j in range(16)]
         mixed = _external_linear_generic(absorbed, ops)
-        out = []
-        for j in range(16):
-            # inner already contains sel_ext/sel_int/sel_none terms; at the
-            # absorb row all of those selectors are 0, so adding the gated
-            # absorb term keeps each row governed by exactly one rule —
-            # but sel_none = 1 - sel_ext - sel_int ALSO fires at the absorb
-            # row, so subtract its copy term there.
-            copy_term = ops.mul(sel_absorb, ops.sub(nxt_state[j], state[j]))
-            absorb_term = ops.mul(sel_absorb,
-                                  ops.sub(nxt_state[j], mixed[j]))
-            out.append(ops.add(ops.sub(inner[j], copy_term), absorb_term))
-        return out
+        return splice_handoff(inner, state, nxt_state, mixed, sel_absorb,
+                              ops)
 
     def boundaries(self, pub_inputs, n: int):
         k = self.num_chunks

@@ -1,0 +1,84 @@
+"""Merkle-membership AIR: agreement with the host path fold, constraint
+satisfaction, and a full prove/verify round-trip with forgery rejection."""
+
+import numpy as np
+import pytest
+
+from ethrex_tpu.models import merkle_air as mair
+from ethrex_tpu.ops import babybear as bb
+from ethrex_tpu.ops import ext
+from ethrex_tpu.ops.merkle import fold_path_canonical
+from ethrex_tpu.stark import prover, verifier
+from ethrex_tpu.stark.air import HostExtOps
+from ethrex_tpu.stark.prover import StarkParams
+
+RNG = np.random.default_rng(31)
+PARAMS = StarkParams(log_blowup=3, num_queries=30, log_final_size=4)
+
+
+def _path(depth):
+    leaf = [int(v) for v in RNG.integers(0, bb.P, 8)]
+    siblings = [[int(v) for v in RNG.integers(0, bb.P, 8)]
+                for _ in range(depth)]
+    index = int(RNG.integers(0, 1 << depth))
+    bits = [(index >> j) & 1 for j in range(depth)]
+    root = fold_path_canonical(index, leaf, siblings)
+    return leaf, siblings, bits, index, root
+
+
+def test_trace_matches_host_fold():
+    leaf, siblings, bits, index, root = _path(3)
+    trace = mair.generate_merkle_trace(leaf, siblings, bits)
+    air = mair.Poseidon2MerkleAir(3)
+    assert trace.shape == (mair.PERIOD * air.periods, 33)
+    tail = mair.PERIOD * 3
+    assert [int(v) for v in trace[tail, 16:24]] == root
+
+
+def test_constraints_vanish_and_catch_tampering():
+    leaf, siblings, bits, index, root = _path(2)
+    air = mair.Poseidon2MerkleAir(2)
+    trace = mair.generate_merkle_trace(leaf, siblings, bits)
+    n = trace.shape[0]
+    periodic_cols = air.periodic_columns(n)
+    hops = HostExtOps()
+
+    def cons_at(tr, r):
+        local = [ext.h_from_base(int(v)) for v in tr[r]]
+        nxt = [ext.h_from_base(int(v)) for v in tr[r + 1]]
+        periodic = [ext.h_from_base(int(col[r])) for col in periodic_cols]
+        return air.constraints(local, nxt, periodic, hops)
+
+    for r in range(n - 1):
+        assert all(c == ext.ZERO_H for c in cons_at(trace, r)), f"row {r}"
+    # flip the direction bit of level 1 -> the handoff constraint breaks
+    bad = trace.copy()
+    rows = slice(mair.PERIOD, 2 * mair.PERIOD)
+    bad[rows, 32] = 1 - bad[mair.PERIOD, 32]
+    broke = any(any(c != ext.ZERO_H for c in cons_at(bad, r))
+                for r in range(n - 1))
+    assert broke
+
+
+@pytest.mark.parametrize("depth", [1, 3])
+def test_prove_verify_membership(depth):
+    leaf, siblings, bits, index, root = _path(depth)
+    air = mair.Poseidon2MerkleAir(depth)
+    trace = mair.generate_merkle_trace(leaf, siblings, bits)
+    pub = mair.merkle_public_inputs(leaf, root)
+    proof = prover.prove(air, trace, pub, PARAMS)
+    assert verifier.verify(air, proof, PARAMS)
+    # a different root must not verify (membership forgery)
+    bad_root = list(root)
+    bad_root[0] = (bad_root[0] + 1) % bb.P
+    bad_pub = mair.merkle_public_inputs(leaf, bad_root)
+    with pytest.raises(verifier.VerificationError):
+        verifier.verify(air, {**proof, "pub_inputs": bad_pub}, PARAMS)
+    # a different leaf must not verify either
+    bad_leaf = list(leaf)
+    bad_leaf[3] = (bad_leaf[3] + 1) % bb.P
+    with pytest.raises(verifier.VerificationError):
+        verifier.verify(
+            air, {**proof,
+                  "pub_inputs": mair.merkle_public_inputs(bad_leaf, root)},
+            PARAMS)
