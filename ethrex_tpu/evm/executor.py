@@ -125,6 +125,12 @@ def execute_privileged_tx(tx: Transaction, state: StateDB, block: BlockEnv,
                   value=tx.value, data=tx.data,
                   gas=max(tx.gas_limit, 21000) - G.TX_BASE, code=code)
     ok, _, output = evm.execute_message(msg)
+    if not ok and tx.value:
+        # the deposited VALUE must reach the recipient even when the call's
+        # effects revert (the L1 deposit is consumed either way; leaving the
+        # mint stranded at the bridge alias would burn user funds)
+        state.sub_balance(sender, tx.value)
+        state.add_balance(tx.to, tx.value)
     logs = list(state.logs) if ok else []
     state.finalize_tx()
     return TxResult(success=ok, gas_used=G.TX_BASE, output=output,

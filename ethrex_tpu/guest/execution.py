@@ -106,20 +106,21 @@ class ProgramOutput:
     first_block_number: int
     last_block_number: int
     privileged_digest: bytes = b"\x00" * 32
+    messages_root: bytes = b"\x00" * 32  # L2->L1 withdrawal Merkle root
 
     def encode(self) -> bytes:
         return (self.initial_state_root + self.final_state_root
                 + self.last_block_hash
                 + self.first_block_number.to_bytes(8, "big")
                 + self.last_block_number.to_bytes(8, "big")
-                + self.privileged_digest)
+                + self.privileged_digest + self.messages_root)
 
     @classmethod
     def decode(cls, data: bytes) -> "ProgramOutput":
         return cls(data[0:32], data[32:64], data[64:96],
                    int.from_bytes(data[96:104], "big"),
                    int.from_bytes(data[104:112], "big"),
-                   data[112:144])
+                   data[112:144], data[144:176])
 
 
 def privileged_tx_digest(tx_hashes: list[bytes]) -> bytes:
@@ -178,6 +179,7 @@ def execution_program(program_input: ProgramInput) -> ProgramOutput:
     state_root = initial_root
     prev = parent_header
     privileged_hashes = []
+    receipts_per_block = []
     for block in blocks:
         privileged_hashes.extend(
             tx.hash for tx in block.body.transactions
@@ -200,6 +202,7 @@ def execution_program(program_input: ProgramInput) -> ProgramOutput:
         if compute_receipts_root(outcome.receipts) != \
                 block.header.receipts_root:
             raise StatelessExecutionError("receipts root mismatch")
+        receipts_per_block.append(outcome.receipts)
         try:
             state_root = apply_updates_to_tries(nodes, codes, state_root,
                                                 state_db)
@@ -211,6 +214,9 @@ def execution_program(program_input: ProgramInput) -> ProgramOutput:
         headers[block.header.number] = block.header
         prev = block.header
 
+    from ..l2.messages import collect_messages, message_root
+
+    messages = collect_messages(blocks, receipts_per_block)
     return ProgramOutput(
         initial_state_root=initial_root,
         final_state_root=state_root,
@@ -218,4 +224,5 @@ def execution_program(program_input: ProgramInput) -> ProgramOutput:
         first_block_number=blocks[0].header.number,
         last_block_number=prev.number,
         privileged_digest=privileged_tx_digest(privileged_hashes),
+        messages_root=message_root(messages),
     )

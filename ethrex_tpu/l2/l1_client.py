@@ -31,6 +31,12 @@ class Deposit:
     index: int = 0
 
 
+# the aliased L1-bridge sender for privileged txs: deposits must NOT spend
+# the recipient's nonce (their next real tx would fail) — the mint executes
+# from this alias, whose nonce counts processed deposits
+L1_BRIDGE_ALIAS = bytes.fromhex("1111000000000000000000000000000000001111")
+
+
 def make_deposit_tx(chain_id: int, deposit: Deposit):
     """Deterministic privileged tx for an L1 deposit — shared by the L2
     watcher and the L1 commitment check, so the L1 can recompute and verify
@@ -39,7 +45,7 @@ def make_deposit_tx(chain_id: int, deposit: Deposit):
 
     return Transaction(
         tx_type=TYPE_PRIVILEGED, chain_id=chain_id, nonce=deposit.index,
-        from_addr=deposit.recipient, to=deposit.recipient,
+        from_addr=L1_BRIDGE_ALIAS, to=deposit.recipient,
         value=deposit.amount, gas_limit=deposit.gas_limit,
         data=deposit.data,
     )
@@ -48,7 +54,8 @@ def make_deposit_tx(chain_id: int, deposit: Deposit):
 class L1Client:
     def commit_batch(self, number: int, new_state_root: bytes,
                      commitment: bytes,
-                     privileged_tx_hashes: list[bytes] = ()) -> bytes:
+                     privileged_tx_hashes: list[bytes] = (),
+                     messages_root: bytes = b"\x00" * 32) -> bytes:
         raise NotImplementedError
 
     def verify_batches(self, first: int, last: int,
@@ -73,6 +80,8 @@ class InMemoryL1(L1Client):
         self.needed = list(needed_prover_types)
         self.l2_chain_id = l2_chain_id
         self.commitments: dict[int, tuple[bytes, bytes]] = {}
+        self.message_roots: dict[int, bytes] = {}
+        self.claimed: set[bytes] = set()
         self.verified_up_to = 0
         self.deposits: list[Deposit] = []
         self.consumed_deposits = 0
@@ -80,7 +89,8 @@ class InMemoryL1(L1Client):
 
     # ---- OnChainProposer ----
     def commit_batch(self, number, new_state_root, commitment,
-                     privileged_tx_hashes=()) -> bytes:
+                     privileged_tx_hashes=(),
+                     messages_root=b"\x00" * 32) -> bytes:
         with self.lock:
             if number != len(self.commitments) + 1:
                 raise L1Error(
@@ -103,18 +113,44 @@ class InMemoryL1(L1Client):
                 cursor += 1
             self.consumed_deposits = cursor
             self.commitments[number] = (new_state_root, commitment)
+            self.message_roots[number] = bytes(messages_root)
             return keccak256(b"commit" + number.to_bytes(8, "big")
                              + commitment)
 
     def verify_batches(self, first, last, proofs) -> bytes:
+        """proofs: {prover_type: [proof_bytes for each batch first..last]}.
+        Each proof's committed ProgramOutput must bind the batch's stored
+        state root and messages root (a fabricated commit-time messages
+        root would otherwise let phantom withdrawals be claimed)."""
+        import json as _json
+
+        from ..guest.execution import ProgramOutput
+
         with self.lock:
             if first != self.verified_up_to + 1:
                 raise L1Error("verification must be contiguous")
             if last > len(self.commitments):
                 raise L1Error("cannot verify uncommitted batches")
             for t in self.needed:
-                if t not in proofs or not proofs[t]:
-                    raise L1Error(f"missing {t} proof")
+                batch_proofs = proofs.get(t)
+                if not batch_proofs or len(batch_proofs) != last - first + 1:
+                    raise L1Error(f"missing {t} proofs")
+                for offset, raw in enumerate(batch_proofs):
+                    number = first + offset
+                    try:
+                        obj = _json.loads(raw)
+                        out = ProgramOutput.decode(
+                            bytes.fromhex(obj["output"][2:]))
+                    except (ValueError, KeyError, TypeError):
+                        raise L1Error(f"unparseable {t} proof")
+                    state_root, _ = self.commitments[number]
+                    if out.final_state_root != state_root:
+                        raise L1Error(
+                            f"proof state root mismatch for batch {number}")
+                    if out.messages_root != self.message_roots[number]:
+                        raise L1Error(
+                            f"proof messages root mismatch for batch "
+                            f"{number}")
             self.verified_up_to = last
             return keccak256(b"verify" + first.to_bytes(8, "big")
                              + last.to_bytes(8, "big"))
@@ -125,7 +161,27 @@ class InMemoryL1(L1Client):
     def last_verified_batch(self) -> int:
         return self.verified_up_to
 
-    # ---- CommonBridge ----
+    # ---- CommonBridge: withdrawals ----
+    def claim_withdrawal(self, batch_number: int, leaf: bytes, index: int,
+                         proof: list[bytes]) -> bytes:
+        """Claim an L2->L1 message once its batch is VERIFIED; Merkle proof
+        against the batch's message root; double-claims rejected."""
+        from .messages import verify_message_proof
+
+        with self.lock:
+            if batch_number > self.verified_up_to:
+                raise L1Error("batch not verified yet")
+            root = self.message_roots.get(batch_number)
+            if not root or root == b"\x00" * 32:
+                raise L1Error("batch has no messages")
+            if leaf in self.claimed:
+                raise L1Error("message already claimed")
+            if not verify_message_proof(root, leaf, index, proof):
+                raise L1Error("invalid message proof")
+            self.claimed.add(leaf)
+            return keccak256(b"claim" + leaf)
+
+    # ---- CommonBridge: deposits ----
     def deposit(self, recipient: bytes, amount: int, data: bytes = b"",
                 gas_limit: int = 200_000):
         """L1-side user action (tests drive this)."""

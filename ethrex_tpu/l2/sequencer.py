@@ -97,14 +97,21 @@ class Sequencer:
         privileged_hashes = [
             tx.hash for b in blocks for tx in b.body.transactions
             if tx.tx_type == TYPE_PRIVILEGED]
+        # L2->L1 withdrawal messages (from stored receipts of these blocks)
+        from .messages import collect_messages, message_root
+
+        receipts = [self.node.store.get_receipts(b.hash) for b in blocks]
+        if any(r is None for r in receipts):
+            raise RuntimeError("missing receipts for a batched block")
+        msgs_root = message_root(collect_messages(blocks, receipts))
         commitment = keccak256(
             b"batch" + number.to_bytes(8, "big") + state_root
             + b"".join(b.hash for b in blocks)
-            + b"".join(privileged_hashes))
+            + b"".join(privileged_hashes) + msgs_root)
         # L1 first: only persist the batch once the commitment is accepted,
         # otherwise a transient L1 failure would desync the batch counter
         self.l1.commit_batch(number, state_root, commitment,
-                             privileged_hashes)
+                             privileged_hashes, msgs_root)
         batch = Batch(number=number, first_block=first,
                       last_block=head, state_root=state_root,
                       commitment=commitment)
@@ -134,8 +141,6 @@ class Sequencer:
             return None
         proofs = {}
         for t in needed:
-            # submit the last batch's proof bytes per type (the L1 verifier
-            # checks each batch's proof; the simulator checks presence)
             from ..prover.backend import get_backend
             backend = get_backend(t)
             all_ok = all(
@@ -148,8 +153,10 @@ class Sequencer:
                     if not backend.verify(self.rollup.get_proof(n, t)):
                         self.rollup.delete_proof(n, t)
                 return None
-            proofs[t] = backend.to_proof_bytes(
-                self.rollup.get_proof(last, t))
+            # per-batch proof bytes: the L1 checks each batch's committed
+            # output (state root + messages root) against its records
+            proofs[t] = [backend.to_proof_bytes(self.rollup.get_proof(n, t))
+                         for n in range(first, last + 1)]
         self.l1.verify_batches(first, last, proofs)
         for n in range(first, last + 1):
             self.rollup.set_verified(n)

@@ -1,0 +1,112 @@
+"""L2 -> L1 withdrawal flow: burn on L2, prove + verify batch, claim on L1
+with a Merkle message proof (the reference's CommonBridge withdrawal
+round-trip, hermetic)."""
+
+import pytest
+
+from ethrex_tpu.crypto import secp256k1
+from ethrex_tpu.l2.l1_client import InMemoryL1, L1Error
+from ethrex_tpu.l2.messages import (BRIDGE_ADDRESS, collect_messages,
+                                    message_proof, message_root,
+                                    verify_message_proof)
+from ethrex_tpu.l2.sequencer import Sequencer, SequencerConfig
+from ethrex_tpu.node import Node
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.primitives.transaction import TYPE_DYNAMIC_FEE, Transaction
+from ethrex_tpu.prover import protocol
+from ethrex_tpu.prover.client import ProverClient
+
+SECRET = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
+SENDER = secp256k1.pubkey_to_address(secp256k1.pubkey_from_secret(SECRET))
+
+GENESIS = {
+    "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+               "shanghaiTime": 0, "cancunTime": 0},
+    "alloc": {"0x" + SENDER.hex(): {"balance": hex(10**21)}},
+    "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7", "timestamp": "0x0",
+}
+
+
+def _withdraw_tx(nonce, value):
+    return Transaction(
+        tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=nonce,
+        max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+        gas_limit=21000, to=BRIDGE_ADDRESS, value=value,
+    ).sign(SECRET)
+
+
+def test_withdrawal_round_trip():
+    node = Node(Genesis.from_json(GENESIS))
+    l1 = InMemoryL1([protocol.PROVER_EXEC])
+    seq = Sequencer(node, l1, SequencerConfig(
+        needed_prover_types=(protocol.PROVER_EXEC,)))
+    seq.coordinator.start()
+    try:
+        # two withdrawals in one batch
+        node.submit_transaction(_withdraw_tx(0, 5000))
+        node.submit_transaction(_withdraw_tx(1, 7000))
+        block = seq.produce_block()
+        seq.commit_next_batch()
+        # prove + settle
+        client = ProverClient(protocol.PROVER_EXEC,
+                              [("127.0.0.1", seq.coordinator.port)])
+        assert client.poll_once() == 1
+        assert seq.send_proofs() == (1, 1)
+        # the guest's committed output carries the same messages root
+        proof_obj = seq.rollup.get_proof(1, protocol.PROVER_EXEC)
+        from ethrex_tpu.guest.execution import ProgramOutput
+        out = ProgramOutput.decode(bytes.fromhex(proof_obj["output"][2:]))
+        receipts = [node.store.get_receipts(block.hash)]
+        msgs = collect_messages([block], receipts)
+        assert len(msgs) == 2
+        assert out.messages_root == message_root(msgs)
+        assert l1.message_roots[1] == out.messages_root
+        # claim both on L1
+        for i, m in enumerate(msgs):
+            tx_hash = l1.claim_withdrawal(1, m.leaf(), i,
+                                          message_proof(msgs, i))
+            assert tx_hash
+        # double-claim rejected
+        with pytest.raises(L1Error, match="already claimed"):
+            l1.claim_withdrawal(1, msgs[0].leaf(), 0,
+                                message_proof(msgs, 0))
+        # forged value rejected
+        from ethrex_tpu.l2.messages import L2Message
+        fake = L2Message(SENDER, 999999, msgs[0].tx_hash)
+        with pytest.raises(L1Error, match="invalid message proof"):
+            l1.claim_withdrawal(1, fake.leaf(), 0, message_proof(msgs, 0))
+    finally:
+        seq.stop()
+        node.stop()
+
+
+def test_claim_requires_verification():
+    node = Node(Genesis.from_json(GENESIS))
+    l1 = InMemoryL1([protocol.PROVER_EXEC])
+    seq = Sequencer(node, l1, SequencerConfig(
+        needed_prover_types=(protocol.PROVER_EXEC,)))
+    try:
+        node.submit_transaction(_withdraw_tx(0, 100))
+        block = seq.produce_block()
+        seq.commit_next_batch()  # committed but NOT verified
+        receipts = [node.store.get_receipts(block.hash)]
+        msgs = collect_messages([block], receipts)
+        with pytest.raises(L1Error, match="not verified"):
+            l1.claim_withdrawal(1, msgs[0].leaf(), 0,
+                                message_proof(msgs, 0))
+    finally:
+        seq.stop()
+        node.stop()
+
+
+def test_message_tree_vectors():
+    from ethrex_tpu.l2.messages import L2Message
+    msgs = [L2Message(bytes([i]) * 20, i * 10, bytes([i]) * 32)
+            for i in range(1, 6)]  # odd count exercises duplicate padding
+    root = message_root(msgs)
+    for i, m in enumerate(msgs):
+        assert verify_message_proof(root, m.leaf(), i,
+                                    message_proof(msgs, i))
+    assert not verify_message_proof(root, msgs[0].leaf(), 1,
+                                    message_proof(msgs, 0))
+    assert message_root([]) == b"\x00" * 32
