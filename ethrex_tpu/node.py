@@ -94,7 +94,33 @@ class Node:
             from .utils.metrics import record_block
 
             record_block(result.block, time.monotonic() - t0)
-            return result.block
+            block = result.block
+        # gossip OUTSIDE the node lock: a stalled peer's socket must never
+        # freeze block production or RPC
+        self._gossip(block)
+        return block
+
+    def _gossip(self, block):
+        hook = getattr(self, "on_new_block", None)
+        if hook is not None:
+            try:
+                hook(block)
+            except Exception:  # noqa: BLE001 — gossip must not fail callers
+                pass
+
+    def import_block(self, block) -> bool:
+        """Serialized p2p import: validates + stores + fork-chooses under
+        the node lock, then relays.  Returns True if the block was new."""
+        from .blockchain.blockchain import InvalidBlock
+        from .blockchain.fork_choice import apply_fork_choice
+
+        with self.lock:
+            if self.store.get_header(block.hash) is not None:
+                return False
+            self.chain.add_block(block)  # raises InvalidBlock on bad blocks
+            apply_fork_choice(self.store, block.hash)
+        self._gossip(block)  # transitive relay (terminates: peers that
+        return True          # already have it import nothing and don't relay
 
     def start_dev_producer(self, block_time: float = 1.0):
         def loop():

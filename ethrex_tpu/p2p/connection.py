@@ -47,6 +47,7 @@ class RlpxPeer:
         self._pending: dict[int, list] = {}
         self._pending_cv = threading.Condition()
         self._late_ok: set[int] = set()
+        self._catching_up = threading.Event()
         self._req_counter = 0
         self._req_lock = threading.Lock()
         # bounded sets with DISTINCT roles: known_txs suppresses outbound
@@ -201,6 +202,12 @@ class RlpxPeer:
         self.send_msg(eth_wire.NEW_BLOCK,
                       eth_wire.encode_new_block(block, 0))
 
+    def announce_block_hash(self, block: Block):
+        from ..primitives import rlp as _rlp
+
+        self.send_msg(eth_wire.NEW_BLOCK_HASHES,
+                      _rlp.encode([[block.hash, block.header.number]]))
+
     # -- inbound loop ------------------------------------------------------
     def _handle(self, msg_id: int, payload: bytes):
         store = self.node.store
@@ -296,15 +303,44 @@ class RlpxPeer:
         elif msg_id == snap.BYTE_CODES:
             rid, codes = snap.decode_byte_codes(payload)
             self._resolve(rid, codes)
+        elif msg_id == eth_wire.NEW_BLOCK_HASHES:
+            # [[hash, number], ...]: fetch-and-import what we don't have.
+            # The fetch MUST NOT run on this reader thread — request()
+            # blocks until the reader processes the response (deadlock).
+            from ..primitives import rlp as _rlp
+
+            try:
+                entries = [(bytes(e[0]), _rlp.decode_int(e[1]))
+                           for e in _rlp.decode(payload)]
+            except _rlp.RLPError:
+                return
+            if any(store.get_header(h) is None for h, _ in entries):
+                self._start_catch_up()
         elif msg_id == eth_wire.NEW_BLOCK:
             block, _td = eth_wire.decode_new_block(payload)
             try:
-                from ..blockchain.fork_choice import apply_fork_choice
+                self.node.import_block(block)
+            except Exception as e:  # noqa: BLE001 — invalid blocks dropped
+                # a gap (unknown parent) means we fell behind: catch up
+                if "unknown parent" in str(e):
+                    self._start_catch_up()
 
-                self.node.chain.add_block(block)
-                apply_fork_choice(self.node.store, block.hash)
-            except Exception:  # noqa: BLE001 — invalid blocks are dropped
+    def _start_catch_up(self):
+        """Header/body sync from this peer on a dedicated thread (request()
+        must never run on the reader thread — it would deadlock)."""
+        if self._catching_up.is_set():
+            return
+        self._catching_up.set()
+
+        def catch_up():
+            try:
+                full_sync(self, self.node)
+            except Exception:  # noqa: BLE001 — peer may be gone/behind
                 pass
+            finally:
+                self._catching_up.clear()
+
+        threading.Thread(target=catch_up, daemon=True).start()
 
     def _resolve(self, request_id: int, value):
         with self._pending_cv:
@@ -345,6 +381,8 @@ class P2PServer:
     def __init__(self, node, secret: int | None = None,
                  host: str = "127.0.0.1", port: int = 0):
         self.node = node
+        node.p2p_server = self
+        node.on_new_block = self.broadcast_block  # producer -> gossip hook
         node.p2p_secret = secret or (
             int.from_bytes(os.urandom(32), "big") % secp256k1.N or 1)
         self.secret = node.p2p_secret
@@ -402,6 +440,31 @@ class P2PServer:
         self.peers.append(peer)
         return peer
 
+    def broadcast_block(self, block: Block):
+        """Gossip a freshly produced/imported block: full NewBlock to a
+        sqrt-ish subset, hash announcements to the rest (devp2p custom).
+        Sends run on a detached thread per peer — a stalled peer's full
+        TCP buffer must never block the caller."""
+        import math
+
+        peers = list(self.peers)
+        if not peers:
+            return
+        full_count = max(1, int(math.isqrt(len(peers))))
+
+        def send(peer, full):
+            try:
+                if full:
+                    peer.announce_block(block)
+                else:
+                    peer.announce_block_hash(block)
+            except (OSError, rlpx.RlpxError):
+                pass
+
+        for i, p in enumerate(peers):
+            threading.Thread(target=send, args=(p, i < full_count),
+                             daemon=True).start()
+
     def start(self):
         threading.Thread(target=self._accept_loop, daemon=True).start()
         return self
@@ -430,7 +493,12 @@ def full_sync(peer: RlpxPeer, node, batch: int = 64) -> int:
         if len(bodies) != len(headers):
             raise PeerError("incomplete bodies response")
         blocks = [Block(h, b) for h, b in zip(headers, bodies)]
-        node.chain.add_blocks_in_batch(blocks)
-        apply_fork_choice(node.store, blocks[-1].hash)
-        imported += len(blocks)
+        # serialize against concurrent NEW_BLOCK imports / block production
+        with node.lock:
+            latest = node.store.latest_number()
+            todo = [b for b in blocks if b.header.number > latest]
+            if todo:
+                node.chain.add_blocks_in_batch(todo)
+                apply_fork_choice(node.store, todo[-1].hash)
+                imported += len(todo)
     return imported

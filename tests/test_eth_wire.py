@@ -121,6 +121,24 @@ def test_receipts_and_pooled_hashes(two_nodes):
     assert tx.hash in srv_a.peers[0].known_txs
 
 
+def test_live_follow(two_nodes):
+    """Nodes follow each other automatically: A produces, B imports via
+    the gossip hook without any explicit sync calls."""
+    node_a, node_b, srv_a, srv_b = two_nodes
+    srv_b.dial(srv_a.host, srv_a.port, srv_a.pub)
+    deadline = time.time() + 5
+    while time.time() < deadline and not srv_a.peers:
+        time.sleep(0.05)
+    for i in range(3):
+        node_a.submit_transaction(_tx(i))
+        node_a.produce_block()  # on_new_block hook gossips automatically
+    deadline = time.time() + 10
+    while time.time() < deadline and node_b.store.latest_number() < 3:
+        time.sleep(0.05)
+    assert node_b.store.latest_number() == 3
+    assert node_b.store.head_header().hash == node_a.store.head_header().hash
+
+
 def test_chain_mismatch_rejected():
     node_a = Node(Genesis.from_json(GENESIS))
     other = dict(GENESIS)
