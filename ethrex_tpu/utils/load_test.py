@@ -3,7 +3,7 @@ eth-transfer / ERC20-style load against a node's JSON-RPC, measuring
 inclusion throughput).
 
 Usage:
-    python -m ethrex_tpu.utils.load_test --url http://127.0..1:8545 \
+    python -m ethrex_tpu.utils.load_test --url http://127.0.0.1:8545 \
         --key <hex> --txs 500 [--mode transfer|sstore]
 """
 
@@ -19,7 +19,7 @@ from ..primitives.transaction import TYPE_DYNAMIC_FEE, Transaction
 
 # counter contract: every call increments slot 0 (the "IO" load shape)
 SSTORE_RUNTIME = "5f546001015f5500"
-SSTORE_INITCODE = "67" + SSTORE_RUNTIME.ljust(16, "0") + "5f5260086018f3"
+SSTORE_INITCODE = "67" + SSTORE_RUNTIME + "5f5260086018f3"
 
 
 def _rpc(url: str, method: str, *params):
@@ -60,6 +60,8 @@ def run_load(url: str, secret: int, num_txs: int,
             time.sleep(0.2)
         if receipt is None:
             raise RuntimeError("deploy was not mined")
+        if receipt["status"] != "0x1":
+            raise RuntimeError("counter deploy reverted")
         target = bytes.fromhex(receipt["contractAddress"][2:])
         gas_limit = 100_000
         nonce += 1
@@ -76,18 +78,18 @@ def run_load(url: str, secret: int, num_txs: int,
              "0x" + tx.encode_canonical().hex())
     submit_time = time.time() - t0
 
-    # wait for full inclusion
+    # wait for full inclusion (incremental scan: only NEW blocks per poll)
     deadline = time.time() + 120
     included = 0
     gas_used = 0
+    scanned = start_block
     while time.time() < deadline:
         head = int(_rpc(url, "eth_blockNumber"), 16)
-        included = 0
-        gas_used = 0
-        for n in range(start_block + 1, head + 1):
+        for n in range(scanned + 1, head + 1):
             blk = _rpc(url, "eth_getBlockByNumber", hex(n), False)
             included += len(blk["transactions"])
             gas_used += int(blk["gasUsed"], 16)
+        scanned = max(scanned, head)
         if included >= num_txs:  # the sstore deploy mines BEFORE start_block
             break
         time.sleep(0.3)
