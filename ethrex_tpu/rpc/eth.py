@@ -93,6 +93,33 @@ class EthApi:
     def syncing(self):
         return False
 
+    def blob_base_fee(self):
+        from ..evm import gas as G
+
+        head = self.node.store.head_header()
+        return hx(G.blob_base_fee(head.excess_blob_gas or 0))
+
+    def block_tx_count(self, tag):
+        try:
+            return hx(len(self._resolve_block(tag).body.transactions))
+        except RpcError:
+            return None
+
+    def block_tx_count_by_hash(self, block_hash):
+        blk = self.node.store.get_block(parse_bytes(block_hash))
+        return hx(len(blk.body.transactions)) if blk else None
+
+    def tx_by_block_and_index(self, tag, index):
+        try:
+            blk = self._resolve_block(tag)
+        except RpcError:
+            return None  # unknown block -> null (spec/geth behavior)
+        i = parse_quantity(index)
+        if i < 0 or i >= len(blk.body.transactions):
+            return None
+        return tx_to_json(blk.body.transactions[i], blk.hash,
+                          blk.header.number, i)
+
     # ---------------- blocks / txs ----------------
     def get_block_by_number(self, tag, full=False):
         try:

@@ -63,8 +63,15 @@ class RpcServer:
             "debug_traceTransaction": e.debug_trace_transaction,
             "net_version": lambda: str(node.config.chain_id),
             "net_listening": lambda: True,
-            "net_peerCount": lambda: "0x0",
+            "net_peerCount": lambda: hex(_peer_count(node)),
             "web3_clientVersion": lambda: "ethrex-tpu/0.1.0",
+            "web3_sha3": _sha3,
+            "eth_blobBaseFee": lambda: e.blob_base_fee(),
+            "eth_getBlockTransactionCountByNumber": e.block_tx_count,
+            "eth_getBlockTransactionCountByHash":
+                e.block_tx_count_by_hash,
+            "eth_getTransactionByBlockNumberAndIndex":
+                e.tx_by_block_and_index,
             "txpool_content": lambda: _txpool_content(node),
             "ethrex_produceBlock": lambda: _produce(node),
             # L2 namespace (reference: crates/l2/networking/rpc)
@@ -142,6 +149,23 @@ class RpcServer:
             self._httpd.server_close()
 
 
+def _peer_count(node) -> int:
+    p2p = getattr(node, "p2p_server", None)
+    return len(p2p.peers) if p2p else 0
+
+
+def _sha3(data) -> str:
+    from ..crypto.keccak import keccak256
+
+    if not isinstance(data, str):
+        raise RpcError(-32602, "web3_sha3 expects a hex string")
+    try:
+        raw = bytes.fromhex(data.removeprefix("0x"))
+    except ValueError as e:
+        raise RpcError(-32602, f"invalid hex data: {e}")
+    return "0x" + keccak256(raw).hex()
+
+
 def _err(rid, code, message, data=None):
     error = {"code": code, "message": message}
     if data is not None:
@@ -205,11 +229,10 @@ def _get_batch(node, n):
 
 
 def _health(node):
-    p2p = getattr(node, "p2p_server", None)
     out = {
         "head": node.store.latest_number(),
         "mempool": len(node.mempool),
-        "peers": len(p2p.peers) if p2p else 0,
+        "peers": _peer_count(node),
     }
     seq = getattr(node, "sequencer", None)
     if seq is not None:

@@ -177,6 +177,25 @@ def test_debug_trace_transaction(rpc):
     assert "error" in err
 
 
+def test_misc_wallet_methods(rpc):
+    call, node = rpc
+    # web3_sha3 known vector
+    assert call("web3_sha3", "0x")["result"] == (
+        "0xc5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470")
+    assert call("eth_blobBaseFee")["result"] == "0x1"
+    cnt = call("eth_getBlockTransactionCountByNumber", "0x1")["result"]
+    assert int(cnt, 16) >= 1
+    blk = call("eth_getBlockByNumber", "0x1", False)["result"]
+    assert call("eth_getBlockTransactionCountByHash",
+                blk["hash"])["result"] == cnt
+    tx0 = call("eth_getTransactionByBlockNumberAndIndex",
+               "0x1", "0x0")["result"]
+    assert tx0["hash"] == blk["transactions"][0]
+    assert call("eth_getTransactionByBlockNumberAndIndex",
+                "0x1", "0x99")["result"] is None
+    assert call("net_peerCount")["result"] == "0x0"  # no p2p attached
+
+
 def test_error_paths(rpc):
     call, node = rpc
     assert "error" in call("eth_fooBar")
