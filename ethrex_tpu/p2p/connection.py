@@ -53,10 +53,12 @@ class RlpxPeer:
         # bounded sets with DISTINCT roles: known_txs suppresses outbound
         # re-sends (peer has seen the hash — via our broadcast, their
         # announcement, or their full tx); _imported gates inbound imports
-        # and is fed ONLY by full transactions (an announcement must never
-        # block a later full delivery — there is no fetch path yet)
+        # and is fed ONLY by full transactions (announced hashes are
+        # fetched via GetPooledTransactions and marked imported only when
+        # the full tx arrives); _fetching gates concurrent fetches
         self.known_txs: dict[bytes, None] = {}
         self._imported: dict[bytes, None] = {}
+        self._fetching: set[bytes] = set()
         self.KNOWN_TX_CAP = 32768
 
     # -- framing over the socket ------------------------------------------
@@ -250,10 +252,47 @@ class RlpxPeer:
         elif msg_id == eth_wire.NEW_POOLED_TX_HASHES:
             types, sizes, hashes = \
                 eth_wire.decode_new_pooled_tx_hashes(payload)
-            # remember announcements (the fetch-on-demand path arrives with
-            # GetPooledTransactions in a later round)
             for h in hashes:
                 self._mark_known_tx(h)
+            unknown = [h for h in hashes
+                       if self.node.mempool.get_transaction(h) is None
+                       and h not in self._imported
+                       and h not in self._fetching][:256]
+            if unknown:
+                self._fetching.update(unknown)
+
+                # fetch off the reader thread (request() would deadlock)
+                def fetch(hashes=unknown):
+                    try:
+                        rid = self._next_request_id()
+                        txs = self.request(
+                            eth_wire.GET_POOLED_TRANSACTIONS,
+                            eth_wire.encode_get_pooled_transactions(
+                                rid, hashes), rid)
+                        for tx in txs:
+                            if tx.hash in self._imported:
+                                continue
+                            self._mark_imported(tx.hash)
+                            try:
+                                self.node.submit_transaction(tx)
+                            except Exception:  # noqa: BLE001
+                                pass
+                    except Exception:  # noqa: BLE001 — peer may vanish
+                        pass
+                    finally:
+                        self._fetching.difference_update(hashes)
+
+                threading.Thread(target=fetch, daemon=True).start()
+        elif msg_id == eth_wire.GET_POOLED_TRANSACTIONS:
+            rid, hashes = eth_wire.decode_get_pooled_transactions(payload)
+            txs = [self.node.mempool.get_transaction(h)
+                   for h in hashes[:1024]]
+            txs = [t for t in txs if t is not None]
+            self.send_msg(eth_wire.POOLED_TRANSACTIONS,
+                          eth_wire.encode_pooled_transactions(rid, txs))
+        elif msg_id == eth_wire.POOLED_TRANSACTIONS:
+            rid, txs = eth_wire.decode_pooled_transactions(payload)
+            self._resolve(rid, txs)
         elif msg_id == eth_wire.BLOCK_HEADERS:
             rid, headers = eth_wire.decode_block_headers(payload)
             self._resolve(rid, headers)

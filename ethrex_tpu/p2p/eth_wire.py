@@ -30,6 +30,8 @@ GET_BLOCK_BODIES = ETH_OFFSET + 0x05
 BLOCK_BODIES = ETH_OFFSET + 0x06
 NEW_BLOCK = ETH_OFFSET + 0x07
 NEW_POOLED_TX_HASHES = ETH_OFFSET + 0x08
+GET_POOLED_TRANSACTIONS = ETH_OFFSET + 0x09
+POOLED_TRANSACTIONS = ETH_OFFSET + 0x0A
 GET_RECEIPTS = ETH_OFFSET + 0x0F
 RECEIPTS = ETH_OFFSET + 0x10
 
@@ -109,24 +111,26 @@ def decode_block_bodies(payload: bytes):
             [BlockBody.from_fields(bf) for bf in f[1]])
 
 
+def _embed_tx(tx):
+    """Wire embedding rule: legacy txs as RLP lists, typed as byte strings
+    (shared by TRANSACTIONS, POOLED_TRANSACTIONS and block bodies)."""
+    if tx.tx_type == 0:
+        return tx._payload_fields(for_signing=False)
+    return tx.encode_canonical()
+
+
+def _parse_tx(item):
+    if isinstance(item, list):
+        return Transaction._decode_legacy(item)
+    return Transaction.decode_canonical(bytes(item))
+
+
 def encode_transactions(txs) -> bytes:
-    fields = []
-    for tx in txs:
-        if tx.tx_type == 0:
-            fields.append(tx._payload_fields(for_signing=False))
-        else:
-            fields.append(tx.encode_canonical())
-    return rlp.encode(fields)
+    return rlp.encode([_embed_tx(tx) for tx in txs])
 
 
 def decode_transactions(payload: bytes):
-    out = []
-    for item in rlp.decode(payload):
-        if isinstance(item, list):
-            out.append(Transaction._decode_legacy(item))
-        else:
-            out.append(Transaction.decode_canonical(bytes(item)))
-    return out
+    return [_parse_tx(item) for item in rlp.decode(payload)]
 
 
 def encode_get_receipts(request_id: int, hashes) -> bytes:
@@ -179,6 +183,24 @@ def decode_new_pooled_tx_hashes(payload: bytes):
     sizes = [rlp.decode_int(s) for s in f[1]]
     hashes = [bytes(h) for h in f[2]]
     return types, sizes, hashes
+
+
+def encode_get_pooled_transactions(request_id: int, hashes) -> bytes:
+    return rlp.encode([request_id, [bytes(h) for h in hashes]])
+
+
+def decode_get_pooled_transactions(payload: bytes):
+    f = rlp.decode(payload)
+    return rlp.decode_int(f[0]), [bytes(h) for h in f[1]]
+
+
+def encode_pooled_transactions(request_id: int, txs) -> bytes:
+    return rlp.encode([request_id, [_embed_tx(tx) for tx in txs]])
+
+
+def decode_pooled_transactions(payload: bytes):
+    f = rlp.decode(payload)
+    return rlp.decode_int(f[0]), [_parse_tx(item) for item in f[1]]
 
 
 def encode_new_block(block: Block, total_difficulty: int) -> bytes:

@@ -110,14 +110,16 @@ def test_receipts_and_pooled_hashes(two_nodes):
     # unknown hash -> empty list, not an error
     receipts = peer.get_receipts([b"\x99" * 32])
     assert receipts == [[]]
-    # pooled-tx-hash announcement is absorbed without error
+    # pooled-tx-hash announcement triggers a fetch: A requests the full tx
+    # from B and imports it into its mempool
     tx = _tx(1)
     node_b.submit_transaction(tx)
     peer.announce_pooled_txs([tx])
     deadline = time.time() + 5
-    while time.time() < deadline and not (
-            srv_a.peers and tx.hash in srv_a.peers[0].known_txs):
+    while time.time() < deadline and \
+            node_a.mempool.get_transaction(tx.hash) is None:
         time.sleep(0.05)
+    assert node_a.mempool.get_transaction(tx.hash) is not None
     assert tx.hash in srv_a.peers[0].known_txs
 
 
