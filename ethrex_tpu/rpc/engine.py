@@ -132,10 +132,7 @@ def block_to_payload(block: Block) -> dict:
                          for tx in block.body.transactions],
     }
     if block.body.withdrawals is not None:
-        out["withdrawals"] = [{
-            "index": hx(w.index), "validatorIndex": hx(w.validator_index),
-            "address": hb(w.address), "amount": hx(w.amount)}
-            for w in block.body.withdrawals]
+        out["withdrawals"] = _body_json(block.body)["withdrawals"]
     if h.blob_gas_used is not None:
         out["blobGasUsed"] = hx(h.blob_gas_used)
         out["excessBlobGas"] = hx(h.excess_blob_gas)
@@ -145,6 +142,19 @@ def block_to_payload(block: Block) -> dict:
 # ---------------------------------------------------------------------------
 # engine namespace
 # ---------------------------------------------------------------------------
+
+def _body_json(body) -> dict:
+    out = {"transactions": [hb(tx.encode_canonical())
+                            for tx in body.transactions]}
+    if body.withdrawals is not None:
+        out["withdrawals"] = [{
+            "index": hx(w.index), "validatorIndex": hx(w.validator_index),
+            "address": hb(w.address), "amount": hx(w.amount)}
+            for w in body.withdrawals]
+    else:
+        out["withdrawals"] = None
+    return out
+
 
 class EngineApi:
     def __init__(self, node):
@@ -157,7 +167,8 @@ class EngineApi:
         return [
             "engine_newPayloadV3", "engine_newPayloadV4",
             "engine_forkchoiceUpdatedV3", "engine_getPayloadV3",
-            "engine_getPayloadV4",
+            "engine_getPayloadV4", "engine_getPayloadBodiesByHashV1",
+            "engine_getPayloadBodiesByRangeV1",
         ]
 
     def new_payload_v3(self, payload, blob_hashes=None,
@@ -275,3 +286,31 @@ class EngineApi:
         return payload
 
     get_payload_v4 = get_payload_v3
+
+    MAX_BODIES_REQUEST = 1024  # Engine API spec limit
+
+    def get_payload_bodies_by_hash_v1(self, hashes):
+        if len(hashes) > self.MAX_BODIES_REQUEST:
+            raise RpcError(-38004, "too large request")
+        return [
+            (_body_json(body) if (body := self.node.store.get_body(
+                parse_bytes(h))) else None)
+            for h in hashes
+        ]
+
+    def get_payload_bodies_by_range_v1(self, start, count):
+        start_n = parse_quantity(start)
+        count_n = parse_quantity(count)
+        if start_n < 1 or count_n < 1:
+            raise RpcError(-32602, "invalid range parameters")
+        if count_n > self.MAX_BODIES_REQUEST:
+            raise RpcError(-38004, "too large request")
+        # spec: no trailing nulls past the latest known block
+        head = self.node.store.latest_number()
+        end = min(start_n + count_n - 1, head)
+        out = []
+        for n in range(start_n, end + 1):
+            bh = self.node.store.canonical_hash(n)
+            body = self.node.store.get_body(bh) if bh else None
+            out.append(_body_json(body) if body else None)
+        return out

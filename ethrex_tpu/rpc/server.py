@@ -33,6 +33,10 @@ class RpcServer:
                 "engine_forkchoiceUpdatedV3": api.forkchoice_updated_v3,
                 "engine_getPayloadV3": api.get_payload_v3,
                 "engine_getPayloadV4": api.get_payload_v4,
+                "engine_getPayloadBodiesByHashV1":
+                    api.get_payload_bodies_by_hash_v1,
+                "engine_getPayloadBodiesByRangeV1":
+                    api.get_payload_bodies_by_range_v1,
             })
 
     def _build_methods(self):

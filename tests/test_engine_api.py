@@ -106,6 +106,35 @@ def test_build_and_import_cycle(engine):
     assert again["status"] == "VALID"
 
 
+def test_get_payload_bodies(engine):
+    call, node = engine
+    if node.store.latest_number() == 0:
+        # self-contained: mine one block so the test runs in isolation
+        tx = Transaction(
+            tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=0,
+            max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+            gas_limit=21000, to=b"\xaa" * 20, value=1).sign(SECRET)
+        node.submit_transaction(tx)
+        node.produce_block()
+    head = node.store.latest_number()
+    assert head >= 1
+    bodies = call("engine_getPayloadBodiesByRangeV1",
+                  "0x1", hex(head))["result"]
+    assert len(bodies) == head
+    assert any(b and b["transactions"] for b in bodies)
+    bh = "0x" + node.store.canonical_hash(1).hex()
+    by_hash = call("engine_getPayloadBodiesByHashV1",
+                   [bh, "0x" + "77" * 32])["result"]
+    assert by_hash[0] is not None and by_hash[1] is None
+    err = call("engine_getPayloadBodiesByRangeV1", "0x0", "0x1")
+    assert err["error"]["code"] == -32602
+    err = call("engine_getPayloadBodiesByRangeV1", "0x1", hex(2000))
+    assert err["error"]["code"] == -38004
+    # no trailing nulls past the head
+    over = call("engine_getPayloadBodiesByRangeV1", "0x1", "0x80")["result"]
+    assert len(over) == head
+
+
 def test_new_payload_rejects_bad_block(engine):
     call, node = engine
     head_hash = node.store.meta["head"]

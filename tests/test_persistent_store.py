@@ -81,6 +81,17 @@ def test_compaction_shrinks_log():
         b2.close()
 
 
+def test_double_open_rejected():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "db")
+        b1 = PersistentBackend(path)
+        with pytest.raises(OSError):
+            PersistentBackend(path)  # flock held by b1
+        b1.close()
+        b2 = PersistentBackend(path)  # released on close
+        b2.close()
+
+
 def test_full_node_restart_resumes_chain():
     with tempfile.TemporaryDirectory() as d:
         path = os.path.join(d, "chain.db")
