@@ -101,8 +101,9 @@ class RlpxPeer:
             total_difficulty=0,
             head_hash=head.hash,
             genesis_hash=genesis_hash,
-            fork_id=eth_wire.fork_id_for(self.node.config, genesis_hash,
-                                         head.number, head.timestamp),
+            fork_id=eth_wire.fork_id_for(
+                self.node.config, genesis_hash, head.number, head.timestamp,
+                genesis_time=self.node.genesis_header.timestamp),
         )
         self.send_msg(eth_wire.STATUS, status.encode())
         msg_id, payload = self.recv_msg()
@@ -113,7 +114,10 @@ class RlpxPeer:
             raise PeerError("genesis mismatch")
         if remote.network_id != self.node.config.chain_id:
             raise PeerError("network id mismatch")
-        if remote.fork_id != status.fork_id:
+        if not eth_wire.validate_fork_id(
+                self.node.config, genesis_hash, head.number, head.timestamp,
+                remote.fork_id,
+                genesis_time=self.node.genesis_header.timestamp):
             raise PeerError("fork id mismatch")
         self.remote_status = remote
         return remote

@@ -217,18 +217,82 @@ def decode_new_block(payload: bytes):
     return block, rlp.decode_int(f[1])
 
 
-def fork_id_for(config, genesis_hash: bytes, head_number: int,
-                head_time: int) -> tuple:
-    """EIP-2124-shaped fork id (CRC of genesis + passed fork blocks/times).
+# Fork-next values at or above this are interpreted as timestamps rather
+# than block numbers when checking "already passed" (mainnet genesis time;
+# same heuristic geth uses to disambiguate EIP-2124 block/time fork points).
+_TIMESTAMP_THRESHOLD = 1_438_269_973
 
-    Simplified: we hash the genesis + the active fork fingerprint — peers on
-    the same chain/config agree, others mismatch (full CRC32 schedule lands
-    with live-network interop).
-    """
+
+def _fork_points(config, genesis_time: int) -> list[tuple[bool, int]]:
+    """Ordered EIP-2124 fork activation points as (is_time, value):
+    non-genesis block-number forks (sorted, deduped) followed by timestamp
+    forks later than genesis (sorted, deduped).  The kind tag is kept so
+    the local schedule never needs the block-vs-time heuristic."""
+    blocks = sorted({b for b in config.block_forks.values() if b > 0})
+    times = sorted({t for t in config.time_forks.values()
+                    if t > genesis_time})
+    return [(False, b) for b in blocks] + [(True, t) for t in times]
+
+
+def _checksums(genesis_hash: bytes, points) -> list[int]:
+    """CRC32 chain: checksum[i] covers genesis + the first i fork points
+    (each point folded in as an 8-byte big-endian integer)."""
     import zlib
 
-    from ..storage.store import _config_fingerprint
+    sums = [zlib.crc32(genesis_hash)]
+    for _, v in points:
+        sums.append(zlib.crc32(v.to_bytes(8, "big"), sums[-1]))
+    return sums
 
-    acc = zlib.crc32(genesis_hash)
-    acc = zlib.crc32(_config_fingerprint(config), acc)
-    return acc.to_bytes(4, "big"), 0
+
+def _passed(point: tuple[bool, int], head_number: int,
+            head_time: int) -> bool:
+    is_time, value = point
+    return (head_time if is_time else head_number) >= value
+
+
+def fork_id_for(config, genesis_hash: bytes, head_number: int,
+                head_time: int, genesis_time: int = 0) -> tuple:
+    """EIP-2124 fork id: (FORK_HASH, FORK_NEXT).
+
+    FORK_HASH is the CRC32 of the genesis hash folded with every fork
+    activation point already passed at the given head; FORK_NEXT is the
+    first upcoming point, or 0 (parity: the reference's
+    crates/networking/p2p fork-id handling).
+    """
+    points = _fork_points(config, genesis_time)
+    sums = _checksums(genesis_hash, points)
+    n_passed = sum(1 for p in points if _passed(p, head_number, head_time))
+    nxt = points[n_passed][1] if n_passed < len(points) else 0
+    return sums[n_passed].to_bytes(4, "big"), nxt
+
+
+def validate_fork_id(config, genesis_hash: bytes, head_number: int,
+                     head_time: int, remote: tuple,
+                     genesis_time: int = 0) -> bool:
+    """EIP-2124 validation of a remote (FORK_HASH, FORK_NEXT) against our
+    chain config and head.  Returns True when the peer is compatible:
+    same checksum (unless it announces a fork we already passed without
+    it), a stale subset that correctly announces our next fork, or a
+    superset of our schedule (the remote is ahead of us)."""
+    remote_hash, remote_next = bytes(remote[0]), int(remote[1])
+    points = _fork_points(config, genesis_time)
+    sums = [s.to_bytes(4, "big")
+            for s in _checksums(genesis_hash, points)]
+    n_passed = sum(1 for p in points if _passed(p, head_number, head_time))
+    if remote_hash == sums[n_passed]:
+        # identical schedules so far; reject only if the remote announces
+        # an upcoming fork that our head has already passed without.  The
+        # remote's FORK_NEXT is an untagged integer, so block-vs-timestamp
+        # is disambiguated by magnitude here (and only here).
+        remote_is_time = remote_next >= _TIMESTAMP_THRESHOLD
+        return not (remote_next and
+                    _passed((remote_is_time, remote_next),
+                            head_number, head_time))
+    if remote_hash in sums[:n_passed]:
+        # remote is behind: it must name the fork it hasn't applied yet
+        i = sums.index(remote_hash)
+        return remote_next == points[i][1]
+    # remote ahead of us on the same chain: its hash shows up later in
+    # our schedule — we'll catch up
+    return remote_hash in sums[n_passed + 1:]

@@ -132,7 +132,7 @@ def block_to_payload(block: Block) -> dict:
                          for tx in block.body.transactions],
     }
     if block.body.withdrawals is not None:
-        out["withdrawals"] = _body_json(block.body)["withdrawals"]
+        out["withdrawals"] = _withdrawals_json(block.body.withdrawals)
     if h.blob_gas_used is not None:
         out["blobGasUsed"] = hx(h.blob_gas_used)
         out["excessBlobGas"] = hx(h.excess_blob_gas)
@@ -143,17 +143,20 @@ def block_to_payload(block: Block) -> dict:
 # engine namespace
 # ---------------------------------------------------------------------------
 
+def _withdrawals_json(withdrawals) -> list[dict]:
+    return [{
+        "index": hx(w.index), "validatorIndex": hx(w.validator_index),
+        "address": hb(w.address), "amount": hx(w.amount)}
+        for w in withdrawals]
+
+
 def _body_json(body) -> dict:
-    out = {"transactions": [hb(tx.encode_canonical())
-                            for tx in body.transactions]}
-    if body.withdrawals is not None:
-        out["withdrawals"] = [{
-            "index": hx(w.index), "validatorIndex": hx(w.validator_index),
-            "address": hb(w.address), "amount": hx(w.amount)}
-            for w in body.withdrawals]
-    else:
-        out["withdrawals"] = None
-    return out
+    return {
+        "transactions": [hb(tx.encode_canonical())
+                         for tx in body.transactions],
+        "withdrawals": (_withdrawals_json(body.withdrawals)
+                        if body.withdrawals is not None else None),
+    }
 
 
 class EngineApi:

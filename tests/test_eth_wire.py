@@ -157,3 +157,78 @@ def test_chain_mismatch_rejected():
         srv_c.stop()
         node_a.stop()
         node_c.stop()
+
+
+# EIP-2124 fork id — checked against the spec's published mainnet vectors
+# (genesis d4e56740..., Homestead..Petersburg block schedule).
+MAINNET_GENESIS = bytes.fromhex(
+    "d4e56740f876aef8c010b86a40d5f56745a118d0906a34e69aec8c0db1cb8fa3")
+MAINNET_FORKS = {"homestead": 1150000, "dao": 1920000,
+                 "tangerine": 2463000, "spurious": 2675000,
+                 "byzantium": 4370000, "constantinople": 7280000,
+                 "petersburg": 7280000}  # same block: folds in once
+
+
+def _mainnet_config():
+    from ethrex_tpu.primitives.genesis import ChainConfig
+    cfg = ChainConfig(chain_id=1)
+    cfg.block_forks = dict(MAINNET_FORKS)
+    return cfg
+
+
+def test_fork_id_eip2124_vectors():
+    from ethrex_tpu.p2p.eth_wire import fork_id_for
+    cfg = _mainnet_config()
+    cases = [
+        (0, (bytes.fromhex("fc64ec04"), 1150000)),        # unsynced
+        (1149999, (bytes.fromhex("fc64ec04"), 1150000)),  # last Frontier
+        (1150000, (bytes.fromhex("97c2c34c"), 1920000)),  # first Homestead
+        (4369999, (bytes.fromhex("3edd5b10"), 4370000)),  # last Spurious
+        (4370000, (bytes.fromhex("a00bc324"), 7280000)),  # first Byzantium
+        (7280000, (bytes.fromhex("668db0af"), 0)),        # Petersburg, dedup
+    ]
+    for head, want in cases:
+        assert fork_id_for(cfg, MAINNET_GENESIS, head, 0) == want, head
+
+
+def test_fork_id_validation_rules():
+    from ethrex_tpu.p2p.eth_wire import fork_id_for, validate_fork_id
+    cfg = _mainnet_config()
+    head = 7987396  # Petersburg-era mainnet head (EIP-2124 examples)
+
+    def ok(remote):
+        return validate_fork_id(cfg, MAINNET_GENESIS, head, 0, remote)
+
+    assert ok((bytes.fromhex("668db0af"), 0))             # same, no next
+    # same hash but remote announces a fork we already passed without it
+    assert not ok((bytes.fromhex("668db0af"), 7280000))
+    # stale remote naming the fork it has not applied yet -> compatible
+    assert ok((bytes.fromhex("a00bc324"), 7280000))
+    # stale remote NOT announcing the next fork -> incompatible
+    assert not ok((bytes.fromhex("a00bc324"), 0))
+    assert ok((bytes.fromhex("fc64ec04"), 1150000))       # far behind, ok
+    assert not ok((bytes.fromhex("5cddc0e1"), 0))         # unknown schedule
+    # remote ahead of us on our own schedule -> compatible
+    early = 4369999
+    ahead = fork_id_for(cfg, MAINNET_GENESIS, 7280000, 0)
+    assert validate_fork_id(cfg, MAINNET_GENESIS, early, 0, ahead)
+    # timestamp forks past genesis fold in; genesis-time ones do not
+    cfg.time_forks = {"shanghai": 0, "cancun": 1681338455}
+    with_time = fork_id_for(cfg, MAINNET_GENESIS, head, 0, genesis_time=0)
+    assert with_time[1] == 1681338455  # announced as next, not yet passed
+    passed = fork_id_for(cfg, MAINNET_GENESIS, head, 1681338455)
+    assert passed[1] == 0 and passed[0] != with_time[0]
+
+
+def test_fork_id_small_timestamp_devnet():
+    """Devnet regression: a time fork whose timestamp is numerically small
+    must still be judged against head TIME locally, never head number."""
+    from ethrex_tpu.p2p.eth_wire import fork_id_for
+    from ethrex_tpu.primitives.genesis import ChainConfig
+    cfg = ChainConfig(chain_id=7)
+    cfg.time_forks = {"cancun": 1700}
+    g = b"\x11" * 32
+    before = fork_id_for(cfg, g, 5000, 500, genesis_time=100)
+    assert before[1] == 1700          # block 5000 alone does not pass it
+    after = fork_id_for(cfg, g, 5000, 1700, genesis_time=100)
+    assert after[1] == 0 and after[0] != before[0]
