@@ -166,7 +166,7 @@ def execute_tx(tx: Transaction, state: StateDB, block: BlockEnv,
         state.warm_address(tx.to)
     if fork >= Fork.SHANGHAI:
         state.warm_address(block.coinbase)
-    for addr in precompiles.PRECOMPILES:
+    for addr in precompiles.active_precompiles(fork):
         state.warm_address(addr)
     for addr, slots in tx.access_list:
         state.warm_address(addr)
@@ -193,7 +193,7 @@ def execute_tx(tx: Transaction, state: StateDB, block: BlockEnv,
         code, code_src = evm.resolve_code(tx.to)
         msg = Message(caller=sender, to=tx.to, code_address=code_src,
                       value=tx.value, data=tx.data, gas=gas, code=code)
-        if tx.to in precompiles.PRECOMPILES:
+        if precompiles.get_precompile(tx.to, fork) is not None:
             msg.code_address = tx.to
         ok, gas_left, output = evm.execute_message(msg)
 

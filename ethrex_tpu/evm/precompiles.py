@@ -13,7 +13,8 @@ from __future__ import annotations
 
 import hashlib
 
-from ..crypto import bn254, secp256k1
+from ..crypto import bn254, p256, secp256k1
+from ..primitives.genesis import Fork
 from ..crypto.keccak import keccak256  # noqa: F401  (used by callers)
 from . import gas as G
 
@@ -238,6 +239,23 @@ def _kzg_point_eval(data: bytes, gas: int, fork):
         "setup (not yet embedded)")
 
 
+def _p256_verify(data: bytes, gas: int, fork) -> tuple[int, bytes]:
+    """P256VERIFY (RIP-7212 / EIP-7951, address 0x100): 160-byte input
+    hash||r||s||qx||qy; returns 32-byte 1 on valid signature, empty
+    otherwise.  Any malformed input is a failed verification (empty
+    output), never an exceptional halt."""
+    cost = 6900
+    if len(data) != 160:
+        return cost, b""
+    h = data[0:32]
+    r = int.from_bytes(data[32:64], "big")
+    s = int.from_bytes(data[64:96], "big")
+    qx = int.from_bytes(data[96:128], "big")
+    qy = int.from_bytes(data[128:160], "big")
+    ok = p256.verify(h, r, s, qx, qy)
+    return cost, (1).to_bytes(32, "big") if ok else b""
+
+
 def _a(n: int) -> bytes:
     return n.to_bytes(20, "big")
 
@@ -253,4 +271,24 @@ PRECOMPILES = {
     _a(8): _ecpairing,
     _a(9): _blake2f,
     _a(10): _kzg_point_eval,
+    _a(0x100): _p256_verify,
 }
+
+# precompiles that only exist from a given fork onward; absent entries are
+# active on every supported fork (all pre-date our earliest target chains)
+PRECOMPILE_FORKS = {
+    _a(0x100): Fork.OSAKA,   # P256VERIFY, EIP-7951
+}
+
+
+def active_precompiles(fork):
+    """Addresses that behave as precompiles at `fork`; anything else at
+    those addresses is an ordinary (empty) account."""
+    return {a for a in PRECOMPILES
+            if fork >= PRECOMPILE_FORKS.get(a, Fork.FRONTIER)}
+
+
+def get_precompile(addr: bytes, fork):
+    if fork < PRECOMPILE_FORKS.get(addr, Fork.FRONTIER):
+        return None
+    return PRECOMPILES.get(addr)

@@ -252,7 +252,7 @@ class EVM:
             if self.state.get_balance(msg.caller) < msg.value:
                 return False, msg.gas, b""
             self._transfer(msg.caller, msg.to, msg.value)
-        pre = precompiles.PRECOMPILES.get(msg.code_address)
+        pre = precompiles.get_precompile(msg.code_address, self.fork)
         if pre is not None:
             try:
                 gas_cost, output = pre(msg.data, msg.gas, self.fork)
@@ -865,7 +865,8 @@ def _do_call(evm, f, *, kind: str):
                       depth=f.msg.depth + 1, is_static=True, code=code,
                       kind="STATICCALL")
     # precompiles execute against the *call target* address
-    if addr in precompiles.PRECOMPILES and kind in ("call", "staticcall"):
+    if (precompiles.get_precompile(addr, evm.fork) is not None
+            and kind in ("call", "staticcall")):
         msg.code_address = addr
     ok, gas_left, output = evm.execute_message(msg)
     f.return_data = output

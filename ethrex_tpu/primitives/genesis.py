@@ -26,6 +26,7 @@ class Fork(enum.IntEnum):
     SHANGHAI = 11
     CANCUN = 12
     PRAGUE = 13
+    OSAKA = 14
 
 
 _BLOCK_FORKS = [
@@ -44,6 +45,7 @@ _TIME_FORKS = [
     ("shanghaiTime", Fork.SHANGHAI),
     ("cancunTime", Fork.CANCUN),
     ("pragueTime", Fork.PRAGUE),
+    ("osakaTime", Fork.OSAKA),
 ]
 
 

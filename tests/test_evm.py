@@ -248,3 +248,62 @@ def test_selfdestruct_eip6780():
     assert state.get_balance(OTHER) == 5000
     assert state.get_balance(CONTRACT) == 0
     assert state.get_code(CONTRACT) == code  # code survives (EIP-6780)
+
+
+def test_p256_verify_precompile():
+    """P256VERIFY at 0x100 (RIP-7212/EIP-7951): valid sig -> 32-byte 1,
+    anything malformed -> empty success."""
+    import hashlib
+    from ethrex_tpu.crypto import p256
+    from ethrex_tpu.primitives.genesis import ChainConfig
+    osaka_cfg = ChainConfig.from_json(
+        {"chainId": 1337, "terminalTotalDifficulty": 0, "shanghaiTime": 0,
+         "cancunTime": 0, "pragueTime": 0, "osakaTime": 0})
+    state = _state()
+    evm = EVM(state, BLOCK, osaka_cfg)
+    addr = b"\x00" * 18 + b"\x01\x00"
+
+    def call(data):
+        return evm.execute_message(Message(
+            caller=SENDER, to=addr, code_address=addr, value=0,
+            data=data, gas=100_000))
+
+    sk = 0xC9AF_A9D8_45BA_7516_6B5C_2157_67B1_D693_4E50_C3DB_36E8_9B12_7B8A_622B_120F_6721
+    qx, qy = p256.pubkey_from_secret(sk)
+    h = hashlib.sha256(b"sample").digest()
+    r, s = p256.sign_for_tests(h, sk)
+    good = h + r.to_bytes(32, "big") + s.to_bytes(32, "big") \
+        + qx.to_bytes(32, "big") + qy.to_bytes(32, "big")
+    ok, gas_left, out = call(good)
+    assert ok and int.from_bytes(out, "big") == 1
+    assert 100_000 - gas_left == 6900  # EIP-7951 cost
+    # flipped s -> invalid -> empty output, still a successful call
+    bad = bytearray(good); bad[95] ^= 1
+    ok, _, out = call(bytes(bad))
+    assert ok and out == b""
+    # wrong length -> empty
+    ok, _, out = call(good[:159])
+    assert ok and out == b""
+    ok, _, out = call(good + b"\x00")
+    assert ok and out == b""
+    # point not on curve -> empty
+    offc = bytearray(good); offc[159] ^= 1
+    ok, _, out = call(bytes(offc))
+    assert ok and out == b""
+    # r = 0 -> empty
+    zr = h + b"\x00" * 32 + s.to_bytes(32, "big") \
+        + qx.to_bytes(32, "big") + qy.to_bytes(32, "big")
+    ok, _, out = call(zr)
+    assert ok and out == b""
+
+
+def test_p256_verify_inactive_before_osaka():
+    """Pre-Osaka, 0x100 is an ordinary empty account: the call succeeds
+    with empty output and burns no precompile gas (CONFIG is Prague)."""
+    state = _state()
+    evm = EVM(state, BLOCK, CONFIG)
+    addr = b"\x00" * 18 + b"\x01\x00"
+    ok, gas_left, out = evm.execute_message(Message(
+        caller=SENDER, to=addr, code_address=addr, value=0,
+        data=b"\x00" * 160, gas=100_000))
+    assert ok and out == b"" and gas_left == 100_000
