@@ -48,6 +48,11 @@ class RlpxPeer:
         self._pending_cv = threading.Condition()
         self._late_ok: set[int] = set()
         self._catching_up = threading.Event()
+        # peer scoring (parity: the reference's peer-handler penalties):
+        # successes nudge up, failures down, protocol violations hard;
+        # the server disconnects peers below SCORE_DISCONNECT.
+        self.score = 0
+        self._score_lock = threading.Lock()
         self._req_counter = 0
         self._req_lock = threading.Lock()
         # bounded sets with DISTINCT roles: known_txs suppresses outbound
@@ -138,6 +143,20 @@ class RlpxPeer:
         while len(self._imported) > self.KNOWN_TX_CAP:
             self._imported.pop(next(iter(self._imported)))
 
+    SCORE_MAX = 50
+    SCORE_DISCONNECT = -50
+
+    def record_success(self):
+        with self._score_lock:
+            self.score = min(self.score + 1, self.SCORE_MAX)
+
+    def record_failure(self, penalty: int = 5):
+        with self._score_lock:
+            self.score -= penalty
+            evict = self.score <= self.SCORE_DISCONNECT
+        if evict:
+            self.close()
+
     def request(self, msg_id: int, payload: bytes, request_id: int,
                 timeout: float = 10.0):
         self.send_msg(msg_id, payload)
@@ -147,8 +166,11 @@ class RlpxPeer:
             if not ok:
                 # a late response must not leak into _pending forever
                 self._late_ok.add(request_id)
+                self.record_failure()
                 raise PeerError("request timed out")
-            return self._pending.pop(request_id)
+            result = self._pending.pop(request_id)
+        self.record_success()
+        return result
 
     def get_block_headers(self, start: int, limit: int):
         rid = self._next_request_id()
@@ -362,11 +384,17 @@ class RlpxPeer:
         elif msg_id == eth_wire.NEW_BLOCK:
             block, _td = eth_wire.decode_new_block(payload)
             try:
-                self.node.import_block(block)
+                imported = self.node.import_block(block)
             except Exception as e:  # noqa: BLE001 — invalid blocks dropped
-                # a gap (unknown parent) means we fell behind: catch up
+                # a gap (unknown parent) means we fell behind: catch up —
+                # an actually invalid block is a heavy scoring offence
                 if "unknown parent" in str(e):
                     self._start_catch_up()
+                else:
+                    self.record_failure(penalty=25)
+            else:
+                if imported:   # duplicates earn nothing (no score farming)
+                    self.record_success()
 
     def _start_catch_up(self):
         """Header/body sync from this peer on a dedicated thread (request()
@@ -405,6 +433,10 @@ class RlpxPeer:
                     pass           # not kill the whole session
         except (ConnectionError, OSError, rlpx.RlpxError, PeerError):
             pass
+        finally:
+            server = getattr(self.node, "p2p_server", None)
+            if server is not None and self in server.peers:
+                server.peers.remove(self)
 
     def start(self):
         threading.Thread(target=self.run, daemon=True).start()
@@ -412,6 +444,11 @@ class RlpxPeer:
 
     def close(self):
         self._stop.set()
+        try:
+            # unblock a reader thread parked in recv() before closing
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self.sock.close()
         except OSError:
@@ -446,8 +483,8 @@ class P2PServer:
                 peer = self._handshake_recipient(sock)
                 peer.exchange_hello()
                 peer.exchange_status()
-                peer.start()
                 self.peers.append(peer)
+                peer.start()
             except (PeerError, rlpx.RlpxError, ConnectionError, OSError):
                 sock.close()
 
@@ -479,8 +516,8 @@ class P2PServer:
         peer = RlpxPeer(sock, secrets, self.node, remote_pub)
         peer.exchange_hello()
         peer.exchange_status()
-        peer.start()
         self.peers.append(peer)
+        peer.start()
         return peer
 
     def broadcast_block(self, block: Block):
@@ -490,7 +527,8 @@ class P2PServer:
         TCP buffer must never block the caller."""
         import math
 
-        peers = list(self.peers)
+        # highest-scored peers get the full block, the rest the hash
+        peers = sorted(self.peers, key=lambda p: p.score, reverse=True)
         if not peers:
             return
         full_count = max(1, int(math.isqrt(len(peers))))
@@ -515,7 +553,7 @@ class P2PServer:
     def stop(self):
         self._stop.set()
         self.listener.close()
-        for p in self.peers:
+        for p in list(self.peers):
             p.close()
 
 
@@ -534,6 +572,7 @@ def full_sync(peer: RlpxPeer, node, batch: int = 64) -> int:
             break
         bodies = peer.get_block_bodies([h.hash for h in headers])
         if len(bodies) != len(headers):
+            peer.record_failure(penalty=25)   # protocol violation
             raise PeerError("incomplete bodies response")
         blocks = [Block(h, b) for h, b in zip(headers, bodies)]
         # serialize against concurrent NEW_BLOCK imports / block production

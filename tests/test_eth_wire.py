@@ -8,6 +8,7 @@ import pytest
 
 from ethrex_tpu.crypto import secp256k1
 from ethrex_tpu.node import Node
+from ethrex_tpu.p2p import eth_wire
 from ethrex_tpu.p2p.connection import P2PServer, PeerError, full_sync
 from ethrex_tpu.primitives.genesis import Genesis
 from ethrex_tpu.primitives.transaction import TYPE_DYNAMIC_FEE, Transaction
@@ -232,3 +233,45 @@ def test_fork_id_small_timestamp_devnet():
     assert before[1] == 1700          # block 5000 alone does not pass it
     after = fork_id_for(cfg, g, 5000, 1700, genesis_time=100)
     assert after[1] == 0 and after[0] != before[0]
+
+
+def test_peer_scoring(two_nodes):
+    """Successful requests raise a peer's score; protocol violations and
+    invalid blocks sink it; hitting SCORE_DISCONNECT closes the session
+    and the server prunes the dead peer."""
+    node_a, node_b, srv_a, srv_b = two_nodes
+    node_a.submit_transaction(_tx(0))
+    node_a.produce_block()
+    peer = srv_b.dial(srv_a.host, srv_a.port, srv_a.pub)
+    assert peer.score == 0
+    headers = peer.get_block_headers(1, 1)
+    assert headers and peer.score == 1          # success -> +1
+    peer.get_block_bodies([headers[0].hash])
+    assert peer.score == 2
+    # a's view of b: feed it a structurally valid but INVALID block
+    deadline = time.time() + 5
+    while time.time() < deadline and not srv_a.peers:
+        time.sleep(0.05)
+    a_view = srv_a.peers[0]
+    bad = node_a.store.get_block(node_a.store.head_header().hash)
+    import dataclasses as _dc
+    bad_header = _dc.replace(bad.header, state_root=b"\x42" * 32,
+                             number=bad.header.number + 1,
+                             parent_hash=bad.hash)
+    from ethrex_tpu.primitives.block import Block as _B
+    try:
+        peer.send_msg(eth_wire.NEW_BLOCK,
+                      eth_wire.encode_new_block(_B(bad_header, bad.body), 0))
+    except OSError:
+        pass   # eviction can close the pipe mid-send
+    deadline = time.time() + 5
+    while time.time() < deadline and a_view.score >= 0:
+        time.sleep(0.05)
+    assert a_view.score <= -25                  # invalid block penalty
+    # sink the score to the disconnect threshold -> session closed + pruned
+    for _ in range(10):
+        a_view.record_failure(penalty=25)
+    deadline = time.time() + 5
+    while time.time() < deadline and srv_a.peers:
+        time.sleep(0.05)
+    assert a_view not in srv_a.peers
