@@ -22,6 +22,9 @@ class Mempool:
         self.by_sender: dict[bytes, dict[int, Transaction]] = {}
         self.blobs_bundles: dict[bytes, object] = {}  # tx_hash -> bundle
         self.lock = threading.RLock()
+        # arrival hooks (e.g. pending-tx RPC filters); invoked OUTSIDE
+        # self.lock so subscribers may take their own locks freely
+        self.on_add: list = []
 
     def add_transaction(self, tx: Transaction, sender_nonce: int,
                         sender_balance: int, base_fee: int,
@@ -54,7 +57,9 @@ class Mempool:
             self.by_hash[tx.hash] = tx
             if blobs_bundle is not None:
                 self.blobs_bundles[tx.hash] = blobs_bundle
-            return tx.hash
+        for hook in list(self.on_add):
+            hook(tx.hash)
+        return tx.hash
 
     def remove_transaction(self, tx_hash: bytes):
         with self.lock:

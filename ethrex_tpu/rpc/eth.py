@@ -3,6 +3,8 @@ crates/networking/rpc eth namespace; SURVEY.md §2.5)."""
 
 from __future__ import annotations
 
+import threading
+
 from ..primitives.transaction import Transaction
 from ..evm.executor import InvalidTransaction
 from ..evm.vm import EVM, BlockEnv, Message
@@ -21,8 +23,25 @@ class RpcError(Exception):
 class EthApi:
     """Implements the eth namespace against a Node (node.py)."""
 
+    FILTER_TTL = 300.0   # seconds since last poll before a filter expires
+
     def __init__(self, node):
         self.node = node
+        # id -> filter record (parity: the reference's rpc/eth/filter.rs
+        # in-memory FilterStore with last-poll TTL cleanup)
+        self._filters: dict = {}
+        self._filter_lock = threading.Lock()
+        self._filter_counter = 0
+        node.mempool.on_add.append(self._on_pending_tx)
+
+    def _on_pending_tx(self, tx_hash: bytes):
+        """Mempool arrival hook: queue the hash into every live
+        pending-transaction filter so a tx mined between two polls is
+        still reported."""
+        with self._filter_lock:
+            for f in self._filters.values():
+                if f["kind"] == "pendingTransactions":
+                    f["queue"].append(tx_hash)
 
     # ---------------- helpers ----------------
     def _resolve_block(self, tag) -> "Block":
@@ -206,6 +225,97 @@ class EthApi:
                     })
                 log_base += len(rec.logs)
         return out
+
+    # ---------------- filters (polling API) ----------------
+    def _expire_locked(self, now: float):
+        self._filters = {k: v for k, v in self._filters.items()
+                         if now - v["polled"] < self.FILTER_TTL}
+
+    def _install_filter(self, kind: str, criteria=None) -> str:
+        import os as _os
+        import time as _time
+        criteria = criteria or {}
+        # resolve the filter's own range once, at install time
+        start = self._resolve_block(
+            criteria.get("fromBlock", "latest")).header.number
+        to_tag = criteria.get("toBlock", "latest")
+        to_limit = (None if to_tag in ("latest", "pending", "safe",
+                                       "finalized", "earliest", None)
+                    else self._resolve_block(to_tag).header.number)
+        with self._filter_lock:
+            now = _time.monotonic()
+            self._expire_locked(now)
+            self._filter_counter += 1
+            fid = hx(int.from_bytes(_os.urandom(4), "big") * 2**32
+                     + self._filter_counter)
+            self._filters[fid] = {
+                "kind": kind, "criteria": criteria,
+                "last_block": (start - 1 if kind == "log"
+                               else self.node.store.latest_number()),
+                "to_limit": to_limit,
+                "queue": [],
+                "polled": now,
+            }
+            return fid
+
+    def new_filter(self, flt):
+        return self._install_filter("log", flt)
+
+    def new_block_filter(self):
+        return self._install_filter("block")
+
+    def new_pending_transaction_filter(self):
+        return self._install_filter("pendingTransactions")
+
+    def uninstall_filter(self, fid) -> bool:
+        with self._filter_lock:
+            return self._filters.pop(fid, None) is not None
+
+    def _poll_locked(self, fid):
+        """Look up + TTL-check + touch a filter; caller holds the lock."""
+        import time as _time
+        now = _time.monotonic()
+        self._expire_locked(now)
+        f = self._filters.get(fid)
+        if f is None:
+            raise RpcError(-32000, "filter not found")
+        f["polled"] = now
+        return f
+
+    def get_filter_changes(self, fid):
+        with self._filter_lock:
+            f = self._poll_locked(fid)
+            head = self.node.store.latest_number()
+            if f["kind"] == "block":
+                out = []
+                for n in range(f["last_block"] + 1, head + 1):
+                    bh = self.node.store.canonical_hash(n)
+                    if bh:
+                        out.append(hb(bh))
+                f["last_block"] = head
+                return out
+            if f["kind"] == "pendingTransactions":
+                out = [hb(h) for h in f["queue"]]
+                f["queue"] = []
+                return out
+            # log filter: new matches in [last_block+1, min(head, toBlock)]
+            hi = head if f["to_limit"] is None else min(head, f["to_limit"])
+            lo = f["last_block"] + 1
+            if lo > hi:
+                return []
+            crit = dict(f["criteria"])
+            crit["fromBlock"] = hx(lo)
+            crit["toBlock"] = hx(hi)
+            f["last_block"] = hi
+        return self.get_logs(crit)
+
+    def get_filter_logs(self, fid):
+        with self._filter_lock:
+            f = self._poll_locked(fid)
+            if f["kind"] != "log":
+                raise RpcError(-32000, "not a log filter")
+            crit = dict(f["criteria"])
+        return self.get_logs(crit)
 
     # ---------------- execution ----------------
     def _call_msg(self, call, tag):

@@ -58,6 +58,13 @@ class RpcServer:
             "eth_getTransactionReceipt": e.get_transaction_receipt,
             "eth_getBlockReceipts": e.get_block_receipts,
             "eth_getLogs": e.get_logs,
+            "eth_newFilter": e.new_filter,
+            "eth_newBlockFilter": lambda: e.new_block_filter(),
+            "eth_newPendingTransactionFilter":
+                lambda: e.new_pending_transaction_filter(),
+            "eth_getFilterChanges": e.get_filter_changes,
+            "eth_getFilterLogs": e.get_filter_logs,
+            "eth_uninstallFilter": e.uninstall_filter,
             "eth_call": e.call,
             "eth_estimateGas": e.estimate_gas,
             "eth_sendRawTransaction": e.send_raw_transaction,

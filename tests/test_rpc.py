@@ -10,6 +10,7 @@ from ethrex_tpu.crypto import secp256k1
 from ethrex_tpu.node import Node
 from ethrex_tpu.primitives.genesis import Genesis
 from ethrex_tpu.primitives.transaction import TYPE_DYNAMIC_FEE, Transaction
+from ethrex_tpu.rpc.serializers import hx
 from ethrex_tpu.rpc.server import RpcServer
 
 SECRET = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
@@ -204,3 +205,94 @@ def test_error_paths(rpc):
     assert "error" in call("eth_sendRawTransaction", "0x00ff")
     # unknown block
     assert call("eth_getBlockByNumber", "0x999", False)["result"] is None
+
+
+def test_filter_family(rpc):
+    """eth_newFilter/newBlockFilter/newPendingTransactionFilter +
+    getFilterChanges/getFilterLogs/uninstallFilter over live HTTP."""
+    call, node = rpc
+    bf = call("eth_newBlockFilter")["result"]
+    pf = call("eth_newPendingTransactionFilter")["result"]
+    assert call("eth_getFilterChanges", bf)["result"] == []
+
+    nonce = int(call("eth_getTransactionCount", "0x" + SENDER.hex(),
+                     "latest")["result"], 16)
+    # deploy a contract whose runtime is PUSH0 PUSH0 LOG0 STOP
+    deploy = Transaction(
+        tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=nonce,
+        max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+        gas_limit=300_000, to=b"", value=0,
+        data=bytes.fromhex("635f5fa0005f526004601cf3")).sign(SECRET)
+    call("eth_sendRawTransaction",
+         "0x" + deploy.encode_canonical().hex())
+    pending = call("eth_getFilterChanges", pf)["result"]
+    assert "0x" + deploy.hash.hex() in pending
+    assert call("eth_getFilterChanges", pf)["result"] == []  # drained
+    call("ethrex_produceBlock")
+    rcpt = call("eth_getTransactionReceipt",
+                "0x" + deploy.hash.hex())["result"]
+    contract = rcpt["contractAddress"]
+
+    lf = call("eth_newFilter", {"address": contract})["result"]
+    blocks = call("eth_getFilterChanges", bf)["result"]
+    assert len(blocks) >= 1 and all(h.startswith("0x") for h in blocks)
+    # trigger the log
+    trig = Transaction(
+        tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=nonce + 1,
+        max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+        gas_limit=100_000, to=bytes.fromhex(contract[2:]),
+        value=0).sign(SECRET)
+    call("eth_sendRawTransaction", "0x" + trig.encode_canonical().hex())
+    call("ethrex_produceBlock")
+    logs = call("eth_getFilterChanges", lf)["result"]
+    assert len(logs) == 1 and logs[0]["address"] == contract
+    assert call("eth_getFilterChanges", lf)["result"] == []
+    # getFilterLogs re-evaluates the criteria from scratch: default range
+    # latest..latest is the log's block, so the log appears again
+    replay = call("eth_getFilterLogs", lf)["result"]
+    assert len(replay) == 1 and replay[0]["address"] == contract
+    assert call("eth_uninstallFilter", lf)["result"] is True
+    err = call("eth_getFilterChanges", lf)
+    assert err["error"]["code"] == -32000
+    assert call("eth_uninstallFilter", lf)["result"] is False
+
+
+def test_filter_ranges_and_pending_accumulation(rpc):
+    """Review regressions: historical fromBlock served on first poll,
+    toBlock bound honored forever, and a tx mined between two polls is
+    still reported by a pending filter (arrival-time accumulation)."""
+    call, node = rpc
+    nonce = int(call("eth_getTransactionCount", "0x" + SENDER.hex(),
+                     "latest")["result"], 16)
+    pf = call("eth_newPendingTransactionFilter")["result"]
+    deploy = Transaction(
+        tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=nonce,
+        max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+        gas_limit=300_000, to=b"", value=0,
+        data=bytes.fromhex("635f5fa0005f526004601cf3")).sign(SECRET)
+    call("eth_sendRawTransaction", "0x" + deploy.encode_canonical().hex())
+    call("ethrex_produceBlock")                 # mined before the poll
+    assert "0x" + deploy.hash.hex() in call(
+        "eth_getFilterChanges", pf)["result"]
+    contract = call("eth_getTransactionReceipt",
+                    "0x" + deploy.hash.hex())["result"]["contractAddress"]
+    log_block = None
+    trig = Transaction(
+        tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=nonce + 1,
+        max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+        gas_limit=100_000, to=bytes.fromhex(contract[2:]),
+        value=0).sign(SECRET)
+    call("eth_sendRawTransaction", "0x" + trig.encode_canonical().hex())
+    call("ethrex_produceBlock")
+    rcpt = call("eth_getTransactionReceipt",
+                "0x" + trig.hash.hex())["result"]
+    log_block = int(rcpt["blockNumber"], 16)
+    # historical range: a fresh filter's first poll returns the past log
+    lf = call("eth_newFilter",
+              {"fromBlock": "0x0", "address": contract})["result"]
+    assert len(call("eth_getFilterChanges", lf)["result"]) >= 1
+    # bounded: toBlock below the log block never reports it
+    bounded = call("eth_newFilter",
+                   {"fromBlock": "0x0", "toBlock": hx(log_block - 1),
+                    "address": contract})["result"]
+    assert call("eth_getFilterChanges", bounded)["result"] == []
