@@ -15,7 +15,9 @@ from ..crypto import secp256k1
 from ..primitives.block import Block
 from . import eth_wire, rlpx, snap
 
-CLIENT_ID = "ethrex-tpu/0.1.0"
+from ..rpc.eth import CLIENT_NAME, CLIENT_VERSION
+
+CLIENT_ID = f"{CLIENT_NAME}/{CLIENT_VERSION}"
 
 
 class PeerError(Exception):

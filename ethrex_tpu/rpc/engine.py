@@ -16,7 +16,7 @@ from ..blockchain.payload import build_payload, create_payload_header
 from ..primitives.block import (Block, BlockBody, BlockHeader, Withdrawal,
                                 EMPTY_UNCLE_HASH)
 from ..primitives.transaction import Transaction
-from .eth import RpcError
+from .eth import CLIENT_NAME, CLIENT_VERSION, RpcError
 from .serializers import hb, hx, parse_bytes, parse_quantity
 
 VALID = "VALID"
@@ -171,8 +171,13 @@ class EngineApi:
             "engine_newPayloadV3", "engine_newPayloadV4",
             "engine_forkchoiceUpdatedV3", "engine_getPayloadV3",
             "engine_getPayloadV4", "engine_getPayloadBodiesByHashV1",
-            "engine_getPayloadBodiesByRangeV1",
+            "engine_getPayloadBodiesByRangeV1", "engine_getClientVersionV1",
         ]
+
+    def get_client_version_v1(self, _client_version=None):
+        # spec: respond with our own version info (the CL's is ignored)
+        return [{"code": "EX", "name": CLIENT_NAME,
+                 "version": CLIENT_VERSION, "commit": "00000000"}]
 
     def new_payload_v3(self, payload, blob_hashes=None,
                        parent_beacon_block_root=None,

@@ -20,6 +20,10 @@ class RpcError(Exception):
         self.data = data
 
 
+CLIENT_NAME = "ethrex-tpu"
+CLIENT_VERSION = "0.1.0"
+
+
 class EthApi:
     """Implements the eth namespace against a Node (node.py)."""
 

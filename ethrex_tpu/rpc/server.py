@@ -8,7 +8,8 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from .eth import EthApi, RpcError  # noqa: F401 (RpcError used below)
+from .eth import (CLIENT_NAME, CLIENT_VERSION, EthApi,
+                  RpcError)  # noqa: F401 (RpcError used below)
 
 
 class RpcServer:
@@ -37,6 +38,7 @@ class RpcServer:
                     api.get_payload_bodies_by_hash_v1,
                 "engine_getPayloadBodiesByRangeV1":
                     api.get_payload_bodies_by_range_v1,
+                "engine_getClientVersionV1": api.get_client_version_v1,
             })
 
     def _build_methods(self):
@@ -75,7 +77,8 @@ class RpcServer:
             "net_version": lambda: str(node.config.chain_id),
             "net_listening": lambda: True,
             "net_peerCount": lambda: hex(_peer_count(node)),
-            "web3_clientVersion": lambda: "ethrex-tpu/0.1.0",
+            "web3_clientVersion":
+                lambda: f"{CLIENT_NAME}/{CLIENT_VERSION}",
             "web3_sha3": _sha3,
             "eth_blobBaseFee": lambda: e.blob_base_fee(),
             "eth_getBlockTransactionCountByNumber": e.block_tx_count,
@@ -84,6 +87,19 @@ class RpcServer:
             "eth_getTransactionByBlockNumberAndIndex":
                 e.tx_by_block_and_index,
             "txpool_content": lambda: _txpool_content(node),
+            # post-merge constants / wallet compatibility
+            "eth_accounts": lambda: [],
+            "eth_mining": lambda: False,
+            "eth_hashrate": lambda: "0x0",
+            # uncles are always empty post-merge, but unknown blocks
+            # must still answer null (matching block_tx_count's convention)
+            "eth_getUncleCountByBlockHash":
+                lambda h: None if e.block_tx_count_by_hash(h) is None
+                else "0x0",
+            "eth_getUncleCountByBlockNumber":
+                lambda n: None if e.block_tx_count(n) is None else "0x0",
+            "eth_getUncleByBlockHashAndIndex": lambda h, i: None,
+            "eth_getUncleByBlockNumberAndIndex": lambda n, i: None,
             "ethrex_produceBlock": lambda: _produce(node),
             # L2 namespace (reference: crates/l2/networking/rpc)
             "ethrex_latestBatch": lambda: _latest_batch(node),

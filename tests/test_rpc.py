@@ -296,3 +296,19 @@ def test_filter_ranges_and_pending_accumulation(rpc):
                    {"fromBlock": "0x0", "toBlock": hx(log_block - 1),
                     "address": contract})["result"]
     assert call("eth_getFilterChanges", bounded)["result"] == []
+
+
+def test_post_merge_constants(rpc):
+    call, node = rpc
+    assert call("eth_accounts")["result"] == []
+    assert call("eth_mining")["result"] is False
+    assert call("eth_hashrate")["result"] == "0x0"
+    head = call("eth_blockNumber")["result"]
+    assert call("eth_getUncleCountByBlockNumber", head)["result"] == "0x0"
+    assert call("eth_getUncleByBlockNumberAndIndex",
+                head, "0x0")["result"] is None
+    # unknown blocks answer null, not "0x0"
+    assert call("eth_getUncleCountByBlockHash",
+                "0x" + "77" * 32)["result"] is None
+    assert call("eth_getUncleCountByBlockNumber",
+                "0x999999")["result"] is None
