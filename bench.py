@@ -11,21 +11,58 @@ that hardware for ethrex blocks, and each cycle occupies one row of a
 (documented, refined in later rounds when the EVM AIR lands and we can
 compare per-block wall-clock directly).
 
+Resilience: the chip sits behind a flaky network tunnel (round 1's official
+bench failed rc=1 because the tunnel died).  The measurement runs in a child
+process under a hard timeout with retries; every success is persisted to
+.bench_last.json, and when all attempts fail the last-known number is
+reported in degraded mode instead of crashing.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 LOG_N = 15
 WIDTH = 64
 BASELINE_CELLS_PER_SEC = 1.0e8
+LAST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".bench_last.json")
+ATTEMPT_TIMEOUT = int(os.environ.get("BENCH_TIMEOUT", "1500"))
+ATTEMPTS = int(os.environ.get("BENCH_ATTEMPTS", "3"))
+PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
 
 
-def main() -> None:
+def probe_backend() -> bool:
+    """Cheap child-process jax.devices() probe so a dead tunnel costs
+    PROBE_TIMEOUT, not a full measurement timeout (the tunnel can hang
+    indefinitely rather than erroring)."""
+    want_cpu = os.environ.get("BENCH_ALLOW_CPU") == "1"
+    check = ("import jax; assert jax.default_backend() != 'cpu'"
+             if not want_cpu else "import jax; jax.devices()")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", check],
+            capture_output=True, timeout=PROBE_TIMEOUT)
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def measure() -> None:
     import jax
+
+    # guard against silently publishing a CPU number as the TPU metric
+    # when the tunnel errors fast and JAX falls back to the CPU backend
+    if (jax.default_backend() == "cpu"
+            and os.environ.get("BENCH_ALLOW_CPU") != "1"):
+        print("backend is cpu, refusing to publish", file=sys.stderr)
+        sys.exit(3)
 
     # persistent XLA cache: repeated bench runs skip the multi-minute
     # cold compile (important when the chip sits behind a network tunnel)
@@ -55,5 +92,62 @@ def main() -> None:
     }))
 
 
+def main() -> None:
+    last_err = ""
+    for attempt in range(ATTEMPTS):
+        if not probe_backend():
+            last_err = f"attempt {attempt + 1}: backend probe failed"
+            time.sleep(10)
+            continue
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--measure"],
+                capture_output=True, text=True, timeout=ATTEMPT_TIMEOUT,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+        except subprocess.TimeoutExpired:
+            last_err = f"attempt {attempt + 1}: timeout {ATTEMPT_TIMEOUT}s"
+            continue
+        line = ""
+        for cand in reversed(proc.stdout.strip().splitlines()):
+            if cand.startswith("{"):
+                line = cand
+                break
+        if proc.returncode == 0 and line:
+            try:
+                result = json.loads(line)
+            except ValueError:
+                last_err = f"attempt {attempt + 1}: unparseable output"
+                continue
+            try:
+                with open(LAST_PATH, "w") as f:
+                    json.dump(result, f)
+            except OSError:
+                pass
+            print(json.dumps(result))
+            return
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        last_err = (f"attempt {attempt + 1}: rc={proc.returncode} "
+                    + " | ".join(tail[-3:])[:500])
+        time.sleep(10)
+    # degraded mode: report last-known instead of crashing the round
+    result = {
+        "metric": "stark_prove_core_trace_cells_per_sec",
+        "value": 0.0,
+        "unit": "cells/s",
+        "vs_baseline": 0.0,
+    }
+    try:
+        with open(LAST_PATH) as f:
+            result = json.load(f)
+    except (OSError, ValueError):
+        pass
+    result["degraded"] = True
+    result["error"] = last_err
+    print(json.dumps(result))
+
+
 if __name__ == "__main__":
-    main()
+    if "--measure" in sys.argv:
+        measure()
+    else:
+        main()

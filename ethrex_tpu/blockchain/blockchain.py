@@ -248,13 +248,17 @@ class Blockchain:
                 f"{header.state_root.hex()}")
         self.store.add_block(block, outcome.receipts)
 
+    VERIFY_INTERVAL = 256  # bound on unverified intermediate state roots
+
     def add_blocks_in_batch(self, blocks: list[Block]) -> None:
         """Bulk import: execute every block against ONE shared state cache
-        and merkleize ONCE at the end (reference: blockchain.rs
-        add_blocks_in_batch — full-sync bulk path).  All header/body rules,
-        receipts roots, blooms and gas are validated per block; the state
-        root is validated for the FINAL block (intermediate roots are
-        implied by determinism — this is the trusted-chunk trade the
+        and merkleize at VERIFY_INTERVAL boundaries + the end (reference:
+        blockchain.rs add_blocks_in_batch — full-sync bulk path).  All
+        header/body rules, receipts roots, blooms and gas are validated per
+        block; state roots are validated every VERIFY_INTERVAL blocks and
+        for the final block, so a malicious bulk peer can persist at most
+        VERIFY_INTERVAL-1 headers with bogus intermediate roots before the
+        whole batch is rejected (bounding the trusted-chunk trade the
         reference makes for bulk sync throughput)."""
         from ..storage.store import StoreSource
 
@@ -269,7 +273,8 @@ class Blockchain:
         state_db = StateDB(source)
         prev = parent
         per_block = []
-        for block in blocks:
+        verified_root = parent.state_root
+        for i, block in enumerate(blocks):
             header = block.header
             if header.parent_hash != prev.hash:
                 raise InvalidBlock("non-contiguous batch")
@@ -280,8 +285,17 @@ class Blockchain:
             per_block.append((block, outcome.receipts))
             overrides[header.number] = header.hash
             prev = header
-        new_root = self.store.apply_account_updates(parent.state_root,
-                                                    state_db)
+            if (i + 1) % self.VERIFY_INTERVAL == 0 and i + 1 < len(blocks):
+                verified_root = self.store.apply_account_updates(
+                    verified_root, state_db)
+                if verified_root != header.state_root:
+                    raise InvalidBlock(
+                        f"intermediate state root mismatch at block "
+                        f"{header.number}: {verified_root.hex()} != "
+                        f"{header.state_root.hex()}")
+                state_db.rebase(StoreSource(self.store, verified_root,
+                                            header_overrides=overrides))
+        new_root = self.store.apply_account_updates(verified_root, state_db)
         if new_root != blocks[-1].header.state_root:
             raise InvalidBlock(
                 f"final state root mismatch: {new_root.hex()} != "

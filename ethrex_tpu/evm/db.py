@@ -35,6 +35,10 @@ class VmDatabase:
     def get_block_hash(self, number: int) -> bytes:
         raise NotImplementedError
 
+    def account_has_storage(self, address: bytes) -> bool:
+        """EIP-7610: does the account have a non-empty storage trie?"""
+        return False
+
 
 class TrieSource(VmDatabase):
     """Shared trie-backed account/storage resolution over a node table.
@@ -69,6 +73,12 @@ class TrieSource(VmDatabase):
         raw = st.get(keccak256(slot.to_bytes(32, "big")))
         return rlp.decode_int(rlp.decode(raw)) if raw else 0
 
+    def account_has_storage(self, address: bytes) -> bool:
+        from ..primitives.account import EMPTY_TRIE_ROOT
+
+        acct = self.get_account_state(address)
+        return acct is not None and acct.storage_root != EMPTY_TRIE_ROOT
+
 
 class InMemorySource(VmDatabase):
     def __init__(self, accounts: dict | None = None,
@@ -92,6 +102,10 @@ class InMemorySource(VmDatabase):
     def get_storage(self, address: bytes, slot: int) -> int:
         acct = self.accounts.get(address)
         return acct.storage.get(slot, 0) if acct else 0
+
+    def account_has_storage(self, address: bytes) -> bool:
+        acct = self.accounts.get(address)
+        return acct is not None and any(v != 0 for v in acct.storage.values())
 
     def get_block_hash(self, number: int) -> bytes:
         return self.block_hashes.get(number, b"\x00" * 32)
@@ -177,17 +191,27 @@ class StateDB:
         self.journal.append(("storage_load", address, slot))
         return value
 
+    def has_nonempty_storage(self, address: bytes) -> bool:
+        """EIP-7610 collision predicate: any non-zero storage on the account
+        (cached writes this block, or the backing source's storage trie)."""
+        acct = self._load(address)
+        if any(v != 0 for v in acct.storage.values()):
+            return True
+        if not acct.exists or acct.storage_cleared:
+            return False
+        return self.source.account_has_storage(address)
+
     def get_original_storage(self, address: bytes, slot: int) -> int:
+        """EIP-2200 'original' value: the slot's value at TX start.  For a
+        slot not yet written this tx that is simply the current value
+        (which may come from the intra-block cache — an earlier tx or an
+        earlier batch-imported block may have modified it; reading the
+        backing source here would be stale).  set_storage records the
+        pre-write value on first write, covering slots already modified."""
         key = (address, slot)
         if key in self._tx_original:
             return self._tx_original[key]
-        acct = self._load(address)
-        if acct.exists and not acct.storage_cleared:
-            value = self.source.get_storage(address, slot)
-        else:
-            value = 0
-        self._tx_original[key] = value
-        return value
+        return self.get_storage(address, slot)
 
     # ---------------- mutations (journaled) ----------------
     def set_balance(self, address: bytes, value: int):
@@ -224,6 +248,8 @@ class StateDB:
 
     def set_storage(self, address: bytes, slot: int, value: int):
         current = self.get_storage(address, slot)
+        # first write this tx: the pre-write value IS the tx-start original
+        self._tx_original.setdefault((address, slot), current)
         acct = self._load(address)
         self.journal.append(("storage", address, slot, current))
         acct.storage[slot] = value
@@ -365,3 +391,16 @@ class StateDB:
     def finalize_tx(self):
         """Clear journal; keep account cache for the rest of the block."""
         self.journal.clear()
+
+    def rebase(self, source: VmDatabase):
+        """Re-point this StateDB at a new backing source whose state already
+        contains every dirty update (i.e. the tries were just flushed with
+        apply_updates_to_tries).  Keeps the account cache hot; resets the
+        dirty/cleared tracking so the next flush applies only what changed
+        since, and so net-zero-write detection compares against the flushed
+        root rather than the original one (batch-import interval flushes)."""
+        self.source = source
+        self.dirty_accounts = set()
+        self.dirty_storage = {}
+        for acct in self.accounts.values():
+            acct.storage_cleared = False

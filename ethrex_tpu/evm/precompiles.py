@@ -63,26 +63,30 @@ def _modexp(data: bytes, gas: int, fork):
     msize = int.from_bytes(data[64:96].ljust(32, b"\x00"), "big")
     if bsize == 0 and msize == 0:
         return 200, b""
-    if bsize > 1024 or esize > 1024 or msize > 1024:
+    if max(bsize, esize, msize) > 1_000_000:
         # EIP-7823-style upper bound guard; also protects the host
-        if max(bsize, esize, msize) > 1_000_000:
-            raise PrecompileError("modexp size too large")
+        raise PrecompileError("modexp size too large")
     body = data[96:]
-    base = int.from_bytes(body[:bsize].ljust(bsize, b"\x00"), "big")
-    exp_bytes = body[bsize:bsize + esize].ljust(esize, b"\x00")
-    exp = int.from_bytes(exp_bytes, "big")
-    mod = int.from_bytes(
-        body[bsize + esize:bsize + esize + msize].ljust(msize, b"\x00"), "big")
-    # EIP-2565 gas
+    # EIP-2565 gas — computed from the header + the 32-byte exponent head
+    # ONLY, before any big-int materialization, so oversized operands are
+    # rejected by the gas check without doing the pow (DoS guard).
+    exp_head = int.from_bytes(body[bsize:bsize + min(esize, 32)]
+                              .ljust(min(esize, 32), b"\x00"), "big")
     max_len = max(bsize, msize)
     mult_complexity = _words(max_len) ** 2
     if esize <= 32:
-        iter_count = max(exp.bit_length() - 1, 0)
+        iter_count = max(exp_head.bit_length() - 1, 0)
     else:
-        head = int.from_bytes(exp_bytes[:32], "big")
-        iter_count = 8 * (esize - 32) + max(head.bit_length() - 1, 0)
+        iter_count = 8 * (esize - 32) + max(exp_head.bit_length() - 1, 0)
     iter_count = max(iter_count, 1)
     cost = max(200, mult_complexity * iter_count // 3)
+    if gas < cost:
+        return cost, b""   # skip the pow when OOG anyway
+    base = int.from_bytes(body[:bsize].ljust(bsize, b"\x00"), "big")
+    exp = int.from_bytes(body[bsize:bsize + esize].ljust(esize, b"\x00"),
+                         "big")
+    mod = int.from_bytes(
+        body[bsize + esize:bsize + esize + msize].ljust(msize, b"\x00"), "big")
     if mod == 0:
         out = 0
     else:

@@ -285,9 +285,10 @@ class EVM:
             new_addr = keccak256(
                 rlp.encode([msg.caller, sender_nonce - 1]))[12:]
         self.state.warm_address(new_addr)
-        # collision check
+        # collision check (EIP-7610: non-empty storage also collides)
         if (self.state.get_nonce(new_addr) != 0
-                or self.state.get_code(new_addr) != b""):
+                or self.state.get_code(new_addr) != b""
+                or self.state.has_nonempty_storage(new_addr)):
             return False, 0, b""
         if self.state.get_balance(msg.caller) < msg.value:
             return False, msg.gas, b""

@@ -83,6 +83,10 @@ bool read_exact(FILE* f, void* buf, size_t n) {
 // after the crc field.
 bool append_record(FILE* f, const std::string& table, const uint8_t* k,
                    uint32_t kl, const uint8_t* v, uint32_t vl) {
+    // vl == TOMBSTONE is the delete sentinel; a real value of that size
+    // would replay as a delete.  tlen is a u8 on the wire.
+    if (v != nullptr && vl >= TOMBSTONE) return false;
+    if (table.size() > 255) return false;
     std::vector<uint8_t> rec;
     uint8_t tlen = (uint8_t)table.size();
     rec.push_back(tlen);

@@ -107,6 +107,60 @@ def test_multi_block_chain_and_contract():
     assert store.storage_at(b2.header.state_root, created, 0) == 1
 
 
+def test_batch_import_interval_flush_net_zero_storage():
+    """Regression: with intermediate VERIFY_INTERVAL merkleize flushes, a
+    slot written to X before a flush boundary and back to its batch-start
+    value after it must still land in the trie (the net-zero-write skip has
+    to compare against the flushed root, not the batch-start root)."""
+    store, chain, gh = _setup()
+    # runtime: CALLDATALOAD(0) -> SSTORE slot 0; STOP
+    runtime = bytes.fromhex("6000355f5500")
+    initcode = bytes.fromhex(
+        "65" + runtime.hex() + "5f526006601af3")
+    deploy = Transaction(
+        tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=0,
+        max_priority_fee_per_gas=2, max_fee_per_gas=10**10,
+        gas_limit=200_000, to=b"", value=0, data=initcode,
+    ).sign(SECRET)
+    from ethrex_tpu.crypto.keccak import keccak256
+    from ethrex_tpu.primitives import rlp as _rlp
+    created = keccak256(_rlp.encode([SENDER, 0]))[12:]
+
+    def store_tx(nonce, value):
+        return Transaction(
+            tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=nonce,
+            max_priority_fee_per_gas=2, max_fee_per_gas=10**10,
+            gas_limit=100_000, to=created, value=0,
+            data=value.to_bytes(32, "big"),
+        ).sign(SECRET)
+
+    b1 = _build_and_add(chain, store, gh, [deploy])
+    assert store.get_receipts(b1.hash)[0].succeeded
+    b2 = _build_and_add(chain, store, b1.header, [store_tx(1, 7)])
+    b3 = _build_and_add(chain, store, b2.header, [store_tx(2, 0)])
+    assert store.storage_at(b2.header.state_root, created, 0) == 7
+    assert store.storage_at(b3.header.state_root, created, 0) == 0
+
+    # re-import as ONE batch with a flush boundary between b2 and b3
+    store2 = Store()
+    store2.init_genesis(Genesis.from_json(GENESIS_JSON))
+    chain2 = Blockchain(store2, chain.config)
+    chain2.VERIFY_INTERVAL = 2
+    chain2.add_blocks_in_batch([b1, b2, b3])
+    apply_fork_choice(store2, b3.hash)
+    assert store2.head_header().state_root == b3.header.state_root
+    assert store2.storage_at(b3.header.state_root, created, 0) == 0
+
+    # and with NO boundary inside the window: SSTORE gas/refund for b3's
+    # write must use b2's value as 'current' and b2's as tx-start original
+    # (get_original_storage must not read the stale batch-start source)
+    store3 = Store()
+    store3.init_genesis(Genesis.from_json(GENESIS_JSON))
+    chain3 = Blockchain(store3, chain.config)
+    chain3.add_blocks_in_batch([b1, b2, b3])
+    assert store3.storage_at(b3.header.state_root, created, 0) == 0
+
+
 def test_withdrawals_credit_balance():
     store, chain, gh = _setup()
     wds = [Withdrawal(index=0, validator_index=1, address=OTHER, amount=3)]
