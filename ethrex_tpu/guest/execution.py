@@ -138,13 +138,18 @@ class _GuestChainView:
         raise StatelessExecutionError("guest execution requires witness db")
 
 
-def execution_program(program_input: ProgramInput) -> ProgramOutput:
+def execution_program(program_input: ProgramInput,
+                      write_log: list | None = None) -> ProgramOutput:
     """The stateless batch-execution program.
 
     1. rebuild pruned tries from the witness; check the initial root
     2. per block: validate linkage + header rules + body roots, execute,
        apply account updates, check the block's state root
     3. return the (initial_root, final_root, last_hash) commitment
+
+    `write_log` (optional) collects every trie write across the batch in
+    application order — the input to the execution proof's access-log
+    binding (guest/access_log.py).
     """
     from ..blockchain.blockchain import (Blockchain, InvalidBlock,
                                          compute_receipts_root)
@@ -175,6 +180,9 @@ def execution_program(program_input: ProgramInput) -> ProgramOutput:
         headers[hdr.number] = hdr
         chain_cursor = hdr
 
+    from ..storage.store import _make_native_engine
+
+    native = _make_native_engine()  # per-batch C++ merkleizer (or None)
     chain = Blockchain(_GuestChainView(), program_input.config)
     state_root = initial_root
     prev = parent_header
@@ -203,14 +211,19 @@ def execution_program(program_input: ProgramInput) -> ProgramOutput:
                 block.header.receipts_root:
             raise StatelessExecutionError("receipts root mismatch")
         receipts_per_block.append(outcome.receipts)
+        block_log = None if write_log is None else []
         try:
             state_root = apply_updates_to_tries(nodes, codes, state_root,
-                                                state_db)
+                                                state_db,
+                                                write_log=block_log,
+                                                native=native)
         except MissingNode as e:
             raise StatelessExecutionError(f"witness incomplete: {e}")
         if state_root != block.header.state_root:
             raise StatelessExecutionError(
                 f"state root mismatch at block {block.header.number}")
+        if write_log is not None:
+            write_log.append(block_log)
         headers[block.header.number] = block.header
         prev = block.header
 

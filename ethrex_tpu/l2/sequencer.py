@@ -104,10 +104,16 @@ class Sequencer:
         if any(r is None for r in receipts):
             raise RuntimeError("missing receipts for a batched block")
         msgs_root = message_root(collect_messages(blocks, receipts))
+        # real KZG sidecar for data availability (reference:
+        # l1_committer.rs generate_blobs_bundle + blobs_bundle.rs)
+        from .blobs import generate_blobs_bundle
+
+        bundle = generate_blobs_bundle(blocks)
         commitment = keccak256(
             b"batch" + number.to_bytes(8, "big") + state_root
             + b"".join(b.hash for b in blocks)
-            + b"".join(privileged_hashes) + msgs_root)
+            + b"".join(privileged_hashes) + msgs_root
+            + b"".join(bundle.versioned_hashes))
         # L1 first: only persist the batch once the commitment is accepted,
         # otherwise a transient L1 failure would desync the batch counter
         self.l1.commit_batch(number, state_root, commitment,
@@ -116,6 +122,7 @@ class Sequencer:
                       last_block=head, state_root=state_root,
                       commitment=commitment)
         self.rollup.store_batch(batch)
+        self.rollup.store_blobs_bundle(number, bundle)
         self.rollup.store_prover_input(number, self.cfg.commit_hash,
                                        program_input.to_json())
         self.rollup.set_committed(number, commitment)
@@ -143,14 +150,28 @@ class Sequencer:
         for t in needed:
             from ..prover.backend import get_backend
             backend = get_backend(t)
-            all_ok = all(
-                backend.verify(self.rollup.get_proof(n, t))
-                for n in range(first, last + 1))
-            if not all_ok:
+
+            def check(n: int) -> bool:
+                proof = self.rollup.get_proof(n, t)
+                # full audit when the backend supports it: the stored
+                # ProverInput lets the proof's write log be replayed
+                # against the witness MPT (no re-execution)
+                if hasattr(backend, "verify_with_input"):
+                    stored = self.rollup.get_prover_input(
+                        n, self.cfg.commit_hash)
+                    if stored is not None:
+                        from ..guest.execution import ProgramInput
+
+                        return backend.verify_with_input(
+                            proof, ProgramInput.from_json(stored))
+                return backend.verify(proof)
+
+            results = {n: check(n) for n in range(first, last + 1)}
+            if not all(results.values()):
                 # invalid proof: delete so the fleet re-proves (reference:
                 # distributed_proving.md:70-72)
-                for n in range(first, last + 1):
-                    if not backend.verify(self.rollup.get_proof(n, t)):
+                for n, ok in results.items():
+                    if not ok:
                         self.rollup.delete_proof(n, t)
                 return None
             # per-batch proof bytes: the L1 checks each batch's committed

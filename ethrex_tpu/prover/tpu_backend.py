@@ -1,24 +1,39 @@
 """TPU prover backend: the `--prover tpu` seam (SURVEY.md north star).
 
-Round-1 scope: the guest program runs natively on the host, and the TPU
-produces an **output-binding STARK** — a real DEEP-FRI proof (device LDE +
-Poseidon2 Merkle + FRI) that the claimed ProgramOutput bytes hash, limb by
-limb **in-circuit through the Poseidon2 sponge**
-(models/poseidon2_air.Poseidon2SpongeAir = exactly ops/poseidon2.hash_leaves,
-the framework's Merkle leaf hash), to the digest in the proof's public
-inputs.  Verified by the independent host verifier.
+Round-2 scope — the proof now covers the STATE TRANSITION, not just the
+output bytes.  `prove` emits two DEEP-FRI STARKs over the same TPU prover
+(stark/prover.py):
 
-What it does NOT yet prove: the EVM execution itself.  That requires the VM
-AIR (the reference delegates this to its zkVM SDKs; our equivalent is the
-arithmetization of guest/execution.py — the sponge AIR here is its hash
-building block).  Until then the execution-trust level matches the
-reference's exec backend, with real TPU proving work end to end.
+  1. the STATE proof (models/state_update_air.StateUpdateAir): in-circuit
+     verification that applying the batch's write log, entry by entry with
+     Merkle openings, transforms the touched-state commitment r_pre into
+     r_post — public inputs (r_pre, r_post, log_digest);
+  2. the BINDING proof (models/poseidon2_air.Poseidon2SpongeAir): the
+     claimed ProgramOutput bytes plus (r_pre, r_post, log_digest) hashed
+     in-circuit to one digest, chaining the state proof's publics to the
+     batch output the L1 consumes.
+
+`verify` checks both STARKs with the independent host verifier, recomputes
+log_digest / r_pre / r_post from the proof-carried write log, and — when
+given the ProverInput — audits the log against the witness MPT with trie
+operations only (guest/access_log.replay_log_against_witness): every old
+value, every storage root, and the final keccak state root, with NO EVM
+execution on the verifying side.
+
+Remaining trust gap (the future VM AIR): that the log's NEW values are
+what EVM semantics dictate.  The reference closes this by running the
+whole guest in a zkVM (crates/prover/src/backend/sp1.rs:145-163); our
+equivalent is arithmetizing the EVM's effects on top of this state
+circuit.
 """
 
 from __future__ import annotations
 
-from ..guest.execution import ProgramInput
+from ..guest import access_log
+from ..guest.execution import ProgramInput, execution_program
 from ..models import poseidon2_air as pair
+from ..models import state_update_air as sua
+from ..ops import babybear as bb
 from ..stark import prover as stark_prover
 from ..stark import verifier as stark_verifier
 from ..stark.prover import StarkParams
@@ -35,39 +50,125 @@ def output_to_limbs(output_bytes: bytes) -> list[int]:
     limbs = [int.from_bytes(padded[i:i + 3], "big")
              for i in range(0, len(padded), 3)]
     limbs.append(len(output_bytes))  # length limb: no padding ambiguity
+    return limbs
+
+
+def binding_limbs(output_bytes: bytes, r_pre: list[int], r_post: list[int],
+                  digest: list[int]) -> list[int]:
+    """Message of the binding sponge: output bytes then the state proof's
+    24 public limbs, one padded stream."""
+    limbs = output_to_limbs(output_bytes) + list(r_pre) + list(r_post) \
+        + list(digest)
     return pair.pad_message_limbs(limbs)
+
+
+def _schedule_for(depth: int) -> int:
+    """seg_periods for a tree depth (smallest power of two fitting the
+    3-leaf + depth-fold + tail schedule; >= 8)."""
+    need = depth + 5
+    return max(8, 1 << (need - 1).bit_length())
 
 
 class TpuBackend(ProverBackend):
     prover_type = protocol.PROVER_TPU
 
     def prove(self, program_input: ProgramInput, proof_format: str) -> dict:
-        output = self.execute(program_input)
+        blocks_log: list = []
+        output = execution_program(program_input, write_log=blocks_log)
         encoded = output.encode()
-        limbs = output_to_limbs(encoded)
-        air = pair.Poseidon2SpongeAir(num_chunks=len(limbs) // 8)
-        trace = pair.generate_sponge_trace(limbs)
-        pub = pair.sponge_public_inputs(limbs)
-        stark = stark_prover.prove(air, trace, pub, PARAMS)
+
+        entries = access_log.flatten_entries(blocks_log)
+        records, r_pre, r_post, depth = \
+            access_log.build_access_records(entries)
+        S = _schedule_for(depth)
+        air = sua.StateUpdateAir(depth, seg_periods=S)
+        trace = sua.generate_state_update_trace(records, r_pre, depth, S)
+        pub = sua.state_update_public_inputs(records, r_pre, r_post, S)
+        state_proof = stark_prover.prove(air, trace, pub, PARAMS)
+        digest = pub[16:24]
+
+        limbs = binding_limbs(encoded, r_pre, r_post, digest)
+        bind_air = pair.Poseidon2SpongeAir(num_chunks=len(limbs) // 8)
+        bind_trace = pair.generate_sponge_trace(limbs)
+        bind_pub = pair.sponge_public_inputs(limbs)
+        bind_proof = stark_prover.prove(bind_air, bind_trace, bind_pub,
+                                        PARAMS)
         return {
             "backend": self.prover_type,
             "format": proof_format,
             "output": "0x" + encoded.hex(),
-            "proof": stark,
+            "write_log": access_log.raw_log_to_json(blocks_log),
+            "depth": depth,
+            "seg_periods": S,
+            "state_proof": state_proof,
+            "proof": bind_proof,
         }
 
-    def verify(self, proof: dict) -> bool:
+    # -- verification -------------------------------------------------------
+
+    def _check(self, proof: dict):
+        """Shared verification core; returns the parsed raw log + claimed
+        output bytes, or raises."""
         if proof.get("backend") != self.prover_type:
-            return False
+            raise ValueError("wrong backend tag")
+        encoded = bytes.fromhex(proof["output"][2:])
+        if sum(len(b) for b in proof["write_log"]) > 1_000_000:
+            raise ValueError("write log too large")
+        blocks_log = access_log.raw_log_from_json(proof["write_log"])
+
+        # recompute the flat commitments from the claimed log; the tree
+        # shape is fully determined by the log, so the proof's claimed
+        # depth/seg_periods get no attacker freedom (a huge claimed depth
+        # would otherwise allocate 2^depth leaves before any AIR check)
+        entries = access_log.flatten_entries(blocks_log)
+        records, r_pre, r_post, depth = \
+            access_log.build_access_records(entries)
+        S = _schedule_for(depth)
+        if int(proof["depth"]) != depth or int(proof["seg_periods"]) != S:
+            raise ValueError("claimed tree shape does not match the log")
+        segments = sua.segment_count(len(records))
+        digest = sua.log_digest(records, S, segments)
+
+        state = proof["state_proof"]
+        claimed_pub = [int(v) % bb.P for v in state["pub_inputs"]]
+        if claimed_pub != r_pre + r_post + digest:
+            raise ValueError("state proof publics do not match the log")
+        air = sua.StateUpdateAir(depth, seg_periods=S)
+        if not stark_verifier.verify(air, state, PARAMS):
+            raise ValueError("state proof rejected")
+
+        limbs = binding_limbs(encoded, r_pre, r_post, digest)
+        bind = proof["proof"]
+        if [int(v) for v in bind["pub_inputs"][:len(limbs)]] != limbs:
+            raise ValueError("binding proof does not bind this statement")
+        bind_air = pair.Poseidon2SpongeAir(num_chunks=len(limbs) // 8)
+        if not stark_verifier.verify(bind_air, bind, PARAMS):
+            raise ValueError("binding proof rejected")
+        return blocks_log, encoded
+
+    def verify(self, proof: dict) -> bool:
         try:
-            encoded = bytes.fromhex(proof["output"][2:])
-            stark = proof["proof"]
-            limbs = output_to_limbs(encoded)
-            air = pair.Poseidon2SpongeAir(num_chunks=len(limbs) // 8)
-            # the proof's public inputs must bind the claimed output limbs
-            if [int(v) for v in stark["pub_inputs"][:len(limbs)]] != limbs:
-                return False
-            return stark_verifier.verify(air, stark, PARAMS)
-        except (KeyError, ValueError, TypeError,
+            self._check(proof)
+            return True
+        except (KeyError, ValueError, TypeError, IndexError,
+                access_log.LogAuditError,
+                stark_verifier.VerificationError):
+            return False
+
+    def verify_with_input(self, proof: dict,
+                          program_input: ProgramInput) -> bool:
+        """Full audit: both STARKs + the witness MPT replay (trie ops
+        only, no EVM) against the claimed initial/final state roots."""
+        from ..guest.execution import ProgramOutput
+
+        try:
+            blocks_log, encoded = self._check(proof)
+            output = ProgramOutput.decode(encoded)
+            access_log.replay_log_against_witness(
+                blocks_log, program_input.witness.nodes,
+                output.initial_state_root, output.final_state_root)
+            return True
+        except (KeyError, ValueError, TypeError, IndexError,
+                access_log.LogAuditError,
                 stark_verifier.VerificationError):
             return False

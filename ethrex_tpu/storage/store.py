@@ -203,13 +203,42 @@ class Store:
         `nodes` overrides the node table (witness recording / stateless
         execution use a recording or witness-only table)."""
         with self.lock:
+            if nodes is None:
+                # persistent native engine over the store's own table: the
+                # C++ map warms up once and batch applies skip Python
+                native = self._native_engine()
+            else:
+                native = _make_native_engine()
             return apply_updates_to_tries(
                 nodes if nodes is not None else self.nodes,
-                self.code, parent_root, state_db)
+                self.code, parent_root, state_db, native=native)
+
+    def _native_engine(self):
+        engine = getattr(self, "_native_mpt", "unset")
+        if engine == "unset":
+            engine = _make_native_engine()
+            self._native_mpt = engine
+        return engine
+
+
+def _make_native_engine():
+    """A NativeMpt when the C++ engine is available and enabled, else
+    None (callers fall back to the Python trie)."""
+    import os
+
+    if os.environ.get("ETHREX_TPU_NATIVE_MPT") == "0":
+        return None
+    from ..trie.native_mpt import NativeMpt, available
+
+    if not available():
+        return None
+    return NativeMpt()
 
 
 def apply_updates_to_tries(node_table: dict, code_table, parent_root: bytes,
-                           state_db: StateDB) -> bytes:
+                           state_db: StateDB,
+                           write_log: list | None = None,
+                           native=None) -> bytes:
     """Shared merkleize step: dirty StateDB -> trie updates -> new root.
     Used by the Store (node path) and the stateless guest program.
 
@@ -217,14 +246,29 @@ def apply_updates_to_tries(node_table: dict, code_table, parent_root: bytes,
     into the same branch avoids collapse paths that would need sibling
     nodes a pruned witness doesn't carry (same ordering rule as the
     reference's guest state application, block_execution_witness.rs:541).
+
+    `write_log` (optional) collects the block's state writes for the
+    execution proof (guest/access_log.py): ("acct", addr, None, old_rlp,
+    new_rlp, storage_cleared) and ("slot", addr, slot, old_int, new_int)
+    tuples, in the deterministic application order above.
+
+    `native` (optional NativeMpt) runs every trie MUTATION batch in the
+    C++ engine (native/mpt.cpp) — reads still go through the Python trie;
+    both paths produce identical roots and node sets (differential-tested
+    in tests/test_native_mpt.py and by the whole suite's root checks).
     """
     trie = Trie.from_nodes(parent_root, node_table, share=True)
+    account_inserts = []
     account_deletes = []
     for addr in sorted(state_db.dirty_accounts):
         cached = state_db.accounts[addr]
         key = keccak256(addr)
         if not cached.exists or cached.is_empty:
             # EIP-161 state clearing / destroyed accounts
+            if write_log is not None:
+                raw = trie.get(key)
+                if raw:
+                    write_log.append(("acct", addr, None, raw, b"", False))
             account_deletes.append(key)
             continue
         raw = trie.get(key)
@@ -233,8 +277,14 @@ def apply_updates_to_tries(node_table: dict, code_table, parent_root: bytes,
                         else prev.storage_root)
         slots = state_db.dirty_storage.get(addr, ())
         if slots or cached.storage_cleared:
-            st = Trie.from_nodes(storage_root, node_table, share=True)
+            slot_inserts = []
             slot_deletes = []
+            if write_log is not None and cached.storage_cleared:
+                # destroy+recreate: downstream consumers reset this
+                # account's flat slot entries to zero (the old trie is
+                # NEVER walked here — a pruned witness legitimately
+                # omits it, and execution reads skip it too)
+                write_log.append(("clear", addr))
             for slot in sorted(slots):
                 # read through the StateDB: a reverted tx's journal undo can
                 # pop the cache entry, and the raw cache default of 0 would
@@ -247,21 +297,42 @@ def apply_updates_to_tries(node_table: dict, code_table, parent_root: bytes,
                     pre = state_db.source.get_storage(addr, slot)
                     if value == pre:
                         continue
-                skey = keccak256(slot.to_bytes(32, "big"))
-                if value:
-                    st.insert(skey, rlp.encode(value))
                 else:
-                    slot_deletes.append(skey)
-            for skey in slot_deletes:
-                st.remove(skey)
-            storage_root = st.commit()
+                    pre = 0  # post-clear semantics: every old value is 0
+                skey = keccak256(slot.to_bytes(32, "big"))
+                if write_log is not None and value != pre:
+                    write_log.append(("slot", addr, slot, pre, value))
+                if value:
+                    slot_inserts.append((skey, rlp.encode(value)))
+                else:
+                    slot_deletes.append((skey, b""))
+            if native is not None:
+                storage_root = native.apply(node_table, storage_root,
+                                            slot_inserts + slot_deletes)
+            else:
+                st = Trie.from_nodes(storage_root, node_table, share=True)
+                for skey, v in slot_inserts:
+                    st.insert(skey, v)
+                for skey, _ in slot_deletes:
+                    st.remove(skey)
+                storage_root = st.commit()
         if (cached.code is not None
                 and cached.code_hash != EMPTY_CODE_HASH):
             code_table[cached.code_hash] = cached.code
         new_state = AccountState(
             nonce=cached.nonce, balance=cached.balance,
             storage_root=storage_root, code_hash=cached.code_hash)
-        trie.insert(key, new_state.encode())
+        encoded = new_state.encode()
+        if write_log is not None and encoded != (raw or b""):
+            write_log.append(("acct", addr, None, raw or b"", encoded,
+                              bool(cached.storage_cleared)))
+        account_inserts.append((key, encoded))
+    if native is not None:
+        return native.apply(node_table, parent_root,
+                            account_inserts
+                            + [(k, b"") for k in account_deletes])
+    for key, encoded in account_inserts:
+        trie.insert(key, encoded)
     for key in account_deletes:
         trie.remove(key)
     return trie.commit()

@@ -1,0 +1,132 @@
+"""State-update AIR: host tree agreement, constraint satisfaction on the
+honest trace, tamper rejection, and a full prove/verify round-trip."""
+
+import numpy as np
+import pytest
+
+from ethrex_tpu.models import state_update_air as sua
+from ethrex_tpu.ops import babybear as bb
+from ethrex_tpu.ops import ext
+from ethrex_tpu.stark import state_tree
+from ethrex_tpu.stark.air import HostExtOps
+
+RNG = np.random.default_rng(7)
+
+
+def _word(i: int) -> bytes:
+    return bytes(RNG.integers(0, 256, 32, dtype=np.uint8))
+
+
+def _setup(num_keys=5, num_writes=4, depth=3):
+    entries = {_word(i): _word(i + 100) for i in range(num_keys)}
+    tree = state_tree.TouchedStateTree(entries, depth)
+    r_pre = tree.root
+    keys = list(entries)
+    accesses = []
+    for w in range(num_writes):
+        key = keys[int(RNG.integers(0, len(keys)))]
+        accesses.append(tree.update(key, _word(w + 200)))
+    return entries, tree, r_pre, accesses
+
+
+def test_tree_update_records_consistent_paths():
+    from ethrex_tpu.ops.merkle import fold_path_canonical
+
+    entries, tree, r_pre, accesses = _setup()
+    root = r_pre
+    for rec in accesses:
+        assert fold_path_canonical(
+            rec.index, rec.old_leaf_digest(), rec.siblings) == root
+        root = fold_path_canonical(
+            rec.index, rec.new_leaf_digest(), rec.siblings)
+    assert root == tree.root
+
+
+def test_trace_satisfies_constraints_and_binds_outputs():
+    entries, tree, r_pre, accesses = _setup(num_keys=4, num_writes=2,
+                                            depth=2)
+    depth, S = 2, 8
+    air = sua.StateUpdateAir(depth, seg_periods=S)
+    trace = sua.generate_state_update_trace(accesses, r_pre, depth, S)
+    n = trace.shape[0]
+    assert n == sua.segment_count(len(accesses)) * S * sua.PERIOD
+
+    pub = sua.state_update_public_inputs(accesses, r_pre, tree.root, S)
+    # boundary values actually appear in the trace
+    for row, col, val in air.boundaries(pub, n):
+        assert int(trace[row, col]) == val, (row, col)
+
+    periodic_cols = air.periodic_columns(n)
+    hops = HostExtOps()
+
+    def cons_at(tr, r):
+        local = [ext.h_from_base(int(v)) for v in tr[r]]
+        nxt = [ext.h_from_base(int(v)) for v in tr[(r + 1) % n]]
+        periodic = [ext.h_from_base(int(col[r % len(col)]))
+                    for col in periodic_cols]
+        return air.constraints(local, nxt, periodic, hops)
+
+    for r in range(n - 1):
+        cs = cons_at(trace, r)
+        bad = [i for i, c in enumerate(cs) if c != ext.ZERO_H]
+        assert not bad, f"row {r}: constraints {bad} nonzero"
+
+
+def test_tampered_write_breaks_constraints():
+    entries, tree, r_pre, accesses = _setup(num_keys=4, num_writes=2,
+                                            depth=2)
+    depth, S = 2, 8
+    air = sua.StateUpdateAir(depth, seg_periods=S)
+    trace = sua.generate_state_update_trace(accesses, r_pre, depth, S)
+    n = trace.shape[0]
+    periodic_cols = air.periodic_columns(n)
+    hops = HostExtOps()
+
+    def violated(tr):
+        for r in range(n - 1):
+            local = [ext.h_from_base(int(v)) for v in tr[r]]
+            nxt = [ext.h_from_base(int(v)) for v in tr[r + 1]]
+            periodic = [ext.h_from_base(int(col[r % len(col)]))
+                        for col in periodic_cols]
+            if any(c != ext.ZERO_H for c in
+                   air.constraints(local, nxt, periodic, hops)):
+                return True
+        return False
+
+    # flip one new-value msg limb in segment 0: the new-leaf sponge no
+    # longer matches the absorbed limbs -> some constraint must break
+    bad = trace.copy()
+    seg = slice(0, S * sua.PERIOD)
+    col = sua.MSG + 22
+    bad[seg, col] = (bad[seg, col] + 1) % bb.P
+    assert violated(bad)
+
+    # tamper the root chain: bump cur_root in segment 1
+    bad2 = trace.copy()
+    seg1 = slice(S * sua.PERIOD, 2 * S * sua.PERIOD)
+    bad2[seg1, sua.CUR_ROOT] = (bad2[seg1, sua.CUR_ROOT] + 1) % bb.P
+    assert violated(bad2)
+
+
+def test_prove_verify_roundtrip():
+    from ethrex_tpu.stark import prover as stark_prover
+    from ethrex_tpu.stark import verifier as stark_verifier
+    from ethrex_tpu.stark.prover import StarkParams
+
+    params = StarkParams(log_blowup=3, num_queries=25, log_final_size=4)
+    entries, tree, r_pre, accesses = _setup(num_keys=4, num_writes=3,
+                                            depth=2)
+    depth, S = 2, 8
+    air = sua.StateUpdateAir(depth, seg_periods=S)
+    trace = sua.generate_state_update_trace(accesses, r_pre, depth, S)
+    pub = sua.state_update_public_inputs(accesses, r_pre, tree.root, S)
+    proof = stark_prover.prove(air, trace, pub, params)
+    assert stark_verifier.verify(air, proof, params)
+
+    # a different claimed final root must not verify
+    bad_pub = list(pub)
+    bad_pub[8] = (bad_pub[8] + 1) % bb.P
+    bad = dict(proof)
+    bad["pub_inputs"] = bad_pub
+    with pytest.raises(stark_verifier.VerificationError):
+        stark_verifier.verify(air, bad, params)
