@@ -19,7 +19,7 @@ DEV_GENESIS = {
         "byzantiumBlock": 0, "constantinopleBlock": 0, "petersburgBlock": 0,
         "istanbulBlock": 0, "berlinBlock": 0, "londonBlock": 0,
         "mergeNetsplitBlock": 0, "terminalTotalDifficulty": 0,
-        "shanghaiTime": 0, "cancunTime": 0,
+        "shanghaiTime": 0, "cancunTime": 0, "pragueTime": 0,
     },
     "alloc": {
         # dev account (well-known test key
@@ -55,7 +55,16 @@ def main(argv=None):
                         default=0, help="Engine API port (0 = off)")
     parser.add_argument("--authrpc.jwtsecret", dest="jwt_path",
                         help="path to a hex-encoded 32-byte JWT secret")
+    parser.add_argument("--kzg-setup", dest="kzg_setup",
+                        help="path to the ceremony trusted_setup.json for "
+                        "the 0x0a precompile; CONSENSUS-CRITICAL: every "
+                        "node of a chain must use the same setup (default: "
+                        "the deterministic dev setup, crypto/kzg.py)")
     args = parser.parse_args(argv)
+    if args.kzg_setup:
+        from .crypto import kzg
+
+        kzg.set_setup(kzg.TrustedSetup.from_ceremony_json(args.kzg_setup))
 
     if args.genesis:
         with open(args.genesis) as f:

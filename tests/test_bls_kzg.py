@@ -1,0 +1,134 @@
+"""BLS12-381 curve/pairing, KZG commitments, and the EIP-2537/EIP-4844
+precompiles (parity: crates/common/crypto/{bls_blst.rs,kzg.rs} and
+crates/vm/levm/src/precompiles.rs BLS/point-eval entries)."""
+
+import pytest
+
+from ethrex_tpu.crypto import bls12_381 as bls
+from ethrex_tpu.crypto import kzg
+from ethrex_tpu.evm import precompiles as pc
+from ethrex_tpu.primitives.genesis import Fork
+
+
+def test_pairing_bilinear():
+    e = bls.pairing(bls.G1_GEN, bls.G2_GEN)
+    assert e != bls.Fp12.one()
+    assert e.pow(bls.R) == bls.Fp12.one()
+    lhs = bls.pairing(bls.g1_mul(bls.G1_GEN, 6),
+                      bls.g2_mul(bls.G2_GEN, 5))
+    assert lhs == e.pow(30)
+
+
+def test_point_serialization_roundtrip():
+    p = bls.g1_mul(bls.G1_GEN, 0xDEADBEEF)
+    q = bls.g2_mul(bls.G2_GEN, 0xCAFE)
+    assert bls.decode_g1(bls.encode_g1(p)) == p
+    assert bls.decode_g2(bls.encode_g2(q)) == q
+    assert bls.g1_decompress(bls.g1_compress(p)) == p
+    assert bls.g2_decompress(bls.g2_compress(q)) == q
+    # the canonical compressed generator (public constant)
+    assert bls.g1_compress(bls.G1_GEN).hex().startswith("97f1d3a73197d794")
+    with pytest.raises(bls.DecodeError):
+        bls.decode_g1(b"\x01" * 128)
+
+
+def test_kzg_commit_prove_verify():
+    setup = kzg.TrustedSetup.dev()
+    blob = kzg.evals_to_blob([7 * i + 3 for i in range(64)])
+    c = kzg.blob_to_kzg_commitment(blob, setup)
+    proof, y = kzg.compute_kzg_proof(blob, 99, setup)
+    assert kzg.verify_kzg_proof(c, 99, y, proof, setup)
+    assert not kzg.verify_kzg_proof(c, 99, (y + 1) % kzg.BLS_MODULUS,
+                                    proof, setup)
+    # blob-level proof (the committer's sidecar flow)
+    bp = kzg.compute_blob_kzg_proof(blob, c, setup)
+    assert kzg.verify_blob_kzg_proof(blob, c, bp, setup)
+    other = kzg.evals_to_blob([1])
+    assert not kzg.verify_blob_kzg_proof(other, c, bp, setup)
+
+
+def test_point_evaluation_precompile():
+    setup = kzg.TrustedSetup.dev()
+    kzg.set_setup(setup)
+    try:
+        blob = kzg.evals_to_blob(list(range(1, 33)))
+        c = kzg.blob_to_kzg_commitment(blob, setup)
+        z = 0x1234
+        proof, y = kzg.compute_kzg_proof(blob, z, setup)
+        inp = (kzg.commitment_to_versioned_hash(c)
+               + z.to_bytes(32, "big") + y.to_bytes(32, "big") + c + proof)
+        fn = pc.get_precompile(pc._a(10), Fork.CANCUN)
+        assert fn is not None
+        cost, out = fn(inp, 10**6, Fork.CANCUN)
+        assert cost == 50_000 and out == kzg.POINT_EVAL_OUTPUT
+        with pytest.raises(pc.PrecompileError):
+            bad = bytearray(inp)
+            bad[40] ^= 1  # z changes -> proof invalid
+            fn(bytes(bad), 10**6, Fork.CANCUN)
+        # not active before Cancun
+        assert pc.get_precompile(pc._a(10), Fork.SHANGHAI) is None
+    finally:
+        kzg.set_setup(None)
+
+
+def test_bls_precompiles_add_msm_pairing():
+    f = Fork.PRAGUE
+    g1 = bls.encode_g1(bls.G1_GEN)
+    two = bls.encode_g1(bls.g1_mul(bls.G1_GEN, 2))
+    add = pc.get_precompile(pc._a(0x0B), f)
+    cost, out = add(g1 + g1, 10**6, f)
+    assert cost == 375 and out == two
+    # infinity encoding
+    _, out0 = add(g1 + b"\x00" * 128, 10**6, f)
+    assert out0 == g1
+
+    msm = pc.get_precompile(pc._a(0x0C), f)
+    scalar = (3).to_bytes(32, "big")
+    cost, out = msm(g1 + scalar, 10**6, f)
+    assert cost == 12_000
+    assert out == bls.encode_g1(bls.g1_mul(bls.G1_GEN, 3))
+    # two-pair MSM with the k=2 discount
+    cost2, out2 = msm(g1 + scalar + two + scalar, 10**6, f)
+    assert cost2 == 2 * 12_000 * 949 // 1000
+    assert out2 == bls.encode_g1(bls.g1_mul(bls.G1_GEN, 9))
+
+    g2add = pc.get_precompile(pc._a(0x0D), f)
+    g2 = bls.encode_g2(bls.G2_GEN)
+    cost, out = g2add(g2 + b"\x00" * 256, 10**6, f)
+    assert cost == 600 and out == g2
+
+    g2msm = pc.get_precompile(pc._a(0x0E), f)
+    cost, out = g2msm(g2 + scalar, 10**6, f)
+    assert cost == 22_500
+    assert out == bls.encode_g2(bls.g2_mul(bls.G2_GEN, 3))
+
+    pairing = pc.get_precompile(pc._a(0x0F), f)
+    neg_g1 = bls.encode_g1((bls.G1_GEN[0], bls.P - bls.G1_GEN[1]))
+    ok_input = g1 + g2 + neg_g1 + g2
+    cost, out = pairing(ok_input, 10**6, f)
+    assert cost == 32_600 * 2 + 37_700
+    assert out == (1).to_bytes(32, "big")
+    _, out = pairing(g1 + g2, 10**6, f)
+    assert out == b"\x00" * 32
+
+    # malformed inputs raise
+    with pytest.raises(pc.PrecompileError):
+        add(g1, 10**6, f)
+    with pytest.raises(pc.PrecompileError):
+        msm(b"", 10**6, f)
+    # subgroup check enforced on MSM: a curve point outside G1's subgroup
+    x = 5
+    while True:
+        y2 = (x * x * x + 4) % bls.P
+        y = pow(y2, (bls.P + 1) // 4, bls.P)
+        if y * y % bls.P == y2:
+            cand = (x, y)
+            if not bls.g1_in_subgroup(cand):
+                break
+        x += 1
+    with pytest.raises(pc.PrecompileError):
+        msm(bls.encode_g1(cand) + scalar, 10**6, f)
+    # ...but ADD accepts it (EIP-2537: no subgroup check on ADD)
+    add(bls.encode_g1(cand) + g1, 10**6, f)
+    # not active before Prague
+    assert pc.get_precompile(pc._a(0x0B), Fork.CANCUN) is None
