@@ -1,0 +1,110 @@
+"""L2 batch blobs: EIP-4844 sidecar generation + state reconstruction.
+
+Parity: the reference committer packs the batch payload into blobs and
+commits with real KZG (crates/l2/sequencer/l1_committer.rs:1489
+generate_blobs_bundle; crates/common/types/blobs_bundle.rs), and rollup
+state can be rebuilt from those blobs alone
+(crates/l2/utils/state_reconstruct.rs).
+
+Packing: the payload (RLP of the batch's block list) is length-prefixed
+and split into 31-byte chunks, one per field element with a zero top byte
+— every 32-byte word is then canonically < BLS_MODULUS by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..crypto import kzg
+from ..primitives import rlp
+from ..primitives.block import Block
+
+BYTES_PER_ELEMENT = 31  # payload bytes per field element (top byte zero)
+PAYLOAD_PER_BLOB = BYTES_PER_ELEMENT * kzg.FIELD_ELEMENTS_PER_BLOB
+
+
+class BlobError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class BlobsBundle:
+    blobs: list[bytes]
+    commitments: list[bytes]
+    proofs: list[bytes]
+
+    @property
+    def versioned_hashes(self) -> list[bytes]:
+        return [kzg.commitment_to_versioned_hash(c)
+                for c in self.commitments]
+
+    def verify(self, setup=None) -> bool:
+        if not (len(self.blobs) == len(self.commitments)
+                == len(self.proofs)):
+            return False
+        return all(
+            kzg.verify_blob_kzg_proof(b, c, p, setup)
+            for b, c, p in zip(self.blobs, self.commitments, self.proofs))
+
+
+def pack_payload(payload: bytes) -> list[bytes]:
+    """Length-prefixed payload -> list of canonical blobs."""
+    framed = len(payload).to_bytes(8, "big") + payload
+    blobs = []
+    for off in range(0, len(framed), PAYLOAD_PER_BLOB):
+        chunk = framed[off:off + PAYLOAD_PER_BLOB]
+        blob = bytearray(kzg.BYTES_PER_BLOB)
+        for i in range(0, len(chunk), BYTES_PER_ELEMENT):
+            el = chunk[i:i + BYTES_PER_ELEMENT]
+            fe = i // BYTES_PER_ELEMENT
+            blob[fe * 32 + 1:fe * 32 + 1 + len(el)] = el
+        blobs.append(bytes(blob))
+    return blobs or [bytes(kzg.BYTES_PER_BLOB)]
+
+
+def unpack_payload(blobs: list[bytes]) -> bytes:
+    stream = bytearray()
+    for blob in blobs:
+        if len(blob) != kzg.BYTES_PER_BLOB:
+            raise BlobError("blob must be 131072 bytes")
+        for fe in range(kzg.FIELD_ELEMENTS_PER_BLOB):
+            word = blob[fe * 32:(fe + 1) * 32]
+            if word[0] != 0:
+                raise BlobError("non-canonical packed element")
+            stream += word[1:]
+    if len(stream) < 8:
+        raise BlobError("truncated payload")
+    size = int.from_bytes(stream[:8], "big")
+    if size > len(stream) - 8:
+        raise BlobError("payload length prefix exceeds blob data")
+    return bytes(stream[8:8 + size])
+
+
+def blocks_to_payload(blocks: list[Block]) -> bytes:
+    return rlp.encode([b.encode() for b in blocks])
+
+
+def payload_to_blocks(payload: bytes) -> list[Block]:
+    items = rlp.decode(payload)
+    if not isinstance(items, list):
+        raise BlobError("payload is not an RLP list")
+    return [Block.decode(bytes(item)) for item in items]
+
+
+def generate_blobs_bundle(blocks: list[Block], setup=None) -> BlobsBundle:
+    """The committer's sidecar: blocks -> blobs -> KZG commitments/proofs."""
+    blobs = pack_payload(blocks_to_payload(blocks))
+    commitments, proofs = [], []
+    for blob in blobs:
+        c = kzg.blob_to_kzg_commitment(blob, setup)
+        commitments.append(c)
+        proofs.append(kzg.compute_blob_kzg_proof(blob, c, setup))
+    return BlobsBundle(blobs=blobs, commitments=commitments, proofs=proofs)
+
+
+def reconstruct_blocks(bundle: BlobsBundle, setup=None) -> list[Block]:
+    """State reconstruction entry: verify the sidecar, then decode the
+    batch's blocks back out of the blob payload."""
+    if not bundle.verify(setup):
+        raise BlobError("blobs bundle failed KZG verification")
+    return payload_to_blocks(unpack_payload(bundle.blobs))

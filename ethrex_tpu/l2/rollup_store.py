@@ -25,6 +25,8 @@ class RollupStore:
         #   (batch_number, commit_hash_version) -> ProgramInput json
         self.proofs: dict[tuple[int, str], dict] = {}
         #   (batch_number, prover_type) -> proof
+        self.blobs: dict[int, object] = {}
+        #   batch_number -> BlobsBundle (the L1 data-availability sidecar)
         self.lock = threading.RLock()
 
     # ---------------- batches ----------------
@@ -50,6 +52,14 @@ class RollupStore:
             self.batches[number].verified = True
 
     # ---------------- prover inputs ----------------
+    def store_blobs_bundle(self, batch_number: int, bundle) -> None:
+        with self.lock:
+            self.blobs[batch_number] = bundle
+
+    def get_blobs_bundle(self, batch_number: int):
+        with self.lock:
+            return self.blobs.get(batch_number)
+
     def store_prover_input(self, batch_number: int, version: str,
                            program_input_json: dict):
         with self.lock:
