@@ -1,0 +1,175 @@
+"""ctypes wrapper for the native MPT engine (native/mpt.cpp) — the
+merkleize hot path of block import.
+
+The engine owns a persistent node map mirroring the Python node table and
+pulls nodes it lacks through a resolver upcall — one callback per unique
+node over the engine's lifetime, so repeated applies touch Python only
+for genuinely new paths.  Differentially tested against trie/trie.py
+(tests/test_native_mpt.py), which stays the behavioral reference.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+
+from ..crypto.keccak import keccak256
+from .trie import MissingNode
+
+_NATIVE_DIR = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "native"))
+_SO_PATH = os.path.join(_NATIVE_DIR, "libmpt.so")
+_SRC = [os.path.join(_NATIVE_DIR, "mpt.cpp"),
+        os.path.join(_NATIVE_DIR, "keccak.c")]
+
+_lib = None
+_lock = threading.Lock()
+_RESOLVER_TYPE = ctypes.CFUNCTYPE(ctypes.c_int,
+                                  ctypes.POINTER(ctypes.c_ubyte))
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+
+        def build():
+            # -x c: keccak.c must compile as C (unmangled keccak256)
+            subprocess.run(
+                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                 "-o", _SO_PATH, _SRC[0], "-x", "c", _SRC[1]],
+                check=True, capture_output=True)
+
+        def bind():
+            lib = ctypes.CDLL(_SO_PATH)
+            lib.mpt_new.restype = ctypes.c_void_p
+            lib.mpt_free.argtypes = [ctypes.c_void_p]
+            lib.mpt_set_resolver.argtypes = [ctypes.c_void_p,
+                                             _RESOLVER_TYPE]
+            lib.mpt_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_size_t]
+            lib.mpt_load.restype = ctypes.c_int
+            lib.mpt_apply.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_char_p, ctypes.c_size_t,
+                                      ctypes.c_char_p]
+            lib.mpt_apply.restype = ctypes.c_int
+            lib.mpt_missing.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_size_t]
+            lib.mpt_missing.restype = ctypes.c_int
+            lib.mpt_fresh_size.argtypes = [ctypes.c_void_p]
+            lib.mpt_fresh_size.restype = ctypes.c_size_t
+            lib.mpt_take_fresh.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                           ctypes.c_size_t]
+            lib.mpt_take_fresh.restype = ctypes.c_int
+            lib.mpt_node_count.argtypes = [ctypes.c_void_p]
+            lib.mpt_node_count.restype = ctypes.c_size_t
+            return lib
+
+        try:
+            newest_src = max(os.path.getmtime(p) for p in _SRC)
+            if not os.path.exists(_SO_PATH) or \
+                    os.path.getmtime(_SO_PATH) < newest_src:
+                build()
+            try:
+                _lib = bind()
+            except OSError:
+                build()
+                _lib = bind()
+        except (OSError, subprocess.CalledProcessError):
+            _lib = False
+        return _lib
+
+
+def available() -> bool:
+    return bool(_load())
+
+
+class NativeMpt:
+    """One engine instance per node table (Store or witness)."""
+
+    def __init__(self):
+        lib = _load()
+        if not lib:
+            raise RuntimeError("native mpt unavailable")
+        self._lib = lib
+        self._h = ctypes.c_void_p(lib.mpt_new())
+        self._known: set[bytes] = set()
+        self._table = None  # active node table during apply
+
+        def _resolve(hash_ptr):
+            h = bytes(hash_ptr[0:32])
+            raw = self._table.get(h) if self._table is not None else None
+            if raw is None:
+                return 0
+            raw = bytes(raw)
+            buf = struct.pack("<I", len(raw)) + raw
+            self._lib.mpt_load(self._h, buf, len(buf))
+            self._known.add(h)
+            return 1
+
+        # keep a reference: ctypes callbacks die with their wrapper object
+        self._resolver_cb = _RESOLVER_TYPE(_resolve)
+        lib.mpt_set_resolver(self._h, self._resolver_cb)
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.mpt_free(h)
+            self._h = None
+
+    def _feed(self, raws: list[bytes]) -> None:
+        raws = [r for r in raws
+                if keccak256(r) not in self._known]
+        if not raws:
+            return
+        buf = b"".join(struct.pack("<I", len(r)) + r for r in raws)
+        rc = self._lib.mpt_load(self._h, buf, len(buf))
+        if rc < 0:
+            raise RuntimeError("mpt_load rejected input")
+        for r in raws:
+            self._known.add(keccak256(r))
+
+    def apply(self, table, root: bytes, ops: list[tuple[bytes, bytes]]
+              ) -> bytes:
+        """Apply ordered (key, value) ops (empty value = delete) against
+        `root`; commit; persist new nodes back into `table`; return the
+        new root.  Raises MissingNode exactly like the Python trie when
+        the table lacks a required node."""
+        lib = self._lib
+        buf = b"".join(
+            struct.pack("<I", len(k)) + k + struct.pack("<I", len(v)) + v
+            for k, v in ops)
+        out = ctypes.create_string_buffer(32)
+        self._table = table
+        try:
+            rc = lib.mpt_apply(self._h, root, buf, len(buf), out)
+        finally:
+            self._table = None
+        if rc == 1:
+            miss_buf = ctypes.create_string_buffer(32 * 64)
+            n = lib.mpt_missing(self._h, miss_buf, len(miss_buf))
+            h = miss_buf.raw[:32] if n else b""
+            raise MissingNode(h.hex())
+        if rc != 0:
+            raise RuntimeError(f"mpt_apply failed rc={rc}")
+        size = lib.mpt_fresh_size(self._h)
+        if size:
+            fresh = ctypes.create_string_buffer(size)
+            n = lib.mpt_take_fresh(self._h, fresh, size)
+            if n < 0:
+                raise RuntimeError("mpt_take_fresh overflow")
+            pos = 0
+            raw = fresh.raw
+            for _ in range(n):
+                (ln,) = struct.unpack_from("<I", raw, pos)
+                pos += 4
+                node = raw[pos:pos + ln]
+                pos += ln
+                h = keccak256(node)
+                table[h] = node
+                self._known.add(h)
+        return bytes(out.raw)

@@ -1,0 +1,102 @@
+"""Native C++ MPT engine: differential equivalence against the Python
+trie (the behavioral reference), missing-node parity, and fresh-node
+persistence (parity seat: crates/common/trie + the reference's
+trie-optimization rounds, docs/l2/bench/prover_performance.md:63-75)."""
+
+import numpy as np
+import pytest
+
+from ethrex_tpu.crypto.keccak import keccak256
+from ethrex_tpu.primitives.account import EMPTY_TRIE_ROOT
+from ethrex_tpu.trie.native_mpt import NativeMpt, available
+from ethrex_tpu.trie.trie import MissingNode, Trie
+
+pytestmark = pytest.mark.skipif(not available(),
+                                reason="native mpt unavailable")
+
+RNG = np.random.default_rng(11)
+
+
+def _rand_key():
+    return bytes(RNG.integers(0, 256, 32, dtype=np.uint8))
+
+
+def _python_apply(table, root, ops):
+    t = Trie.from_nodes(root, dict(table), share=True)
+    for k, v in ops:
+        if v:
+            t.insert(k, v)
+    for k, v in ops:
+        if not v:
+            t.remove(k)
+    return t.commit()
+
+
+def test_differential_random_batches():
+    table = {}
+    root = EMPTY_TRIE_ROOT
+    native = NativeMpt()
+    live = []
+    for batch in range(6):
+        ops = []
+        for _ in range(80):
+            k = keccak256(_rand_key())
+            ops.append((k, b"val" + k[:6]))
+            live.append(k)
+        # delete some existing keys (inserts first, then deletes — the
+        # pruned-witness ordering rule of apply_updates_to_tries)
+        dels = [live.pop(RNG.integers(0, len(live)))
+                for _ in range(min(25, len(live) // 2))]
+        ops += [(k, b"") for k in dels]
+        expected = _python_apply(table, root, ops)
+        root = native.apply(table, root, ops)
+        assert root == expected, f"batch {batch} diverged"
+
+
+def test_variable_length_values_and_empty_trie():
+    table = {}
+    native = NativeMpt()
+    ops = [(keccak256(bytes([i])), bytes([i]) * (1 + 7 * i))
+           for i in range(40)]
+    root = native.apply(table, EMPTY_TRIE_ROOT, ops)
+    assert root == _python_apply({}, EMPTY_TRIE_ROOT, ops)
+    # delete everything -> back to the empty root
+    root = native.apply(table, root, [(k, b"") for k, _ in ops])
+    assert root == EMPTY_TRIE_ROOT
+
+
+def test_short_values_inline_nodes():
+    """Values < 32 bytes produce inline (<32B) nodes — the embedding
+    rules must match the Python encoder exactly."""
+    table = {}
+    native = NativeMpt()
+    ops = [(keccak256(bytes([i, j])), bytes([i]))
+           for i in range(6) for j in range(6)]
+    root = native.apply(table, EMPTY_TRIE_ROOT, ops)
+    assert root == _python_apply({}, EMPTY_TRIE_ROOT, ops)
+    # python trie reads the native-written nodes back
+    t = Trie.from_nodes(root, table, share=True)
+    assert t.get(keccak256(bytes([2, 3]))) == bytes([2])
+
+
+def test_missing_node_raises_like_python():
+    table = {}
+    py = Trie.from_nodes(EMPTY_TRIE_ROOT, table, share=True)
+    for i in range(100):
+        py.insert(keccak256(bytes([i])), b"v%d" % i)
+    root = py.commit()
+    pruned = dict(list(table.items())[:3])
+    native = NativeMpt()
+    with pytest.raises(MissingNode):
+        native.apply(pruned, root, [(keccak256(bytes([5])), b"x")])
+
+
+def test_fresh_nodes_persist_to_table():
+    table = {}
+    native = NativeMpt()
+    ops = [(keccak256(bytes([i])), b"value-%d" % i) for i in range(50)]
+    root = native.apply(table, EMPTY_TRIE_ROOT, ops)
+    # a fresh python trie over the SAME table resolves every path
+    t = Trie.from_nodes(root, table, share=True)
+    for i in range(50):
+        assert t.get(keccak256(bytes([i]))) == b"value-%d" % i

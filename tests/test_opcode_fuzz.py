@@ -1,0 +1,160 @@
+"""Differential opcode fuzzer: random straight-line programs over the
+arithmetic/bitwise/comparison opcode family, executed by the EVM and by an
+independent big-int reference evaluator written directly from the yellow-
+paper semantics.  Stand-in for the EF state fixtures (not shipped in this
+image) in the spirit of the reference's revm differential rerun
+(tooling/ef_tests/state/README.md)."""
+
+import numpy as np
+
+from tests.test_evm import _call, _state
+
+RNG = np.random.default_rng(1234)
+M = 1 << 256
+SIGN = 1 << 255
+
+
+def _sx(v):
+    """unsigned 256-bit -> signed"""
+    return v - M if v & SIGN else v
+
+
+def _ux(v):
+    return v % M
+
+
+def _byte(i, x):
+    return (x >> (8 * (31 - i))) & 0xFF if i < 32 else 0
+
+
+def _sar(shift, x):
+    s = _sx(x)
+    if shift >= 256:
+        return _ux(-1) if s < 0 else 0
+    return _ux(s >> shift)
+
+
+def _signextend(b, x):
+    if b >= 31:
+        return x
+    bit = 8 * (b + 1) - 1
+    if x & (1 << bit):
+        return _ux(x | (M - (1 << (bit + 1))))
+    return x & ((1 << (bit + 1)) - 1)
+
+
+# opcode -> (byte, arity, reference fn)
+OPS = {
+    "ADD": (0x01, 2, lambda a, b: _ux(a + b)),
+    "MUL": (0x02, 2, lambda a, b: _ux(a * b)),
+    "SUB": (0x03, 2, lambda a, b: _ux(a - b)),
+    "DIV": (0x04, 2, lambda a, b: a // b if b else 0),
+    "SDIV": (0x05, 2, lambda a, b: _ux(
+        0 if _sx(b) == 0 else
+        abs(_sx(a)) // abs(_sx(b)) * (1 if (_sx(a) < 0) == (_sx(b) < 0)
+                                      else -1))),
+    "MOD": (0x06, 2, lambda a, b: a % b if b else 0),
+    "SMOD": (0x07, 2, lambda a, b: _ux(
+        0 if _sx(b) == 0 else
+        abs(_sx(a)) % abs(_sx(b)) * (1 if _sx(a) >= 0 else -1))),
+    "ADDMOD": (0x08, 3, lambda a, b, n: (a + b) % n if n else 0),
+    "MULMOD": (0x09, 3, lambda a, b, n: (a * b) % n if n else 0),
+    "EXP": (0x0A, 2, lambda a, b: pow(a, b, M)),
+    "SIGNEXTEND": (0x0B, 2, lambda b, x: _signextend(b, x)
+                   if b < 32 else x),
+    "LT": (0x10, 2, lambda a, b: int(a < b)),
+    "GT": (0x11, 2, lambda a, b: int(a > b)),
+    "SLT": (0x12, 2, lambda a, b: int(_sx(a) < _sx(b))),
+    "SGT": (0x13, 2, lambda a, b: int(_sx(a) > _sx(b))),
+    "EQ": (0x14, 2, lambda a, b: int(a == b)),
+    "ISZERO": (0x15, 1, lambda a: int(a == 0)),
+    "AND": (0x16, 2, lambda a, b: a & b),
+    "OR": (0x17, 2, lambda a, b: a | b),
+    "XOR": (0x18, 2, lambda a, b: a ^ b),
+    "NOT": (0x19, 1, lambda a: a ^ (M - 1)),
+    "BYTE": (0x1A, 2, lambda i, x: _byte(i, x)),
+    "SHL": (0x1B, 2, lambda s, x: _ux(x << s) if s < 256 else 0),
+    "SHR": (0x1C, 2, lambda s, x: x >> s if s < 256 else 0),
+    "SAR": (0x1D, 2, _sar),
+}
+NAMES = list(OPS)
+
+
+def _interesting_word():
+    kind = RNG.integers(0, 6)
+    if kind == 0:
+        return int(RNG.integers(0, 256))          # tiny (shift counts)
+    if kind == 1:
+        return int(RNG.integers(0, 1 << 16))
+    if kind == 2:
+        return M - 1 - int(RNG.integers(0, 3))    # near -1
+    if kind == 3:
+        return SIGN - int(RNG.integers(0, 2))     # sign boundary
+    if kind == 4:
+        return (1 << int(RNG.integers(1, 256))) - int(RNG.integers(0, 2))
+    return int.from_bytes(RNG.integers(0, 256, 32, dtype=np.uint8)
+                          .tobytes(), "big")
+
+
+def _push(v):
+    if v == 0:
+        return bytes([0x5F])                       # PUSH0
+    raw = v.to_bytes((v.bit_length() + 7) // 8, "big")
+    return bytes([0x5F + len(raw)]) + raw
+
+
+def _gen_program(n_ops):
+    """Random program; returns (code, reference stack evaluator result)."""
+    code = bytearray()
+    stack = []
+    for _ in range(n_ops):
+        # keep at least 3 words available; push with some probability
+        if len(stack) < 3 or RNG.random() < 0.35:
+            v = _interesting_word()
+            code += _push(v)
+            stack.append(v)
+            continue
+        name = NAMES[int(RNG.integers(0, len(NAMES)))]
+        op, arity, fn = OPS[name]
+        if len(stack) < arity:
+            continue
+        args = [stack.pop() for _ in range(arity)]
+        code.append(op)
+        stack.append(_ux(int(fn(*args))))
+    # XOR-fold the stack so every produced word matters
+    while len(stack) > 1:
+        code.append(0x18)
+        a, b = stack.pop(), stack.pop()
+        stack.append(a ^ b)
+    # MSTORE(0, result); RETURN(0, 32)
+    code += bytes.fromhex("5f52" + "60205ff3")
+    return bytes(code), stack[0]
+
+
+def test_differential_random_programs():
+    mismatches = []
+    for trial in range(300):
+        n_ops = int(RNG.integers(4, 40))
+        code, expected = _gen_program(n_ops)
+        ok, _, out = _call(_state(bytes(code)), gas=5_000_000)
+        if not ok:
+            mismatches.append((trial, code.hex(), "execution failed"))
+            continue
+        got = int.from_bytes(out, "big")
+        if got != expected:
+            mismatches.append((trial, code.hex(),
+                               f"got {got:#x} want {expected:#x}"))
+    assert not mismatches, mismatches[:3]
+
+
+def test_differential_exp_edges():
+    """EXP with large exponents (gas-heavy, run fewer)."""
+    for _ in range(40):
+        base = _interesting_word()
+        exp = _interesting_word()
+        code = _push(exp) + _push(base) + bytes([0x0A]) \
+            + bytes.fromhex("5f5260205ff3")
+        ok, _, out = _call(_state(bytes(code)), gas=10_000_000)
+        assert ok
+        assert int.from_bytes(out, "big") == pow(base, exp, M), \
+            (hex(base), hex(exp))
