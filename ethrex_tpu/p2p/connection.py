@@ -216,6 +216,12 @@ class RlpxPeer:
         payload = snap.encode_get_byte_codes(rid, hashes)
         return self.request(snap.GET_BYTE_CODES, payload, rid)
 
+    def snap_get_trie_nodes(self, root: bytes, paths):
+        self._require_snap()
+        rid = self._next_request_id()
+        payload = snap.encode_get_trie_nodes(rid, root, paths)
+        return self.request(snap.GET_TRIE_NODES, payload, rid)
+
     def announce_pooled_txs(self, txs):
         for tx in txs:
             self._mark_known_tx(tx.hash)
@@ -370,6 +376,14 @@ class RlpxPeer:
         elif msg_id == snap.BYTE_CODES:
             rid, codes = snap.decode_byte_codes(payload)
             self._resolve(rid, codes)
+        elif msg_id == snap.GET_TRIE_NODES:
+            rid, root, paths = snap.decode_get_trie_nodes(payload)
+            nodes = snap.serve_trie_nodes(store, root, paths)
+            self.send_msg(snap.TRIE_NODES,
+                          snap.encode_trie_nodes(rid, nodes))
+        elif msg_id == snap.TRIE_NODES:
+            rid, nodes = snap.decode_trie_nodes(payload)
+            self._resolve(rid, nodes)
         elif msg_id == eth_wire.NEW_BLOCK_HASHES:
             # [[hash, number], ...]: fetch-and-import what we don't have.
             # The fetch MUST NOT run on this reader thread — request()
@@ -385,6 +399,9 @@ class RlpxPeer:
                 self._start_catch_up()
         elif msg_id == eth_wire.NEW_BLOCK:
             block, _td = eth_wire.decode_new_block(payload)
+            # remember the peer's freshest announced head: snap-sync pivot
+            # selection must not reuse the handshake-time status forever
+            self.remote_head_hash = block.hash
             try:
                 imported = self.node.import_block(block)
             except Exception as e:  # noqa: BLE001 — invalid blocks dropped

@@ -22,6 +22,8 @@ GET_STORAGE_RANGES = SNAP_OFFSET + 0x02
 STORAGE_RANGES = SNAP_OFFSET + 0x03
 GET_BYTE_CODES = SNAP_OFFSET + 0x04
 BYTE_CODES = SNAP_OFFSET + 0x05
+GET_TRIE_NODES = SNAP_OFFSET + 0x06
+TRIE_NODES = SNAP_OFFSET + 0x07
 
 MAX_RESPONSE_ITEMS = 512
 
@@ -105,6 +107,30 @@ def decode_byte_codes(payload: bytes):
     return rlp.decode_int(f[0]), [bytes(c) for c in f[1]]
 
 
+def encode_get_trie_nodes(request_id: int, root: bytes, paths) -> bytes:
+    """paths: list of path-sets — [nibbles_bytes] addresses a state-trie
+    node, [account_hash32, nibbles_bytes] a storage-trie node (nibbles
+    packed one per byte; healing only addresses hash-referenced child
+    positions, which always sit on node boundaries)."""
+    return rlp.encode([request_id, root,
+                       [[bytes(p) for p in ps] for ps in paths]])
+
+
+def decode_get_trie_nodes(payload: bytes):
+    f = rlp.decode(payload)
+    return (rlp.decode_int(f[0]), bytes(f[1]),
+            [[bytes(p) for p in ps] for ps in f[2]])
+
+
+def encode_trie_nodes(request_id: int, nodes) -> bytes:
+    return rlp.encode([request_id, [bytes(n) for n in nodes]])
+
+
+def decode_trie_nodes(payload: bytes):
+    f = rlp.decode(payload)
+    return rlp.decode_int(f[0]), [bytes(n) for n in f[1]]
+
+
 # ---------------------------------------------------------------------------
 # server side (answers from a node's Store)
 # ---------------------------------------------------------------------------
@@ -159,6 +185,65 @@ def serve_storage_range(store, state_root: bytes, account_hash: bytes,
     except MissingNode:
         return [], []
     return slots, list(proof.values())
+
+
+def node_at_path(node_table, root_hash: bytes, nibbles: bytes):
+    """Walk raw encoded nodes from `root_hash` along `nibbles` (one per
+    byte); returns the encoded node at that exact position or None.
+    Healing only addresses hash-referenced children, so paths always land
+    on node boundaries; inline children travel with their parent."""
+    cur = node_table.get(root_hash)
+    path = list(nibbles)
+    while cur is not None:
+        if not path:
+            return cur
+        item = rlp.decode(cur)
+        if isinstance(item, list) and len(item) == 17:
+            child = item[path.pop(0)]
+            if isinstance(child, list) or len(child) != 32:
+                return None
+            cur = node_table.get(bytes(child))
+        elif isinstance(item, list) and len(item) == 2:
+            from ..trie.trie import hp_decode
+
+            nib, is_leaf = hp_decode(bytes(item[0]))
+            if is_leaf or list(nib) != path[:len(nib)]:
+                return None
+            path = path[len(nib):]
+            # an empty remainder now addresses the extension's child —
+            # a real node boundary (the healer enqueues exactly these)
+            child = item[1]
+            if isinstance(child, list) or len(child) != 32:
+                return None
+            cur = node_table.get(bytes(child))
+        else:
+            return None
+    return None
+
+
+def serve_trie_nodes(store, root: bytes, paths):
+    """Answer a healing request: resolve each path-set against the state
+    (or an account's storage) trie; unknown entries are skipped (the
+    requester retries elsewhere)."""
+    out = []
+    for ps in paths[:MAX_RESPONSE_ITEMS]:
+        node = None
+        if len(ps) == 1:
+            node = node_at_path(store.nodes, root, ps[0])
+        elif len(ps) == 2:
+            trie = Trie.from_nodes(root, store.nodes, share=True)
+            from ..trie.trie import MissingNode
+
+            try:
+                raw = trie.get(ps[0])
+            except MissingNode:
+                raw = None
+            if raw:
+                acct = AccountState.decode(raw)
+                node = node_at_path(store.nodes, acct.storage_root, ps[1])
+        if node is not None:
+            out.append(node)
+    return out
 
 
 def _nibbles_to_key(path) -> bytes:

@@ -105,3 +105,179 @@ def test_snap_sync_rejects_wrong_root():
         srv_c.stop()
         server_node.stop()
         client_node.stop()
+
+
+# ---------------------------------------------------------------------------
+# SnapSyncer state machine: resume, re-pivot, healing
+# (parity: crates/networking/p2p/sync/snap_sync.rs + sync/healing/)
+# ---------------------------------------------------------------------------
+
+def _state_matches(client_node, server_node, root):
+    """Every account + storage slot + code at `root` is present and equal
+    on the client (walked via the client's own tries)."""
+    from ethrex_tpu.primitives.account import (AccountState,
+                                               EMPTY_CODE_HASH,
+                                               EMPTY_TRIE_ROOT)
+    from ethrex_tpu.trie.trie import Trie
+
+    server = Trie.from_nodes(root, server_node.store.nodes, share=True)
+    client = Trie.from_nodes(root, client_node.store.nodes, share=True)
+    count = 0
+    for path, body in server.iter_from(b"\x00" * 32, max_items=10_000):
+        key = bytes((path[i] << 4) | path[i + 1]
+                    for i in range(0, len(path), 2))
+        assert client.get(key) == body, f"account {key.hex()} differs"
+        acct = AccountState.decode(body)
+        if acct.storage_root != EMPTY_TRIE_ROOT:
+            sserver = Trie.from_nodes(acct.storage_root,
+                                      server_node.store.nodes, share=True)
+            sclient = Trie.from_nodes(acct.storage_root,
+                                      client_node.store.nodes, share=True)
+            for sp, sv in sserver.iter_from(b"\x00" * 32, max_items=10_000):
+                sk = bytes((sp[i] << 4) | sp[i + 1]
+                           for i in range(0, len(sp), 2))
+                assert sclient.get(sk) == sv
+        if acct.code_hash != EMPTY_CODE_HASH:
+            assert acct.code_hash in client_node.store.code
+        count += 1
+    return count
+
+
+def test_snap_syncer_completes_and_resumes(monkeypatch):
+    import ethrex_tpu.p2p.snap as snap_mod
+    import ethrex_tpu.p2p.snap_sync as ss_mod
+    from ethrex_tpu.p2p.snap_sync import SnapSyncer
+
+    # small windows so the test chain spans several account ranges
+    monkeypatch.setattr(snap_mod, "MAX_RESPONSE_ITEMS", 16)
+    monkeypatch.setattr(ss_mod, "MAX_RESPONSE_ITEMS", 16)
+    server_node = _rich_chain()
+    client_node = Node(Genesis.from_json(GENESIS))
+    srv_s = P2PServer(server_node).start()
+    srv_c = P2PServer(client_node).start()
+    try:
+        peer = srv_c.dial(srv_s.host, srv_s.port, srv_s.pub)
+
+        # fail the peer after 2 account-range answers -> progress persists
+        class Flaky:
+            def __init__(self, inner):
+                self.inner = inner
+                self.ranges = 0
+
+            def __getattr__(self, name):
+                return getattr(self.inner, name)
+
+            def snap_get_account_range(self, *a):
+                self.ranges += 1
+                if self.ranges > 2:
+                    raise RuntimeError("simulated disconnect")
+                return self.inner.snap_get_account_range(*a)
+
+        try:
+            SnapSyncer(client_node).run(Flaky(peer))
+        except RuntimeError:
+            pass
+        saved = client_node.store.meta.get("snap_sync")
+        assert saved is not None, "progress must persist across failures"
+
+        # a NEW syncer (fresh process semantics) resumes and completes
+        syncer = SnapSyncer(client_node)
+        assert syncer.progress["pivot_root"] is not None
+        summary = syncer.run(peer)
+        assert summary["phase"] == "done"
+        root = server_node.store.head_header().state_root
+        assert _state_matches(client_node, server_node, root) >= 42
+        assert client_node.store.meta.get("snap_sync") is None
+    finally:
+        srv_s.stop()
+        srv_c.stop()
+
+
+def test_snap_syncer_repivots_and_heals(monkeypatch):
+    import ethrex_tpu.p2p.snap as snap_mod
+    import ethrex_tpu.p2p.snap_sync as ss_mod
+    from ethrex_tpu.p2p.snap_sync import SnapSyncer
+
+    monkeypatch.setattr(snap_mod, "MAX_RESPONSE_ITEMS", 16)
+    monkeypatch.setattr(ss_mod, "MAX_RESPONSE_ITEMS", 16)
+    server_node = _rich_chain()
+    client_node = Node(Genesis.from_json(GENESIS))
+    srv_s = P2PServer(server_node).start()
+    srv_c = P2PServer(client_node).start()
+    try:
+        peer = srv_c.dial(srv_s.host, srv_s.port, srv_s.pub)
+        old_root = server_node.store.head_header().state_root
+
+        class StalePivot:
+            """Serves 1 range against the first pivot, then advances the
+            chain and refuses the old root (pruned-peer behavior)."""
+
+            def __init__(self, inner):
+                self.inner = inner
+                self.ranges = 0
+                self.advanced = False
+
+            def __getattr__(self, name):
+                return getattr(self.inner, name)
+
+            def snap_get_account_range(self, root, origin, limit):
+                self.ranges += 1
+                if self.ranges > 1 and not self.advanced:
+                    # the chain moves on: more balances change state
+                    # (sender nonce after _rich_chain: 40 sprays + 1 deploy)
+                    for i in range(6):
+                        server_node.submit_transaction(Transaction(
+                            tx_type=TYPE_DYNAMIC_FEE, chain_id=1337,
+                            nonce=41 + i, max_priority_fee_per_gas=1,
+                            max_fee_per_gas=10**10, gas_limit=21000,
+                            to=bytes([0x50 + i]) * 20, value=999_999,
+                        ).sign(SECRET))
+                    blk = server_node.produce_block()
+                    assert len(blk.body.transactions) == 6
+                    self.advanced = True
+                if self.advanced and root == old_root:
+                    return [], []      # stale: peer pruned the old pivot
+                return self.inner.snap_get_account_range(root, origin,
+                                                         limit)
+
+        wrapper = StalePivot(peer)
+        syncer = SnapSyncer(client_node)
+        summary = syncer.run(wrapper)
+        assert summary["phase"] == "done"
+        assert summary["repivots"] >= 1
+        assert summary["healed"] > 0, "mixed pivots must trigger healing"
+        new_root = server_node.store.head_header().state_root
+        assert new_root != old_root
+        assert _state_matches(client_node, server_node, new_root) >= 42
+    finally:
+        srv_s.stop()
+        srv_c.stop()
+
+
+def test_node_at_path_extension_boundary():
+    """Healing regression: a path landing exactly on an extension node's
+    hash child must resolve (extensions arise whenever keys share nibble
+    prefixes)."""
+    from ethrex_tpu.crypto.keccak import keccak256
+    from ethrex_tpu.p2p.snap import node_at_path
+    from ethrex_tpu.primitives.account import EMPTY_TRIE_ROOT
+    from ethrex_tpu.primitives import rlp as _rlp
+    from ethrex_tpu.trie.trie import Trie
+
+    nodes = {}
+    t = Trie.from_nodes(EMPTY_TRIE_ROOT, nodes, share=True)
+    # shared 10-nibble prefix -> root extension over a branch
+    t.insert(bytes.fromhex("aabbccddee" + "00" * 27), b"value-one" * 8)
+    t.insert(bytes.fromhex("aabbccddee" + "ff" * 27), b"value-two" * 8)
+    root = t.commit()
+    root_node = nodes[root]
+    item = _rlp.decode(root_node)
+    assert len(item) == 2, "expected a root extension node"
+    child_hash = bytes(item[1])
+    assert len(child_hash) == 32
+    # the extension's nibbles, one per byte (path of its child)
+    from ethrex_tpu.trie.trie import hp_decode
+    nib, is_leaf = hp_decode(bytes(item[0]))
+    assert not is_leaf
+    got = node_at_path(nodes, root, bytes(nib))
+    assert got is not None and keccak256(got) == child_hash
