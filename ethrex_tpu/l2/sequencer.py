@@ -8,8 +8,11 @@ L1Client.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time
+
+log = logging.getLogger("ethrex_tpu.l2.sequencer")
 
 from ..crypto.keccak import keccak256
 from ..guest.execution import ProgramInput
@@ -30,6 +33,34 @@ class SequencerConfig:
     watcher_interval: float = 1.0
     needed_prover_types: tuple = (protocol.PROVER_TPU,)
     commit_hash: str = protocol.PROTOCOL_VERSION
+    # failure handling (reference: the fatal-subsystem cancellation token
+    # pattern, cmd/ethrex/ethrex.rs, + per-actor health endpoints)
+    max_actor_failures: int = 10
+    max_backoff_factor: int = 32
+
+
+@dataclasses.dataclass
+class ActorHealth:
+    """Per-actor failure/backoff state, exposed via ethrex_health."""
+
+    name: str
+    runs: int = 0
+    consecutive_failures: int = 0
+    last_error: str | None = None
+    last_success: float | None = None
+
+    @property
+    def healthy(self) -> bool:
+        return self.consecutive_failures == 0
+
+    def to_json(self) -> dict:
+        return {
+            "healthy": self.healthy,
+            "runs": self.runs,
+            "consecutiveFailures": self.consecutive_failures,
+            "lastError": self.last_error,
+            "lastSuccess": self.last_success,
+        }
 
 
 class Sequencer:
@@ -50,6 +81,9 @@ class Sequencer:
         self.pending_privileged: list[Transaction] = []
         self.last_batched_block = 0
         self._lock = threading.RLock()
+        self.health: dict[str, ActorHealth] = {}
+        self.fatal: tuple[str, str] | None = None
+        self.on_fatal = None  # callback(actor, error) for orchestrators
 
     # ------------------------------------------------------------------
     # BlockProducer (reference: block_producer.rs produce_block)
@@ -200,12 +234,46 @@ class Sequencer:
         self.coordinator.start()
 
         def loop(interval, fn):
+            st = ActorHealth(fn.__name__)
+            self.health[st.name] = st
+
             def run():
-                while not self._stop.wait(interval):
+                while True:
+                    # exponential backoff while an actor keeps failing
+                    factor = min(1 << st.consecutive_failures,
+                                 self.cfg.max_backoff_factor)
+                    if self._stop.wait(interval * factor):
+                        return
                     try:
                         fn()
+                        st.runs += 1
+                        st.consecutive_failures = 0
+                        st.last_success = time.time()
                     except Exception as e:  # noqa: BLE001 — actors survive
-                        print(f"sequencer actor error ({fn.__name__}): {e}")
+                        st.consecutive_failures += 1
+                        st.last_error = f"{type(e).__name__}: {e}"
+                        log.warning("sequencer actor %s failed (%d/%d): %s",
+                                    st.name, st.consecutive_failures,
+                                    self.cfg.max_actor_failures,
+                                    st.last_error)
+                        if st.consecutive_failures >= \
+                                self.cfg.max_actor_failures:
+                            # fatal subsystem: cancel the whole sequencer
+                            # (reference: cancellation token -> non-zero
+                            # exit, ethrex.rs:208)
+                            self.fatal = (st.name, st.last_error)
+                            log.error("sequencer actor %s is fatally "
+                                      "failing; stopping all actors",
+                                      st.name)
+                            self._stop.set()
+                            cb = self.on_fatal
+                            if cb is not None:
+                                cb(st.name, st.last_error)
+                            try:
+                                self.coordinator.stop()
+                            except Exception:  # noqa: BLE001 — not started
+                                pass
+                            return
             t = threading.Thread(target=run, daemon=True)
             t.start()
             self._threads.append(t)

@@ -267,5 +267,8 @@ def _health(node):
             "latestBatch": seq.rollup.latest_batch_number(),
             "lastBatchedBlock": seq.last_batched_block,
             "pendingPrivileged": len(seq.pending_privileged),
+            "actors": {name: st.to_json()
+                       for name, st in seq.health.items()},
+            "fatal": list(seq.fatal) if seq.fatal else None,
         }
     return out

@@ -193,3 +193,57 @@ def test_sequencer_timers_smoke():
         prover.stop()
         seq.stop()
         node2.stop()
+
+
+def test_actor_backoff_and_fatal_cancellation():
+    """A persistently failing actor backs off exponentially, then fatally
+    cancels the whole sequencer (reference: the cancellation-token ->
+    non-zero-exit pattern, cmd/ethrex/ethrex.rs)."""
+    import time as _time
+
+    from ethrex_tpu.l2.sequencer import Sequencer, SequencerConfig
+    from ethrex_tpu.l2.l1_client import InMemoryL1
+
+    node = Node(Genesis.from_json(GENESIS))
+    l1 = InMemoryL1([protocol.PROVER_EXEC])
+    cfg = SequencerConfig(block_time=0.01, commit_interval=0.01,
+                          proof_send_interval=0.01, watcher_interval=0.01,
+                          needed_prover_types=(protocol.PROVER_EXEC,),
+                          max_actor_failures=3, max_backoff_factor=4)
+    seq = Sequencer(node, l1, cfg)
+
+    boom_calls = []
+
+    def boom():
+        boom_calls.append(_time.time())
+        raise RuntimeError("always failing")
+
+    boom.__name__ = "boom"
+    seq.commit_next_batch = boom
+    fatal_seen = []
+    seq.on_fatal = lambda actor, err: fatal_seen.append((actor, err))
+    seq.start()
+    try:
+        deadline = _time.time() + 10
+        while seq.fatal is None and _time.time() < deadline:
+            _time.sleep(0.05)
+        assert seq.fatal is not None, "fatal cancellation did not fire"
+        assert seq.fatal[0] == "boom"
+        assert fatal_seen and "always failing" in fatal_seen[0][1]
+        assert len(boom_calls) == 3
+        # backoff: gaps grow between consecutive failures
+        gaps = [b - a for a, b in zip(boom_calls, boom_calls[1:])]
+        assert gaps[-1] > gaps[0]
+        # health reflects the failure
+        st = seq.health["boom"]
+        assert not st.healthy and "always failing" in st.last_error
+        # cancellation stopped the other actors too (allow in-flight
+        # actor bodies to finish their current run)
+        deadline2 = _time.time() + 5
+        while _time.time() < deadline2 and \
+                any(t.is_alive() for t in seq._threads):
+            _time.sleep(0.05)
+        assert all(not t.is_alive() for t in seq._threads)
+    finally:
+        seq.stop()
+        node.stop()
