@@ -1,0 +1,188 @@
+"""Outbound HTTP JSON-RPC EthClient with retry + exponential gas bumping.
+
+Parity target: the reference sequencer's EthClient
+(crates/networking/rpc/clients/eth — retrying transport,
+send_tx_bump_gas_exponential_backoff used by the L1 committer,
+l1_committer.rs:42).  Speaks to any execution JSON-RPC endpoint —
+dogfooded against this repo's own node in the L2 tests.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import urllib.error
+import urllib.request
+
+from ..crypto import secp256k1
+from ..primitives.transaction import TYPE_DYNAMIC_FEE, Transaction
+
+log = logging.getLogger("ethrex_tpu.l2.eth_client")
+
+
+class RpcError(Exception):
+    """JSON-RPC level error (the node answered with an error object)."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(f"rpc error {code}: {message}")
+        self.code = code
+        self.message = message
+
+
+class TransportError(Exception):
+    """Network/transport failure (retriable)."""
+
+
+class EthClient:
+    def __init__(self, url: str, timeout: float = 10.0, retries: int = 3,
+                 retry_backoff: float = 0.5):
+        self.url = url
+        self.timeout = timeout
+        self.retries = retries
+        self.retry_backoff = retry_backoff
+        self._id = 0
+
+    # ---------------- transport ----------------
+    def call(self, method: str, params: list):
+        """One JSON-RPC call with transport-level retries (rpc errors are
+        NOT retried — the node answered authoritatively)."""
+        self._id += 1
+        body = json.dumps({"jsonrpc": "2.0", "id": self._id,
+                           "method": method, "params": params}).encode()
+        last = None
+        for attempt in range(self.retries):
+            if attempt:
+                time.sleep(self.retry_backoff * (2 ** (attempt - 1)))
+            try:
+                req = urllib.request.Request(
+                    self.url, data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout) as resp:
+                    obj = json.loads(resp.read())
+                if "error" in obj and obj["error"] is not None:
+                    err = obj["error"]
+                    raise RpcError(err.get("code", -1),
+                                   err.get("message", ""))
+                return obj.get("result")
+            except (urllib.error.URLError, OSError, TimeoutError,
+                    json.JSONDecodeError) as e:
+                last = e
+                log.warning("rpc transport failure (%d/%d): %s",
+                            attempt + 1, self.retries, e)
+        raise TransportError(f"{self.url}: {last}")
+
+    # ---------------- reads ----------------
+    def block_number(self) -> int:
+        return int(self.call("eth_blockNumber", []), 16)
+
+    def chain_id(self) -> int:
+        return int(self.call("eth_chainId", []), 16)
+
+    def gas_price(self) -> int:
+        return int(self.call("eth_gasPrice", []), 16)
+
+    def get_nonce(self, address: bytes, tag: str = "pending") -> int:
+        return int(self.call("eth_getTransactionCount",
+                             ["0x" + address.hex(), tag]), 16)
+
+    def get_balance(self, address: bytes) -> int:
+        return int(self.call("eth_getBalance",
+                             ["0x" + address.hex(), "latest"]), 16)
+
+    def eth_call(self, to: bytes, data: bytes, tag: str = "latest") -> bytes:
+        out = self.call("eth_call", [{"to": "0x" + to.hex(),
+                                      "data": "0x" + data.hex()}, tag])
+        return bytes.fromhex(out[2:]) if out and out != "0x" else b""
+
+    def get_receipt(self, tx_hash: bytes):
+        return self.call("eth_getTransactionReceipt",
+                         ["0x" + tx_hash.hex()])
+
+    def get_logs(self, address: bytes, from_block: int,
+                 to_block: int | str = "latest", topics=None) -> list:
+        flt = {"address": "0x" + address.hex(), "fromBlock": hex(from_block),
+               "toBlock": to_block if isinstance(to_block, str)
+               else hex(to_block)}
+        if topics:
+            flt["topics"] = ["0x" + t.hex() for t in topics]
+        return self.call("eth_getLogs", [flt]) or []
+
+    # ---------------- transaction path ----------------
+    def send_raw(self, raw: bytes) -> bytes:
+        out = self.call("eth_sendRawTransaction", ["0x" + raw.hex()])
+        return bytes.fromhex(out[2:])
+
+    def send_tx_bump_gas_exponential_backoff(
+            self, secret: int, to: bytes | None, data: bytes = b"",
+            value: int = 0, gas_limit: int = 500_000,
+            max_attempts: int = 6, receipt_timeout: float = 15.0,
+            poll_interval: float = 0.25) -> dict:
+        """The committer's send seam (reference l1_committer.rs:42):
+        sign with the current pending nonce, submit, wait for the
+        receipt; on underpriced/replacement rejections or a stuck
+        mempool, bump fees exponentially and resubmit with the SAME
+        nonce.  Returns the receipt; raises on definitive failure."""
+        sender = secp256k1.pubkey_to_address(
+            secp256k1.pubkey_from_secret(secret))
+        chain_id = self.chain_id()
+        nonce = self.get_nonce(sender)
+        max_fee = max(self.gas_price(), 8)
+        tip = 1
+        last_err: Exception | None = None
+        attempted: list[bytes] = []  # every hash sent under this nonce
+
+        def any_receipt():
+            # earlier same-nonce attempts can mine after we bumped —
+            # a receipt for ANY of them is success
+            for h in reversed(attempted):
+                rec = self.get_receipt(h)
+                if rec is not None:
+                    return rec
+            return None
+
+        for attempt in range(max_attempts):
+            tx = Transaction(
+                tx_type=TYPE_DYNAMIC_FEE, chain_id=chain_id, nonce=nonce,
+                max_priority_fee_per_gas=tip, max_fee_per_gas=max_fee,
+                gas_limit=gas_limit, to=to or b"", value=value, data=data,
+            ).sign(secret)
+            attempted.append(tx.hash)
+            try:
+                self.send_raw(tx.encode_canonical())
+            except RpcError as e:
+                # underpriced / replacement-underpriced / fee-too-low:
+                # bump and retry with the same nonce; anything else that
+                # is not "already known" is definitive
+                msg = e.message.lower()
+                if "nonce too low" in msg:
+                    rec = any_receipt()
+                    if rec is not None:
+                        return rec
+                elif "underpriced" in msg or "fee" in msg \
+                        or "replacement" in msg:
+                    last_err = e
+                    max_fee *= 2
+                    tip *= 2
+                    log.info("gas bump (attempt %d): max_fee=%d",
+                             attempt + 1, max_fee)
+                    continue
+                elif "already known" not in msg:
+                    raise
+            deadline = time.time() + receipt_timeout
+            while time.time() < deadline:
+                rec = any_receipt()
+                if rec is not None:
+                    return rec
+                time.sleep(poll_interval)
+            # receipt never appeared: bump fees, same nonce
+            last_err = TransportError("tx not mined before timeout")
+            max_fee *= 2
+            tip *= 2
+            log.info("tx stuck; gas bump (attempt %d): max_fee=%d",
+                     attempt + 1, max_fee)
+        rec = any_receipt()
+        if rec is not None:
+            return rec
+        raise TransportError(f"transaction never mined: {last_err}")

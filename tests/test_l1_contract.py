@@ -1,0 +1,136 @@
+"""Real L1 settlement seam: the hand-assembled bridge contract driven
+over HTTP JSON-RPC by the retrying/gas-bumping EthClient, and the full L2
+sequencer pipeline settling against it (parity:
+crates/l2/contracts/src/l1/OnChainProposer.sol + CommonBridge.sol and
+the EthClient tx path, l1_committer.rs:42)."""
+
+import time
+
+import pytest
+
+from ethrex_tpu.crypto import secp256k1
+from ethrex_tpu.l2.eth_client import EthClient, RpcError
+from ethrex_tpu.l2.l1_client import L1Error
+from ethrex_tpu.l2.l1_contract import RpcL1Client, bridge_runtime
+from ethrex_tpu.node import Node
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.prover import protocol
+from ethrex_tpu.rpc.server import RpcServer
+
+SECRET = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
+SENDER = secp256k1.pubkey_to_address(secp256k1.pubkey_from_secret(SECRET))
+
+L1_GENESIS = {
+    "config": {"chainId": 1, "terminalTotalDifficulty": 0,
+               "shanghaiTime": 0, "cancunTime": 0},
+    "alloc": {"0x" + SENDER.hex(): {"balance": hex(10**21)}},
+    "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7", "timestamp": "0x0",
+}
+
+
+@pytest.fixture()
+def l1():
+    node = Node(Genesis.from_json(L1_GENESIS))
+    node.start_dev_producer(0.05)
+    srv = RpcServer(node, "127.0.0.1", 0).start()
+    client = EthClient(f"http://127.0.0.1:{srv.port}", timeout=5.0)
+    try:
+        yield node, srv, client
+    finally:
+        srv.stop()
+        node.stop()
+
+
+def test_bridge_contract_rules_on_chain(l1):
+    node, srv, client = l1
+    bridge = RpcL1Client.deploy(client, SECRET, [protocol.PROVER_EXEC])
+    assert bridge.last_committed_batch() == 0
+    assert bridge.last_verified_batch() == 0
+
+    # out-of-order commit reverts ON CHAIN
+    with pytest.raises(L1Error):
+        bridge.commit_batch(2, b"\x00" * 32, b"\x11" * 32)
+    bridge.commit_batch(1, b"\xaa" * 32, b"\xc1" * 32)
+    assert bridge.last_committed_batch() == 1
+    # the commitment word is readable back
+    assert bridge._view(b"\x08" + (1).to_bytes(32, "big"))[-32:] \
+        == b"\xc1" * 32
+    # verifying past the committed head reverts
+    with pytest.raises(L1Error):
+        bridge._tx(b"\x02" + (1).to_bytes(32, "big")
+                   + (2).to_bytes(32, "big"))
+
+    # deposits queue on-chain with value
+    bridge.deposit(b"\x77" * 20, 12345)
+    bridge.deposit(b"\x88" * 20, 67890)
+    deps = bridge.get_deposits(0)
+    assert [(d.recipient, d.amount, d.index) for d in deps] == [
+        (b"\x77" * 20, 12345, 0), (b"\x88" * 20, 67890, 1)]
+    assert bridge.get_deposits(1)[0].index == 1
+
+
+def test_eth_client_gas_bump_on_underpriced(l1):
+    node, srv, client = l1
+
+    class Fussy(EthClient):
+        """Rejects the first two submissions as underpriced."""
+
+        def __init__(self, url):
+            super().__init__(url, timeout=5.0)
+            self.rejections = 0
+            self.fees_seen = []
+
+        def call(self, method, params):
+            if method == "eth_sendRawTransaction" and self.rejections < 2:
+                self.rejections += 1
+                raise RpcError(-32000, "transaction underpriced")
+            return super().call(method, params)
+
+    fussy = Fussy(client.url)
+    rec = fussy.send_tx_bump_gas_exponential_backoff(
+        SECRET, to=b"\x99" * 20, value=5)
+    assert int(rec["status"], 16) == 1
+    assert fussy.rejections == 2  # two bumps happened before acceptance
+
+
+def test_l2_pipeline_settles_on_rpc_l1(l1):
+    from ethrex_tpu.l2.sequencer import Sequencer, SequencerConfig
+    from ethrex_tpu.prover.client import ProverClient
+    from tests.test_l2_pipeline import GENESIS as L2_GENESIS
+    from tests.test_l2_pipeline import _transfer
+
+    node, srv, client = l1
+    bridge = RpcL1Client.deploy(client, SECRET, [protocol.PROVER_EXEC],
+                                l2_chain_id=65536999)
+    l2_node = Node(Genesis.from_json(L2_GENESIS))
+    cfg = SequencerConfig(needed_prover_types=(protocol.PROVER_EXEC,))
+    seq = Sequencer(l2_node, bridge, cfg)
+    seq.coordinator.start()
+    try:
+        # a deposit on the real L1 flows into an L2 privileged tx
+        bridge.deposit(b"\x55" * 20, 777_000)
+        seq.watch_l1()
+        l2_node.submit_transaction(_transfer(0))
+        seq.produce_block()
+        batch = seq.commit_next_batch()
+        assert batch is not None
+        assert bridge.last_committed_batch() == 1
+
+        prover = ProverClient(protocol.PROVER_EXEC,
+                              [("127.0.0.1", seq.coordinator.port)])
+        assert prover.poll_once() == 1
+        assert seq.send_proofs() == (1, 1)
+        assert bridge.last_verified_batch() == 1
+        # the deposit minted on L2
+        state = l2_node.store.account_state(
+            l2_node.store.head_header().state_root, b"\x55" * 20)
+        assert state is not None and state.balance == 777_000
+    finally:
+        seq.stop()
+        l2_node.stop()
+
+
+def test_runtime_assembles():
+    code = bridge_runtime()
+    assert len(code) < 512
+    assert code[-1] == 0xFD  # trailing revert
