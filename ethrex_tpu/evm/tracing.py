@@ -72,3 +72,94 @@ class CallTracer:
 
     def result(self) -> dict:
         return self.root.to_json() if self.root else {}
+
+
+# ---------------------------------------------------------------------------
+# struct-log (opcode-level) tracer — geth debug_traceTransaction default
+# (parity: crates/vm/levm/src/opcode_tracer.rs + rpc structLogs)
+# ---------------------------------------------------------------------------
+
+OPCODE_NAMES = {
+    0x00: "STOP", 0x01: "ADD", 0x02: "MUL", 0x03: "SUB", 0x04: "DIV",
+    0x05: "SDIV", 0x06: "MOD", 0x07: "SMOD", 0x08: "ADDMOD",
+    0x09: "MULMOD", 0x0A: "EXP", 0x0B: "SIGNEXTEND", 0x10: "LT",
+    0x11: "GT", 0x12: "SLT", 0x13: "SGT", 0x14: "EQ", 0x15: "ISZERO",
+    0x16: "AND", 0x17: "OR", 0x18: "XOR", 0x19: "NOT", 0x1A: "BYTE",
+    0x1B: "SHL", 0x1C: "SHR", 0x1D: "SAR", 0x20: "KECCAK256",
+    0x30: "ADDRESS", 0x31: "BALANCE", 0x32: "ORIGIN", 0x33: "CALLER",
+    0x34: "CALLVALUE", 0x35: "CALLDATALOAD", 0x36: "CALLDATASIZE",
+    0x37: "CALLDATACOPY", 0x38: "CODESIZE", 0x39: "CODECOPY",
+    0x3A: "GASPRICE", 0x3B: "EXTCODESIZE", 0x3C: "EXTCODECOPY",
+    0x3D: "RETURNDATASIZE", 0x3E: "RETURNDATACOPY", 0x3F: "EXTCODEHASH",
+    0x40: "BLOCKHASH", 0x41: "COINBASE", 0x42: "TIMESTAMP",
+    0x43: "NUMBER", 0x44: "PREVRANDAO", 0x45: "GASLIMIT", 0x46: "CHAINID",
+    0x47: "SELFBALANCE", 0x48: "BASEFEE", 0x49: "BLOBHASH",
+    0x4A: "BLOBBASEFEE", 0x50: "POP", 0x51: "MLOAD", 0x52: "MSTORE",
+    0x53: "MSTORE8", 0x54: "SLOAD", 0x55: "SSTORE", 0x56: "JUMP",
+    0x57: "JUMPI", 0x58: "PC", 0x59: "MSIZE", 0x5A: "GAS",
+    0x5B: "JUMPDEST", 0x5C: "TLOAD", 0x5D: "TSTORE", 0x5E: "MCOPY",
+    0xF0: "CREATE", 0xF1: "CALL", 0xF2: "CALLCODE", 0xF3: "RETURN",
+    0xF4: "DELEGATECALL", 0xF5: "CREATE2", 0xFA: "STATICCALL",
+    0xFD: "REVERT", 0xFE: "INVALID", 0xFF: "SELFDESTRUCT",
+}
+for _i in range(32):
+    OPCODE_NAMES[0x5F + _i] = f"PUSH{_i}"
+for _i in range(16):
+    OPCODE_NAMES[0x80 + _i] = f"DUP{_i + 1}"
+    OPCODE_NAMES[0x90 + _i] = f"SWAP{_i + 1}"
+for _i in range(5):
+    OPCODE_NAMES[0xA0 + _i] = f"LOG{_i}"
+
+
+def op_name(op: int) -> str:
+    return OPCODE_NAMES.get(op, f"opcode 0x{op:02x}")
+
+
+class StructLogTracer:
+    """Opcode-level trace: one entry per step with pc/op/gas/gasCost/depth
+    (+ stack tail when enabled).  gasCost is filled retroactively when the
+    same frame's next step (or its exit) reveals the post-step gas, which
+    also folds child-call consumption into the call opcode's cost exactly
+    like geth.  `max_logs` bounds memory (keeps the LAST entries)."""
+
+    def __init__(self, with_stack: bool = True, stack_depth: int = 8,
+                 max_logs: int = 1_000_000):
+        self.logs: list[dict] = []
+        self.with_stack = with_stack
+        self.stack_depth = stack_depth
+        self.max_logs = max_logs
+        self._depth = 0
+        self._open: list[dict | None] = []  # last entry per frame depth
+
+    # frame hooks (shared signature with CallTracer)
+    def enter(self, msg):
+        self._depth += 1
+        self._open.append(None)
+
+    def exit(self, ok: bool, gas_left: int, output: bytes):
+        last = self._open.pop()
+        if last is not None and last.get("gasCost") is None:
+            last["gasCost"] = last["gas"] - gas_left
+            if not ok and gas_left == 0:
+                last["error"] = "out of gas"
+        self._depth -= 1
+
+    def step(self, frame, op: int):
+        prev = self._open[-1] if self._open else None
+        if prev is not None and prev.get("gasCost") is None:
+            prev["gasCost"] = prev["gas"] - frame.gas
+        entry = {
+            "pc": frame.pc, "op": op_name(op), "gas": frame.gas,
+            "gasCost": None, "depth": self._depth,
+        }
+        if self.with_stack:
+            entry["stack"] = [hex(v)
+                              for v in frame.stack[-self.stack_depth:]]
+        if len(self.logs) >= self.max_logs:
+            self.logs.pop(0)
+        self.logs.append(entry)
+        if self._open:
+            self._open[-1] = entry
+
+    def result(self) -> dict:
+        return {"structLogs": self.logs}

@@ -327,6 +327,20 @@ class EVM:
         code = f.code
         n = len(code)
         handlers = _HANDLERS
+        step = getattr(self.tracer, "step", None) if self.tracer else None
+        if step is not None:
+            # opcode-level tracing variant: the hot path below stays free
+            # of per-step hooks (reference: monomorphized dispatch,
+            # vm.rs:2737-2761)
+            while f.pc < n:
+                op = code[f.pc]
+                handler = handlers[op]
+                step(f, op)
+                if handler is None:
+                    raise InvalidOpcode(hex(op))
+                f.pc += 1
+                handler(self, f)
+            raise _Halt(b"")
         while f.pc < n:
             op = code[f.pc]
             handler = handlers[op]

@@ -468,14 +468,16 @@ class EthApi:
         return witness.to_json()
 
     def debug_trace_transaction(self, tx_hash, opts=None):
-        """debug_traceTransaction with the callTracer (default)."""
+        """debug_traceTransaction: geth-default structLogs when no tracer
+        is named, or the callTracer (parity: rpc/tracing.rs +
+        levm opcode_tracer.rs)."""
         from ..evm.executor import execute_tx
-        from ..evm.tracing import CallTracer
+        from ..evm.tracing import CallTracer, StructLogTracer
         from ..evm.vm import BlockEnv
 
         opts = opts or {}
-        tracer_name = opts.get("tracer", "callTracer")
-        if tracer_name != "callTracer":
+        tracer_name = opts.get("tracer", "structLogs")
+        if tracer_name not in ("callTracer", "structLogs"):
             raise RpcError(-32602, f"unsupported tracer {tracer_name!r}")
         store = self.node.store
         loc = store.tx_index.get(parse_bytes(tx_hash))
@@ -499,10 +501,20 @@ class EthApi:
         # replay preceding txs untraced, then trace the target
         for tx in blk.body.transactions[:loc[1]]:
             execute_tx(tx, state, env, self.node.config)
-        tracer = CallTracer()
-        execute_tx(blk.body.transactions[loc[1]], state, env,
-                   self.node.config, tracer=tracer)
-        return tracer.result()
+        if tracer_name == "callTracer":
+            tracer = CallTracer()
+        else:
+            cfg = opts.get("tracerConfig", {}) or {}
+            tracer = StructLogTracer(
+                with_stack=not cfg.get("disableStack", False),
+                max_logs=int(cfg.get("limit", 100_000)))
+        res = execute_tx(blk.body.transactions[loc[1]], state, env,
+                         self.node.config, tracer=tracer)
+        out = tracer.result()
+        if tracer_name == "structLogs":
+            out = {"gas": res.gas_used, "failed": not res.success,
+                   "returnValue": "", **out}
+        return out
 
     def fee_history(self, count, newest, percentiles=None):
         count = parse_quantity(count)

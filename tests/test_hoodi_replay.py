@@ -14,12 +14,16 @@ logs-bloom membership + state-root sweeps, round 2):
     receiver address appears in NO header-bloom log position) and tx 7
     succeeded — our replay reproduces exactly that shape.
   * residual gap (tracked): tx 3 fails with gas_used 811045 vs the 816911
-    implied by the header total — a ~0.7% difference in how much gas the
-    63/64-cascade burned before the deep OOG; and tx 4's gas-refunder
-    contract logs a gas-derived indexed amount whose value differs from the
-    chain's (single bloom-element delta).  Both trace to one residual gas
-    divergence somewhere in the 800k-gas verifier path; EF fixtures are the
-    tool to isolate it (none are available in this image).
+    implied by the header total.  Struct-log analysis (round 2) localizes
+    OUR failure point exactly: a depth-4 SSTORE (SSTORE_SET, 20000) with
+    12368 gas left inside the bridge-relay cascade — a clean OOG whose
+    burn equals the gas forwarded into that frame, so the 5866 delta sits
+    UPSTREAM in a forwarded amount, not at the failure site.  All call-
+    site accounting (memory-expansion-first ordering, 2929 access charge,
+    63/64 cap, stipend) matches the EIPs on audit; isolating the one
+    divergent charge needs a reference opcode trace or EF fixtures
+    (neither is available in this image — the EF fixture chains in
+    fixtures/blockchain are Git-LFS pointers without objects).
 """
 
 import json

@@ -139,7 +139,12 @@ def test_debug_trace_transaction(rpc):
                      "latest")["result"], 16)
     # trace an existing transfer from the earlier test
     txs = call("eth_getBlockByNumber", "0x1", True)["result"]["transactions"]
-    trace = call("debug_traceTransaction", txs[0]["hash"])["result"]
+    # geth default (no tracer option) = structLogs
+    struct = call("debug_traceTransaction", txs[0]["hash"])["result"]
+    assert "structLogs" in struct and struct["failed"] is False
+    assert struct["gas"] == 21000
+    trace = call("debug_traceTransaction", txs[0]["hash"],
+                 {"tracer": "callTracer"})["result"]
     assert trace["type"] == "CALL"
     assert trace["from"] == txs[0]["from"]
     assert int(trace["gasUsed"], 16) >= 0
@@ -164,15 +169,22 @@ def test_debug_trace_transaction(rpc):
     ).sign(SECRET)
     call("eth_sendRawTransaction", "0x" + tx3.encode_canonical().hex())
     call("ethrex_produceBlock")
-    trace = call("debug_traceTransaction", "0x" + tx3.hash.hex())["result"]
+    trace = call("debug_traceTransaction", "0x" + tx3.hash.hex(),
+                 {"tracer": "callTracer"})["result"]
     assert trace["type"] == "CALL" and trace["to"] == addr
     assert len(trace.get("calls", [])) == 1
     inner = trace["calls"][0]
     assert inner["type"] == "CALL"
     assert inner["to"] == "0x" + "00" * 19 + "04"  # identity precompile
     # deploy trace shows CREATE
-    trace2 = call("debug_traceTransaction", "0x" + tx2.hash.hex())["result"]
+    trace2 = call("debug_traceTransaction", "0x" + tx2.hash.hex(),
+                  {"tracer": "callTracer"})["result"]
     assert trace2["type"] == "CREATE"
+    # structLogs on the inner-call tx shows opcode steps incl. the CALL
+    struct3 = call("debug_traceTransaction", "0x" + tx3.hash.hex())["result"]
+    ops = [e["op"] for e in struct3["structLogs"]]
+    assert "CALL" in ops and "RETURN" in ops
+    assert all(e["gasCost"] is not None for e in struct3["structLogs"])
     # unknown tx errors cleanly
     err = call("debug_traceTransaction", "0x" + "ab" * 32)
     assert "error" in err
