@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import threading
 
 from ..crypto.keccak import keccak256
 from ..primitives import rlp
@@ -44,6 +45,36 @@ GWEI = 10**9
 
 class InvalidBlock(Exception):
     pass
+
+
+class DirtySnapshot:
+    """Frozen copy of one block's dirty write set, duck-typing the slice
+    of StateDB that apply_updates_to_tries consumes (dirty_accounts,
+    dirty_storage, accounts, get_storage, source).  Lets the pipelined
+    importer merkleize block N on a worker thread while block N+1 keeps
+    executing — and mutating — the live StateDB."""
+
+    def __init__(self, db: StateDB):
+        self.dirty_accounts = set(db.dirty_accounts)
+        self.dirty_storage = {a: set(s)
+                              for a, s in db.dirty_storage.items()}
+        self.accounts = {}
+        for addr in self.dirty_accounts | set(self.dirty_storage):
+            acct = db.accounts.get(addr)
+            if acct is None:
+                continue
+            frozen = dataclasses.replace(acct)
+            frozen.storage = dict(acct.storage)
+            self.accounts[addr] = frozen
+        self.source = None  # the worker chains StoreSource(prev_root)
+
+    def get_storage(self, addr: bytes, slot: int) -> int:
+        acct = self.accounts[addr]
+        if slot in acct.storage:
+            return acct.storage[slot]
+        if acct.exists and not acct.storage_cleared:
+            return self.source.get_storage(addr, slot)
+        return 0
 
 
 @dataclasses.dataclass
@@ -247,6 +278,86 @@ class Blockchain:
                 f"state root mismatch: {new_root.hex()} != "
                 f"{header.state_root.hex()}")
         self.store.add_block(block, outcome.receipts)
+
+    def add_blocks_pipelined(self, blocks: list[Block]) -> None:
+        """Pipelined import: execute block N+1 WHILE block N merkleizes
+        and stores on a worker thread (reference: blockchain.rs
+        add_block_pipeline + execute_block_pipeline streaming account
+        updates to the merkleizer).  Unlike the batch path, EVERY block's
+        state root is validated.  The overlap is real under CPython: the
+        merkleize step runs in the native C++ MPT engine via ctypes,
+        which releases the GIL.
+
+        Execution state chains through one shared StateDB cache; each
+        block's dirty writes are snapshotted (DirtySnapshot) at handoff,
+        and the worker chains the trie roots block by block."""
+        import queue as queue_mod
+
+        from ..evm.db import StateDB
+        from ..storage.store import StoreSource
+
+        if not blocks:
+            return
+        parent = self.store.get_header(blocks[0].header.parent_hash)
+        if parent is None:
+            raise InvalidBlock("unknown parent")
+        overrides = {parent.number: parent.hash}
+        state_db = StateDB(StoreSource(self.store, parent.state_root,
+                                       header_overrides=overrides))
+        q: queue_mod.Queue = queue_mod.Queue(maxsize=2)
+        failure: list[Exception] = []
+
+        def merkleizer():
+            prev_root = parent.state_root
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                block, receipts, snap = item
+                try:
+                    snap.source = StoreSource(self.store, prev_root,
+                                              header_overrides=overrides)
+                    new_root = self.store.apply_account_updates(
+                        prev_root, snap)
+                    if new_root != block.header.state_root:
+                        raise InvalidBlock(
+                            f"state root mismatch at block "
+                            f"{block.header.number}: {new_root.hex()} != "
+                            f"{block.header.state_root.hex()}")
+                    self.store.add_block(block, receipts)
+                    prev_root = new_root
+                except Exception as exc:  # noqa: BLE001 — joined below
+                    failure.append(exc)
+                    # keep draining so the producer's put() never blocks
+                    # against a dead consumer
+                    while q.get() is not None:
+                        pass
+                    return
+
+        worker = threading.Thread(target=merkleizer, daemon=True)
+        worker.start()
+        prev = parent
+        try:
+            for block in blocks:
+                if failure:
+                    break
+                header = block.header
+                if header.parent_hash != prev.hash:
+                    raise InvalidBlock("non-contiguous batch")
+                self.validate_header(header, prev)
+                self._validate_body_roots(block)
+                outcome = self.execute_block(block, prev, state_db)
+                self._validate_block_outcome(header, outcome)
+                snap = DirtySnapshot(state_db)
+                state_db.drain_dirty()
+                q.put((block, outcome.receipts, snap))
+                overrides[header.number] = header.hash
+                prev = header
+        finally:
+            q.put(None)
+            worker.join()
+        if failure:
+            raise failure[0]
 
     VERIFY_INTERVAL = 256  # bound on unverified intermediate state roots
 

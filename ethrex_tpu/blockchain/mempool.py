@@ -118,5 +118,33 @@ class Mempool:
                 for sender, queue in self.by_sender.items()
             }
 
+    def split(self, get_nonce) -> tuple[dict, dict]:
+        """The pending-vs-queued partition (reference mempool / geth txpool
+        semantics): per sender, txs forming a contiguous nonce run from the
+        account's current nonce are PENDING (executable); gapped/future
+        nonces are QUEUED until the gap fills."""
+        with self.lock:
+            pending: dict[bytes, dict[int, Transaction]] = {}
+            queued: dict[bytes, dict[int, Transaction]] = {}
+            for sender, queue in self.by_sender.items():
+                nonce = get_nonce(sender)
+                run = {}
+                while nonce in queue:
+                    run[nonce] = queue[nonce]
+                    nonce += 1
+                rest = {n: tx for n, tx in queue.items() if n not in run}
+                if run:
+                    pending[sender] = run
+                if rest:
+                    queued[sender] = rest
+            return pending, queued
+
+    def status(self, get_nonce) -> dict:
+        pending, queued = self.split(get_nonce)
+        return {
+            "pending": sum(len(q) for q in pending.values()),
+            "queued": sum(len(q) for q in queued.values()),
+        }
+
     def __len__(self):
         return len(self.by_hash)

@@ -1,10 +1,16 @@
-"""ethrex-tpu CLI (parity target: cmd/ethrex/cli.rs — the L1 node entry
-point; L2 subcommands arrive with the sequencer)."""
+"""ethrex-tpu CLI (parity target: cmd/ethrex/cli.rs — ~90 clap flags with
+ETHREX_* env-var mirrors, plus the removedb / import / export /
+compute-state-root subcommands, cli.rs:562-676).
+
+Every flag reads its default from the matching ETHREX_* environment
+variable (the reference's clap `env` mirrors); explicit CLI arguments win.
+"""
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import signal
 import sys
 
@@ -33,60 +39,220 @@ DEV_GENESIS = {
 }
 
 
-def main(argv=None):
-    parser = argparse.ArgumentParser(
-        prog="ethrex-tpu", description="TPU-native Ethereum L1/L2 node")
+def _env(name: str, default=None):
+    return os.environ.get(f"ETHREX_{name}", default)
+
+
+def _env_int(name: str, default: int) -> int:
+    v = _env(name)
+    return int(v) if v is not None else default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = _env(name)
+    return float(v) if v is not None else default
+
+
+def _add_node_flags(parser: argparse.ArgumentParser):
     parser.add_argument("--dev", action="store_true",
+                        default=_env("DEV") == "1",
                         help="dev mode: auto-produce blocks from the mempool")
-    parser.add_argument("--datadir",
+    parser.add_argument("--datadir", default=_env("DATADIR"),
                         help="persist the chain in <datadir>/chain.db "
                              "(native C++ KV store); default: in-memory")
     parser.add_argument("--network", "--genesis", dest="genesis",
+                        default=_env("NETWORK"),
                         help="path to a genesis JSON file")
-    parser.add_argument("--http.addr", dest="http_addr", default="127.0.0.1")
+    parser.add_argument("--http.addr", dest="http_addr",
+                        default=_env("HTTP_ADDR", "127.0.0.1"))
     parser.add_argument("--http.port", dest="http_port", type=int,
-                        default=8545)
+                        default=_env_int("HTTP_PORT", 8545))
+    parser.add_argument("--ws.port", dest="ws_port", type=int,
+                        default=_env_int("WS_PORT", 0),
+                        help="WebSocket JSON-RPC + subscriptions (0 = off)")
     parser.add_argument("--block-time", dest="block_time", type=float,
-                        default=1.0, help="dev block production interval (s)")
-    parser.add_argument("--coinbase", default="0x" + "00" * 20)
+                        default=_env_float("BLOCK_TIME", 1.0),
+                        help="dev block production interval (s)")
+    parser.add_argument("--coinbase",
+                        default=_env("COINBASE", "0x" + "00" * 20))
     parser.add_argument("--metrics.port", dest="metrics_port", type=int,
-                        default=0, help="Prometheus /metrics port (0 = off)")
+                        default=_env_int("METRICS_PORT", 0),
+                        help="Prometheus /metrics port (0 = off)")
+    parser.add_argument("--authrpc.addr", dest="authrpc_addr",
+                        default=_env("AUTHRPC_ADDR", "127.0.0.1"))
     parser.add_argument("--authrpc.port", dest="authrpc_port", type=int,
-                        default=0, help="Engine API port (0 = off)")
+                        default=_env_int("AUTHRPC_PORT", 0),
+                        help="Engine API port (0 = off)")
     parser.add_argument("--authrpc.jwtsecret", dest="jwt_path",
+                        default=_env("AUTHRPC_JWTSECRET"),
                         help="path to a hex-encoded 32-byte JWT secret")
+    parser.add_argument("--p2p.enabled", dest="p2p_enabled",
+                        action="store_true",
+                        default=_env("P2P_ENABLED") == "1")
+    parser.add_argument("--p2p.addr", dest="p2p_addr",
+                        default=_env("P2P_ADDR", "0.0.0.0"))
+    parser.add_argument("--p2p.port", dest="p2p_port", type=int,
+                        default=_env_int("P2P_PORT", 30303))
+    parser.add_argument("--discovery.port", dest="discovery_port", type=int,
+                        default=_env_int("DISCOVERY_PORT", 30303),
+                        help="discv4 UDP port")
+    parser.add_argument("--bootnodes", default=_env("BOOTNODES", ""),
+                        help="comma-separated enode URLs")
+    parser.add_argument("--syncmode", choices=("full", "snap"),
+                        default=_env("SYNCMODE", "full"))
     parser.add_argument("--kzg-setup", dest="kzg_setup",
+                        default=_env("KZG_SETUP"),
                         help="path to the ceremony trusted_setup.json for "
                         "the 0x0a precompile; CONSENSUS-CRITICAL: every "
                         "node of a chain must use the same setup (default: "
                         "the deterministic dev setup, crypto/kzg.py)")
-    args = parser.parse_args(argv)
+    parser.add_argument("--node-config", dest="node_config",
+                        default=_env("NODE_CONFIG"),
+                        help="JSON file persisting known peers across "
+                        "restarts (reference: node_config.json)")
+
+
+def _load_genesis(args) -> Genesis | None:
+    if args.genesis:
+        with open(args.genesis) as f:
+            return Genesis.from_json(json.load(f))
+    if args.dev:
+        return Genesis.from_json(DEV_GENESIS)
+    return None
+
+
+def _open_store(datadir: str | None):
+    if not datadir:
+        return None
+    from .storage.persistent import PersistentBackend
+    from .storage.store import Store
+
+    os.makedirs(datadir, exist_ok=True)
+    return Store(PersistentBackend(os.path.join(datadir, "chain.db")))
+
+
+def _decode_chain_file(path: str):
+    from .primitives import rlp
+    from .primitives.block import Block, BlockBody, BlockHeader
+
+    with open(path, "rb") as f:
+        rest = f.read()
+    blocks = []
+    while rest:
+        item, rest = rlp.decode_prefix(rest)
+        blocks.append(Block(BlockHeader.decode_fields(item[0]),
+                            BlockBody.from_fields(item[1:])))
+    return blocks
+
+
+def cmd_import(args) -> int:
+    """`ethrex import <chain.rlp>` — bulk-import an RLP chain file and
+    report throughput (cli.rs `import` + tooling/import_benchmark)."""
+    import time
+
+    genesis = _load_genesis(args)
+    if genesis is None:
+        print("import requires --network <genesis.json> (or --dev)",
+              file=sys.stderr)
+        return 1
+    node = Node(genesis, store=_open_store(args.datadir))
+    blocks = _decode_chain_file(args.file)
+    t0 = time.perf_counter()
+    node.chain.add_blocks_in_batch(blocks)
+    # make the imported tip canonical (the reference's import subcommand
+    # ends with a fork-choice update to the last imported block)
+    from .blockchain.fork_choice import apply_fork_choice
+
+    tip = blocks[-1].hash
+    apply_fork_choice(node.store, tip, tip, tip)
+    dt = time.perf_counter() - t0
+    gas = sum(b.header.gas_used for b in blocks)
+    print(f"imported {len(blocks)} blocks, {gas / 1e6:.1f} Mgas "
+          f"in {dt:.2f}s = {gas / dt / 1e6:.1f} Mgas/s")
+    node.store.flush()
+    return 0
+
+
+def cmd_export(args) -> int:
+    """`ethrex export <out.rlp>` — canonical chain to an RLP file."""
+    from .primitives import rlp
+
+    genesis = _load_genesis(args)
+    if genesis is None:
+        print("export requires --network/--dev", file=sys.stderr)
+        return 1
+    node = Node(genesis, store=_open_store(args.datadir))
+    last = args.last if args.last is not None else \
+        node.store.latest_number()
+    with open(args.file, "wb") as f:
+        for n in range(args.first, last + 1):
+            block = node.store.get_canonical_block(n)
+            if block is None:
+                print(f"missing canonical block {n}", file=sys.stderr)
+                return 1
+            f.write(block.encode())
+    print(f"exported blocks {args.first}..{last} to {args.file}")
+    return 0
+
+
+def cmd_removedb(args) -> int:
+    """`ethrex removedb` — delete the datadir (cli.rs removedb)."""
+    import shutil
+
+    if not args.datadir:
+        print("removedb requires --datadir", file=sys.stderr)
+        return 1
+    if not os.path.isdir(args.datadir):
+        print(f"no database at {args.datadir}")
+        return 0
+    if not args.force:
+        resp = input(f"delete {args.datadir}? [y/N] ")
+        if resp.strip().lower() not in ("y", "yes"):
+            print("aborted")
+            return 1
+    shutil.rmtree(args.datadir)
+    print(f"removed {args.datadir}")
+    return 0
+
+
+def cmd_compute_state_root(args) -> int:
+    """`ethrex compute-state-root --network genesis.json`."""
+    genesis = _load_genesis(args)
+    if genesis is None:
+        print("compute-state-root requires --network", file=sys.stderr)
+        return 1
+    from .storage.store import Store
+
+    header = Store().init_genesis(genesis)
+    print(f"state root: 0x{header.state_root.hex()}")
+    print(f"genesis hash: 0x{header.hash.hex()}")
+    return 0
+
+
+def _parse_enode(url: str):
+    # enode://<128-hex pubkey>@host:port
+    if not url.startswith("enode://"):
+        raise ValueError(f"not an enode URL: {url}")
+    rest = url[len("enode://"):]
+    pub_hex, _, addr = rest.partition("@")
+    host, _, port = addr.partition(":")
+    return bytes.fromhex(pub_hex), host, int(port or 30303)
+
+
+def run_node(args) -> int:
     if args.kzg_setup:
         from .crypto import kzg
 
         kzg.set_setup(kzg.TrustedSetup.from_ceremony_json(args.kzg_setup))
 
-    if args.genesis:
-        with open(args.genesis) as f:
-            genesis = Genesis.from_json(json.load(f))
-    elif args.dev:
-        genesis = Genesis.from_json(DEV_GENESIS)
-    else:
+    genesis = _load_genesis(args)
+    if genesis is None:
         print("either --dev or --network <genesis.json> is required",
               file=sys.stderr)
         return 1
 
     coinbase = bytes.fromhex(args.coinbase.removeprefix("0x"))
-    store = None
-    if args.datadir:
-        import os
-
-        from .storage.persistent import PersistentBackend
-        from .storage.store import Store
-
-        os.makedirs(args.datadir, exist_ok=True)
-        store = Store(PersistentBackend(
-            os.path.join(args.datadir, "chain.db")))
+    store = _open_store(args.datadir)
     node = Node(genesis, coinbase=coinbase, store=store)
     server = RpcServer(node, args.http_addr, args.http_port).start()
     print(f"genesis hash: 0x{node.genesis_header.hash.hex()}")
@@ -105,16 +271,44 @@ def main(argv=None):
             jwt_secret = _secrets.token_bytes(32)
             print(f"generated JWT secret (pass to your CL): "
                   f"{jwt_secret.hex()}")
-        authrpc = RpcServer(node, args.http_addr, args.authrpc_port,
+        authrpc = RpcServer(node, args.authrpc_addr, args.authrpc_port,
                             jwt_secret=jwt_secret, engine=True).start()
-        print(f"Engine API listening on http://{args.http_addr}:"
+        print(f"Engine API listening on http://{args.authrpc_addr}:"
               f"{authrpc.port}")
+    ws = None
+    if args.ws_port:
+        from .rpc.websocket import WsServer
+
+        ws = WsServer(server, args.http_addr, args.ws_port).start()
+        print(f"WebSocket JSON-RPC on ws://{args.http_addr}:{ws.port}")
     metrics = None
     if args.metrics_port:
         from .utils.metrics import MetricsServer
 
         metrics = MetricsServer(args.http_addr, args.metrics_port).start()
         print(f"metrics on http://{args.http_addr}:{metrics.port}/metrics")
+
+    p2p = None
+    if args.p2p_enabled:
+        from .p2p.connection import P2PServer
+
+        p2p = P2PServer(node, host=args.p2p_addr, port=args.p2p_port)
+        p2p.start()
+        print(f"p2p listening on {p2p.host}:{p2p.port} "
+              f"(enode pubkey {p2p.pub.hex()[:16]}...)")
+        peers = []
+        if args.node_config and os.path.exists(args.node_config):
+            with open(args.node_config) as f:
+                peers = json.load(f).get("known_peers", [])
+        for url in filter(None, args.bootnodes.split(",")):
+            peers.append(url.strip())
+        for url in peers:
+            try:
+                pub, host, port = _parse_enode(url)
+                p2p.dial(host, port, pub)
+            except (ValueError, OSError) as e:
+                print(f"bootnode {url}: {e}", file=sys.stderr)
+
     if args.dev:
         node.start_dev_producer(args.block_time)
         print(f"dev producer running (block time {args.block_time}s)")
@@ -124,6 +318,19 @@ def main(argv=None):
     except (KeyboardInterrupt, AttributeError):
         pass
     finally:
+        # persist known peers (reference: node_config.json on shutdown)
+        if p2p is not None and args.node_config:
+            known = []
+            for peer in p2p.peers:
+                try:
+                    host, port = peer.sock.getpeername()[:2]
+                    known.append(
+                        f"enode://{bytes(peer.remote_pub).hex()}"
+                        f"@{host}:{port}")
+                except (OSError, AttributeError, TypeError):
+                    continue
+            with open(args.node_config, "w") as f:
+                json.dump({"known_peers": known}, f)
         # order matters: stop writers (join producer), THEN fsync, THEN
         # close the backend; servers last-but-harmless
         writers_stopped = node.stop()
@@ -136,6 +343,40 @@ def main(argv=None):
             # never close the native handle under a live writer
             store.backend.close()
     return 0
+
+
+def main(argv=None):
+    flags = argparse.ArgumentParser(add_help=False)
+    _add_node_flags(flags)
+    parser = argparse.ArgumentParser(
+        prog="ethrex-tpu", description="TPU-native Ethereum L1/L2 node",
+        parents=[flags])
+    # shared flags are accepted before OR after the subcommand (clap-style)
+    sub = parser.add_subparsers(dest="command")
+
+    p_import = sub.add_parser("import", parents=[flags],
+                              help="import an RLP chain file")
+    p_import.add_argument("file")
+    p_export = sub.add_parser("export", parents=[flags],
+                              help="export the canonical chain")
+    p_export.add_argument("file")
+    p_export.add_argument("--first", type=int, default=1)
+    p_export.add_argument("--last", type=int, default=None)
+    p_rm = sub.add_parser("removedb", parents=[flags],
+                          help="delete the database directory")
+    p_rm.add_argument("--force", action="store_true")
+    sub.add_parser("compute-state-root", parents=[flags],
+                   help="print the genesis state root")
+
+    args = parser.parse_args(argv)
+    handlers = {
+        "import": cmd_import,
+        "export": cmd_export,
+        "removedb": cmd_removedb,
+        "compute-state-root": cmd_compute_state_root,
+        None: run_node,
+    }
+    return handlers[args.command](args)
 
 
 if __name__ == "__main__":

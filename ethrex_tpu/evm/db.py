@@ -392,6 +392,16 @@ class StateDB:
         """Clear journal; keep account cache for the rest of the block."""
         self.journal.clear()
 
+    def drain_dirty(self):
+        """Reset dirty/cleared tracking WITHOUT changing the source —
+        the pipelined importer snapshots the dirty state per block
+        (blockchain.DirtySnapshot) and keeps executing on the warm cache
+        while the snapshot merkleizes on another thread."""
+        self.dirty_accounts = set()
+        self.dirty_storage = {}
+        for acct in self.accounts.values():
+            acct.storage_cleared = False
+
     def rebase(self, source: VmDatabase):
         """Re-point this StateDB at a new backing source whose state already
         contains every dirty update (i.e. the tries were just flushed with

@@ -102,7 +102,7 @@ OPCODE_NAMES = {
     0xF4: "DELEGATECALL", 0xF5: "CREATE2", 0xFA: "STATICCALL",
     0xFD: "REVERT", 0xFE: "INVALID", 0xFF: "SELFDESTRUCT",
 }
-for _i in range(32):
+for _i in range(33):  # PUSH0 (0x5F) .. PUSH32 (0x7F)
     OPCODE_NAMES[0x5F + _i] = f"PUSH{_i}"
 for _i in range(16):
     OPCODE_NAMES[0x80 + _i] = f"DUP{_i + 1}"
@@ -120,14 +120,16 @@ class StructLogTracer:
     (+ stack tail when enabled).  gasCost is filled retroactively when the
     same frame's next step (or its exit) reveals the post-step gas, which
     also folds child-call consumption into the call opcode's cost exactly
-    like geth.  `max_logs` bounds memory (keeps the LAST entries)."""
+    like geth.  `max_logs` bounds memory (keeps the LAST entries; 0 means
+    unlimited, matching geth's TraceConfig limit semantics)."""
 
     def __init__(self, with_stack: bool = True, stack_depth: int = 8,
                  max_logs: int = 1_000_000):
-        self.logs: list[dict] = []
+        import collections
+
+        self.logs = collections.deque(maxlen=max_logs or None)
         self.with_stack = with_stack
         self.stack_depth = stack_depth
-        self.max_logs = max_logs
         self._depth = 0
         self._open: list[dict | None] = []  # last entry per frame depth
 
@@ -155,11 +157,9 @@ class StructLogTracer:
         if self.with_stack:
             entry["stack"] = [hex(v)
                               for v in frame.stack[-self.stack_depth:]]
-        if len(self.logs) >= self.max_logs:
-            self.logs.pop(0)
         self.logs.append(entry)
         if self._open:
             self._open[-1] = entry
 
     def result(self) -> dict:
-        return {"structLogs": self.logs}
+        return {"structLogs": list(self.logs)}

@@ -117,6 +117,9 @@ class ProgramOutput:
 
     @classmethod
     def decode(cls, data: bytes) -> "ProgramOutput":
+        if len(data) != 176:
+            raise ValueError(
+                f"ProgramOutput must be 176 bytes, got {len(data)}")
         return cls(data[0:32], data[32:64], data[64:96],
                    int.from_bytes(data[96:104], "big"),
                    int.from_bytes(data[104:112], "big"),

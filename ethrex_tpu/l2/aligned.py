@@ -1,0 +1,147 @@
+"""Aligned-mode proving: batched proof aggregation with an L1ProofVerifier.
+
+Mirrors the reference's aligned deployment mode (crates/l2/sequencer/
+l1_proof_verifier.rs:66; docs/l2/deployment/aligned_failure_recovery.md):
+instead of posting each batch proof directly, proofs are SUBMITTED to an
+aggregation layer, and a separate verifier actor polls until the
+aggregated verification lands, resubmitting after a timeout.  The
+`AlignedLayer` here is an in-process stand-in for the external service —
+it checks the submitted proofs with the registered backends and reports
+inclusion after a configurable number of polls (so tests exercise the
+pending -> included and pending -> expired -> resubmit paths
+deterministically).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..prover.backend import get_backend
+
+
+class AlignedLayer:
+    """In-process aggregation service stand-in.
+
+    Submissions become `included` after `latency_polls` status polls
+    (simulating the aggregation epoch), unless `fail_every` marks them
+    lost (simulating a dropped aggregation — the resubmission path).
+    """
+
+    PENDING, INCLUDED, LOST = "pending", "included", "lost"
+
+    def __init__(self, latency_polls: int = 2, fail_every: int = 0):
+        self.latency_polls = latency_polls
+        self.fail_every = fail_every
+        self.submissions: dict[int, dict] = {}
+        self._next_id = 0
+        self._submit_count = 0
+        self.lock = threading.RLock()
+
+    def submit(self, first: int, last: int, proofs: dict) -> int:
+        """Validate and enqueue an aggregation request; returns its id."""
+        with self.lock:
+            for prover_type, batch_proofs in proofs.items():
+                backend = get_backend(prover_type)
+                for proof in batch_proofs:
+                    if not backend.verify(proof):
+                        raise ValueError(
+                            f"aligned: invalid {prover_type} proof")
+            self._submit_count += 1
+            lost = (self.fail_every
+                    and self._submit_count % self.fail_every == 0)
+            sid = self._next_id
+            self._next_id += 1
+            self.submissions[sid] = {
+                "range": (first, last), "polls": 0,
+                "state": self.LOST if lost else self.PENDING,
+            }
+            return sid
+
+    def status(self, sid: int) -> str:
+        with self.lock:
+            sub = self.submissions.get(sid)
+            if sub is None:
+                return self.LOST
+            if sub["state"] == self.PENDING:
+                sub["polls"] += 1
+                if sub["polls"] >= self.latency_polls:
+                    sub["state"] = self.INCLUDED
+            return sub["state"]
+
+
+class L1ProofVerifier:
+    """Tracks aligned submissions and finalizes them on the L1.
+
+    One `step()` per timer tick (the sequencer loop drives it):
+      1. collect the next run of consecutive committed+fully-proven
+         batches (same predicate as the direct L1ProofSender path);
+      2. submit them to the aligned layer if not already in flight;
+      3. poll the in-flight submission: included -> verify_batches on the
+         L1 and mark verified; lost or timed out -> resubmit.
+    """
+
+    def __init__(self, rollup, l1, aligned: AlignedLayer,
+                 needed_prover_types: list[str],
+                 resubmit_timeout: float = 30.0):
+        self.rollup = rollup
+        self.l1 = l1
+        self.aligned = aligned
+        self.needed = list(needed_prover_types)
+        self.resubmit_timeout = resubmit_timeout
+        self.inflight: dict | None = None
+
+    def _collect(self):
+        first = self.l1.last_verified_batch() + 1
+        last = first - 1
+        while True:
+            batch = self.rollup.get_batch(last + 1)
+            if batch is None or not batch.committed:
+                break
+            if not self.rollup.batch_fully_proven(last + 1, self.needed):
+                break
+            last += 1
+        if last < first:
+            return None
+        proofs = {
+            t: [self.rollup.get_proof(n, t)
+                for n in range(first, last + 1)]
+            for t in self.needed
+        }
+        return first, last, proofs
+
+    def _submit(self, first, last, proofs):
+        sid = self.aligned.submit(first, last, proofs)
+        self.inflight = {"sid": sid, "first": first, "last": last,
+                         "proofs": proofs, "submitted_at": time.time()}
+
+    def step(self) -> str | None:
+        if self.inflight is None:
+            work = self._collect()
+            if work is None:
+                return None
+            self._submit(*work)
+            return "submitted"
+        sid = self.inflight["sid"]
+        state = self.aligned.status(sid)
+        if state == AlignedLayer.INCLUDED:
+            first, last = self.inflight["first"], self.inflight["last"]
+            wire = {
+                t: [get_backend(t).to_proof_bytes(p) for p in plist]
+                for t, plist in self.inflight["proofs"].items()
+            }
+            self.l1.verify_batches(first, last, wire)
+            for n in range(first, last + 1):
+                self.rollup.set_verified(n)
+            self.inflight = None
+            return "verified"
+        timed_out = (time.time() - self.inflight["submitted_at"]
+                     > self.resubmit_timeout)
+        if state == AlignedLayer.LOST or timed_out:
+            # resubmission path (aligned_failure_recovery.md:98)
+            work = (self.inflight["first"], self.inflight["last"],
+                    self.inflight["proofs"])
+            self.inflight = None
+            self._submit(*work)
+            return "resubmitted"
+        return "pending"

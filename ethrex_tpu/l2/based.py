@@ -1,0 +1,95 @@
+"""Based-rollup follower: fetch committed batches from L1 and import them.
+
+The reference's based mode lets any node follow the canonical L2 chain
+from L1 data alone (crates/l2/based/block_fetcher.rs:72): the fetcher
+walks the committed batches, pulls each commit's blob sidecar, decodes
+the block payload, executes it locally, and checks the resulting state
+root against the one committed on L1.  Here the sidecar comes from the
+L1 client's DA record (the commit transaction IS the blob carrier;
+InMemoryL1 keeps the bundles, an RPC L1 serves them from the chain).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .blobs import BlobsBundle, reconstruct_blocks
+from .rollup_store import Batch
+
+
+class FetchError(Exception):
+    pass
+
+
+class BlockFetcher:
+    """Import committed batches from L1 into a local node."""
+
+    def __init__(self, node, l1, rollup=None):
+        self.node = node
+        self.l1 = l1
+        self.rollup = rollup
+        self.next_batch = 1
+        self._stop = threading.Event()
+        self._thread = None
+
+    def fetch_once(self) -> int:
+        """Import every not-yet-imported committed batch; returns the
+        number of batches imported.  Raises FetchError on a state-root
+        divergence (the local execution disagrees with L1) — a fatal
+        condition for a follower."""
+        imported = 0
+        last = self.l1.last_committed_batch()
+        while self.next_batch <= last:
+            number = self.next_batch
+            bundle = self.l1.get_blob_sidecar(number)
+            if bundle is None:
+                raise FetchError(f"no blob sidecar for batch {number}")
+            if isinstance(bundle, dict):
+                bundle = BlobsBundle(**bundle)
+            if not bundle.verify():
+                raise FetchError(f"batch {number}: bad KZG sidecar")
+            blocks = reconstruct_blocks(bundle)
+            for block in blocks:
+                if self.node.store.get_header(block.hash) is None:
+                    self.node.chain.add_block(block)
+                from ..blockchain.fork_choice import apply_fork_choice
+
+                apply_fork_choice(self.node.store, block.hash,
+                                  block.hash, block.hash)
+            committed_root = self.l1.get_committed_state_root(number)
+            local_root = blocks[-1].header.state_root
+            if committed_root is not None \
+                    and committed_root != local_root:
+                raise FetchError(
+                    f"batch {number}: local root "
+                    f"0x{local_root.hex()} != committed "
+                    f"0x{committed_root.hex()}")
+            if self.rollup is not None:
+                self.rollup.store_batch(Batch(
+                    number=number,
+                    first_block=blocks[0].header.number,
+                    last_block=blocks[-1].header.number,
+                    state_root=local_root, commitment=b"",
+                    committed=True))
+                self.rollup.store_blobs_bundle(number, bundle)
+            self.next_batch += 1
+            imported += 1
+        return imported
+
+    def start(self, interval: float = 1.0):
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.fetch_once()
+                except FetchError:
+                    raise
+                except Exception:
+                    continue  # transient L1 errors: retry next tick
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -71,6 +71,16 @@ class L1Client:
     def get_deposits(self, since_index: int) -> list[Deposit]:
         raise NotImplementedError
 
+    # DA surface for based followers (the commit tx carries the sidecar)
+    def publish_blobs(self, number: int, bundle) -> None:
+        raise NotImplementedError
+
+    def get_blob_sidecar(self, number: int):
+        return None
+
+    def get_committed_state_root(self, number: int) -> bytes | None:
+        return None
+
 
 class InMemoryL1(L1Client):
     """OnChainProposer/CommonBridge semantics without an actual chain."""
@@ -81,6 +91,7 @@ class InMemoryL1(L1Client):
         self.l2_chain_id = l2_chain_id
         self.commitments: dict[int, tuple[bytes, bytes]] = {}
         self.message_roots: dict[int, bytes] = {}
+        self.blob_sidecars: dict[int, object] = {}
         self.claimed: set[bytes] = set()
         self.verified_up_to = 0
         self.deposits: list[Deposit] = []
@@ -116,6 +127,19 @@ class InMemoryL1(L1Client):
             self.message_roots[number] = bytes(messages_root)
             return keccak256(b"commit" + number.to_bytes(8, "big")
                              + commitment)
+
+    def publish_blobs(self, number: int, bundle) -> None:
+        with self.lock:
+            self.blob_sidecars[number] = bundle
+
+    def get_blob_sidecar(self, number: int):
+        with self.lock:
+            return self.blob_sidecars.get(number)
+
+    def get_committed_state_root(self, number: int) -> bytes | None:
+        with self.lock:
+            rec = self.commitments.get(number)
+            return rec[0] if rec else None
 
     def verify_batches(self, first, last, proofs) -> bytes:
         """proofs: {prover_type: [proof_bytes for each batch first..last]}.

@@ -29,6 +29,8 @@ class ProofCoordinator:
         self.proof_format = proof_format
         # (batch_number, prover_type) -> assignment deadline
         self.assignments: dict[tuple[int, str], float] = {}
+        # (batch_number, prover_type) -> first-assignment time (metrics)
+        self.assigned_at: dict[tuple[int, str], float] = {}
         self.lock = threading.RLock()
         self.host = host
         self.port = port
@@ -54,6 +56,7 @@ class ProofCoordinator:
                     continue
                 self.assignments[(num, prover_type)] = \
                     now + ASSIGNMENT_TIMEOUT
+                self.assigned_at[(num, prover_type)] = now
                 return num
         return None
 
@@ -84,6 +87,13 @@ class ProofCoordinator:
             self.rollup.store_proof(batch, prover_type, proof)
             with self.lock:
                 self.assignments.pop((batch, prover_type), None)
+                started = self.assigned_at.pop((batch, prover_type), None)
+            if started is not None:
+                # proving-time metric (reference: set_batch_proving_time,
+                # proof_coordinator.rs:286-296)
+                from ..utils.metrics import record_batch
+
+                record_batch(batch, time.monotonic() - started)
             return {"type": protocol.SUBMIT_ACK, "batch_id": batch}
         return {"type": protocol.ERROR, "message": f"unknown type {mtype}"}
 

@@ -152,6 +152,13 @@ class Sequencer:
         # otherwise a transient L1 failure would desync the batch counter
         self.l1.commit_batch(number, state_root, commitment,
                              privileged_hashes, msgs_root)
+        try:
+            # publish the DA sidecar alongside the commitment (the commit
+            # tx is the blob carrier; based followers re-derive the chain
+            # from it — l2/based.py)
+            self.l1.publish_blobs(number, bundle)
+        except NotImplementedError:
+            pass
         batch = Batch(number=number, first_block=first,
                       last_block=head, state_root=state_root,
                       commitment=commitment)

@@ -1,0 +1,467 @@
+"""FRI-verifier AIR: the recursion/aggregation circuit.
+
+Proves IN-CIRCUIT the expensive part of verifying N inner DEEP-FRI STARKs —
+every FRI query's Merkle openings and fold equations across every layer —
+so that one outer STARK attests to the whole batch of inner query checks.
+This is the seat of the reference prover's STARK recursion/"Compressed"
+aggregation stage (SURVEY.md §2.6; the reference gets it from SP1's
+recursion circuits, /root/reference/crates/prover/src/backend/sp1.rs:97-102
+Compressed-vs-Groth16 split).
+
+Statement (public inputs, 8 limbs):
+    digest — Poseidon2 sponge over every segment's 32-limb message under
+    the fixed in-trace absorb schedule.
+
+One SEGMENT verifies one (query, layer) opening of one inner proof:
+
+    leaf = H(lo || hi)                      (1-chunk sponge, lane M)
+    fold(leaf, path) == root                (f-gated compress folds, lane M)
+    idx  == sum of path bits (LSB first)    (idxacc accumulator)
+    #folds == depth                         (facc accumulator)
+    carried_in == (s_bit ? hi : lo), raw == idx + s_bit*half   (chaining)
+    (carried_out - (lo+hi)/2) * 2x == beta * (lo - hi)         (fold eqn)
+
+and lane T absorbs the segment message
+
+    [first, k, half, depth, x, lo(4), hi(4), beta(4), root(8),
+     carried_out(4), idx, s_bit, last]                          (32 limbs)
+
+into the running transcript sponge.  The OUTER verifier (stark/aggregate.py)
+re-derives every message limb except lo/hi from the inner proofs' public
+data — Fiat-Shamir betas and query indices from the roots, x / half / depth
+from the layer structure, carried values from lo/hi/beta/x, the final-layer
+polynomial evaluation from the final coefficients — and recomputes the
+digest, so a trace that lies about any of them cannot reproduce the public
+digest.  What the circuit alone establishes is the EXISTENCE of Merkle
+paths: the openings' hash work, which dominates native verification, never
+has to be re-executed (and the aggregate proof drops the path data).
+
+Schedule per segment (S periods of 32 rows, uniform lanes):
+    period 0:      lane M = fresh sponge absorbing the leaf chunk;
+                   lane T absorbs msg chunk 1
+    end period 0:  dig <- leaf digest, first compress input loaded
+    periods 1..D:  f-gated path folds (f = 1 for the first `depth` slots);
+                   lane T absorbs msg chunks 2, 3 at periods 1, 2
+    periods D+1..: idle permutations
+    segment end:   chain/root/fold-eqn checks; registers reset; lanes
+                   restart on the next segment's message
+
+Columns (width 90):
+    0..15  lane M        49 f (fold flag)    57..88 msg
+    16..31 lane T        50 idxacc           89 active
+    32..39 dig           51 facc
+    40..47 sib           52..55 carried
+    48 bit               56 raw
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import babybear as bb
+from ..ops import ext as ext_ops
+from ..ops import merkle
+from ..ops import poseidon2 as p2
+from ..stark.air import Air
+from .poseidon2_air import (PERIOD, ROUNDS, Poseidon2Air,
+                            _external_linear_generic, generate_trace)
+
+M_STATE, T_STATE = 0, 16
+DIG, SIB, BIT, FOLD = 32, 40, 48, 49
+IDXACC, FACC, CARRIED, RAW = 50, 51, 52, 56
+MSG, ACTIVE = 57, 89
+WIDTH = 90
+MSG_LIMBS = 32
+
+# msg limb offsets
+(MF_FIRST, MF_K, MF_HALF, MF_DEPTH, MF_X, MF_LO, MF_HI, MF_BETA, MF_ROOT,
+ MF_COUT, MF_IDX, MF_SBIT, MF_LAST) = (0, 1, 2, 3, 4, 5, 9, 13, 17, 25,
+                                       29, 30, 31)
+
+_INV2 = bb.inv_host(2)
+
+
+def _chunks(limbs: list[int]) -> list[list[int]]:
+    vals = [int(v) % bb.P for v in limbs]
+    assert len(vals) == MSG_LIMBS
+    return [vals[i:i + 8] for i in range(0, MSG_LIMBS, 8)]
+
+
+class FriVerifyAir(Air):
+    width = WIDTH
+    max_degree = 8
+    num_pub_inputs = 8
+    # Poseidon2 round selectors + sel_pe, sel_seg_end, sp0..sp2,
+    # sel_fold, sel_foldpre, pw2, sel_first
+    num_periodic = Poseidon2Air.num_periodic + 9
+
+    def __init__(self, max_depth: int, seg_periods: int | None = None):
+        if max_depth < 1:
+            raise ValueError("max_depth must be >= 1")
+        need = max_depth + 2
+        natural = 1 << (need - 1).bit_length()
+        self.seg_periods = seg_periods or natural
+        if self.seg_periods < need or self.seg_periods < 8 \
+                or self.seg_periods & (self.seg_periods - 1):
+            raise ValueError(
+                f"seg_periods must be a power of two >= {max(need, 8)}")
+        self.max_depth = max_depth
+        self.seg_len = PERIOD * self.seg_periods
+
+    def cache_key(self) -> tuple:
+        return (type(self), self.width, self.max_degree,
+                self.num_pub_inputs, self.max_depth, self.seg_periods)
+
+    def periodic_columns(self, n: int):
+        if n % self.seg_len:
+            raise ValueError("trace length must be a multiple of seg_len")
+        base = Poseidon2Air().periodic_columns(PERIOD)
+        sel_pe = np.zeros(PERIOD, dtype=np.uint32)
+        sel_pe[PERIOD - 1] = 1
+        sl = self.seg_len
+
+        def marker(rows):
+            col = np.zeros(sl, dtype=np.uint32)
+            for r in rows:
+                col[r] = 1
+            return col
+
+        sel_seg_end = marker([sl - 1])
+        sp = [marker([PERIOD * (j + 1) - 1]) for j in range(3)]
+        fold_rows = [PERIOD * (1 + j) + PERIOD - 1
+                     for j in range(self.max_depth)]
+        sel_fold = marker(fold_rows)
+        sel_foldpre = marker(fold_rows[:-1])
+        pw2 = np.zeros(sl, dtype=np.uint32)
+        for j, r in enumerate(fold_rows):
+            pw2[r] = (1 << j) % bb.P
+        sel_first = np.zeros(n, dtype=np.uint32)
+        sel_first[0] = 1
+        return base + [sel_pe, sel_seg_end] + sp \
+            + [sel_fold, sel_foldpre, pw2, sel_first]
+
+    def _select(self, dig, sib, bit, ops):
+        one = ops.const(1)
+        inv = ops.sub(one, bit)
+        lo = [ops.add(ops.mul(inv, dig[i]), ops.mul(bit, sib[i]))
+              for i in range(8)]
+        hi = [ops.add(ops.mul(bit, dig[i]), ops.mul(inv, sib[i]))
+              for i in range(8)]
+        return lo + hi
+
+    def _absorbed(self, state, chunk, ops):
+        zero = ops.const(0)
+        padded = list(chunk) + [zero] * (16 - len(chunk))
+        mixed = [ops.add(state[j], padded[j]) for j in range(16)]
+        return _external_linear_generic(mixed, ops)
+
+    def constraints(self, local, nxt, periodic, ops):
+        nb = Poseidon2Air.num_periodic
+        base_p = periodic[:nb]
+        (sel_pe, sel_seg, sp0, sp1, sp2, sel_fold, sel_foldpre, pw2,
+         sel_first) = periodic[nb:]
+        one = ops.const(1)
+        zero = ops.const(0)
+        inv2 = ops.const(_INV2)
+
+        m_st = local[M_STATE:M_STATE + 16]
+        m_nst = nxt[M_STATE:M_STATE + 16]
+        t_st = local[T_STATE:T_STATE + 16]
+        t_nst = nxt[T_STATE:T_STATE + 16]
+        dig = local[DIG:DIG + 8]
+        ndig = nxt[DIG:DIG + 8]
+        sib = local[SIB:SIB + 8]
+        nsib = nxt[SIB:SIB + 8]
+        bit, nbit = local[BIT], nxt[BIT]
+        f, nf = local[FOLD], nxt[FOLD]
+        idxacc, nidxacc = local[IDXACC], nxt[IDXACC]
+        facc, nfacc = local[FACC], nxt[FACC]
+        carried = local[CARRIED:CARRIED + 4]
+        ncarried = nxt[CARRIED:CARRIED + 4]
+        raw, nraw = local[RAW], nxt[RAW]
+        msg = local[MSG:MSG + MSG_LIMBS]
+        nmsg = nxt[MSG:MSG + MSG_LIMBS]
+        active, nactive = local[ACTIVE], nxt[ACTIVE]
+
+        out = []
+
+        # ---- lane M: leaf sponge + f-gated folds --------------------------
+        cons_m = Poseidon2Air.constraints(self, m_st, m_nst, base_p, ops)
+        me_m = _external_linear_generic(m_st, ops)
+        leaf_next = self._absorbed([zero] * 16, nmsg[MF_LO:MF_LO + 8], ops)
+        load = _external_linear_generic(
+            self._select(ndig, nsib, nbit, ops), ops)
+        for j in range(16):
+            c = cons_m[j]
+            c = ops.add(c, ops.mul(sel_pe, ops.sub(m_st[j], me_m[j])))
+            # end of period 0: next input is the first compress (every
+            # ACTIVE layer has depth >= 1; padding segments idle-carry)
+            c = ops.add(c, ops.mul(sp0, ops.mul(active,
+                                                ops.sub(me_m[j], load[j]))))
+            # fold period ends: next input is the next compress when the
+            # next period still folds, else the idle carry M_E(state)
+            blend = [ops.add(ops.mul(nf, load[i]),
+                             ops.mul(ops.sub(one, nf), me_m[i]))
+                     for i in range(16)]
+            c = ops.add(c, ops.mul(sel_foldpre, ops.sub(me_m[j], blend[j])))
+            # segment end: fresh sponge on the next segment's leaf
+            c = ops.add(c, ops.mul(sel_seg, ops.sub(me_m[j], leaf_next[j])))
+            first_leaf = self._absorbed([zero] * 16,
+                                        msg[MF_LO:MF_LO + 8], ops)
+            c = ops.add(c, ops.mul(sel_first,
+                                   ops.sub(m_st[j], first_leaf[j])))
+            out.append(c)
+
+        # ---- lane T: transcript sponge ------------------------------------
+        cons_t = Poseidon2Air.constraints(self, t_st, t_nst, base_p, ops)
+        me_t = _external_linear_generic(t_st, ops)
+        absorbs = [(sp0, msg[8:16]), (sp1, msg[16:24]), (sp2, msg[24:32]),
+                   (sel_seg, nmsg[0:8])]
+        first_t = self._absorbed([zero] * 16, msg[0:8], ops)
+        for j in range(16):
+            c = cons_t[j]
+            c = ops.add(c, ops.mul(sel_pe, ops.sub(t_st[j], me_t[j])))
+            for sel, chunk in absorbs:
+                mixed = self._absorbed(t_st, chunk, ops)
+                c = ops.add(c, ops.mul(sel, ops.sub(me_t[j], mixed[j])))
+            c = ops.add(c, ops.mul(sel_first, ops.sub(t_st[j], first_t[j])))
+            out.append(c)
+
+        # ---- dig register: load at sp0, f-gated feed-forward at folds -----
+        keep_dig = ops.sub(ops.sub(one, sp0), sel_fold)
+        inv_b = ops.sub(one, bit)
+        for i in range(8):
+            left = ops.add(ops.mul(inv_b, dig[i]), ops.mul(bit, sib[i]))
+            ff = ops.add(m_st[i], left)
+            folded = ops.add(ops.mul(f, ff),
+                             ops.mul(ops.sub(one, f), dig[i]))
+            out.append(ops.add(
+                ops.add(ops.mul(keep_dig, ops.sub(ndig[i], dig[i])),
+                        ops.mul(sp0, ops.sub(ndig[i], m_st[i]))),
+                ops.mul(sel_fold, ops.sub(ndig[i], folded))))
+        # sib/bit update freely at load rows, hold otherwise
+        keep_path = ops.sub(ops.sub(one, sp0), sel_fold)
+        for i in range(8):
+            out.append(ops.mul(keep_path, ops.sub(nsib[i], sib[i])))
+        out.append(ops.mul(keep_path, ops.sub(nbit, bit)))
+        out.append(ops.mul(bit, ops.sub(bit, one)))
+
+        # ---- fold flag: boolean, constant per period, prefix-shaped -------
+        out.append(ops.mul(f, ops.sub(f, one)))
+        out.append(ops.mul(ops.sub(one, sel_pe), ops.sub(nf, f)))
+        out.append(ops.mul(sel_foldpre, ops.mul(nf, ops.sub(one, f))))
+        # period 1 always folds on active segments
+        out.append(ops.mul(sp0, ops.mul(active, ops.sub(one, nf))))
+
+        # ---- accumulators -------------------------------------------------
+        keep_acc = ops.sub(ops.sub(one, sel_fold), sel_seg)
+        step_idx = ops.mul(f, ops.mul(bit, pw2))
+        out.append(ops.add(
+            ops.add(ops.mul(keep_acc, ops.sub(nidxacc, idxacc)),
+                    ops.mul(sel_fold,
+                            ops.sub(nidxacc, ops.add(idxacc, step_idx)))),
+            ops.mul(sel_seg, nidxacc)))
+        out.append(ops.add(
+            ops.add(ops.mul(keep_acc, ops.sub(nfacc, facc)),
+                    ops.mul(sel_fold, ops.sub(nfacc, ops.add(facc, f)))),
+            ops.mul(sel_seg, nfacc)))
+
+        # ---- segment-end checks (active segments) -------------------------
+        seg_act = ops.mul(sel_seg, active)
+        # accumulated index / fold count match the absorbed message
+        out.append(ops.mul(seg_act, ops.sub(idxacc, msg[MF_IDX])))
+        out.append(ops.mul(seg_act, ops.sub(facc, msg[MF_DEPTH])))
+        # the path folds to the layer root
+        for i in range(8):
+            out.append(ops.mul(seg_act, ops.sub(dig[i], msg[MF_ROOT + i])))
+        # chaining vs the previous layer (skipped on each query's first)
+        chain = ops.mul(seg_act, ops.sub(one, msg[MF_FIRST]))
+        sbit = msg[MF_SBIT]
+        out.append(ops.mul(seg_act, ops.mul(sbit, ops.sub(sbit, one))))
+        for i in range(4):
+            got = ops.add(ops.mul(ops.sub(one, sbit), msg[MF_LO + i]),
+                          ops.mul(sbit, msg[MF_HI + i]))
+            out.append(ops.mul(chain, ops.sub(carried[i], got)))
+        out.append(ops.mul(chain, ops.sub(
+            raw, ops.add(msg[MF_IDX], ops.mul(sbit, msg[MF_HALF])))))
+        # fold equation: (cout - (lo+hi)/2) * 2x == beta * (lo - hi)
+        two_x = ops.add(msg[MF_X], msg[MF_X])
+        e = [ops.sub(msg[MF_COUT + i],
+                     ops.mul(ops.add(msg[MF_LO + i], msg[MF_HI + i]), inv2))
+             for i in range(4)]
+        d = [ops.sub(msg[MF_LO + i], msg[MF_HI + i]) for i in range(4)]
+        beta = [msg[MF_BETA + i] for i in range(4)]
+        # quartic ext product beta * d with x^4 = W reduction, generic ops
+        w_c = ops.const(ext_ops.W)
+        bd = []
+        for c_i in range(4):
+            acc = zero
+            for a_i in range(4):
+                b_i = c_i - a_i
+                if b_i < 0:
+                    b_i += 4
+                    term = ops.mul(w_c, ops.mul(beta[a_i], d[b_i]))
+                else:
+                    term = ops.mul(beta[a_i], d[b_i])
+                acc = ops.add(acc, term)
+            bd.append(acc)
+        for i in range(4):
+            out.append(ops.mul(seg_act,
+                               ops.sub(ops.mul(e[i], two_x), bd[i])))
+
+        # ---- carried / raw registers --------------------------------------
+        keep_seg = ops.sub(one, sel_seg)
+        for i in range(4):
+            out.append(ops.add(
+                ops.mul(keep_seg, ops.sub(ncarried[i], carried[i])),
+                ops.mul(sel_seg,
+                        ops.sub(ncarried[i], msg[MF_COUT + i]))))
+        out.append(ops.add(
+            ops.mul(keep_seg, ops.sub(nraw, raw)),
+            ops.mul(sel_seg, ops.sub(nraw, msg[MF_IDX]))))
+
+        # ---- message limbs / active flag ----------------------------------
+        for i in range(MSG_LIMBS):
+            out.append(ops.mul(keep_seg, ops.sub(nmsg[i], msg[i])))
+            out.append(ops.mul(ops.sub(one, active), msg[i]))
+        out.append(ops.mul(active, ops.sub(active, one)))
+        out.append(ops.mul(keep_seg, ops.sub(nactive, active)))
+        out.append(ops.mul(ops.mul(sel_seg, nactive), ops.sub(one, active)))
+        return out
+
+    def boundaries(self, pub_inputs, n: int):
+        digest = [int(v) % bb.P for v in pub_inputs[:8]]
+        out = [(n - 1, T_STATE + i, digest[i]) for i in range(8)]
+        out += [(0, IDXACC, 0), (0, FACC, 0)]
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Host schedule: segment messages, digest, trace generation
+# ---------------------------------------------------------------------------
+
+def segment_count(num_items: int) -> int:
+    need = num_items + 1
+    return 1 << (need - 1).bit_length()
+
+
+def transcript_digest(messages: list[list[int]], seg_periods: int,
+                      segments: int | None = None) -> list[int]:
+    """The public digest: sponge over every segment's 32 limbs under the
+    in-trace schedule (4 absorb periods then idle carries per segment)."""
+    if segments is None:
+        segments = segment_count(len(messages))
+    state = [0] * 16
+    for k in range(segments):
+        limbs = (messages[k] if k < len(messages) else [0] * MSG_LIMBS)
+        chunks = _chunks(limbs)
+        for j in range(seg_periods):
+            if j < 4:
+                state = [(state[i] + chunks[j][i]) % bb.P if i < 8
+                         else state[i] for i in range(16)]
+            state = p2.permute_ref(state)
+    return state[:8]
+
+
+def generate_fri_verify_trace(items: list[dict], max_depth: int,
+                              seg_periods: int,
+                              segments: int | None = None) -> np.ndarray:
+    """Build the honest trace.  Each item is one (query, layer) check:
+
+        {"msg": [32 limbs], "path": [[8 limbs] per level], "bits": [...]}
+
+    with len(path) == len(bits) == msg[MF_DEPTH].
+    """
+    if segments is None:
+        segments = segment_count(len(items))
+    if segments <= len(items):
+        raise ValueError("need at least one inert tail segment")
+    S = seg_periods
+    n = segments * S * PERIOD
+    tr = np.zeros((n, WIDTH), dtype=np.uint32)
+
+    zero_msg = [0] * MSG_LIMBS
+    lane_m_in = None
+    lane_t_in = [0] * 16
+    dig_reg = [0] * 8
+    sib_reg, bit_reg = [0] * 8, 0
+    carried_reg, raw_reg = [0] * 4, 0
+
+    for k in range(segments):
+        active = 1 if k < len(items) else 0
+        item = items[k] if active else None
+        msg = [int(v) % bb.P for v in item["msg"]] if active else zero_msg
+        depth = msg[MF_DEPTH] if active else 0
+        path = item["path"] if active else []
+        bits = item["bits"] if active else []
+        chunks = _chunks(msg)
+        leaf_chunk = msg[MF_LO:MF_LO + 8]
+        seg0 = k * S * PERIOD
+        if k == 0:
+            lane_m_in = [leaf_chunk[i] if i < 8 else 0 for i in range(16)]
+            lane_t_in = [chunks[0][i] if i < 8 else 0 for i in range(16)]
+        idxacc = 0
+        facc = 0
+        for j in range(S):
+            base = seg0 + j * PERIOD
+            sl = slice(base, base + PERIOD)
+            fold_now = 1 if (1 <= j <= depth) else 0
+            tr[sl, DIG:DIG + 8] = dig_reg
+            tr[sl, SIB:SIB + 8] = sib_reg
+            tr[sl, BIT] = bit_reg
+            tr[sl, FOLD] = fold_now
+            tr[sl, IDXACC] = idxacc
+            tr[sl, FACC] = facc
+            tr[sl, CARRIED:CARRIED + 4] = carried_reg
+            tr[sl, RAW] = raw_reg
+            tr[sl, MSG:MSG + MSG_LIMBS] = msg
+            tr[sl, ACTIVE] = active
+            rows_m = generate_trace(lane_m_in)
+            rows_t = generate_trace(lane_t_in)
+            tr[sl, M_STATE:M_STATE + 16] = rows_m
+            tr[sl, T_STATE:T_STATE + 16] = rows_t
+            end_m = [int(v) for v in rows_m[ROUNDS]]
+            end_t = [int(v) for v in rows_t[ROUNDS]]
+            # accumulator updates AFTER fold periods
+            if fold_now:
+                idxacc = (idxacc + bit_reg * ((1 << (j - 1)) % bb.P)) % bb.P
+                facc += 1
+            # lane T absorb schedule
+            lane_t_in = list(end_t)
+            if j < 3:
+                lane_t_in = [(end_t[i] + chunks[j + 1][i]) % bb.P
+                             if i < 8 else end_t[i] for i in range(16)]
+            # lane M handoffs
+            if j == S - 1:
+                break
+            if j == 0:
+                dig_reg = end_m[:8]
+                nxt_fold = 1 if depth >= 1 else 0
+            elif fold_now:
+                inp = lane_m_in
+                dig_reg = [(end_m[i] + inp[i]) % bb.P for i in range(8)]
+                nxt_fold = 1 if (j + 1 <= depth) else 0
+            else:
+                nxt_fold = 0
+            if (j == 0 or fold_now) and nxt_fold:
+                lvl = j  # fold during period j+1 consumes level j
+                sib_reg = [int(v) % bb.P for v in path[lvl]]
+                bit_reg = int(bits[lvl])
+                lane_m_in = (list(sib_reg) + list(dig_reg)) if bit_reg \
+                    else (list(dig_reg) + list(sib_reg))
+            else:
+                lane_m_in = list(end_m)
+        # segment end: register updates and next-segment lane inputs
+        carried_reg = [msg[MF_COUT + i] for i in range(4)]
+        raw_reg = msg[MF_IDX]
+        if k + 1 < segments:
+            nxt_msg = ([int(v) % bb.P for v in items[k + 1]["msg"]]
+                       if k + 1 < len(items) else zero_msg)
+            nxt_chunks = _chunks(nxt_msg)
+            lane_m_in = [nxt_msg[MF_LO + i] if i < 8 else 0
+                         for i in range(16)]
+            lane_t_in = [(end_t[i] + nxt_chunks[0][i]) % bb.P
+                         if i < 8 else end_t[i] for i in range(16)]
+            # sib/bit persist across the boundary (the keep constraints
+            # hold them; the next segment's sp0 load refreshes them)
+    return tr

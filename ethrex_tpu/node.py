@@ -28,6 +28,9 @@ class Node:
         self._producer_thread = None
         self._stop = threading.Event()
         self.lock = threading.RLock()
+        # new-canonical-block observers (websocket subscriptions etc.);
+        # `on_new_block` stays the single p2p gossip hook
+        self.block_listeners: list = []
 
     # ------------------------------------------------------------------
     def head_state_root(self) -> bytes:
@@ -106,6 +109,11 @@ class Node:
             try:
                 hook(block)
             except Exception:  # noqa: BLE001 — gossip must not fail callers
+                pass
+        for listener in list(self.block_listeners):
+            try:
+                listener(block)
+            except Exception:  # noqa: BLE001 — observers must not fail us
                 pass
 
     def import_block(self, block) -> bool:

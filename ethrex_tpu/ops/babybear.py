@@ -179,6 +179,89 @@ def sum_mod(x, axis: int = -1):
 
 
 # ---------------------------------------------------------------------------
+# MXU modular matmul (8-bit-limb bf16 matmuls, exact f32 accumulation)
+# ---------------------------------------------------------------------------
+
+_LIMBS = 4          # 4 x 8-bit limbs cover p < 2^31
+_CHUNK = 128        # max contraction length per f32 accumulation:
+#                     128 * 255^2 = 8.3e6 < 2^24 keeps every partial sum
+#                     exactly representable in f32 (MXU accumulates f32)
+
+
+def mod_matmul(a, b, montgomery: bool = True):
+    """Exact modular matmul `a @ b mod p` on the MXU.
+
+    a: (..., n, k), b: (k, m), both uint32 arrays of field elements < p.
+    Splits each operand into 4 8-bit limbs (bf16 — integers <= 255 are
+    exact), runs the 16 limb matmuls on the MXU with f32 accumulation
+    (contraction chunked to 128 so every partial product sum stays below
+    2^24, the f32 exact-integer bound), then recombines the 7 diagonal
+    sums mod p on the VPU.
+
+    With montgomery=True (the default), inputs are Montgomery-form and so
+    is the result: the recombination constants absorb the extra R factor
+    (sum aR*bR = R^2*sum ab; folding 2^{8s} in CANONICAL form through
+    mont_mul strips one R).  With montgomery=False all values are
+    canonical and the result is the plain modular product.
+
+    This is the building block for the DEEP gamma-contraction, the
+    blocked zeta evaluation, and the radix-128 matmul NTT — the work the
+    reference's prover does in CUDA kernels (SURVEY.md §2.6) mapped onto
+    the TPU's systolic array instead.
+    """
+    a = _u32(a)
+    b = _u32(b)
+    k = a.shape[-1]
+    if b.shape[0] != k:
+        raise ValueError(f"contraction mismatch: {a.shape} @ {b.shape}")
+    a_limbs = [((a >> (8 * i)) & np.uint32(0xFF)).astype(jnp.bfloat16)
+               for i in range(_LIMBS)]
+    b_limbs = [((b >> (8 * j)) & np.uint32(0xFF)).astype(jnp.bfloat16)
+               for j in range(_LIMBS)]
+
+    n_chunks = (k + _CHUNK - 1) // _CHUNK
+    # int32 diagonal accumulators: each partial matmul entry < 128*255^2
+    # (~2^23) and up to 4 limb pairs land on one diagonal, so up to 64
+    # chunks (4 * 64 * 8_323_200 < 2^31) accumulate exactly before the
+    # running total must fold into the mod-p accumulator.
+    max_group = (1 << 31) // (_LIMBS * 8_323_200)  # 64 chunks
+
+    out = None
+    diag = [None] * (2 * _LIMBS - 1)
+    chunks_in_diag = 0
+
+    def flush(diag, out):
+        for s, c in enumerate(diag):
+            if c is None:
+                continue
+            c = c.astype(jnp.uint32)
+            c = jnp.where(c >= P_U32, c - P_U32, c)  # c < 2^31 < 2p
+            if montgomery:
+                t_s = np.uint32((1 << (8 * s)) % P)       # canonical
+            else:
+                t_s = np.uint32(int(to_mont_host((1 << (8 * s)) % P)))
+            term = mont_mul(c, t_s)
+            out = term if out is None else add(out, term)
+        return out
+
+    for ci in range(n_chunks):
+        sl = slice(ci * _CHUNK, min((ci + 1) * _CHUNK, k))
+        for i in range(_LIMBS):
+            for j in range(_LIMBS):
+                pp = jnp.matmul(
+                    a_limbs[i][..., sl], b_limbs[j][sl, :],
+                    preferred_element_type=jnp.float32).astype(jnp.int32)
+                s = i + j
+                diag[s] = pp if diag[s] is None else diag[s] + pp
+        chunks_in_diag += 1
+        if chunks_in_diag >= max_group:
+            out = flush(diag, out)
+            diag = [None] * (2 * _LIMBS - 1)
+            chunks_in_diag = 0
+    return flush(diag, out)
+
+
+# ---------------------------------------------------------------------------
 # Roots of unity / domain helpers (host-side bignum, device arrays out)
 # ---------------------------------------------------------------------------
 

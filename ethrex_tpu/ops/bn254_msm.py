@@ -1,0 +1,621 @@
+"""BN254 G1 multi-scalar multiplication on TPU.
+
+The Groth16 wrap's prover hot loop (crypto/groth16.py) is three G1 MSMs
+over witness-length point tables; the reference runs them inside its zkVM
+SDKs' CUDA provers (SURVEY.md §2.6, BASELINE config 4 "Groth16 BN254
+wrap").  Here the 254-bit base-field arithmetic runs in 16 uint32 lanes of
+16-bit limbs — every partial product a_i*b_j fits uint32 exactly, partial
+sums are carried in split lo/hi-16 accumulators (<= 2^21, no overflow),
+and a CIOS-style Montgomery reduction interleaves per-limb steps, all
+shape-uniform so the whole point-add vectorizes over thousands of points.
+
+MSM algorithm: per scalar bit (LSB-first), a masked accumulation into a
+running bucket, then one doubling of the base column per bit — i.e. the
+classic parallel double-and-add with the point axis vectorized:
+
+    acc_i <- acc_i + bit_ij ? P_i : O        (lane-parallel, j ascending)
+    P_i   <- 2 P_i
+    result = tree_sum_i acc_i                (log2 N masked point adds)
+
+Points use Jacobian coordinates with an explicit infinity flag (Z = 0) so
+the add formulas stay branch-free; the doubling/add path handles the
+P == Q case with a select (complete enough for MSM inputs, verified
+against the host implementation in tests/test_bn254_msm.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..crypto import bn254
+
+L = 16          # limbs
+LB = 16         # bits per limb
+MASK = np.uint32(0xFFFF)
+
+P_INT = bn254.P
+R_INT = (1 << (L * LB)) % P_INT          # Montgomery radix 2^256 mod p
+R2_INT = (R_INT * R_INT) % P_INT
+NP_INT = (-pow(P_INT, -1, 1 << LB)) % (1 << LB)   # -p^-1 mod 2^16
+
+
+def _to_limbs(x: int) -> np.ndarray:
+    return np.array([(x >> (LB * i)) & 0xFFFF for i in range(L)],
+                    dtype=np.uint32)
+
+
+def _from_limbs(a) -> int:
+    return sum(int(v) << (LB * i) for i, v in enumerate(np.asarray(a)))
+
+
+P_LIMBS = _to_limbs(P_INT)
+NP_U32 = np.uint32(NP_INT)
+
+
+def to_mont_host(x: int) -> np.ndarray:
+    return _to_limbs((x % P_INT) * R_INT % P_INT)
+
+
+def from_mont_host(a) -> int:
+    return _from_limbs(a) * pow(R_INT, P_INT - 2, P_INT) % P_INT
+
+
+# ---------------------------------------------------------------------------
+# limb-vector field arithmetic; operands (..., 16) uint32 with 16-bit limbs
+# ---------------------------------------------------------------------------
+
+def _ge(a, b):
+    """a >= b lexicographically from the top limb down; returns bool array."""
+    gt = jnp.zeros(a.shape[:-1], dtype=bool)
+    eq = jnp.ones(a.shape[:-1], dtype=bool)
+    for i in range(L - 1, -1, -1):
+        gt = gt | (eq & (a[..., i] > b[..., i]))
+        eq = eq & (a[..., i] == b[..., i])
+    return gt | eq
+
+
+def _sub_raw(a, b):
+    """a - b assuming a >= b (schoolbook borrow chain)."""
+    out = []
+    borrow = jnp.zeros(a.shape[:-1], dtype=jnp.uint32)
+    for i in range(L):
+        d = a[..., i] - b[..., i] - borrow
+        borrow = (d >> 31)                 # went negative in uint32 wrap
+        out.append(d & MASK)
+    return jnp.stack(out, axis=-1)
+
+
+def _add_raw(a, b):
+    out = []
+    carry = jnp.zeros(a.shape[:-1], dtype=jnp.uint32)
+    for i in range(L):
+        s = a[..., i] + b[..., i] + carry
+        carry = s >> LB
+        out.append(s & MASK)
+    return jnp.stack(out, axis=-1), carry
+
+
+def fadd(a, b):
+    s, carry = _add_raw(a, b)
+    p = jnp.asarray(P_LIMBS)
+    over = (carry > 0) | _ge(s, jnp.broadcast_to(p, s.shape))
+    red = _sub_raw(s, jnp.broadcast_to(p, s.shape))
+    return jnp.where(over[..., None], red, s)
+
+
+def fsub(a, b):
+    p = jnp.asarray(P_LIMBS)
+    lt = ~_ge(a, b)
+    ap, _ = _add_raw(a, jnp.broadcast_to(p, a.shape))
+    src = jnp.where(lt[..., None], ap, a)
+    return _sub_raw(src, b)
+
+
+def fmul(a, b):
+    """Montgomery product over 16-bit limbs (CIOS), limb-axis-vectorized.
+
+    t is a (..., L+2) uint32 limb vector with a small carry margin; each
+    of the L outer rounds adds a_i * b (partial products < 2^32 split into
+    lo/hi-16) plus m * p, then shifts one limb.  All limb values stay well
+    below 2^32 (sums of <= ~2*L 16-bit terms plus carries).  The body is
+    ~10 vector ops per round so the traced graph stays small enough for
+    fast XLA compiles even inside the 254-step MSM scan.
+    """
+    shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    t = jnp.zeros(shape + (L + 2,), dtype=jnp.uint32)
+    p = jnp.asarray(P_LIMBS)
+    zero_tail = jnp.zeros(shape + (1,), dtype=jnp.uint32)
+    pad2 = [(0, 0)] * len(shape)
+
+    def add_lo_hi(t, v):
+        # t[0:L] += v & MASK; t[1:L+1] += v >> 16 — as pads (XLA:CPU
+        # compiles scatter updates pathologically slowly)
+        lo = jnp.pad(v & MASK, pad2 + [(0, 2)])
+        hi = jnp.pad(v >> LB, pad2 + [(1, 1)])
+        return t + lo + hi
+
+    for i in range(L):
+        t = add_lo_hi(t, a[..., i:i + 1] * b)   # products < 2^32, exact
+        m = ((t[..., 0] & MASK) * NP_U32) & MASK
+        t = add_lo_hi(t, m[..., None] * p)
+        carry0 = t[..., 0] >> LB           # t[0] now ends in 16 zero bits
+        t = jnp.concatenate([t[..., 1:], zero_tail], axis=-1)
+        t = t + jnp.pad(carry0[..., None], pad2 + [(0, L + 1)])
+    # final carry propagation
+    out = []
+    carry = jnp.zeros(shape, dtype=jnp.uint32)
+    for j in range(L):
+        v = t[..., j] + carry
+        out.append(v & MASK)
+        carry = v >> LB
+    res = jnp.stack(out, axis=-1)
+    over = (carry + t[..., L] > 0) \
+        | _ge(res, jnp.broadcast_to(p, res.shape))
+    red = _sub_raw(res, jnp.broadcast_to(p, res.shape))
+    return jnp.where(over[..., None], red, res)
+
+
+def fsqr(a):
+    return fmul(a, a)
+
+
+# ---------------------------------------------------------------------------
+# field dispatch: Fp = (..., 16) limbs; Fp2 = (..., 2, 16) limbs (c0, c1)
+# ---------------------------------------------------------------------------
+
+class FpOps:
+    add = staticmethod(fadd)
+    sub = staticmethod(fsub)
+    mul = staticmethod(fmul)
+    sqr = staticmethod(fsqr)
+
+    @staticmethod
+    def is_zero(v):
+        return jnp.all(v == 0, axis=-1)
+
+    @staticmethod
+    def expand(mask):
+        """bool (...) -> broadcastable over an element's limb axes."""
+        return mask[..., None]
+
+
+class Fp2Ops:
+    """BN254 Fp2 = Fp[i]/(i^2 + 1) over limb pairs."""
+
+    @staticmethod
+    def add(a, b):
+        return jnp.stack([fadd(a[..., 0, :], b[..., 0, :]),
+                          fadd(a[..., 1, :], b[..., 1, :])], axis=-2)
+
+    @staticmethod
+    def sub(a, b):
+        return jnp.stack([fsub(a[..., 0, :], b[..., 0, :]),
+                          fsub(a[..., 1, :], b[..., 1, :])], axis=-2)
+
+    @staticmethod
+    def mul(a, b):
+        a0, a1 = a[..., 0, :], a[..., 1, :]
+        b0, b1 = b[..., 0, :], b[..., 1, :]
+        t0 = fmul(a0, b0)
+        t1 = fmul(a1, b1)
+        mid = fmul(fadd(a0, a1), fadd(b0, b1))
+        return jnp.stack([fsub(t0, t1),
+                          fsub(fsub(mid, t0), t1)], axis=-2)
+
+    @classmethod
+    def sqr(cls, a):
+        return cls.mul(a, a)
+
+    @staticmethod
+    def is_zero(v):
+        return jnp.all(v == 0, axis=(-1, -2))
+
+    @staticmethod
+    def expand(mask):
+        return mask[..., None, None]
+
+
+def point_double(X, Y, Z, F=FpOps):
+    A = F.sqr(X)
+    B_ = F.sqr(Y)
+    C = F.sqr(B_)
+    t = F.sub(F.sqr(F.add(X, B_)), F.add(A, C))
+    D = F.add(t, t)                        # 2*((X+B)^2 - A - C)
+    E = F.add(F.add(A, A), A)              # 3A (curve a = 0 in both groups)
+    Fq = F.sqr(E)
+    X3 = F.sub(Fq, F.add(D, D))
+    c4 = F.add(F.add(C, C), F.add(C, C))
+    c8 = F.add(c4, c4)
+    Y3 = F.sub(F.mul(E, F.sub(D, X3)), c8)
+    Z3 = F.mul(F.add(Y, Y), Z)
+    inf = F.expand(F.is_zero(Z))
+    return (jnp.where(inf, X, X3), jnp.where(inf, Y, Y3),
+            jnp.where(inf, Z, Z3))
+
+
+def point_add(X1, Y1, Z1, X2, Y2, Z2, F=FpOps):
+    """Jacobian add handling inf on either side and P == Q via doubling."""
+    Z1Z1 = F.sqr(Z1)
+    Z2Z2 = F.sqr(Z2)
+    U1 = F.mul(X1, Z2Z2)
+    U2 = F.mul(X2, Z1Z1)
+    S1 = F.mul(F.mul(Y1, Z2), Z2Z2)
+    S2 = F.mul(F.mul(Y2, Z1), Z1Z1)
+    H = F.sub(U2, U1)
+    Rr = F.sub(S2, S1)
+    h_zero = F.is_zero(H)
+    r_zero = F.is_zero(Rr)
+    HH = F.sqr(H)
+    HHH = F.mul(H, HH)
+    V = F.mul(U1, HH)
+    X3 = F.sub(F.sub(F.sqr(Rr), HHH), F.add(V, V))
+    Y3 = F.sub(F.mul(Rr, F.sub(V, X3)), F.mul(S1, HHH))
+    Z3 = F.mul(F.mul(Z1, Z2), H)
+    # doubling case: H == 0 and R == 0
+    dX, dY, dZ = point_double(X1, Y1, Z1, F)
+    dbl = F.expand(h_zero & r_zero)
+    X3 = jnp.where(dbl, dX, X3)
+    Y3 = jnp.where(dbl, dY, Y3)
+    Z3 = jnp.where(dbl, dZ, Z3)
+    # opposite points (H == 0, R != 0) -> infinity
+    opp = F.expand(h_zero & ~r_zero)
+    X3 = jnp.where(opp, jnp.zeros_like(X3), X3)
+    Y3 = jnp.where(opp, jnp.zeros_like(Y3), Y3)
+    Z3 = jnp.where(opp, jnp.zeros_like(Z3), Z3)
+    # infinity on either input
+    i1 = F.expand(F.is_zero(Z1))
+    i2 = F.expand(F.is_zero(Z2))
+    X3 = jnp.where(i1, X2, jnp.where(i2, X1, X3))
+    Y3 = jnp.where(i1, Y2, jnp.where(i2, Y1, Y3))
+    Z3 = jnp.where(i1, Z2, jnp.where(i2, Z1, Z3))
+    return X3, Y3, Z3
+
+
+# ---------------------------------------------------------------------------
+# MSM
+# ---------------------------------------------------------------------------
+
+def points_to_device(points: list) -> tuple:
+    """Affine host points [(x, y) or None] -> Montgomery Jacobian arrays."""
+    n = len(points)
+    X = np.zeros((n, L), dtype=np.uint32)
+    Y = np.zeros((n, L), dtype=np.uint32)
+    Z = np.zeros((n, L), dtype=np.uint32)
+    one = to_mont_host(1)
+    for i, pt in enumerate(points):
+        if pt is None:
+            continue
+        X[i] = to_mont_host(pt[0])
+        Y[i] = to_mont_host(pt[1])
+        Z[i] = one
+    return jnp.asarray(X), jnp.asarray(Y), jnp.asarray(Z)
+
+
+def scalars_to_bits(scalars: list[int], bits: int = 256) -> np.ndarray:
+    n = len(scalars)
+    out = np.zeros((n, bits), dtype=np.uint32)
+    for i, s in enumerate(scalars):
+        s = int(s) % bn254.R
+        for j in range(bits):
+            out[i, j] = (s >> j) & 1
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("bits", "fp2"))
+def _msm_device(X, Y, Z, bit_rows, bits: int, fp2: bool = False):
+    F = Fp2Ops if fp2 else FpOps
+
+    def body(carry, bit_col):
+        X, Y, Z, aX, aY, aZ = carry
+        mask = bit_col.astype(jnp.uint32)
+        mask = mask[:, None, None] if fp2 else mask[:, None]
+        # masked add: add P where bit else add infinity
+        aX, aY, aZ = point_add(aX, aY, aZ, X * mask, Y * mask, Z * mask,
+                               F)
+        X, Y, Z = point_double(X, Y, Z, F)
+        return (X, Y, Z, aX, aY, aZ), None
+
+    acc = (jnp.zeros_like(X), jnp.zeros_like(Y), jnp.zeros_like(Z))
+    (X, Y, Z, aX, aY, aZ), _ = jax.lax.scan(
+        body, (X, Y, Z) + acc, jnp.moveaxis(bit_rows, 0, 1)[:bits])
+    # tree-sum the per-point accumulators
+    pad_spec = ((0, 1), (0, 0), (0, 0)) if fp2 else ((0, 1), (0, 0))
+    n = aX.shape[0]
+    while n > 1:
+        half = (n + 1) // 2
+        if half * 2 - n:
+            aX = jnp.pad(aX, pad_spec)
+            aY = jnp.pad(aY, pad_spec)
+            aZ = jnp.pad(aZ, pad_spec)
+        aX, aY, aZ = point_add(aX[:half], aY[:half], aZ[:half],
+                               aX[half:], aY[half:], aZ[half:], F)
+        n = half
+    return aX[0], aY[0], aZ[0]
+
+
+# ---------------------------------------------------------------------------
+# numpy substrate: identical limb algorithms on uint64 intermediates.
+# XLA:CPU compiles the deep uint32 point-op graphs pathologically slowly
+# (~150 s for one point_add), so when the session's backend is the CPU
+# (tests, dev boxes) the MSM runs here instead; the JAX path above is the
+# TPU path.  Both substrates are differential-tested against the host
+# bignum implementation (tests/test_bn254_msm.py).
+# ---------------------------------------------------------------------------
+
+_MASK64 = np.uint64(0xFFFF)
+_LB64 = np.uint64(LB)
+_P64 = P_LIMBS.astype(np.uint64)
+_NP64 = np.uint64(NP_INT)
+
+
+def _np_ge(a, b):
+    gt = np.zeros(a.shape[:-1], dtype=bool)
+    eq = np.ones(a.shape[:-1], dtype=bool)
+    for i in range(L - 1, -1, -1):
+        gt |= eq & (a[..., i] > b[..., i])
+        eq &= a[..., i] == b[..., i]
+    return gt | eq
+
+
+def _np_sub_raw(a, b):
+    out = np.empty_like(a)
+    borrow = np.zeros(a.shape[:-1], dtype=np.uint64)
+    for i in range(L):
+        d = a[..., i] - b[..., i] - borrow
+        borrow = (d >> np.uint64(63)) & np.uint64(1)
+        out[..., i] = d & _MASK64
+    return out
+
+
+def np_fadd(a, b):
+    s = a + b
+    carry = np.zeros(s.shape[:-1], dtype=np.uint64)
+    for i in range(L):
+        v = s[..., i] + carry
+        s[..., i] = v & _MASK64
+        carry = v >> _LB64
+    over = (carry > 0) | _np_ge(s, _P64)
+    red = _np_sub_raw(s, np.broadcast_to(_P64, s.shape))
+    return np.where(over[..., None], red, s)
+
+
+def np_fsub(a, b):
+    lt = ~_np_ge(a, b)
+    ap = a + np.where(lt[..., None], _P64, np.uint64(0))
+    # normalize the addition's limb carries before the raw subtract
+    carry = np.zeros(ap.shape[:-1], dtype=np.uint64)
+    out = np.empty_like(ap)
+    for i in range(L):
+        v = ap[..., i] + carry
+        out[..., i] = v & _MASK64
+        carry = v >> _LB64
+    return _np_sub_raw(out, b)
+
+
+def np_fmul(a, b):
+    shape = np.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    t = np.zeros(shape + (L + 2,), dtype=np.uint64)
+    a = np.broadcast_to(a, shape + (L,))
+    b = np.broadcast_to(b, shape + (L,))
+    for i in range(L):
+        prod = a[..., i:i + 1] * b
+        t[..., 0:L] += prod & _MASK64
+        t[..., 1:L + 1] += prod >> _LB64
+        m = ((t[..., 0] & _MASK64) * _NP64) & _MASK64
+        mp = m[..., None] * _P64
+        t[..., 0:L] += mp & _MASK64
+        t[..., 1:L + 1] += mp >> _LB64
+        carry0 = t[..., 0] >> _LB64
+        t[..., :-1] = t[..., 1:]
+        t[..., -1] = 0
+        t[..., 0] += carry0
+    out = np.empty(shape + (L,), dtype=np.uint64)
+    carry = np.zeros(shape, dtype=np.uint64)
+    for j in range(L):
+        v = t[..., j] + carry
+        out[..., j] = v & _MASK64
+        carry = v >> _LB64
+    over = (carry + t[..., L] > 0) | _np_ge(out, _P64)
+    red = _np_sub_raw(out, np.broadcast_to(_P64, out.shape))
+    return np.where(over[..., None], red, out)
+
+
+class NpFpOps:
+    add = staticmethod(np_fadd)
+    sub = staticmethod(np_fsub)
+    mul = staticmethod(np_fmul)
+
+    @classmethod
+    def sqr(cls, a):
+        return np_fmul(a, a)
+
+    @staticmethod
+    def is_zero(v):
+        return np.all(v == 0, axis=-1)
+
+    @staticmethod
+    def expand(mask):
+        return mask[..., None]
+
+
+class NpFp2Ops:
+    @staticmethod
+    def add(a, b):
+        return np.stack([np_fadd(a[..., 0, :], b[..., 0, :]),
+                         np_fadd(a[..., 1, :], b[..., 1, :])], axis=-2)
+
+    @staticmethod
+    def sub(a, b):
+        return np.stack([np_fsub(a[..., 0, :], b[..., 0, :]),
+                         np_fsub(a[..., 1, :], b[..., 1, :])], axis=-2)
+
+    @staticmethod
+    def mul(a, b):
+        a0, a1 = a[..., 0, :], a[..., 1, :]
+        b0, b1 = b[..., 0, :], b[..., 1, :]
+        t0 = np_fmul(a0, b0)
+        t1 = np_fmul(a1, b1)
+        mid = np_fmul(np_fadd(a0, a1), np_fadd(b0, b1))
+        return np.stack([np_fsub(t0, t1),
+                         np_fsub(np_fsub(mid, t0), t1)], axis=-2)
+
+    @classmethod
+    def sqr(cls, a):
+        return cls.mul(a, a)
+
+    @staticmethod
+    def is_zero(v):
+        return np.all(v == 0, axis=(-1, -2))
+
+    @staticmethod
+    def expand(mask):
+        return mask[..., None, None]
+
+
+def _np_point_double(X, Y, Z, F):
+    A = F.sqr(X)
+    B_ = F.sqr(Y)
+    C = F.sqr(B_)
+    t = F.sub(F.sqr(F.add(X, B_)), F.add(A, C))
+    D = F.add(t, t)
+    E = F.add(F.add(A, A), A)
+    Fq = F.sqr(E)
+    X3 = F.sub(Fq, F.add(D, D))
+    c4 = F.add(F.add(C, C), F.add(C, C))
+    c8 = F.add(c4, c4)
+    Y3 = F.sub(F.mul(E, F.sub(D, X3)), c8)
+    Z3 = F.mul(F.add(Y, Y), Z)
+    inf = F.expand(F.is_zero(Z))
+    return (np.where(inf, X, X3), np.where(inf, Y, Y3),
+            np.where(inf, Z, Z3))
+
+
+def _np_point_add(X1, Y1, Z1, X2, Y2, Z2, F):
+    Z1Z1 = F.sqr(Z1)
+    Z2Z2 = F.sqr(Z2)
+    U1 = F.mul(X1, Z2Z2)
+    U2 = F.mul(X2, Z1Z1)
+    S1 = F.mul(F.mul(Y1, Z2), Z2Z2)
+    S2 = F.mul(F.mul(Y2, Z1), Z1Z1)
+    H = F.sub(U2, U1)
+    Rr = F.sub(S2, S1)
+    h_zero = F.is_zero(H)
+    r_zero = F.is_zero(Rr)
+    HH = F.sqr(H)
+    HHH = F.mul(H, HH)
+    V = F.mul(U1, HH)
+    X3 = F.sub(F.sub(F.sqr(Rr), HHH), F.add(V, V))
+    Y3 = F.sub(F.mul(Rr, F.sub(V, X3)), F.mul(S1, HHH))
+    Z3 = F.mul(F.mul(Z1, Z2), H)
+    dX, dY, dZ = _np_point_double(X1, Y1, Z1, F)
+    dbl = F.expand(h_zero & r_zero)
+    X3 = np.where(dbl, dX, X3)
+    Y3 = np.where(dbl, dY, Y3)
+    Z3 = np.where(dbl, dZ, Z3)
+    opp = F.expand(h_zero & ~r_zero)
+    X3 = np.where(opp, 0, X3)
+    Y3 = np.where(opp, 0, Y3)
+    Z3 = np.where(opp, 0, Z3)
+    i1 = F.expand(F.is_zero(Z1))
+    i2 = F.expand(F.is_zero(Z2))
+    X3 = np.where(i1, X2, np.where(i2, X1, X3))
+    Y3 = np.where(i1, Y2, np.where(i2, Y1, Y3))
+    Z3 = np.where(i1, Z2, np.where(i2, Z1, Z3))
+    return X3, Y3, Z3
+
+
+def _np_msm(X, Y, Z, bit_rows, fp2: bool):
+    F = NpFp2Ops if fp2 else NpFpOps
+    aX, aY, aZ = (np.zeros_like(X), np.zeros_like(Y), np.zeros_like(Z))
+    for j in range(bit_rows.shape[1]):
+        mask = bit_rows[:, j].astype(np.uint64)
+        mask = mask[:, None, None] if fp2 else mask[:, None]
+        aX, aY, aZ = _np_point_add(aX, aY, aZ, X * mask, Y * mask,
+                                   Z * mask, F)
+        X, Y, Z = _np_point_double(X, Y, Z, F)
+    n = aX.shape[0]
+    while n > 1:
+        half = (n + 1) // 2
+        if half * 2 - n:
+            pad = ((0, 1), (0, 0), (0, 0)) if fp2 else ((0, 1), (0, 0))
+            aX = np.pad(aX, pad)
+            aY = np.pad(aY, pad)
+            aZ = np.pad(aZ, pad)
+        aX, aY, aZ = _np_point_add(aX[:half], aY[:half], aZ[:half],
+                                   aX[half:], aY[half:], aZ[half:], F)
+        n = half
+    return aX[0], aY[0], aZ[0]
+
+
+def _run_msm(X, Y, Z, scalars, fp2: bool):
+    max_s = max((int(s) % bn254.R for s in scalars), default=0)
+    bits = max(1, max_s.bit_length())
+    bit_rows = scalars_to_bits(scalars, bits)
+    if jax.default_backend() == "cpu":
+        out = _np_msm(np.asarray(X, dtype=np.uint64),
+                      np.asarray(Y, dtype=np.uint64),
+                      np.asarray(Z, dtype=np.uint64),
+                      bit_rows, fp2)
+        return tuple(np.asarray(v, dtype=np.uint32) for v in out)
+    return jax.device_get(_msm_device(X, Y, Z, jnp.asarray(bit_rows),
+                                      bits, fp2))
+
+
+def msm(points: list, scalars: list[int]) -> tuple | None:
+    """sum_i scalars[i] * points[i] over G1; returns affine (x, y) or None
+    (infinity).  Points are host affine ints; compute runs device-side."""
+    if len(points) != len(scalars):
+        raise ValueError("points/scalars length mismatch")
+    if not points:
+        return None
+    X, Y, Z = points_to_device(points)
+    aX, aY, aZ = _run_msm(X, Y, Z, scalars, fp2=False)
+    z = from_mont_host(aZ)
+    if z == 0:
+        return None
+    x = from_mont_host(aX)
+    y = from_mont_host(aY)
+    zinv = pow(z, P_INT - 2, P_INT)
+    zinv2 = zinv * zinv % P_INT
+    return (x * zinv2 % P_INT, y * zinv2 * zinv % P_INT)
+
+
+def g2_points_to_device(points: list) -> tuple:
+    """Affine host G2 points [(Fp2, Fp2) or None] -> Montgomery Jacobian
+    limb arrays of shape (n, 2, 16)."""
+    n = len(points)
+    X = np.zeros((n, 2, L), dtype=np.uint32)
+    Y = np.zeros((n, 2, L), dtype=np.uint32)
+    Z = np.zeros((n, 2, L), dtype=np.uint32)
+    one = to_mont_host(1)
+    for i, pt in enumerate(points):
+        if pt is None:
+            continue
+        X[i, 0] = to_mont_host(pt[0].c0)
+        X[i, 1] = to_mont_host(pt[0].c1)
+        Y[i, 0] = to_mont_host(pt[1].c0)
+        Y[i, 1] = to_mont_host(pt[1].c1)
+        Z[i, 0] = one
+    return jnp.asarray(X), jnp.asarray(Y), jnp.asarray(Z)
+
+
+def g2_msm(points: list, scalars: list[int]) -> tuple | None:
+    """sum_i scalars[i] * points[i] over G2; affine (Fp2, Fp2) or None."""
+    if len(points) != len(scalars):
+        raise ValueError("points/scalars length mismatch")
+    if not points:
+        return None
+    X, Y, Z = g2_points_to_device(points)
+    aX, aY, aZ = _run_msm(X, Y, Z, scalars, fp2=True)
+    z = bn254.Fp2(from_mont_host(aZ[0]), from_mont_host(aZ[1]))
+    if z.c0 == 0 and z.c1 == 0:
+        return None
+    x = bn254.Fp2(from_mont_host(aX[0]), from_mont_host(aX[1]))
+    y = bn254.Fp2(from_mont_host(aY[0]), from_mont_host(aY[1]))
+    zinv = z.inv()
+    zinv2 = zinv * zinv
+    return (x * zinv2, y * zinv2 * zinv)

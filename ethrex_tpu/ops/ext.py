@@ -85,17 +85,91 @@ def ext_powers(point, n: int):
     return jnp.concatenate([one_like((1,)), incl[:-1]], axis=0)
 
 
+def ext_powers_blocked(point, n: int, block: int = 128):
+    """[1, z, ..., z^{n-1}] as (n, 4) via a two-level table: z^{a+Bb} =
+    (z^B)^b * z^a.  Two short scans plus one outer product instead of a
+    length-n associative scan of ext multiplies — ~15x fewer ext muls at
+    n=32K and a much smaller XLA graph.
+    """
+    if n <= block:
+        return ext_powers(point, n)
+    nb = -(-n // block)
+    small = ext_powers(point, block)                        # (B, 4)
+    big = ext_powers(ext_pow(point, block), nb)             # (nb, 4)
+    out = mul(jnp.broadcast_to(big[:, None, :], (nb, block, DEG)),
+              jnp.broadcast_to(small[None, :, :], (nb, block, DEG)))
+    return out.reshape(nb * block, DEG)[:n]
+
+
 def eval_base_poly_at_ext(coeffs, point):
     """Evaluate base-coefficient polys at an ext point.
 
     coeffs: (..., n) base Montgomery; point: (4,) ext Montgomery.
-    Returns (..., 4).  Uses a log-depth powers scan + mod-sum reduction
-    instead of sequential Horner (prover-side opening at zeta).
+    Returns (..., 4).  Power table via the blocked scan; the contraction
+    sum_i coeffs[i] * z^i runs per extension coordinate as a modular
+    matmul (..., n) @ (n, 4) on the MXU (bb.mod_matmul).
     """
     n = coeffs.shape[-1]
-    pows = ext_powers(point, n)                      # (n, 4)
-    terms = bb.mont_mul(pows, coeffs[..., None])     # (..., n, 4)
-    return bb.sum_mod(terms, axis=-2)
+    pows = ext_powers_blocked(point, n)              # (n, 4)
+    return bb.mod_matmul(coeffs, pows)
+
+
+# Frobenius x -> x^p acts coordinate-wise on the quartic tower: coordinate
+# j of x^{p^k} is coordinate j of x times W^{j*(p-1)/4*k} (see
+# ext_inv_device).  Precompute the three conjugation masks.
+_FR_K = (bb.P - 1) // 4
+_FR = [
+    np.asarray(bb.to_mont_host(np.array(
+        [pow(W, (j * _FR_K * k) % (bb.P - 1), bb.P) for j in range(4)],
+        dtype=np.uint32)))
+    for k in (1, 2, 3)
+]
+
+
+def frobenius(a, k: int = 1):
+    """a^{p^k} for k in 1..3 — coordinate-wise mask multiply."""
+    return bb.mont_mul(a, jnp.asarray(_FR[k - 1]))
+
+
+def inv_x_minus_zeta(x, zeta):
+    """Scan-free batch inverse of (x_i - zeta) for base-field points x.
+
+    x: (...,) base Montgomery; zeta: (4,) ext Montgomery (not in the base
+    subfield).  Returns (..., 4).
+
+    1/(x - z) = conj(x) / N(x) where conj(x) = prod_{k=1..3} (x - z^{p^k})
+    is a cubic in x with precomputable ext coefficients, and N(x) =
+    (x - z) * conj(x) is the minimal polynomial of z — a quartic with BASE
+    coefficients.  Both evaluate per element by Horner (a handful of
+    mont_muls), and the base-field N inverts with per-element Fermat
+    exponentiation — no associative scans, no ext-field inversion chains.
+    This replaces batch_inv on the DEEP hot path (batch_inv's two
+    length-N ext scans were one of the four prove-step hotspots).
+    """
+    z1 = frobenius(zeta, 1)
+    z2 = frobenius(zeta, 2)
+    z3 = frobenius(zeta, 3)
+    # elementary symmetric functions of the three conjugates (ext)
+    s1 = add(add(z1, z2), z3)
+    s2 = add(add(mul(z1, z2), mul(z1, z3)), mul(z2, z3))
+    s3 = mul(mul(z1, z2), z3)
+    # of all four roots (base-valued; take coordinate 0)
+    e1 = add(zeta, s1)[..., 0]
+    e2 = add(mul(zeta, s1), s2)[..., 0]
+    e3 = add(mul(zeta, s2), s3)[..., 0]
+    e4 = mul(zeta, s3)[..., 0]
+
+    # conj(x) = x^3 - s1 x^2 + s2 x - s3   (Horner, ext accumulator)
+    acc = sub(from_base(x), jnp.broadcast_to(s1, x.shape + (DEG,)))
+    acc = add(scalar_mul(acc, x), jnp.broadcast_to(s2, x.shape + (DEG,)))
+    conj = sub(scalar_mul(acc, x), jnp.broadcast_to(s3, x.shape + (DEG,)))
+    # N(x) = x^4 - e1 x^3 + e2 x^2 - e3 x + e4   (Horner, base)
+    m = bb.mont_mul
+    nacc = bb.sub(x, e1)
+    nacc = bb.add(m(nacc, x), e2)
+    nacc = bb.sub(m(nacc, x), e3)
+    norm = bb.add(m(nacc, x), e4)
+    return scalar_mul(conj, bb.mont_inv(norm))
 
 
 def eval_ext_poly_at_ext(coeffs, point):

@@ -47,6 +47,70 @@ def root(levels):
     return levels[-1][0]
 
 
+def batched_roots(digests, sizes: tuple[int, ...]):
+    """Roots of MANY Merkle trees from one flat digest array.
+
+    `digests`: (sum(sizes), 8) leaf digests, trees concatenated in order;
+    every size a power of two.  Each global level runs ONE batched
+    compression over every still-active tree (finished roots ride along
+    untouched), so committing the whole FRI layer chain costs
+    max(log2(sizes)) kernels instead of sum(log2(sizes)) — the
+    small-kernel serialization in the fused prove step was one of its
+    hotspots.  Index plans are static numpy, traced once per shape.
+
+    Returns a list of (8,) root digests, one per tree.
+    """
+    import jax.numpy as jnp
+
+    sizes = [int(s) for s in sizes]
+    for s in sizes:
+        if s & (s - 1):
+            raise ValueError("tree sizes must be powers of two")
+    cur = list(sizes)
+    state = digests
+    while any(s > 1 for s in cur):
+        left = []
+        right = []
+        passthrough = []
+        off = 0
+        new_sizes = []
+        for s in cur:
+            if s > 1:
+                left.extend(range(off, off + s, 2))
+                right.extend(range(off + 1, off + s, 2))
+                new_sizes.append(s // 2)
+            else:
+                passthrough.append(off)
+                new_sizes.append(1)
+            off += s
+        li = jnp.asarray(np.array(left, dtype=np.int32))
+        ri = jnp.asarray(np.array(right, dtype=np.int32))
+        compressed = p2.compress(state[li], state[ri])
+        # reassemble in tree order: compressed rows and passthrough rows
+        # interleave by segment; build the permutation statically
+        pieces = []
+        c_off = 0
+        p_iter = iter(passthrough)
+        for s, ns in zip(cur, new_sizes):
+            if s > 1:
+                pieces.append(("c", c_off, ns))
+                c_off += ns
+            else:
+                pieces.append(("p", next(p_iter), 1))
+        if all(kind == "c" for kind, _, _ in pieces):
+            state = compressed
+        else:
+            parts = []
+            for kind, start, count in pieces:
+                if kind == "c":
+                    parts.append(compressed[start:start + count])
+                else:
+                    parts.append(state[start:start + 1])
+            state = jnp.concatenate(parts, axis=0)
+        cur = new_sizes
+    return [state[i] for i in range(len(sizes))]
+
+
 def open_path(levels, index: int):
     """Host-side: sibling digests bottom-up for leaf `index`."""
     path = []

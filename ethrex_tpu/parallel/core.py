@@ -22,6 +22,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..ops import babybear as bb
 from ..ops import ext
 from ..ops import fri as fri_ops
+from ..ops import merkle
 from ..ops import ntt
 from ..ops import poseidon2 as p2
 from ..ops.fri import _fold_inv_points, _INV2
@@ -90,25 +91,37 @@ def build_prove_step(log_n: int, width: int, log_blowup: int = 2,
         lde_rows = shard(lde_cols.T, (axis, None))  # transpose => all-to-all
         # 2. row-parallel Merkle commit
         troot = commit_root(lde_rows)
-        # 3. DEEP-style combination at zeta (row-parallel ext arithmetic)
+        # 3. DEEP-style combination at zeta.  sum_w gamma^w*(T_w(x)-T_w(z))
+        # splits into a base-field MXU matmul (N, w) @ (w, 4) minus the
+        # constant sum_w gamma^w*T_w(z); 1/(x-z) is the scan-free
+        # minimal-polynomial inverse (ops/ext.py) — together these replace
+        # the (N, w, 4) ext-arithmetic blowup that dominated the profile.
         tcoeffs = ntt.intt(trace_cols)
         tz = ext.eval_base_poly_at_ext(tcoeffs, zeta)          # (w, 4)
-        x_m = jnp.concatenate(
-            [bb.sub(pts_m, jnp.broadcast_to(zeta[0], (N,)))[:, None],
-             jnp.broadcast_to(bb.neg(zeta[1:]), (N, 3))], axis=-1)
-        inv_xz = ext.batch_inv(x_m)
+        inv_xz = ext.inv_x_minus_zeta(pts_m, zeta)             # (N, 4)
         gpow = ext.ext_powers(gamma, width)                    # (w, 4)
-        diff = ext.sub(ext.from_base(lde_rows), tz[None])      # (N, w, 4)
-        comb = bb.sum_mod(ext.mul(diff, gpow[None]), axis=1)   # (N, 4)
+        comb = bb.mod_matmul(lde_rows, gpow)                   # (N, 4)
+        const = bb.sum_mod(ext.mul(tz, gpow), axis=0)          # (4,)
+        comb = ext.sub(comb, jnp.broadcast_to(const, comb.shape))
         cw = ext.mul(comb, inv_xz)
         cw = shard(cw, (axis, None))
-        # 4. FRI fold chain, committing each layer (reuses ops/fri kernels)
-        fri_roots = []
+        # 4. FRI fold chain.  The interactive transcript samples beta_k
+        # AFTER root_k, but inside this fused step the betas are inputs —
+        # so fold ALL layers first (cheap elementwise work), then hash
+        # every layer's leaves in ONE batched sponge call and build all
+        # the trees with level-batched compressions (ops/merkle
+        # batched_roots): ~log(N) kernels total instead of a sequential
+        # per-layer tree chain of small kernels.
+        layer_leaves = []
         for k in range(L):
-            leaves = shard(fri_ops._pair_leaves(cw), (axis, None))
-            fri_roots.append(commit_root(leaves))
+            layer_leaves.append(fri_ops._pair_leaves(cw))
             cw = fri_ops._fold(cw, betas[k], fold_invs[k], inv2)
             cw = shard(cw, (axis, None))
+        sizes = tuple(lv.shape[0] for lv in layer_leaves)
+        all_leaves = shard(jnp.concatenate(layer_leaves, axis=0),
+                           (axis, None))
+        digests = p2.hash_leaves(all_leaves)
+        fri_roots = merkle.batched_roots(digests, sizes)
         return troot, tuple(fri_roots), cw
 
     rng = np.random.default_rng(0)

@@ -44,8 +44,15 @@ class ExecBackend(ProverBackend):
         }
 
     def verify(self, proof: dict) -> bool:
-        return proof.get("backend") == self.prover_type \
-            and "output" in proof
+        if proof.get("backend") != self.prover_type:
+            return False
+        try:
+            from ..guest.execution import ProgramOutput
+
+            ProgramOutput.decode(bytes.fromhex(proof["output"][2:]))
+            return True
+        except (KeyError, TypeError, ValueError):
+            return False
 
 
 def get_backend(name: str) -> ProverBackend:

@@ -19,8 +19,11 @@ SUBMIT_ACK = "ProofSubmitACK"           # {batch_id}
 ERROR = "Error"                         # {message}
 
 # proof formats (reference: ProofFormat — Compressed STARK vs Groth16 wrap)
-FORMAT_STARK = "stark"
-FORMAT_GROTH16 = "groth16"
+FORMAT_STARK = "stark"            # the two batch STARKs as-is
+FORMAT_COMPRESSED = "compressed"  # + recursion: FRI query work aggregated
+#                                   into one outer STARK, path data dropped
+FORMAT_GROTH16 = "groth16"        # compressed + BN254 MiMC wrap of the
+#                                   aggregate digest (one pairing on L1)
 
 # prover types (reference: ProverType {Exec, SP1, RISC0, ...} + TPU)
 PROVER_EXEC = "exec"

@@ -93,7 +93,7 @@ class TpuBackend(ProverBackend):
         bind_pub = pair.sponge_public_inputs(limbs)
         bind_proof = stark_prover.prove(bind_air, bind_trace, bind_pub,
                                         PARAMS)
-        return {
+        proof = {
             "backend": self.prover_type,
             "format": proof_format,
             "output": "0x" + encoded.hex(),
@@ -103,6 +103,27 @@ class TpuBackend(ProverBackend):
             "state_proof": state_proof,
             "proof": bind_proof,
         }
+        if proof_format in (protocol.FORMAT_COMPRESSED,
+                            protocol.FORMAT_GROTH16):
+            # recursion: one outer STARK proves both proofs' FRI query
+            # openings; their Merkle path data is dropped from the wire
+            from ..stark import aggregate as agg_mod
+
+            agg = agg_mod.aggregate([air, bind_air],
+                                    [state_proof, bind_proof], PARAMS)
+            proof["state_proof"], proof["proof"] = agg.inners
+            proof["aggregate"] = {
+                "outer": agg.outer, "max_depth": agg.max_depth,
+                "seg_periods": agg.seg_periods,
+            }
+            if proof_format == protocol.FORMAT_GROTH16:
+                from . import groth16_wrap
+
+                wrapped = groth16_wrap.wrap_prove(
+                    [int(v) for v in agg.outer["pub_inputs"]],
+                    rnd=encoded[:32])
+                proof["groth16"] = groth16_wrap.proof_to_json(wrapped)
+        return proof
 
     # -- verification -------------------------------------------------------
 
@@ -134,16 +155,37 @@ class TpuBackend(ProverBackend):
         if claimed_pub != r_pre + r_post + digest:
             raise ValueError("state proof publics do not match the log")
         air = sua.StateUpdateAir(depth, seg_periods=S)
-        if not stark_verifier.verify(air, state, PARAMS):
-            raise ValueError("state proof rejected")
 
         limbs = binding_limbs(encoded, r_pre, r_post, digest)
         bind = proof["proof"]
         if [int(v) for v in bind["pub_inputs"][:len(limbs)]] != limbs:
             raise ValueError("binding proof does not bind this statement")
         bind_air = pair.Poseidon2SpongeAir(num_chunks=len(limbs) // 8)
-        if not stark_verifier.verify(bind_air, bind, PARAMS):
-            raise ValueError("binding proof rejected")
+
+        agg_info = proof.get("aggregate")
+        if agg_info is not None:
+            # compressed/groth16: both proofs verified through the outer
+            # recursion STARK (their FRI paths are gone from the wire)
+            from ..stark import aggregate as agg_mod
+
+            agg = agg_mod.AggregateProof(
+                inners=[state, bind], outer=agg_info["outer"],
+                max_depth=int(agg_info["max_depth"]),
+                seg_periods=int(agg_info["seg_periods"]))
+            agg_mod.verify_aggregated([air, bind_air], agg, PARAMS)
+            wrapped = proof.get("groth16")
+            if wrapped is not None:
+                from . import groth16_wrap
+
+                if not groth16_wrap.wrap_verify(
+                        groth16_wrap.proof_from_json(wrapped),
+                        [int(v) for v in agg.outer["pub_inputs"]]):
+                    raise ValueError("groth16 wrap rejected")
+        else:
+            if not stark_verifier.verify(air, state, PARAMS):
+                raise ValueError("state proof rejected")
+            if not stark_verifier.verify(bind_air, bind, PARAMS):
+                raise ValueError("binding proof rejected")
         return blocks_log, encoded
 
     def verify(self, proof: dict) -> bool:

@@ -168,8 +168,12 @@ class EngineApi:
     def exchange_capabilities(self, caps):
         # per spec the response must NOT include exchangeCapabilities itself
         return [
+            "engine_newPayloadV1", "engine_newPayloadV2",
             "engine_newPayloadV3", "engine_newPayloadV4",
-            "engine_forkchoiceUpdatedV3", "engine_getPayloadV3",
+            "engine_forkchoiceUpdatedV1", "engine_forkchoiceUpdatedV2",
+            "engine_forkchoiceUpdatedV3",
+            "engine_getPayloadV1", "engine_getPayloadV2",
+            "engine_getPayloadV3",
             "engine_getPayloadV4", "engine_getPayloadBodiesByHashV1",
             "engine_getPayloadBodiesByRangeV1", "engine_getClientVersionV1",
         ]
@@ -219,6 +223,19 @@ class EngineApi:
                 "validationError": None}
 
     new_payload_v4 = new_payload_v3
+
+    # -- legacy V1/V2 (pre-Cancun CLs; reference: engine/payload.rs
+    # NewPayloadV1..V5) ---------------------------------------------------
+    def new_payload_v1(self, payload):
+        if payload.get("withdrawals") is not None \
+                or payload.get("blobGasUsed") is not None:
+            raise RpcError(-32602, "V1 payload with post-Paris fields")
+        return self.new_payload_v3(payload)
+
+    def new_payload_v2(self, payload):
+        if payload.get("blobGasUsed") is not None:
+            raise RpcError(-32602, "V2 payload with Cancun fields")
+        return self.new_payload_v3(payload)
 
     def forkchoice_updated_v3(self, state, attrs=None):
         head = parse_bytes(state["headBlockHash"])
@@ -294,6 +311,22 @@ class EngineApi:
         return payload
 
     get_payload_v4 = get_payload_v3
+
+    def get_payload_v1(self, payload_id):
+        # V1 returns the bare ExecutionPayloadV1
+        return self.get_payload_v3(payload_id)["executionPayload"]
+
+    def get_payload_v2(self, payload_id):
+        full = self.get_payload_v3(payload_id)
+        return {"executionPayload": full["executionPayload"],
+                "blockValue": full.get("blockValue", "0x0")}
+
+    def forkchoice_updated_v1(self, state, attrs=None):
+        if attrs and attrs.get("withdrawals") is not None:
+            raise RpcError(-32602, "V1 attributes with withdrawals")
+        return self.forkchoice_updated_v3(state, attrs)
+
+    forkchoice_updated_v2 = forkchoice_updated_v3
 
     MAX_BODIES_REQUEST = 1024  # Engine API spec limit
 

@@ -504,7 +504,10 @@ class EthApi:
         if tracer_name == "callTracer":
             tracer = CallTracer()
         else:
-            cfg = opts.get("tracerConfig", {}) or {}
+            # geth TraceConfig inlines the struct-logger options at the top
+            # level (disableStack/limit); tracerConfig is read as a fallback
+            # for callers that nest them
+            cfg = {**(opts.get("tracerConfig") or {}), **opts}
             tracer = StructLogTracer(
                 with_stack=not cfg.get("disableStack", False),
                 max_logs=int(cfg.get("limit", 100_000)))
@@ -513,7 +516,7 @@ class EthApi:
         out = tracer.result()
         if tracer_name == "structLogs":
             out = {"gas": res.gas_used, "failed": not res.success,
-                   "returnValue": "", **out}
+                   "returnValue": res.output.hex(), **out}
         return out
 
     def fee_history(self, count, newest, percentiles=None):

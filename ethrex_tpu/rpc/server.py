@@ -29,9 +29,15 @@ class RpcServer:
             self.engine_api = api
             self.methods.update({
                 "engine_exchangeCapabilities": api.exchange_capabilities,
+                "engine_newPayloadV1": api.new_payload_v1,
+                "engine_newPayloadV2": api.new_payload_v2,
                 "engine_newPayloadV3": api.new_payload_v3,
                 "engine_newPayloadV4": api.new_payload_v4,
+                "engine_forkchoiceUpdatedV1": api.forkchoice_updated_v1,
+                "engine_forkchoiceUpdatedV2": api.forkchoice_updated_v2,
                 "engine_forkchoiceUpdatedV3": api.forkchoice_updated_v3,
+                "engine_getPayloadV1": api.get_payload_v1,
+                "engine_getPayloadV2": api.get_payload_v2,
                 "engine_getPayloadV3": api.get_payload_v3,
                 "engine_getPayloadV4": api.get_payload_v4,
                 "engine_getPayloadBodiesByHashV1":
@@ -87,6 +93,9 @@ class RpcServer:
             "eth_getTransactionByBlockNumberAndIndex":
                 e.tx_by_block_and_index,
             "txpool_content": lambda: _txpool_content(node),
+            "txpool_status": lambda: _txpool_status(node),
+            "admin_nodeInfo": lambda: _admin_node_info(node),
+            "admin_peers": lambda: _admin_peers(node),
             # post-merge constants / wallet compatibility
             "eth_accounts": lambda: [],
             "eth_mining": lambda: False,
@@ -200,17 +209,81 @@ def _err(rid, code, message, data=None):
     return {"jsonrpc": "2.0", "id": rid, "error": error}
 
 
+def _get_nonce_fn(node):
+    head = node.store.get_canonical_block(node.store.latest_number())
+
+    def get_nonce(sender: bytes) -> int:
+        acct = node.store.account_state(head.header.state_root, sender)
+        return acct.nonce if acct else 0
+
+    return get_nonce
+
+
 def _txpool_content(node):
     from .serializers import tx_to_json
-    content = node.mempool.content()
-    return {
-        "pending": {
+
+    pending, queued = node.mempool.split(_get_nonce_fn(node))
+
+    def fmt(part):
+        return {
             "0x" + sender.hex(): {
                 str(nonce): tx_to_json(tx) for nonce, tx in queue.items()
-            } for sender, queue in content.items()
+            } for sender, queue in part.items()
+        }
+
+    return {"pending": fmt(pending), "queued": fmt(queued)}
+
+
+def _txpool_status(node):
+    counts = node.mempool.status(_get_nonce_fn(node))
+    return {"pending": hex(counts["pending"]),
+            "queued": hex(counts["queued"])}
+
+
+def _admin_node_info(node):
+    """admin_nodeInfo (reference: admin namespace, rpc.rs)."""
+    p2p = getattr(node, "p2p_server", None)
+    genesis = node.store.meta.get("genesis")
+    info = {
+        "name": f"{CLIENT_NAME}/{CLIENT_VERSION}",
+        "protocols": {
+            "eth": {
+                "network": node.config.chain_id,
+                "genesis": "0x" + genesis.hex() if genesis else None,
+            },
         },
-        "queued": {},
     }
+    if p2p is not None:
+        info["enode"] = (f"enode://{p2p.pub.hex()}"
+                         f"@{p2p.host}:{p2p.port}")
+        info["listenAddr"] = f"{p2p.host}:{p2p.port}"
+        info["id"] = p2p.pub.hex()
+    return info
+
+
+def _admin_peers(node):
+    p2p = getattr(node, "p2p_server", None)
+    if p2p is None:
+        return []
+    out = []
+    for peer in list(p2p.peers):
+        try:
+            host, port = peer.sock.getpeername()[:2]
+        except OSError:
+            host, port = "", 0
+        entry = {
+            "id": bytes(peer.remote_pub).hex(),
+            "network": {"remoteAddress": f"{host}:{port}"},
+            "score": getattr(peer, "score", 0),
+        }
+        status = peer.remote_status
+        if status is not None:
+            entry["protocols"] = {"eth": {
+                "version": status.version,
+                "head": "0x" + status.head_hash.hex(),
+            }}
+        out.append(entry)
+    return out
 
 
 def _produce(node):

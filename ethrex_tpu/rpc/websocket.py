@@ -1,0 +1,306 @@
+"""WebSocket JSON-RPC transport + eth_subscribe push subscriptions.
+
+The reference serves subscriptions over websockets
+(crates/networking/rpc subscription_manager; newHeads / logs /
+newPendingTransactions).  This is a dependency-free RFC 6455 server:
+handshake, masked client frames, text frames out, ping/pong, close.  All
+regular JSON-RPC methods route through the owning RpcServer's method
+table; eth_subscribe/eth_unsubscribe manage per-connection subscriptions
+pushed from the node's block and mempool hooks.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import socket
+import struct
+import threading
+
+_GUID = b"258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+OP_TEXT = 0x1
+OP_CLOSE = 0x8
+OP_PING = 0x9
+OP_PONG = 0xA
+
+
+def _accept_key(key: str) -> str:
+    digest = hashlib.sha1(key.encode() + _GUID).digest()
+    return base64.b64encode(digest).decode()
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def read_frame(sock: socket.socket) -> tuple[int, bytes]:
+    """Returns (opcode, payload) of one (possibly fragmented) message."""
+    payload = b""
+    opcode = None
+    while True:
+        h0, h1 = _recv_exact(sock, 2)
+        fin = h0 & 0x80
+        op = h0 & 0x0F
+        masked = h1 & 0x80
+        length = h1 & 0x7F
+        if length == 126:
+            (length,) = struct.unpack(">H", _recv_exact(sock, 2))
+        elif length == 127:
+            (length,) = struct.unpack(">Q", _recv_exact(sock, 8))
+        mask = _recv_exact(sock, 4) if masked else b"\x00" * 4
+        data = bytearray(_recv_exact(sock, length))
+        if masked:
+            for i in range(len(data)):
+                data[i] ^= mask[i % 4]
+        if op != 0:
+            opcode = op
+        payload += bytes(data)
+        if fin:
+            return opcode, payload
+
+
+def make_frame(opcode: int, payload: bytes) -> bytes:
+    header = bytes([0x80 | opcode])
+    n = len(payload)
+    if n < 126:
+        header += bytes([n])
+    elif n < (1 << 16):
+        header += bytes([126]) + struct.pack(">H", n)
+    else:
+        header += bytes([127]) + struct.pack(">Q", n)
+    return header + payload
+
+
+class _Subscription:
+    def __init__(self, sid: str, kind: str, params: dict | None):
+        self.sid = sid
+        self.kind = kind
+        self.params = params or {}
+
+
+class WsConnection:
+    def __init__(self, server: "WsServer", sock: socket.socket):
+        self.server = server
+        self.sock = sock
+        self.subs: dict[str, _Subscription] = {}
+        self.send_lock = threading.Lock()
+        self.alive = True
+
+    def send_json(self, obj) -> bool:
+        data = json.dumps(obj).encode()
+        try:
+            with self.send_lock:
+                self.sock.sendall(make_frame(OP_TEXT, data))
+            return True
+        except OSError:
+            self.alive = False
+            return False
+
+    def notify(self, sid: str, result) -> bool:
+        return self.send_json({
+            "jsonrpc": "2.0", "method": "eth_subscription",
+            "params": {"subscription": sid, "result": result},
+        })
+
+    def handle_request(self, req: dict):
+        method = req.get("method")
+        rid = req.get("id")
+        params = req.get("params", [])
+        if method == "eth_subscribe":
+            kind = params[0]
+            if kind not in ("newHeads", "newPendingTransactions", "logs"):
+                return {"jsonrpc": "2.0", "id": rid,
+                        "error": {"code": -32602,
+                                  "message": f"unsupported: {kind}"}}
+            import secrets
+
+            sid = "0x" + secrets.token_hex(16)
+            opts = params[1] if len(params) > 1 else None
+            self.subs[sid] = _Subscription(sid, kind, opts)
+            return {"jsonrpc": "2.0", "id": rid, "result": sid}
+        if method == "eth_unsubscribe":
+            found = self.subs.pop(params[0], None) is not None
+            return {"jsonrpc": "2.0", "id": rid, "result": found}
+        return self.server.rpc.handle(req)
+
+    def run(self):
+        try:
+            while self.alive:
+                opcode, payload = read_frame(self.sock)
+                if opcode == OP_CLOSE:
+                    with self.send_lock:
+                        self.sock.sendall(make_frame(OP_CLOSE, b""))
+                    break
+                if opcode == OP_PING:
+                    with self.send_lock:
+                        self.sock.sendall(make_frame(OP_PONG, payload))
+                    continue
+                if opcode != OP_TEXT:
+                    continue
+                try:
+                    req = json.loads(payload)
+                except json.JSONDecodeError:
+                    self.send_json({"jsonrpc": "2.0", "id": None,
+                                    "error": {"code": -32700,
+                                              "message": "parse error"}})
+                    continue
+                if isinstance(req, list):
+                    self.send_json([self.handle_request(r) for r in req])
+                else:
+                    self.send_json(self.handle_request(req))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self.alive = False
+            self.server.connections.discard(self)
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+
+class WsServer:
+    """WebSocket endpoint bound to an RpcServer's method table."""
+
+    def __init__(self, rpc_server, host: str = "127.0.0.1", port: int = 0):
+        self.rpc = rpc_server
+        self.node = rpc_server.node
+        self.listener = socket.create_server((host, port))
+        self.host, self.port = self.listener.getsockname()[:2]
+        self.connections: set[WsConnection] = set()
+        self._stop = threading.Event()
+        # push hooks
+        self.node.block_listeners.append(self._on_block)
+        self.node.mempool.on_add.append(self._on_pending_tx)
+
+    # -- push paths --------------------------------------------------------
+    def _on_block(self, block):
+        from .serializers import header_to_json
+
+        head_json = None
+        logs_cache = None
+        for conn in list(self.connections):
+            for sub in list(conn.subs.values()):
+                if sub.kind == "newHeads":
+                    if head_json is None:
+                        head_json = header_to_json(block.header, block.hash)
+                    conn.notify(sub.sid, head_json)
+                elif sub.kind == "logs":
+                    if logs_cache is None:
+                        logs_cache = self._block_logs(block)
+                    for log_json in logs_cache:
+                        if _log_matches(log_json, sub.params):
+                            conn.notify(sub.sid, log_json)
+
+    def _block_logs(self, block) -> list[dict]:
+        receipts = self.node.store.get_receipts(block.hash) or []
+        out = []
+        log_index = 0
+        for tx_index, (tx, receipt) in enumerate(
+                zip(block.body.transactions, receipts)):
+            for log in receipt.logs:
+                out.append({
+                    "address": "0x" + log.address.hex(),
+                    "topics": ["0x" + bytes(t).hex() for t in log.topics],
+                    "data": "0x" + log.data.hex(),
+                    "blockNumber": hex(block.header.number),
+                    "blockHash": "0x" + block.hash.hex(),
+                    "transactionHash": "0x" + tx.hash.hex(),
+                    "transactionIndex": hex(tx_index),
+                    "logIndex": hex(log_index),
+                    "removed": False,
+                })
+                log_index += 1
+        return out
+
+    def _on_pending_tx(self, tx_hash: bytes):
+        for conn in list(self.connections):
+            for sub in list(conn.subs.values()):
+                if sub.kind == "newPendingTransactions":
+                    conn.notify(sub.sid, "0x" + tx_hash.hex())
+
+    # -- accept loop -------------------------------------------------------
+    def _handshake(self, sock: socket.socket) -> bool:
+        data = b""
+        while b"\r\n\r\n" not in data:
+            chunk = sock.recv(4096)
+            if not chunk:
+                return False
+            data += chunk
+        headers = {}
+        for line in data.split(b"\r\n")[1:]:
+            if b":" in line:
+                k, v = line.split(b":", 1)
+                headers[k.strip().lower().decode()] = v.strip().decode()
+        key = headers.get("sec-websocket-key")
+        if not key or "websocket" not in \
+                headers.get("upgrade", "").lower():
+            sock.sendall(b"HTTP/1.1 400 Bad Request\r\n\r\n")
+            return False
+        sock.sendall(
+            b"HTTP/1.1 101 Switching Protocols\r\n"
+            b"Upgrade: websocket\r\n"
+            b"Connection: Upgrade\r\n"
+            b"Sec-WebSocket-Accept: " + _accept_key(key).encode()
+            + b"\r\n\r\n")
+        return True
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                sock, _ = self.listener.accept()
+            except OSError:
+                break
+            try:
+                if not self._handshake(sock):
+                    sock.close()
+                    continue
+            except OSError:
+                continue
+            conn = WsConnection(self, sock)
+            self.connections.add(conn)
+            threading.Thread(target=conn.run, daemon=True).start()
+
+    def start(self):
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+        for conn in list(self.connections):
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+
+
+def _log_matches(log_json: dict, params: dict) -> bool:
+    addr = params.get("address")
+    if addr:
+        addrs = [addr] if isinstance(addr, str) else list(addr)
+        if log_json["address"].lower() not in \
+                (a.lower() for a in addrs):
+            return False
+    topics = params.get("topics") or []
+    have = log_json["topics"]
+    for i, want in enumerate(topics):
+        if want is None:
+            continue
+        if i >= len(have):
+            return False
+        options = [want] if isinstance(want, str) else list(want)
+        if have[i].lower() not in (o.lower() for o in options):
+            return False
+    return True

@@ -146,9 +146,9 @@ def _build_phases(air: Air, log_n: int, lb: int, shift: int):
         periodic = [jnp.asarray(p) for p in periodic_np]
         cons = jnp.stack(air.constraints(local, nxt, periodic, dev))  # (K, N)
         apow = ext.ext_powers(alpha, K + nb)                      # (K+nb, 4)
-        acc = bb.sum_mod(
-            bb.mont_mul(cons[:, :, None], apow[:K, None, :]), axis=0
-        )                                                          # (N, 4)
+        # random-linear-combination of constraint columns: an MXU matmul
+        # (N, K) @ (K, 4) instead of materializing a (K, N, 4) product
+        acc = bb.mod_matmul(cons.T, apow[:K])                      # (N, 4)
         inv_stack = jnp.asarray(inv_stack_np)
         inv_xn1 = jnp.tile(inv_stack[:B], N // B)
         xm = jnp.asarray(bb.to_mont_host(x_minus_glast))
@@ -179,21 +179,19 @@ def _build_phases(air: Air, log_n: int, lb: int, shift: int):
 
     @jax.jit
     def phase_deep(lde_rows, q_lde, t_z, t_zg, q_z, zeta, zeta_g, gamma):
+        # sum_w gamma^w*(T_w(x) - T_w(z)) = (lde_rows @ gamma-powers) minus
+        # a per-z constant: the contraction over columns runs as a base-
+        # field MXU matmul (bb.mod_matmul) and 1/(x-z) uses the scan-free
+        # minimal-polynomial inverse — same restructure as the fused
+        # prove step (parallel/core.py), avoiding (N, w, 4) ext tensors.
         pts_m = jnp.asarray(pts_m_np)
-
-        def x_minus(pt):
-            first = bb.sub(pts_m, jnp.broadcast_to(pt[0], (N,)))
-            rest = jnp.broadcast_to(bb.neg(pt[1:]), (N, 3))
-            return jnp.concatenate([first[:, None], rest], axis=-1)
-
-        inv_xz = ext.batch_inv(x_minus(zeta))
-        inv_xzg = ext.batch_inv(x_minus(zeta_g))
+        inv_xz = ext.inv_x_minus_zeta(pts_m, zeta)
+        inv_xzg = ext.inv_x_minus_zeta(pts_m, zeta_g)
         gpow = ext.ext_powers(gamma, 2 * w + B)
-        rows_ext = ext.from_base(lde_rows)                         # (N, w, 4)
-        d1 = ext.sub(rows_ext, t_z[None])
-        s1 = bb.sum_mod(ext.mul(d1, gpow[None, :w]), axis=1)
-        d2 = ext.sub(rows_ext, t_zg[None])
-        s2 = bb.sum_mod(ext.mul(d2, gpow[None, w:2 * w]), axis=1)
+        s1 = ext.sub(bb.mod_matmul(lde_rows, gpow[:w]),
+                     bb.sum_mod(ext.mul(t_z, gpow[:w]), axis=0)[None])
+        s2 = ext.sub(bb.mod_matmul(lde_rows, gpow[w:2 * w]),
+                     bb.sum_mod(ext.mul(t_zg, gpow[w:2 * w]), axis=0)[None])
         q_ext = jnp.moveaxis(q_lde, 1, -1)                         # (B, N, 4)
         d3 = ext.sub(q_ext, q_z[:, None])
         s3 = bb.sum_mod(ext.mul(d3, gpow[2 * w:, None]), axis=0)

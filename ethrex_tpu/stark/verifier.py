@@ -47,19 +47,26 @@ def _fail(msg: str):
     raise VerificationError(msg)
 
 
-def verify(air: Air, proof: dict, params: StarkParams = StarkParams()):
+def verify(air: Air, proof: dict, params: StarkParams = StarkParams(),
+           fri_verify_fn=None):
     """Verify an untrusted proof dict.  Returns True or raises
     VerificationError — structural garbage (missing keys, wrong types) is
-    converted to VerificationError, never an unhandled crash."""
+    converted to VerificationError, never an unhandled crash.
+
+    `fri_verify_fn(fri_proof, log_N, challenger, fparams) -> (indices,
+    layer0)` overrides the FRI query verification step — the aggregation
+    path (stark/aggregate.py) substitutes a derivation that defers the
+    Merkle-opening work to the outer recursion STARK."""
     try:
-        return _verify(air, proof, params)
+        return _verify(air, proof, params, fri_verify_fn)
     except VerificationError:
         raise
     except (KeyError, TypeError, IndexError, ValueError, AttributeError) as e:
         raise VerificationError(f"malformed proof: {type(e).__name__}: {e}")
 
 
-def _verify(air: Air, proof: dict, params: StarkParams):
+def _verify(air: Air, proof: dict, params: StarkParams,
+            fri_verify_fn=None):
     n = proof["n"]
     w = proof["width"]
     lb = proof["log_blowup"]
@@ -145,7 +152,8 @@ def _verify(air: Air, proof: dict, params: StarkParams):
         queries=proof["fri"]["queries"],
     )
     try:
-        indices, layer0 = fri.verify(fri_proof, log_N, ch, fparams)
+        indices, layer0 = (fri_verify_fn or fri.verify)(
+            fri_proof, log_N, ch, fparams)
     except ValueError as e:
         _fail(str(e))
 

@@ -1,0 +1,150 @@
+"""Recursion/aggregation: the FRI-verifier AIR (models/fri_verifier_air)
+and the aggregate prove/verify flow (stark/aggregate) — constraint
+satisfaction on honest traces, tamper rejection in-circuit and at the
+digest, and a full 2-inner-proof aggregation round-trip."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ethrex_tpu.models import fri_verifier_air as fva
+from ethrex_tpu.ops import babybear as bb
+from ethrex_tpu.ops import ext
+from ethrex_tpu.ops import fri
+from ethrex_tpu.ops import ntt
+from ethrex_tpu.ops.challenger import Challenger
+from ethrex_tpu.stark import aggregate
+from ethrex_tpu.stark.air import HostExtOps
+from ethrex_tpu.stark.prover import StarkParams
+
+RNG = np.random.default_rng(11)
+
+
+def _codeword(log_deg, log_blowup):
+    n = 1 << log_deg
+    coeffs = RNG.integers(0, bb.P, size=(4, n), dtype=np.uint32)
+    evals = ntt.coset_evals_from_coeffs(
+        bb.to_mont(jnp.asarray(coeffs)), n << log_blowup)
+    return jnp.moveaxis(evals, 0, -1)
+
+
+def _small_fri_items(num_queries=3):
+    """One tiny FRI proof (N=32, 1 layer) -> aggregation work items."""
+    params = fri.FriParams(log_blowup=2, num_queries=num_queries,
+                           log_final_size=4)
+    cw = _codeword(3, 2)  # N = 32, log_n0 = 5
+    prover = fri.FriProver(params)
+    proof, _ = prover.prove(cw, Challenger())
+    indices, layer0, items = aggregate.derive_query_items(
+        proof, 5, Challenger(), params, with_paths=True)
+    return proof, items
+
+
+def _eval_rows(air, trace, periodic_cols):
+    hops = HostExtOps()
+    n = trace.shape[0]
+    bad = []
+    for r in range(n - 1):
+        local = [ext.h_from_base(int(v)) for v in trace[r]]
+        nxt = [ext.h_from_base(int(v)) for v in trace[r + 1]]
+        periodic = [ext.h_from_base(int(col[r % len(col)]))
+                    for col in periodic_cols]
+        cs = air.constraints(local, nxt, periodic, hops)
+        bad.extend((r, i) for i, c in enumerate(cs) if c != ext.ZERO_H)
+        if bad:
+            break
+    return bad
+
+
+def test_fri_verify_trace_satisfies_constraints():
+    proof, items = _small_fri_items()
+    max_depth = max(it["msg"][fva.MF_DEPTH] for it in items)
+    air = fva.FriVerifyAir(max_depth)
+    trace = fva.generate_fri_verify_trace(items, max_depth,
+                                          air.seg_periods)
+    n = trace.shape[0]
+    periodic_cols = air.periodic_columns(n)
+    bad = _eval_rows(air, trace, periodic_cols)
+    assert not bad, f"constraints violated: {bad[:5]}"
+    digest = fva.transcript_digest([it["msg"] for it in items],
+                                   air.seg_periods)
+    for row, col, val in air.boundaries(digest, n):
+        assert int(trace[row, col]) == val, (row, col)
+
+
+def test_tampered_path_or_message_breaks_constraints():
+    proof, items = _small_fri_items()
+    max_depth = max(it["msg"][fva.MF_DEPTH] for it in items)
+    air = fva.FriVerifyAir(max_depth)
+    trace = fva.generate_fri_verify_trace(items, max_depth,
+                                          air.seg_periods)
+    periodic_cols = air.periodic_columns(trace.shape[0])
+    seg_rows = air.seg_periods * fva.PERIOD
+
+    # flip a sibling limb inside segment 0's fold window: the fold no
+    # longer lands on the absorbed root
+    bad = trace.copy()
+    fold_rows = slice(2 * fva.PERIOD, 3 * fva.PERIOD)
+    bad[fold_rows, fva.SIB] = (bad[fold_rows, fva.SIB] + 1) % bb.P
+    assert _eval_rows(air, bad, periodic_cols)
+
+    # flip the claimed root limb (message): same story via the root check
+    bad2 = trace.copy()
+    seg0 = slice(0, seg_rows)
+    bad2[seg0, fva.MSG + fva.MF_ROOT] = \
+        (bad2[seg0, fva.MSG + fva.MF_ROOT] + 1) % bb.P
+    assert _eval_rows(air, bad2, periodic_cols)
+
+    # flip the carried_out value: the fold equation must catch it
+    bad3 = trace.copy()
+    bad3[seg0, fva.MSG + fva.MF_COUT] = \
+        (bad3[seg0, fva.MSG + fva.MF_COUT] + 1) % bb.P
+    assert _eval_rows(air, bad3, periodic_cols)
+
+
+def _fib_air_and_proofs(count=2):
+    from ethrex_tpu.models.fibonacci import FibonacciAir, generate_trace
+    from ethrex_tpu.stark import prover as stark_prover
+
+    params = StarkParams(log_blowup=2, num_queries=2, log_final_size=4)
+    airs, proofs = [], []
+    for i in range(count):
+        air = FibonacciAir()
+        trace = generate_trace(16, a0=1, b0=2 + i)
+        pub = [1, 2 + i, int(trace[-1, 1])]
+        proofs.append(stark_prover.prove(air, trace, pub, params))
+        airs.append(air)
+    return airs, proofs, params
+
+
+def test_aggregate_roundtrip_and_tamper():
+    airs, proofs, params = _fib_air_and_proofs(2)
+    outer_params = StarkParams(log_blowup=3, num_queries=8,
+                               log_final_size=4)
+    agg = aggregate.aggregate(airs, proofs, params, outer_params)
+    # paths are dropped from the aggregate's inner proofs
+    for inner in agg.inners:
+        for per_layer in inner["fri"]["queries"]:
+            for opening in per_layer:
+                assert "path" not in opening
+    assert aggregate.verify_aggregated(airs, agg, params, outer_params)
+
+    # tampering an inner FRI value breaks the digest binding
+    import copy
+
+    bad = copy.deepcopy(agg)
+    opening = bad.inners[0]["fri"]["queries"][0][0]
+    vals = [list(v) for v in opening["values"]]
+    vals[0][0] = (int(vals[0][0]) + 1) % bb.P
+    opening["values"] = vals
+    with pytest.raises(Exception):
+        aggregate.verify_aggregated(airs, bad, params, outer_params)
+
+    # tampering the outer public input is rejected
+    bad2 = copy.deepcopy(agg)
+    bad2.outer["pub_inputs"] = list(bad2.outer["pub_inputs"])
+    bad2.outer["pub_inputs"][0] = \
+        (int(bad2.outer["pub_inputs"][0]) + 1) % bb.P
+    with pytest.raises(Exception):
+        aggregate.verify_aggregated(airs, bad2, params, outer_params)

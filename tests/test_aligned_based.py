@@ -1,0 +1,117 @@
+"""Aligned-mode L1ProofVerifier and the based BlockFetcher follower."""
+
+import pytest
+
+from ethrex_tpu.l2.aligned import AlignedLayer, L1ProofVerifier
+from ethrex_tpu.l2.based import BlockFetcher, FetchError
+from ethrex_tpu.l2.l1_client import InMemoryL1
+from ethrex_tpu.l2.rollup_store import RollupStore
+from ethrex_tpu.l2.sequencer import Sequencer, SequencerConfig
+from ethrex_tpu.node import Node
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.prover import protocol
+from ethrex_tpu.prover.backend import get_backend
+
+from tests.test_l2_pipeline import GENESIS, _transfer
+
+
+def _setup():
+    node = Node(Genesis.from_json(GENESIS))
+    l1 = InMemoryL1(needed_prover_types=[protocol.PROVER_EXEC])
+    seq = Sequencer(node, l1, SequencerConfig(
+        needed_prover_types=(protocol.PROVER_EXEC,)))
+    return node, l1, seq
+
+
+def _commit_one_proven_batch(node, seq):
+    node.submit_transaction(_transfer(0))
+    seq.produce_block()
+    batch = seq.commit_next_batch()
+    assert batch is not None
+    # prove it directly (skip the TCP fleet for these unit tests)
+    backend = get_backend(protocol.PROVER_EXEC)
+    from ethrex_tpu.guest.execution import ProgramInput
+
+    stored = seq.rollup.get_prover_input(batch.number,
+                                         seq.cfg.commit_hash)
+    proof = backend.prove(ProgramInput.from_json(stored),
+                          protocol.FORMAT_STARK)
+    seq.rollup.store_proof(batch.number, protocol.PROVER_EXEC, proof)
+    return batch
+
+
+def test_aligned_submit_poll_verify():
+    node, l1, seq = _setup()
+    batch = _commit_one_proven_batch(node, seq)
+    aligned = AlignedLayer(latency_polls=2)
+    ver = L1ProofVerifier(seq.rollup, l1, aligned,
+                          [protocol.PROVER_EXEC])
+    assert ver.step() == "submitted"
+    assert ver.step() == "pending"
+    assert ver.step() == "verified"        # second poll -> included
+    assert l1.last_verified_batch() == batch.number
+    assert seq.rollup.get_batch(batch.number).verified
+    assert ver.step() is None              # nothing left
+
+
+def test_aligned_lost_submission_resubmits():
+    node, l1, seq = _setup()
+    _commit_one_proven_batch(node, seq)
+    aligned = AlignedLayer(latency_polls=1)
+    ver = L1ProofVerifier(seq.rollup, l1, aligned,
+                          [protocol.PROVER_EXEC])
+    assert ver.step() == "submitted"
+    # the aggregation drops the submission behind the verifier's back
+    aligned.submissions[ver.inflight["sid"]]["state"] = AlignedLayer.LOST
+    assert ver.step() == "resubmitted"
+    assert ver.step() == "verified"
+    assert l1.last_verified_batch() == 1
+
+
+def test_aligned_rejects_invalid_proof():
+    node, l1, seq = _setup()
+    batch = _commit_one_proven_batch(node, seq)
+    proof = seq.rollup.get_proof(batch.number, protocol.PROVER_EXEC)
+    proof["output"] = "0x" + "00" * 8  # corrupt
+    aligned = AlignedLayer()
+    ver = L1ProofVerifier(seq.rollup, l1, aligned,
+                          [protocol.PROVER_EXEC])
+    with pytest.raises(ValueError):
+        ver.step()
+
+
+def test_based_follower_imports_committed_batches():
+    node, l1, seq = _setup()
+    node.submit_transaction(_transfer(0))
+    seq.produce_block()
+    assert seq.commit_next_batch() is not None
+    node.submit_transaction(_transfer(1))
+    seq.produce_block()
+    assert seq.commit_next_batch() is not None
+
+    follower = Node(Genesis.from_json(GENESIS))
+    rollup = RollupStore()
+    fetcher = BlockFetcher(follower, l1, rollup)
+    assert fetcher.fetch_once() == 2
+    assert follower.store.latest_number() == node.store.latest_number()
+    head = follower.store.get_canonical_block(follower.store.latest_number())
+    assert head.header.state_root == \
+        node.store.get_canonical_block(node.store.latest_number()) \
+            .header.state_root
+    assert rollup.get_batch(2).committed
+    # idempotent: nothing new to fetch
+    assert fetcher.fetch_once() == 0
+
+
+def test_based_follower_detects_root_divergence():
+    node, l1, seq = _setup()
+    node.submit_transaction(_transfer(0))
+    seq.produce_block()
+    batch = seq.commit_next_batch()
+    # corrupt the committed root on the (hostile) L1 record
+    root, comm = l1.commitments[batch.number]
+    l1.commitments[batch.number] = (b"\x11" * 32, comm)
+    follower = Node(Genesis.from_json(GENESIS))
+    fetcher = BlockFetcher(follower, l1)
+    with pytest.raises(FetchError):
+        fetcher.fetch_once()

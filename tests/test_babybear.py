@@ -84,3 +84,43 @@ def test_root_of_unity():
         w = bb.root_of_unity(log_n)
         assert pow(w, 1 << log_n, bb.P) == 1
         assert pow(w, 1 << (log_n - 1), bb.P) != 1
+
+
+def test_mod_matmul_montgomery():
+    """MXU limb matmul vs uint64 numpy reference, Montgomery in/out."""
+    a = _rand((5, 37, 64))
+    b = _rand((64, 4))
+    am = bb.to_mont(jnp.asarray(a))
+    bm = bb.to_mont(jnp.asarray(b))
+    got = np.asarray(bb.from_mont(bb.mod_matmul(am, bm)))
+    expect = np.zeros((5, 37, 4), dtype=np.uint64)
+    for k in range(64):
+        expect = (expect + a[..., k, None].astype(np.uint64)
+                  * b[k].astype(np.uint64)) % bb.P
+    np.testing.assert_array_equal(got, expect.astype(np.uint32))
+
+
+def test_mod_matmul_canonical_and_chunked():
+    """k > 128 exercises the chunked contraction; canonical mode."""
+    k = 1000
+    a = _rand((3, k))
+    b = _rand((k, 8))
+    got = np.asarray(bb.mod_matmul(jnp.asarray(a), jnp.asarray(b),
+                                   montgomery=False))
+    expect = np.zeros((3, 8), dtype=np.uint64)
+    for i in range(k):
+        expect = (expect + a[:, i, None].astype(np.uint64)
+                  * b[i].astype(np.uint64)) % bb.P
+    np.testing.assert_array_equal(got, expect.astype(np.uint32))
+
+
+def test_mod_matmul_flush_path():
+    """k > 64*128 forces the int32 accumulator to flush mid-contraction."""
+    k = 64 * 128 + 257
+    a = _rand((2, k))
+    b = _rand((k, 4))
+    am = bb.to_mont(jnp.asarray(a))
+    bm = bb.to_mont(jnp.asarray(b))
+    got = np.asarray(bb.from_mont(bb.mod_matmul(am, bm)))
+    expect = (a.astype(object) @ b.astype(object)) % bb.P
+    np.testing.assert_array_equal(got, expect.astype(np.uint32))

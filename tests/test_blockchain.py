@@ -229,3 +229,23 @@ def test_mempool_ordering_and_replacement():
                         value=0).sign(SECRET),
             0, 10**21, 7)
     assert len(pool) == 2
+
+
+def test_mempool_pending_queued_split():
+    pool = Mempool()
+    # contiguous nonces 0,1 pending; gap at 2 leaves 3,4 queued
+    for n in (0, 1, 3, 4):
+        pool.add_transaction(_tx(n), 0, 10**21, 7)
+    pending, queued = pool.split(lambda s: 0)
+    sender = next(iter(pending))
+    assert sorted(pending[sender]) == [0, 1]
+    assert sorted(queued[sender]) == [3, 4]
+    assert pool.status(lambda s: 0) == {"pending": 2, "queued": 2}
+    # filling the gap promotes everything
+    pool.add_transaction(_tx(2), 0, 10**21, 7)
+    pending, queued = pool.split(lambda s: 0)
+    assert sorted(pending[sender]) == [0, 1, 2, 3, 4]
+    assert not queued
+    # account nonce advancing drops the low run from pending
+    pending, queued = pool.split(lambda s: 3)
+    assert sorted(pending[sender]) == [3, 4]

@@ -1,0 +1,87 @@
+"""EF GeneralStateTest runner (the tooling/ef_tests/state_v2 seat).
+
+The vendored fixtures under tests/fixtures/ef_state/ are written in the
+exact EF wire format (see _generate.py there for provenance); a public EF
+archive plugs in unmodified via EF_STATE_FIXTURES=<dir>.
+"""
+
+import copy
+import json
+import os
+
+import pytest
+
+from ethrex_tpu.utils import ef_state
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures", "ef_state")
+
+
+def test_vendored_fixtures_all_pass():
+    passed, failed = ef_state.run_directory(FIXDIR)
+    assert not failed, "; ".join(
+        f"{r.case.name}[{r.case.fork}{r.case.indexes}]: {r.detail}"
+        for r in failed)
+    # forks x indexes expansion: 6 files expand to well over 6 cases
+    assert len(passed) >= 12
+
+
+def test_case_expansion_covers_forks_and_indexes():
+    cases = ef_state.load_fixture_file(
+        os.path.join(FIXDIR, "create_tx.json"))
+    # one fork, two value indexes
+    assert {c.indexes for c in cases} == {(0, 0, 0), (0, 0, 1)}
+    cases = ef_state.load_fixture_file(
+        os.path.join(FIXDIR, "transfer_legacy.json"))
+    assert {c.fork for c in cases} == {"Shanghai", "Cancun", "Prague"}
+
+
+def test_tampered_hash_fails(tmp_path):
+    with open(os.path.join(FIXDIR, "transfer_legacy.json")) as f:
+        fixture = json.load(f)
+    bad = copy.deepcopy(fixture)
+    post = bad["transfer_legacy"]["post"]["Prague"][0]
+    post["hash"] = "0x" + "11" * 32
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps(bad))
+    passed, failed = ef_state.run_directory(str(tmp_path))
+    assert len(failed) == 1 and "state root" in failed[0].detail
+
+
+def test_tampered_logs_fails(tmp_path):
+    with open(os.path.join(FIXDIR, "sstore_refund_log_1559.json")) as f:
+        fixture = json.load(f)
+    bad = copy.deepcopy(fixture)
+    for cases in bad["sstore_refund_log_1559"]["post"].values():
+        for post in cases:
+            post["logs"] = "0x" + "22" * 32
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps(bad))
+    passed, failed = ef_state.run_directory(str(tmp_path))
+    assert failed and all("logs hash" in r.detail for r in failed)
+
+
+def test_expect_exception_enforced(tmp_path):
+    """A fixture claiming an exception for a VALID tx must fail."""
+    with open(os.path.join(FIXDIR, "transfer_legacy.json")) as f:
+        fixture = json.load(f)
+    bad = copy.deepcopy(fixture)
+    post = bad["transfer_legacy"]["post"]["Prague"][0]
+    post["expectException"] = "TransactionException.INTRINSIC_GAS_TOO_LOW"
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps(bad))
+    passed, failed = ef_state.run_directory(str(tmp_path))
+    assert any("expected TransactionException" in r.detail for r in failed)
+
+
+def test_info_blocks_skipped(tmp_path):
+    p = tmp_path / "info.json"
+    p.write_text(json.dumps({"weird": {"_info": {"comment": "no tx"}}}))
+    assert ef_state.load_fixture_file(str(p)) == []
+
+
+@pytest.mark.skipif(not os.environ.get("EF_STATE_FIXTURES"),
+                    reason="EF_STATE_FIXTURES not set (archive not in image)")
+def test_external_archive():
+    passed, failed = ef_state.run_directory(
+        os.environ["EF_STATE_FIXTURES"])
+    assert not failed, f"{len(failed)} failures, first: {failed[0].detail}"

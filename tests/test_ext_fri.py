@@ -99,3 +99,44 @@ def test_fri_rejects_tampered_query():
     )
     with pytest.raises(ValueError):
         fri.verify(proof, 8, Challenger(), params)
+
+
+def test_ext_powers_blocked_matches_scan():
+    pt = ext.to_device(_rand_ext_h())
+    for n in (1, 5, 128, 300, 1024):
+        a = np.asarray(ext.ext_powers(pt, n))
+        b = np.asarray(ext.ext_powers_blocked(pt, n, block=64))
+        np.testing.assert_array_equal(a, b)
+
+
+def test_eval_base_poly_large_uses_blocked_path():
+    coeffs = RNG.integers(0, bb.P, size=300, dtype=np.uint32)
+    pt = _rand_ext_h()
+    got = ext.eval_base_poly_at_ext(
+        bb.to_mont(jnp.asarray(coeffs)), ext.to_device(pt))
+    acc = ext.ZERO_H
+    for c in reversed([int(v) for v in coeffs]):
+        acc = ext.h_add(ext.h_mul(acc, pt), ext.h_from_base(c))
+    assert ext.to_host(got) == acc
+
+
+def test_frobenius_is_p_power():
+    zh = _rand_ext_h()
+    zd = ext.to_device(zh)
+    for k in (1, 2, 3):
+        expect = ext.h_pow(zh, bb.P ** k)
+        assert ext.to_host(ext.frobenius(zd, k)) == expect
+
+
+def test_inv_x_minus_zeta_matches_batch_inv():
+    zeta_h = _rand_ext_h()
+    zeta = ext.to_device(zeta_h)
+    xs = RNG.integers(0, bb.P, size=257, dtype=np.uint32)
+    xm = bb.to_mont(jnp.asarray(xs))
+    got = ext.inv_x_minus_zeta(xm, zeta)
+    # reference: explicit (x - zeta) then the scan-based batch_inv
+    x_ext = jnp.concatenate(
+        [bb.sub(xm, jnp.broadcast_to(zeta[0], xm.shape))[:, None],
+         jnp.broadcast_to(bb.neg(zeta[1:]), xm.shape + (3,))], axis=-1)
+    expect = ext.batch_inv(x_ext)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(expect))

@@ -1,0 +1,60 @@
+"""Groth16 over BN254: tiny-circuit round-trip, soundness rejections, and
+the NTT/QAP plumbing."""
+
+import pytest
+
+from ethrex_tpu.crypto import bn254, groth16
+
+
+def _mult_r1cs():
+    """x * y = out, with out public: z = [1, out, x, y]."""
+    return groth16.R1CS(
+        num_vars=4, num_pub=1,
+        constraints=[({2: 1}, {3: 1}, {1: 1})])
+
+
+def test_fr_ntt_roundtrip():
+    vals = [3, 1, 4, 1, 5, 9, 2, 6]
+    back = groth16._ntt_fr(groth16._ntt_fr(vals), inverse=True)
+    assert back == [v % groth16.R for v in vals]
+
+
+def test_groth16_roundtrip_mult_gate():
+    r1cs = _mult_r1cs()
+    pk, vk = groth16.setup(r1cs, seed=b"test-setup-1")
+    z = [1, 35, 5, 7]
+    assert r1cs.is_satisfied(z)
+    proof = groth16.prove(pk, r1cs, z, rnd=b"t1")
+    assert groth16.verify(vk, proof, [35])
+    # wrong public input rejected
+    assert not groth16.verify(vk, proof, [36])
+    # tampered proof rejected
+    bad = dict(proof)
+    bad["a"] = bn254.g1_mul(groth16.G1, 123)
+    assert not groth16.verify(vk, bad, [35])
+
+
+def test_groth16_multi_constraint():
+    """(x + 1) * x = y;  y * x = out  -> z = [1, out, x, y]."""
+    r1cs = groth16.R1CS(
+        num_vars=4, num_pub=1,
+        constraints=[
+            ({2: 1, 0: 1}, {2: 1}, {3: 1}),
+            ({3: 1}, {2: 1}, {1: 1}),
+        ])
+    x = 9
+    y = (x + 1) * x % groth16.R
+    out = y * x % groth16.R
+    z = [1, out, x, y]
+    assert r1cs.is_satisfied(z)
+    pk, vk = groth16.setup(r1cs, seed=b"test-setup-2")
+    proof = groth16.prove(pk, r1cs, z, rnd=b"t2")
+    assert groth16.verify(vk, proof, [out])
+    assert not groth16.verify(vk, proof, [out + 1])
+
+
+def test_unsatisfied_witness_refused():
+    r1cs = _mult_r1cs()
+    pk, _vk = groth16.setup(r1cs, seed=b"test-setup-1")
+    with pytest.raises(ValueError):
+        groth16.prove(pk, r1cs, [1, 36, 5, 7], rnd=b"t3")

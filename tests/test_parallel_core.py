@@ -1,0 +1,64 @@
+"""The flagship fused prove step (parallel/core.py) vs a direct
+re-derivation of each phase with the plain ops — catches regressions when
+the fused step's phases are restructured for performance (MXU matmul DEEP
+combination, scan-free inverses)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ethrex_tpu.ops import babybear as bb, ext, fri as fri_ops, ntt
+from ethrex_tpu.ops import poseidon2 as p2
+from ethrex_tpu.ops.fri import _fold_inv_points, _INV2
+from ethrex_tpu.parallel.core import build_prove_step
+
+LOG_N, WIDTH, LOG_BLOWUP, LOG_FINAL = 6, 8, 2, 4
+
+
+def _commit_root_ref(leaves):
+    digests = p2.hash_leaves(leaves)
+    while digests.shape[0] > 1:
+        digests = p2.compress(digests[0::2], digests[1::2])
+    return digests[0]
+
+
+def test_prove_step_matches_plain_op_composition():
+    fn, (trace, zeta, gamma, betas) = build_prove_step(
+        LOG_N, WIDTH, LOG_BLOWUP, LOG_FINAL, mesh=None)
+    troot, fri_roots, final_cw = fn(trace, zeta, gamma, betas)
+
+    n = 1 << LOG_N
+    N = n << LOG_BLOWUP
+    shift = bb.GENERATOR
+    # phase 1-2: LDE + commit
+    lde_rows = ntt.coset_lde(trace, LOG_BLOWUP, shift=shift).T
+    np.testing.assert_array_equal(np.asarray(troot),
+                                  np.asarray(_commit_root_ref(lde_rows)))
+    # phase 3: DEEP combination, written the direct (pre-MXU) way
+    pts_m = jnp.asarray(bb.to_mont_host(
+        ntt.domain_points(LOG_N + LOG_BLOWUP, shift)))
+    tcoeffs = ntt.intt(trace)
+    pows = ext.ext_powers(zeta, n)
+    tz = bb.sum_mod(bb.mont_mul(pows, tcoeffs[..., None]), axis=-2)
+    x_ext = jnp.concatenate(
+        [bb.sub(pts_m, jnp.broadcast_to(zeta[0], (N,)))[:, None],
+         jnp.broadcast_to(bb.neg(zeta[1:]), (N, 3))], axis=-1)
+    inv_xz = ext.batch_inv(x_ext)
+    gpow = ext.ext_powers(gamma, WIDTH)
+    diff = ext.sub(ext.from_base(lde_rows), tz[None])
+    comb = bb.sum_mod(ext.mul(diff, gpow[None]), axis=1)
+    cw = ext.mul(comb, inv_xz)
+    # phase 4: FRI fold chain
+    fold_invs = []
+    s = shift
+    L = (LOG_N + LOG_BLOWUP) - LOG_FINAL
+    for k in range(L):
+        fold_invs.append(jnp.asarray(
+            _fold_inv_points(LOG_N + LOG_BLOWUP - k, s)))
+        s = (s * s) % bb.P
+    inv2 = jnp.asarray(np.uint32(int(bb.to_mont_host(_INV2))))
+    for k in range(L):
+        leaves = fri_ops._pair_leaves(cw)
+        np.testing.assert_array_equal(
+            np.asarray(fri_roots[k]), np.asarray(_commit_root_ref(leaves)))
+        cw = fri_ops._fold(cw, betas[k], fold_invs[k], inv2)
+    np.testing.assert_array_equal(np.asarray(final_cw), np.asarray(cw))

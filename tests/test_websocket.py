@@ -1,0 +1,120 @@
+"""WebSocket JSON-RPC transport + eth_subscribe push subscriptions
+(reference: rpc subscription_manager over websockets)."""
+
+import base64
+import hashlib
+import json
+import os
+import socket
+import time
+
+import pytest
+
+from ethrex_tpu.node import Node
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.rpc.server import RpcServer
+from ethrex_tpu.rpc.websocket import (WsServer, _accept_key, make_frame,
+                                      read_frame, OP_TEXT)
+
+from tests.test_l2_pipeline import GENESIS, SENDER, _transfer
+
+
+class WsClient:
+    """Minimal masked-frame client for tests."""
+
+    def __init__(self, host, port):
+        self.sock = socket.create_connection((host, port))
+        key = base64.b64encode(os.urandom(16)).decode()
+        self.sock.sendall(
+            f"GET / HTTP/1.1\r\nHost: {host}\r\nUpgrade: websocket\r\n"
+            f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+            f"Sec-WebSocket-Version: 13\r\n\r\n".encode())
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            resp += self.sock.recv(4096)
+        assert b"101" in resp.split(b"\r\n")[0]
+        assert _accept_key(key).encode() in resp
+
+    def send(self, obj):
+        import struct
+
+        payload = json.dumps(obj).encode()
+        mask = os.urandom(4)
+        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        n = len(payload)
+        if n < 126:
+            header = bytes([0x80 | OP_TEXT, 0x80 | n])
+        else:
+            header = bytes([0x80 | OP_TEXT, 0x80 | 126]) \
+                + struct.pack(">H", n)
+        self.sock.sendall(header + mask + masked)
+
+    def recv(self, timeout=10.0):
+        self.sock.settimeout(timeout)
+        _op, payload = read_frame(self.sock)
+        return json.loads(payload)
+
+    def close(self):
+        self.sock.close()
+
+
+@pytest.fixture()
+def ws_setup():
+    node = Node(Genesis.from_json(GENESIS))
+    rpc = RpcServer(node, port=0)
+    ws = WsServer(rpc).start()
+    client = WsClient("127.0.0.1", ws.port)
+    yield node, ws, client
+    client.close()
+    ws.stop()
+
+
+def test_ws_plain_rpc_roundtrip(ws_setup):
+    node, ws, client = ws_setup
+    client.send({"jsonrpc": "2.0", "id": 1, "method": "eth_chainId",
+                 "params": []})
+    resp = client.recv()
+    assert resp["result"] == hex(node.config.chain_id)
+
+
+def test_ws_newheads_and_pending_subscriptions(ws_setup):
+    node, ws, client = ws_setup
+    client.send({"jsonrpc": "2.0", "id": 1, "method": "eth_subscribe",
+                 "params": ["newHeads"]})
+    heads_sid = client.recv()["result"]
+    client.send({"jsonrpc": "2.0", "id": 2, "method": "eth_subscribe",
+                 "params": ["newPendingTransactions"]})
+    pending_sid = client.recv()["result"]
+
+    tx = _transfer(0)
+    node.submit_transaction(tx)
+    note = client.recv()
+    assert note["method"] == "eth_subscription"
+    assert note["params"]["subscription"] == pending_sid
+    assert note["params"]["result"] == "0x" + tx.hash.hex()
+
+    block = node.produce_block()
+    note = client.recv()
+    assert note["params"]["subscription"] == heads_sid
+    assert note["params"]["result"]["hash"] == "0x" + block.hash.hex()
+
+    # unsubscribe stops the pushes
+    client.send({"jsonrpc": "2.0", "id": 3, "method": "eth_unsubscribe",
+                 "params": [heads_sid]})
+    assert client.recv()["result"] is True
+
+
+def test_ws_logs_subscription_filters(ws_setup):
+    node, ws, client = ws_setup
+    # contract emitting LOG1(topic=0x42...) on any call
+    from ethrex_tpu.evm.db import InMemorySource  # noqa: F401 (docs)
+
+    client.send({"jsonrpc": "2.0", "id": 1, "method": "eth_subscribe",
+                 "params": ["logs", {"address": "0x" + "bb" * 20}]})
+    sid = client.recv()["result"]
+    # a plain transfer produces no logs -> no notification
+    node.submit_transaction(_transfer(0))
+    node.produce_block()
+    client.sock.settimeout(0.5)
+    with pytest.raises((TimeoutError, socket.timeout)):
+        read_frame(client.sock)
