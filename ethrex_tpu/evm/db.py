@@ -147,6 +147,10 @@ class StateDB:
         # block-scoped write-back tracking (consumed by apply_account_updates)
         self.dirty_accounts: set[bytes] = set()
         self.dirty_storage: dict[bytes, set[int]] = {}
+        # accounts whose storage was wiped in an already-drained block while
+        # the source still sits at the batch-parent root (pipelined import):
+        # source storage reads for them are stale until rebase()
+        self.source_cleared: set[bytes] = set()
 
     # ---------------- account loading ----------------
     def _load(self, address: bytes) -> CachedAccount:
@@ -185,7 +189,8 @@ class StateDB:
         if slot in acct.storage:
             return acct.storage[slot]
         value = 0
-        if acct.exists and not acct.storage_cleared:
+        if (acct.exists and not acct.storage_cleared
+                and address not in self.source_cleared):
             value = self.source.get_storage(address, slot)
         acct.storage[slot] = value
         self.journal.append(("storage_load", address, slot))
@@ -198,6 +203,8 @@ class StateDB:
         if any(v != 0 for v in acct.storage.values()):
             return True
         if not acct.exists or acct.storage_cleared:
+            return False
+        if address in self.source_cleared:
             return False
         return self.source.account_has_storage(address)
 
@@ -396,11 +403,21 @@ class StateDB:
         """Reset dirty/cleared tracking WITHOUT changing the source —
         the pipelined importer snapshots the dirty state per block
         (blockchain.DirtySnapshot) and keeps executing on the warm cache
-        while the snapshot merkleizes on another thread."""
+        while the snapshot merkleizes on another thread.
+
+        An account whose storage was wiped this block (SELFDESTRUCT /
+        destroy+recreate) must NOT fall through to the un-rebased source
+        for later blocks of the same batch — those reads would see stale
+        pre-clear slots.  Record it in source_cleared (consulted by
+        get_storage / has_nonempty_storage) instead of leaving
+        storage_cleared set, which would wrongly re-emit the clear at the
+        next merkleize and drop slots recreated this block."""
         self.dirty_accounts = set()
         self.dirty_storage = {}
-        for acct in self.accounts.values():
-            acct.storage_cleared = False
+        for addr, acct in self.accounts.items():
+            if acct.storage_cleared:
+                self.source_cleared.add(addr)
+                acct.storage_cleared = False
 
     def rebase(self, source: VmDatabase):
         """Re-point this StateDB at a new backing source whose state already
@@ -412,5 +429,6 @@ class StateDB:
         self.source = source
         self.dirty_accounts = set()
         self.dirty_storage = {}
+        self.source_cleared = set()
         for acct in self.accounts.values():
             acct.storage_cleared = False

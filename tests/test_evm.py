@@ -307,3 +307,29 @@ def test_p256_verify_inactive_before_osaka():
         caller=SENDER, to=addr, code_address=addr, value=0,
         data=b"\x00" * 160, gas=100_000))
     assert ok and out == b"" and gas_left == 100_000
+
+
+def test_drain_dirty_suppresses_stale_source_storage():
+    """Pipelined-batch regression: a storage wipe (destroy+recreate) in
+    block N of a batch must not leak stale pre-clear slots through the
+    un-rebased source into later blocks of the same batch — and the
+    cleared flag itself must NOT survive the drain (it would re-emit the
+    clear at the next merkleize and drop recreated slots)."""
+    accounts = {CONTRACT: Account.new(code=b"\x00", storage={1: 5})}
+    state = StateDB(InMemorySource(accounts))
+    assert state.get_storage(CONTRACT, 1) == 5
+    state.begin_tx()
+    state.mark_created(CONTRACT)  # CREATE2 redeploy wipes storage
+    state.finalize_tx()
+    state.drain_dirty()           # block boundary (pipelined handoff)
+    # flag reset so the NEXT merkleize doesn't re-clear...
+    assert not state.accounts[CONTRACT].storage_cleared
+    # ...but source reads stay suppressed until rebase
+    state.begin_tx()
+    assert state.get_storage(CONTRACT, 1) == 0
+    assert not state.has_nonempty_storage(CONTRACT)
+    # rebase: the flushed source is authoritative again
+    state.rebase(InMemorySource(
+        {CONTRACT: Account.new(code=b"\x00", storage={3: 9})}))
+    state.accounts.clear()
+    assert state.get_storage(CONTRACT, 3) == 9
