@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import os
 
 from . import bn254
 from ..ops import bn254_msm as msm_ops
@@ -290,10 +291,19 @@ def prove(pk: ProvingKey, r1cs: R1CS, z: list[int],
         raise ValueError("witness does not satisfy the R1CS")
     m = _domain_size(r1cs)
 
+    # RFC-6979-style blinding: fold the secret witness tail and fresh OS
+    # entropy into r/s so proofs are hiding even when callers pass a public
+    # rnd seed (and two proofs never share randomizers).
+    wit_digest = hashlib.sha512(
+        b"groth16-wit/" + b"".join(
+            v.to_bytes(32, "big") for v in z[1 + r1cs.num_pub:])).digest()
+    entropy = os.urandom(32)
+
     def fr(tag: bytes) -> int:
         return int.from_bytes(
-            hashlib.sha512(b"groth16-rnd/" + rnd + tag).digest(),
-            "big") % R
+            hashlib.sha512(
+                b"groth16-rnd/" + rnd + wit_digest + entropy + tag
+            ).digest(), "big") % R
 
     r = fr(b"r")
     s = fr(b"s")

@@ -29,6 +29,7 @@ class BlockFetcher:
         self.l1 = l1
         self.rollup = rollup
         self.next_batch = 1
+        self.fatal: FetchError | None = None
         self._stop = threading.Event()
         self._thread = None
 
@@ -76,13 +77,22 @@ class BlockFetcher:
             imported += 1
         return imported
 
+    def healthy(self) -> bool:
+        return self.fatal is None
+
     def start(self, interval: float = 1.0):
         def loop():
             while not self._stop.wait(interval):
                 try:
                     self.fetch_once()
-                except FetchError:
-                    raise
+                except FetchError as exc:
+                    # Fatal for a follower (state-root divergence / bad DA):
+                    # record it so health checks surface the failure instead
+                    # of an unhandled daemon-thread traceback, and stop
+                    # fetching — the frozen chain must not silently advance.
+                    self.fatal = exc
+                    self._stop.set()
+                    return
                 except Exception:
                     continue  # transient L1 errors: retry next tick
 

@@ -15,6 +15,7 @@ from ..blockchain.fork_choice import ForkChoiceError, apply_fork_choice
 from ..blockchain.payload import build_payload, create_payload_header
 from ..primitives.block import (Block, BlockBody, BlockHeader, Withdrawal,
                                 EMPTY_UNCLE_HASH)
+from ..primitives.genesis import Fork
 from ..primitives.transaction import Transaction
 from .eth import CLIENT_NAME, CLIENT_VERSION, RpcError
 from .serializers import hb, hx, parse_bytes, parse_quantity
@@ -185,7 +186,8 @@ class EngineApi:
 
     def new_payload_v3(self, payload, blob_hashes=None,
                        parent_beacon_block_root=None,
-                       execution_requests=None):
+                       execution_requests=None, *, _version=3):
+        self._check_payload_fork(payload, _version)
         try:
             requests_hash = None
             if execution_requests is not None:
@@ -222,22 +224,48 @@ class EngineApi:
         return {"status": VALID, "latestValidHash": hb(block.hash),
                 "validationError": None}
 
-    new_payload_v4 = new_payload_v3
+    def new_payload_v4(self, payload, blob_hashes=None,
+                       parent_beacon_block_root=None,
+                       execution_requests=None):
+        return self.new_payload_v3(payload, blob_hashes,
+                                   parent_beacon_block_root,
+                                   execution_requests, _version=4)
 
-    # -- legacy V1/V2 (pre-Cancun CLs; reference: engine/payload.rs
-    # NewPayloadV1..V5) ---------------------------------------------------
+    # -- per-version fork gating (Engine API spec: each method version
+    # serves a bounded fork range and MUST answer -38005 outside it;
+    # reference: engine/payload.rs NewPayloadV1..V5 validation) -----------
+    def _fork_of(self, timestamp: int) -> Fork:
+        head = self.node.store.latest_number()
+        return self.node.config.fork_at(head + 1, timestamp)
+
+    def _check_payload_fork(self, payload, version: int):
+        try:
+            ts = parse_quantity(payload["timestamp"])
+        except (KeyError, ValueError, TypeError):
+            raise RpcError(-32602, "invalid payload timestamp")
+        fork = self._fork_of(ts)
+        if version == 1 and fork >= Fork.SHANGHAI:
+            raise RpcError(-38005, "V1 payload for post-Paris fork")
+        if version == 2 and fork >= Fork.CANCUN:
+            raise RpcError(-38005, "V2 payload for post-Shanghai fork")
+        if version == 3 and fork != Fork.CANCUN:
+            raise RpcError(-38005, "V3 payload outside Cancun")
+        if version == 4 and fork < Fork.PRAGUE:
+            raise RpcError(-38005, "V4 payload before Prague")
+
+    # -- legacy V1/V2 (pre-Cancun CLs) ------------------------------------
     def new_payload_v1(self, payload):
         if payload.get("withdrawals") is not None \
                 or payload.get("blobGasUsed") is not None:
             raise RpcError(-32602, "V1 payload with post-Paris fields")
-        return self.new_payload_v3(payload)
+        return self.new_payload_v3(payload, _version=1)
 
     def new_payload_v2(self, payload):
         if payload.get("blobGasUsed") is not None:
             raise RpcError(-32602, "V2 payload with Cancun fields")
-        return self.new_payload_v3(payload)
+        return self.new_payload_v3(payload, _version=2)
 
-    def forkchoice_updated_v3(self, state, attrs=None):
+    def forkchoice_updated_v3(self, state, attrs=None, *, _version=3):
         head = parse_bytes(state["headBlockHash"])
         safe = parse_bytes(state.get("safeBlockHash", "0x" + "00" * 32))
         final = parse_bytes(state.get("finalizedBlockHash",
@@ -257,6 +285,9 @@ class EngineApi:
             raise RpcError(-38002, f"invalid forkchoice state: {e}")
         payload_id = None
         if attrs:
+            # spec: attribute errors must not roll back the (already
+            # applied) forkchoice state; only the build is refused
+            self._validate_attrs(attrs, _version)
             payload_id = self._start_payload(head, attrs)
         return {"payloadStatus": {"status": VALID,
                                   "latestValidHash": hb(head),
@@ -304,29 +335,59 @@ class EngineApi:
         }
         return payload_id
 
-    def get_payload_v3(self, payload_id):
+    def _get_payload_checked(self, payload_id, version: int):
         payload = self.payloads.get(payload_id)
         if payload is None:
             raise RpcError(-38001, "unknown payload")
+        self._check_payload_fork(payload["executionPayload"], version)
         return payload
 
-    get_payload_v4 = get_payload_v3
+    def get_payload_v3(self, payload_id):
+        return self._get_payload_checked(payload_id, 3)
+
+    def get_payload_v4(self, payload_id):
+        return self._get_payload_checked(payload_id, 4)
 
     def get_payload_v1(self, payload_id):
         # V1 returns the bare ExecutionPayloadV1
-        return self.get_payload_v3(payload_id)["executionPayload"]
+        return self._get_payload_checked(payload_id, 1)["executionPayload"]
 
     def get_payload_v2(self, payload_id):
-        full = self.get_payload_v3(payload_id)
+        full = self._get_payload_checked(payload_id, 2)
         return {"executionPayload": full["executionPayload"],
                 "blockValue": full.get("blockValue", "0x0")}
 
-    def forkchoice_updated_v1(self, state, attrs=None):
-        if attrs and attrs.get("withdrawals") is not None:
-            raise RpcError(-32602, "V1 attributes with withdrawals")
-        return self.forkchoice_updated_v3(state, attrs)
+    def _check_attrs_fork(self, attrs, version: int):
+        try:
+            ts = parse_quantity(attrs["timestamp"])
+        except (KeyError, ValueError, TypeError):
+            raise RpcError(-32602, "invalid payload attributes timestamp")
+        fork = self._fork_of(ts)
+        if version == 1 and fork >= Fork.SHANGHAI:
+            raise RpcError(-38005, "V1 attributes for post-Paris fork")
+        if version == 2 and fork >= Fork.CANCUN:
+            raise RpcError(-38005, "V2 attributes for post-Shanghai fork")
+        if version == 3 and fork < Fork.CANCUN:
+            raise RpcError(-38005, "V3 attributes before Cancun")
 
-    forkchoice_updated_v2 = forkchoice_updated_v3
+    def _validate_attrs(self, attrs, version: int):
+        """Per-version payloadAttributes validation.  Called AFTER the
+        forkchoice state is applied: the Engine API spec forbids rolling
+        back the forkchoiceState update when attribute validation fails."""
+        if version == 1 and attrs.get("withdrawals") is not None:
+            raise RpcError(-32602, "V1 attributes with withdrawals")
+        if version == 2 and attrs.get("parentBeaconBlockRoot") is not None:
+            raise RpcError(-32602, "V2 attributes with parentBeaconBlockRoot")
+        if version == 3 and attrs.get("parentBeaconBlockRoot") is None:
+            raise RpcError(
+                -32602, "V3 attributes without parentBeaconBlockRoot")
+        self._check_attrs_fork(attrs, version)
+
+    def forkchoice_updated_v1(self, state, attrs=None):
+        return self.forkchoice_updated_v3(state, attrs, _version=1)
+
+    def forkchoice_updated_v2(self, state, attrs=None):
+        return self.forkchoice_updated_v3(state, attrs, _version=2)
 
     MAX_BODIES_REQUEST = 1024  # Engine API spec limit
 

@@ -25,6 +25,18 @@ OP_CLOSE = 0x8
 OP_PING = 0x9
 OP_PONG = 0xA
 
+# RFC 6455 §10.4: cap the total message size so a client-declared 2^64-1
+# length can't drive unbounded buffering; 8 MiB covers any JSON-RPC batch.
+MAX_MESSAGE_BYTES = 8 * 1024 * 1024
+
+
+class ProtocolError(ConnectionError):
+    """Client violated RFC 6455 (oversized message / unmasked frame)."""
+
+    def __init__(self, close_code: int, reason: str):
+        super().__init__(reason)
+        self.close_code = close_code
+
 
 def _accept_key(key: str) -> str:
     digest = hashlib.sha1(key.encode() + _GUID).digest()
@@ -41,8 +53,18 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
-def read_frame(sock: socket.socket) -> tuple[int, bytes]:
-    """Returns (opcode, payload) of one (possibly fragmented) message."""
+def read_frame(sock: socket.socket, require_mask: bool = False,
+               on_control=None) -> tuple[int, bytes]:
+    """Returns (opcode, payload) of one (possibly fragmented) message.
+
+    Servers pass require_mask=True: RFC 6455 §5.1 requires client→server
+    frames to be masked and the connection failed otherwise.
+
+    Control frames may be interleaved between fragments of a data message
+    (RFC 6455 §5.4); `on_control(op, data) -> bool` handles them inline
+    (True = consumed, keep reading).  Unconsumed control frames are
+    returned directly — mid-fragment that abandons the partial data
+    message, which only happens for CLOSE."""
     payload = b""
     opcode = None
     while True:
@@ -55,11 +77,25 @@ def read_frame(sock: socket.socket) -> tuple[int, bytes]:
             (length,) = struct.unpack(">H", _recv_exact(sock, 2))
         elif length == 127:
             (length,) = struct.unpack(">Q", _recv_exact(sock, 8))
+        if require_mask and not masked:
+            # RFC 6455 §5.1: a server MUST fail the connection on
+            # unmasked client frames.
+            raise ProtocolError(1002, "unmasked client frame")
+        if length + len(payload) > MAX_MESSAGE_BYTES:
+            raise ProtocolError(1009, "message too big")
         mask = _recv_exact(sock, 4) if masked else b"\x00" * 4
         data = bytearray(_recv_exact(sock, length))
         if masked:
             for i in range(len(data)):
                 data[i] ^= mask[i % 4]
+        if op & 0x8:
+            # control frame: never fragmented (§5.5), must not interrupt
+            # the reassembly buffer of an in-flight data message
+            if not fin or length > 125:
+                raise ProtocolError(1002, "bad control frame")
+            if on_control is not None and on_control(op, bytes(data)):
+                continue
+            return op, bytes(data)
         if op != 0:
             opcode = op
         payload += bytes(data)
@@ -131,18 +167,24 @@ class WsConnection:
             return {"jsonrpc": "2.0", "id": rid, "result": found}
         return self.server.rpc.handle(req)
 
+    def _on_control(self, op: int, data: bytes) -> bool:
+        if op == OP_PING:
+            with self.send_lock:
+                self.sock.sendall(make_frame(OP_PONG, data))
+            return True
+        if op == OP_PONG:
+            return True
+        return False  # CLOSE: surface to the main loop
+
     def run(self):
         try:
             while self.alive:
-                opcode, payload = read_frame(self.sock)
+                opcode, payload = read_frame(self.sock, require_mask=True,
+                                             on_control=self._on_control)
                 if opcode == OP_CLOSE:
                     with self.send_lock:
                         self.sock.sendall(make_frame(OP_CLOSE, b""))
                     break
-                if opcode == OP_PING:
-                    with self.send_lock:
-                        self.sock.sendall(make_frame(OP_PONG, payload))
-                    continue
                 if opcode != OP_TEXT:
                     continue
                 try:
@@ -156,6 +198,13 @@ class WsConnection:
                     self.send_json([self.handle_request(r) for r in req])
                 else:
                     self.send_json(self.handle_request(req))
+        except ProtocolError as exc:
+            try:
+                with self.send_lock:
+                    self.sock.sendall(make_frame(
+                        OP_CLOSE, struct.pack(">H", exc.close_code)))
+            except OSError:
+                pass
         except (ConnectionError, OSError):
             pass
         finally:

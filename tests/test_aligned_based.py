@@ -115,3 +115,27 @@ def test_based_follower_detects_root_divergence():
     fetcher = BlockFetcher(follower, l1)
     with pytest.raises(FetchError):
         fetcher.fetch_once()
+
+
+def test_based_follower_records_fatal_divergence():
+    """A FetchError inside the polling loop must not die as an unhandled
+    daemon-thread exception: the fetcher records it and stops, so health
+    checks surface the frozen-follower condition."""
+    import time
+
+    node, l1, seq = _setup()
+    node.submit_transaction(_transfer(0))
+    seq.produce_block()
+    batch = seq.commit_next_batch()
+    root, comm = l1.commitments[batch.number]
+    l1.commitments[batch.number] = (b"\x22" * 32, comm)
+    follower = Node(Genesis.from_json(GENESIS))
+    fetcher = BlockFetcher(follower, l1)
+    assert fetcher.healthy()
+    fetcher.start(interval=0.01)
+    deadline = time.time() + 5
+    while fetcher.fatal is None and time.time() < deadline:
+        time.sleep(0.01)
+    assert not fetcher.healthy()
+    assert "committed" in str(fetcher.fatal)
+    fetcher.stop()

@@ -152,3 +152,45 @@ def test_new_payload_rejects_bad_block(engine):
     status = call("engine_newPayloadV3", payload2, [],
                   "0x" + "00" * 32)["result"]
     assert status["status"] in ("SYNCING", "INVALID")
+
+
+def test_legacy_versions_fork_gated(engine):
+    """Engine API spec: each method version serves a bounded fork range and
+    answers -38005 (unsupported fork) outside it (reference validates per
+    version in engine/payload.rs / fork_choice.rs)."""
+    call, node = engine
+    genesis_hash = node.store.canonical_hash(0)
+    # Cancun is active from t=0 here, so V1/V2 payloads are unsupported.
+    payload = {"timestamp": "0x1", "parentHash": "0x" + "00" * 32}
+    resp = call("engine_newPayloadV1", payload)
+    assert resp["error"]["code"] == -38005
+    resp = call("engine_newPayloadV2", payload)
+    assert resp["error"]["code"] == -38005
+
+    fcu = {"headBlockHash": "0x" + genesis_hash.hex(),
+           "safeBlockHash": "0x" + "00" * 32,
+           "finalizedBlockHash": "0x" + "00" * 32}
+    attrs = {"timestamp": "0x1", "prevRandao": "0x" + "00" * 32,
+             "suggestedFeeRecipient": "0x" + "00" * 20}
+    resp = call("engine_forkchoiceUpdatedV2", fcu, attrs)
+    assert resp["error"]["code"] == -38005
+    # V3 attributes must carry parentBeaconBlockRoot
+    resp = call("engine_forkchoiceUpdatedV3", fcu, attrs)
+    assert resp["error"]["code"] == -32602
+
+
+def test_attrs_error_does_not_roll_back_forkchoice(engine):
+    """Spec: a payloadAttributes validation failure must not roll back the
+    already-applied forkchoiceState update."""
+    call, node = engine
+    head_num = node.store.latest_number()
+    head_hash = node.store.canonical_hash(head_num)
+    fcu = {"headBlockHash": "0x" + head_hash.hex(),
+           "safeBlockHash": "0x" + head_hash.hex(),
+           "finalizedBlockHash": "0x" + head_hash.hex()}
+    bad_attrs = {"timestamp": "0x1", "prevRandao": "0x" + "00" * 32,
+                 "suggestedFeeRecipient": "0x" + "00" * 20}  # no beacon root
+    resp = call("engine_forkchoiceUpdatedV3", fcu, bad_attrs)
+    assert resp["error"]["code"] == -32602
+    # the head/safe/finalized update stuck despite the attrs error
+    assert node.store.meta["finalized"] == head_hash

@@ -118,3 +118,35 @@ def test_ws_logs_subscription_filters(ws_setup):
     client.sock.settimeout(0.5)
     with pytest.raises((TimeoutError, socket.timeout)):
         read_frame(client.sock)
+
+
+def test_ws_rejects_unmasked_client_frame(ws_setup):
+    """RFC 6455 §5.1: server must fail the connection on unmasked frames."""
+    import struct
+
+    _node, _ws, client = ws_setup
+    payload = json.dumps({"jsonrpc": "2.0", "id": 1,
+                          "method": "eth_chainId", "params": []}).encode()
+    client.sock.sendall(make_frame(OP_TEXT, payload))  # unmasked
+    client.sock.settimeout(5.0)
+    op, body = read_frame(client.sock)
+    assert op == 0x8  # close
+    (code,) = struct.unpack(">H", body[:2])
+    assert code == 1002
+
+
+def test_ws_rejects_oversized_message(ws_setup):
+    """A client-declared length beyond MAX_MESSAGE_BYTES closes with 1009
+    without buffering the body."""
+    import struct
+
+    _node, _ws, client = ws_setup
+    # header claiming an 2^40-byte masked text frame; no body sent
+    header = bytes([0x80 | OP_TEXT, 0x80 | 127]) \
+        + struct.pack(">Q", 1 << 40) + os.urandom(4)
+    client.sock.sendall(header)
+    client.sock.settimeout(5.0)
+    op, body = read_frame(client.sock)
+    assert op == 0x8
+    (code,) = struct.unpack(">H", body[:2])
+    assert code == 1009
