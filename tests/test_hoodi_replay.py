@@ -13,17 +13,44 @@ logs-bloom membership + state-root sweeps, round 2):
   * txs 3 and 7 relay the SAME bridge message; on-chain tx 3 FAILED (its
     receiver address appears in NO header-bloom log position) and tx 7
     succeeded — our replay reproduces exactly that shape.
-  * residual gap (tracked): tx 3 fails with gas_used 811045 vs the 816911
-    implied by the header total.  Struct-log analysis (round 2) localizes
-    OUR failure point exactly: a depth-4 SSTORE (SSTORE_SET, 20000) with
-    12368 gas left inside the bridge-relay cascade — a clean OOG whose
-    burn equals the gas forwarded into that frame, so the 5866 delta sits
-    UPSTREAM in a forwarded amount, not at the failure site.  All call-
-    site accounting (memory-expansion-first ordering, 2929 access charge,
-    63/64 cap, stipend) matches the EIPs on audit; isolating the one
-    divergent charge needs a reference opcode trace or EF fixtures
-    (neither is available in this image — the EF fixture chains in
-    fixtures/blockchain are Git-LFS pointers without objects).
+
+Round-3 deep diagnosis of the residual (supersedes the round-2 note):
+
+  * The block's relay txs route fees through one shared beacon-proxied
+    paymaster implementation (0xd15d6cf0be3d...).  It brackets the relay
+    with `startGas = gasleft()` (depth 2) ... `used = startGas - gasleft()`
+    (depth 4, across two delegatecall boundaries) and emits a gas-derived
+    refund: amount = used*price + used*price/4 with price 0xe4ba2f80.
+  * Our tx4 measures used = 785,959 (0xbfe27); the header bloom has
+    exactly THREE bits not covered by our logs ({1565, 1819, 1857}) and
+    exactly ONE of our items absent from the bloom (our tx4 refund
+    topic).  Sweeping `used` over 400k..1.2M, a single value reproduces
+    those three bits: used' = 787,216 — the chain consumed EXACTLY
+    1,257 more gas than us inside the paymaster bracket (p < 1e-8 of a
+    bloom false positive over that sweep).
+  * Simulating a flat 1,257 surcharge at the paymaster impl entry makes
+    the tx4 refund amount byte-exact vs the bloom and shifts txs 4/6/7/8
+    by +1,257 each, leaving an 838 residual on the header total.
+    5,866 = 14 x 419 and 1,257 = 3 x 419 suggest a per-iteration
+    419-gas undercharge (3 relayers in txs 4/6/7), but no distribution
+    of 419-quanta over the txs matches the RECEIPTS ROOT, and the state
+    root also stays off after balance-only corrections — so some log
+    DATA or storage value (fee quotes / token payouts) still differs
+    from the chain beyond pure gas.
+  * Audits that came back CLEAN: every formulaic charge in tx4
+    (keccak/copy/log/exp/memory-expansion recomputed independently, 0
+    mismatches), precompile prices (ecAdd 150, ecMul 6000, pairing
+    45k+34k*k), the diamond-router dispatch SLOAD/cold-account charges,
+    intrinsic gas, and the 63/64 forwarding chain (cap inversions are
+    integer-consistent at every boundary).
+  * The dying tx3 frame burns its whole 161,467 allocation (OOG at an
+    SSTORE_SET with 12,368 left), so tx3's total is INSENSITIVE to
+    in-frame charges; its on-chain 816,911 implied a different
+    distribution across txs 4/6/7/8 all along — round 2's "tx 4/6/8
+    match exactly" was an artifact of attributing the whole residual to
+    tx3.  The hard oracles are header.gas_used, receipts_root,
+    state_root, and the bloom — the per-tx pins below reflect OUR
+    current measured values and the bloom-proven tx4 refund.
 """
 
 import json
@@ -83,7 +110,10 @@ def test_hoodi_block_replay():
     results = [execute_tx(tx, state, env, cfg)
                for tx in blk.body.transactions]
 
-    # exact per-tx gas for everything except the tracked tx3 residual
+    # per-tx gas pins for OUR implementation (drift detectors).  The blob
+    # transfers and the EIP-7623-floor tx are chain-exact by construction;
+    # the relay txs 4/6/7/8 are our measured values — the chain's are
+    # +1257-ish each (see module docstring), tracked via the residual.
     gases = [r.gas_used for r in results]
     assert gases[:3] == [21000] * 3
     assert gases[5] == 21000
@@ -97,19 +127,38 @@ def test_hoodi_block_replay():
     # tx7 (the second relay) succeeds — exactly as on-chain
     assert [r.success for r in results] == [
         True, True, True, False, True, True, True, True, True, True, True]
-    # tracked residual: tx3's OOG burns 811045 vs 816911 implied on-chain
     assert gases[3] == 811045, "tx3 residual changed — retighten this test"
     total = sum(gases)
     assert h.gas_used - total == 5866, (
         f"aggregate residual changed: {h.gas_used - total}")
 
-    # every log element we emit is present in the header bloom (we produce
-    # no spurious logs); the known delta is tx4's gas-derived refund amount
+    # bloom structure: our logs cover ALL header-bloom bits except exactly
+    # the three belonging to the true (chain) tx4 refund amount, and our
+    # only spurious item is our own tx4 refund amount — the paymaster
+    # gas-metering divergence is the SOLE topic-level log delta.
+    have = {n for n in range(2048)
+            if (h.bloom[256 - 1 - n // 8] >> (n % 8)) & 1}
+
+    def _bits(item: bytes) -> set:
+        h3 = keccak256(item)
+        return {((h3[i] << 8) | h3[i + 1]) & 0x7FF for i in (0, 2, 4)}
+
+    ours = set()
+    spurious = []
     for i, r in enumerate(results):
         for log in r.logs:
-            assert _bloom_has(h.bloom, log.address), f"tx{i} addr not in bloom"
-            for j, t in enumerate(log.topics):
-                if i == 4 and j == 2 and log.topics[0].hex().startswith(
-                        "518ae4ce"):
-                    continue  # tracked: gas-derived indexed refund amount
-                assert _bloom_has(h.bloom, t), f"tx{i} topic not in bloom"
+            for item in [log.address] + [bytes(t) for t in log.topics]:
+                ours |= _bits(item)
+                if not _bloom_has(h.bloom, item):
+                    spurious.append((i, item))
+    assert have - ours == {1565, 1819, 1857}
+    assert len(spurious) == 1 and spurious[0][0] == 4
+    our_amt = int.from_bytes(spurious[0][1], "big")
+
+    # the chain's refund amount reproduces those three bits at
+    # used' = 787,216 = our measured 785,959 + 1,257 (and at no other
+    # used value nearby) — the bracket divergence is pinned to the gas
+    price = 0xE4BA2F80
+    assert our_amt == 785959 * price + 785959 * price // 4
+    chain_amt = 787216 * price + 787216 * price // 4
+    assert _bits(chain_amt.to_bytes(32, "big")) == {1565, 1819, 1857}
