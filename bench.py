@@ -1,23 +1,28 @@
-"""Headline benchmark: flagship STARK prove-core throughput on TPU.
+"""Headline benchmark: BASELINE config 1 — prove a 10-transfer block
+end-to-end on one TPU chip.
 
-Runs the fully-jitted prover step (trace LDE -> Poseidon2 Merkle commit ->
-DEEP combination -> FRI fold/commit chain) on one chip and reports trace
-cells (rows x columns) proven per second.
+The measured quantity is the full `--prover tpu` pipeline on a real
+committed batch: stateless re-execution, per-tx transfer-log derivation,
+and THREE DEEP-FRI STARKs (state-update circuit, transfer VM circuit,
+output binding), exactly what `TpuBackend.prove` ships to the proof
+coordinator, followed by an independent `verify`.  This replaces round
+1-2's synthetic prove-core cells/s metric and its estimated anchor
+(VERDICT.md round 2, "produce one honest end-to-end benchmark").
 
-vs_baseline anchors against the reference's SP1-CUDA prover on an RTX 4090
-(BASELINE.md: 7.9M-gas block in 143 s).  SP1 executes ~1M zkVM cycles/s on
-that hardware for ethrex blocks, and each cycle occupies one row of a
-~100-column trace family => ~1e8 trace cells/s.  That anchor is an estimate
-(documented, refined in later rounds when the EVM AIR lands and we can
-compare per-block wall-clock directly).
+vs_baseline is a measured-vs-measured gas rate: the reference's SP1-CUDA
+prover does a 7,898,434-gas mainnet block in 143 s on an RTX 4090
+(/root/reference/docs/l2/bench/prover_performance.md:7-9) = 55,234 gas/s;
+we report (batch_gas / wall_s) / 55,234.  The batch here is small (210k
+gas of transfers), so the comparison favors neither side's batching
+amortization; larger configs land as the VM AIR's scope widens.
 
-Resilience: the chip sits behind a flaky network tunnel (round 1's official
-bench failed rc=1 because the tunnel died).  The measurement runs in a child
-process under a hard timeout with retries; every success is persisted to
-.bench_last.json, and when all attempts fail the last-known number is
-reported in degraded mode instead of crashing.
+Resilience: the chip sits behind a flaky network tunnel.  The measurement
+runs in a child process under a hard timeout with retries; successes are
+persisted to .bench_last.json; if the end-to-end measurement cannot run,
+the prove-core microbench (cells/s) is attempted as a live fallback
+before degrading to the last-known number.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 from __future__ import annotations
@@ -28,14 +33,14 @@ import subprocess
 import sys
 import time
 
-LOG_N = 15
-WIDTH = 64
-BASELINE_CELLS_PER_SEC = 1.0e8
+BASELINE_GAS_PER_SEC = 7_898_434 / 143.0
+BASELINE_CELLS_PER_SEC = 1.0e8  # round-1/2 estimated anchor (fallback only)
 LAST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          ".bench_last.json")
-ATTEMPT_TIMEOUT = int(os.environ.get("BENCH_TIMEOUT", "1500"))
+ATTEMPT_TIMEOUT = int(os.environ.get("BENCH_TIMEOUT", "3000"))
 ATTEMPTS = int(os.environ.get("BENCH_ATTEMPTS", "3"))
 PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
+NUM_TXS = int(os.environ.get("BENCH_TXS", "10"))
 
 
 def probe_backend() -> bool:
@@ -54,27 +59,89 @@ def probe_backend() -> bool:
         return False
 
 
-def measure() -> None:
+def _guard_backend() -> None:
     import jax
 
-    # guard against silently publishing a CPU number as the TPU metric
-    # when the tunnel errors fast and JAX falls back to the CPU backend
     if (jax.default_backend() == "cpu"
             and os.environ.get("BENCH_ALLOW_CPU") != "1"):
         print("backend is cpu, refusing to publish", file=sys.stderr)
         sys.exit(3)
+    from ethrex_tpu.utils.jax_cache import enable_persistent_cache
 
-    # persistent XLA cache: repeated bench runs skip the multi-minute
-    # cold compile (important when the chip sits behind a network tunnel)
-    jax.config.update("jax_compilation_cache_dir",
-                      "/tmp/ethrex_tpu_jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    enable_persistent_cache()
+
+
+def measure() -> None:
+    """BASELINE config 1: one block of NUM_TXS plain transfers, proven
+    end-to-end and independently verified."""
+    _guard_backend()
+
+    from ethrex_tpu.crypto import secp256k1
+    from ethrex_tpu.guest.execution import ProgramInput
+    from ethrex_tpu.guest.witness import generate_witness
+    from ethrex_tpu.node import Node
+    from ethrex_tpu.primitives.genesis import Genesis
+    from ethrex_tpu.primitives.transaction import Transaction
+    from ethrex_tpu.prover.tpu_backend import TpuBackend
+
+    secret = 0xA11CE
+    sender = secp256k1.pubkey_to_address(secp256k1.pubkey_from_secret(secret))
+    genesis = {
+        "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+                   "shanghaiTime": 0, "cancunTime": 0},
+        "alloc": {"0x" + sender.hex(): {"balance": hex(10**21)}},
+        "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7",
+        "timestamp": "0x0",
+    }
+    node = Node(Genesis.from_json(genesis))
+    for n in range(NUM_TXS):
+        tx = Transaction(
+            tx_type=2, chain_id=1337, nonce=n,
+            max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+            gas_limit=21_000, to=bytes([0x50 + n]) * 20, value=1000 + n,
+        ).sign(secret)
+        node.submit_transaction(tx)
+    block = node.produce_block()
+    gas = block.header.gas_used
+    witness = generate_witness(node.chain, [block])
+    pi = ProgramInput(blocks=[block], witness=witness, config=node.config)
+
+    backend = TpuBackend()
+    # one warm-up prove compiles every XLA program (persistent-cached)
+    warm = backend.prove(pi, "stark")
+    assert warm.get("vm", {}).get("mode") == "transfer"
+
+    t0 = time.perf_counter()
+    proof = backend.prove(pi, "stark")
+    wall = time.perf_counter() - t0
+    if not backend.verify(proof):
+        print("self-verification failed", file=sys.stderr)
+        sys.exit(4)
+
+    gas_per_sec = gas / wall
+    print(json.dumps({
+        "metric": "transfer_batch_prove_wall_s",
+        "value": round(wall, 3),
+        "unit": "s",
+        "vs_baseline": round(gas_per_sec / BASELINE_GAS_PER_SEC, 4),
+        "batch_gas": gas,
+        "num_txs": NUM_TXS,
+        "gas_per_sec": round(gas_per_sec, 1),
+        "proofs_per_hour_chip": round(3600.0 / wall, 2),
+        "config": "BASELINE-1 (10-transfer block, vm mode, 3 STARKs)",
+    }))
+
+
+def measure_core() -> None:
+    """Fallback microbench: fully-jitted prove-core throughput (the round
+    1-2 metric, against its documented estimated anchor)."""
+    _guard_backend()
+    import jax
 
     from ethrex_tpu.parallel.core import build_prove_step
 
-    fn, args = build_prove_step(log_n=LOG_N, width=WIDTH, log_blowup=2,
+    fn, args = build_prove_step(log_n=15, width=64, log_blowup=2,
                                 log_final_size=5, mesh=None)
-    # warm-up / compile
     jax.block_until_ready(fn(*args))
     runs = []
     for _ in range(5):
@@ -82,14 +149,36 @@ def measure() -> None:
         jax.block_until_ready(fn(*args))
         runs.append(time.perf_counter() - t0)
     wall = min(runs)
-    cells = (1 << LOG_N) * WIDTH
-    value = cells / wall
+    value = (1 << 15) * 64 / wall
     print(json.dumps({
         "metric": "stark_prove_core_trace_cells_per_sec",
         "value": round(value, 1),
         "unit": "cells/s",
         "vs_baseline": round(value / BASELINE_CELLS_PER_SEC, 4),
+        "note": "fallback microbench; baseline anchor is an estimate",
     }))
+
+
+def _attempt(flag: str, timeout: int) -> dict | None:
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), flag],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return {"_err": f"timeout {timeout}s"}
+    line = ""
+    for cand in reversed(proc.stdout.strip().splitlines()):
+        if cand.startswith("{"):
+            line = cand
+            break
+    if proc.returncode == 0 and line:
+        try:
+            return json.loads(line)
+        except ValueError:
+            return {"_err": "unparseable output"}
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+    return {"_err": f"rc={proc.returncode} " + " | ".join(tail[-3:])[:400]}
 
 
 def main() -> None:
@@ -99,25 +188,8 @@ def main() -> None:
             last_err = f"attempt {attempt + 1}: backend probe failed"
             time.sleep(10)
             continue
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--measure"],
-                capture_output=True, text=True, timeout=ATTEMPT_TIMEOUT,
-                cwd=os.path.dirname(os.path.abspath(__file__)))
-        except subprocess.TimeoutExpired:
-            last_err = f"attempt {attempt + 1}: timeout {ATTEMPT_TIMEOUT}s"
-            continue
-        line = ""
-        for cand in reversed(proc.stdout.strip().splitlines()):
-            if cand.startswith("{"):
-                line = cand
-                break
-        if proc.returncode == 0 and line:
-            try:
-                result = json.loads(line)
-            except ValueError:
-                last_err = f"attempt {attempt + 1}: unparseable output"
-                continue
+        result = _attempt("--measure", ATTEMPT_TIMEOUT)
+        if result is not None and "_err" not in result:
             try:
                 with open(LAST_PATH, "w") as f:
                     json.dump(result, f)
@@ -125,15 +197,20 @@ def main() -> None:
                 pass
             print(json.dumps(result))
             return
-        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
-        last_err = (f"attempt {attempt + 1}: rc={proc.returncode} "
-                    + " | ".join(tail[-3:])[:500])
+        last_err = f"attempt {attempt + 1}: {result.get('_err', '?')}"
         time.sleep(10)
-    # degraded mode: report last-known instead of crashing the round
+    # live fallback: the core microbench before any cached degradation
+    if probe_backend():
+        result = _attempt("--measure-core", min(ATTEMPT_TIMEOUT, 1500))
+        if result is not None and "_err" not in result:
+            result["degraded"] = True
+            result["error"] = last_err
+            print(json.dumps(result))
+            return
     result = {
-        "metric": "stark_prove_core_trace_cells_per_sec",
+        "metric": "transfer_batch_prove_wall_s",
         "value": 0.0,
-        "unit": "cells/s",
+        "unit": "s",
         "vs_baseline": 0.0,
     }
     try:
@@ -149,5 +226,7 @@ def main() -> None:
 if __name__ == "__main__":
     if "--measure" in sys.argv:
         measure()
+    elif "--measure-core" in sys.argv:
+        measure_core()
     else:
         main()

@@ -10,11 +10,15 @@ apply_updates_to_tries) to:
      EVM — validating every logged old value, every storage root, and the
      final keccak state root.
 
-Flat key/value model (32-byte words, uniform across entry kinds):
-  * account:  key = keccak(0x00 || address)
-              value = keccak(rlp(account_state)), 0^32 when absent/cleared
+Flat key/value model (32-byte words):
+  * account:  key = pack32(P2([ACCOUNT_TAG, addr_limbs]))     (flat_model)
+              value = pack32(P2(fields_limbs)), 0^32 when absent/cleared
   * storage:  key = keccak(0x01 || address || slot32)
               value = the raw 32-byte slot value (0^32 when unset)
+Account entries use Poseidon2 digests of structured field data so the VM
+circuit (models/transfer_air.py) can recompute them from account fields
+in-trace; storage entries stay keccak/raw until their semantics are
+arithmetized.
 
 The slot entries audit per-slot history across the batch; the account
 entries are the authoritative state commitment (an account's value hashes
@@ -45,12 +49,13 @@ from ..primitives import rlp
 from ..primitives.account import EMPTY_TRIE_ROOT, AccountState
 from ..stark.state_tree import TouchedStateTree, tree_depth_for
 from ..trie.trie import MissingNode, Trie
+from . import flat_model
 
 ZERO32 = b"\x00" * 32
 
 
 def account_key(address: bytes) -> bytes:
-    return keccak256(b"\x00" + address)
+    return flat_model.account_key32(address)
 
 
 def storage_key(address: bytes, slot: int) -> bytes:
@@ -130,8 +135,8 @@ def flatten_entries(blocks_log: list) -> list[WriteEntry]:
             if entry[0] == "acct":
                 _, addr, _, old, new, _cleared = entry
                 emit(account_key(addr),
-                     keccak256(old) if old else ZERO32,
-                     keccak256(new) if new else ZERO32)
+                     flat_model.account_value32(old),
+                     flat_model.account_value32(new))
             elif entry[0] == "clear":
                 addr = entry[1]
                 for key in sorted(slots_of.get(addr, ())):

@@ -1,0 +1,202 @@
+"""Fine-grained transfer log: the bridge between executed batches and the
+transfer VM circuit (models/transfer_air.py).
+
+For a batch whose transactions are all plain ETH transfers, this module
+re-derives the batch's state writes per transaction from first principles
+(nonce + 1, balance - value - fee, balance + value, coinbase + tip) and
+emits a per-tx ordered raw log (sender, recipient, coinbase entry per tx)
+whose per-key old/new chain is exactly what the state-update AIR and the
+witness replay audit consume — replacing the executor's per-block
+aggregated diff with an EVM-semantics-shaped one the circuit can constrain
+(reference equivalent: the zkVM executes the guest natively,
+crates/guest-program/src/common/execution.rs:42-209).
+
+Safety: the builder's final per-account states are compared against the
+executor's coarse write log.  ANY behavioral difference — a recipient with
+code, a precompile target, an EIP-7702 delegation, gas refunds beyond the
+plain-transfer model — makes the comparison fail and the prover falls back
+to the claimed-log mode, so the circuit never signs off on semantics the
+builder did not model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..models.transfer_air import CbSeg, TxSeg
+from ..primitives.account import EMPTY_CODE_HASH, AccountState
+from ..primitives.transaction import TYPE_PRIVILEGED, Transaction
+
+TRANSFER_GAS = 21000
+
+
+class NotTransferBatch(Exception):
+    """The batch is outside the transfer circuit's scope."""
+
+
+def is_plain_transfer(tx: Transaction) -> bool:
+    return (tx.tx_type in (0, 1, 2)
+            and tx.to is not None
+            and not tx.data
+            and not tx.access_list
+            and not tx.blob_versioned_hashes
+            and not tx.authorization_list)
+
+
+@dataclasses.dataclass
+class TxMeta:
+    sender: bytes
+    recipient: bytes
+    value: int
+    fee: int
+    tip: int
+
+
+@dataclasses.dataclass
+class BlockMeta:
+    coinbase: bytes
+    base_fee: int
+    txs: list
+
+
+@dataclasses.dataclass
+class TransferBatch:
+    blocks_log: list       # fine per-block raw log (3 acct entries per tx)
+    segs: list             # TxSeg/CbSeg stream for the circuit
+    blocks: list           # BlockMeta per block
+
+
+def _first_seen_olds(coarse_log: list) -> dict:
+    pre: dict[bytes, bytes] = {}
+    for block in coarse_log:
+        for entry in block:
+            if entry[0] == "acct" and entry[1] not in pre:
+                pre[entry[1]] = entry[3]
+    return pre
+
+
+def _final_news(coarse_log: list) -> dict:
+    fin: dict[bytes, bytes] = {}
+    for block in coarse_log:
+        for entry in block:
+            if entry[0] == "acct":
+                fin[entry[1]] = entry[4]
+    return fin
+
+
+def build_transfer_batch(blocks, coarse_log: list) -> TransferBatch:
+    """Derive the fine log + circuit segments for an all-transfer batch.
+
+    `blocks` are the executed blocks, `coarse_log` the executor's raw
+    write log (the source of batch-pre account states and the consistency
+    oracle).  Raises NotTransferBatch when out of scope."""
+    for block in coarse_log:
+        for entry in block:
+            if entry[0] != "acct":
+                raise NotTransferBatch("batch writes storage")
+    state: dict[bytes, AccountState | None] = {}
+    pre = _first_seen_olds(coarse_log)
+
+    def acct(addr: bytes) -> AccountState | None:
+        if addr not in state:
+            rlp_bytes = pre.get(addr, b"")
+            state[addr] = AccountState.decode(rlp_bytes) if rlp_bytes \
+                else None
+        return state[addr]
+
+    blocks_log = []
+    segs: list = []
+    metas = []
+    for block in blocks:
+        h = block.header
+        base_fee = h.base_fee_per_gas or 0
+        rows = []
+        txmetas = []
+        for tx in block.body.transactions:
+            if tx.tx_type == TYPE_PRIVILEGED or not is_plain_transfer(tx):
+                raise NotTransferBatch("non-transfer tx in batch")
+            sender = tx.sender()
+            if sender is None:
+                raise NotTransferBatch("unrecoverable sender")
+            price = tx.effective_gas_price(base_fee)
+            if price is None or price < base_fee:
+                raise NotTransferBatch("underpriced tx")
+            fee = TRANSFER_GAS * price
+            tip = TRANSFER_GAS * (price - base_fee)
+            value = tx.value
+
+            s_old = acct(sender)
+            if s_old is None or s_old.nonce != tx.nonce \
+                    or s_old.balance < value + fee:
+                raise NotTransferBatch("sender state out of scope")
+            s_new = dataclasses.replace(
+                s_old, nonce=s_old.nonce + 1,
+                balance=s_old.balance - value - fee)
+            state[sender] = s_new
+            rows.append(("acct", sender, None, s_old.encode(),
+                         s_new.encode(), False))
+
+            r_old = acct(tx.to)
+            r_created = r_noop = False
+            if r_old is None:
+                if value == 0:
+                    r_noop = True
+                    r_new = None
+                else:
+                    r_created = True
+                    r_new = AccountState(nonce=0, balance=value)
+            else:
+                if r_old.code_hash != EMPTY_CODE_HASH:
+                    raise NotTransferBatch("recipient has code")
+                r_new = dataclasses.replace(
+                    r_old, balance=r_old.balance + value)
+            if not r_noop:
+                state[tx.to] = r_new
+            rows.append(("acct", tx.to, None,
+                         r_old.encode() if r_old else b"",
+                         r_new.encode() if r_new else b"", False))
+
+            cb_old = acct(h.coinbase)
+            cb_created = cb_noop = False
+            if cb_old is None:
+                if tip == 0:
+                    cb_noop = True
+                    cb_new = None
+                else:
+                    cb_created = True
+                    cb_new = AccountState(nonce=0, balance=tip)
+            else:
+                if cb_old.code_hash != EMPTY_CODE_HASH:
+                    raise NotTransferBatch("coinbase has code")
+                cb_new = dataclasses.replace(
+                    cb_old, balance=cb_old.balance + tip)
+            if not cb_noop:
+                state[h.coinbase] = cb_new
+            rows.append(("acct", h.coinbase, None,
+                         cb_old.encode() if cb_old else b"",
+                         cb_new.encode() if cb_new else b"", False))
+
+            segs.append(TxSeg(sender, tx.to, s_old, s_new, r_old, r_new,
+                              value, fee, tip, r_created, r_noop))
+            segs.append(CbSeg(h.coinbase, cb_old, cb_new, tip,
+                              cb_created, cb_noop))
+            txmetas.append(TxMeta(sender, tx.to, value, fee, tip))
+        blocks_log.append(rows)
+        metas.append(BlockMeta(h.coinbase, base_fee, txmetas))
+
+    # consistency oracle: the model must reproduce the executor's final
+    # states exactly, or the batch is out of scope
+    fin = _final_news(coarse_log)
+    for addr, want in fin.items():
+        got = state.get(addr)
+        got_rlp = got.encode() if got is not None else b""
+        if got_rlp != want:
+            raise NotTransferBatch(
+                f"model diverges from executor at {addr.hex()}")
+    for addr, st in state.items():
+        if addr not in fin:
+            want_rlp = pre.get(addr, b"")
+            if (st.encode() if st else b"") != want_rlp:
+                raise NotTransferBatch(
+                    f"model touches {addr.hex()} the executor did not")
+    return TransferBatch(blocks_log=blocks_log, segs=segs, blocks=metas)

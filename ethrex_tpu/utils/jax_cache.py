@@ -1,0 +1,30 @@
+"""Persistent XLA compile cache keyed by a host-CPU fingerprint.
+
+XLA's AOT results embed machine features; loading a cache written on a
+different host SIGSEGVs/SIGILLs (observed as "Compile machine features ...
+doesn't match" warnings before a crash).  Both the test suite and bench.py
+route through this helper so they share one correctly-scoped cache.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import platform
+
+
+def cache_dir(prefix: str = "/tmp/ethrex_tpu_jax_cache") -> str:
+    try:
+        with open("/proc/cpuinfo") as f:
+            cpu = [ln for ln in f if ln.startswith("flags")][0]
+    except (OSError, IndexError):
+        cpu = platform.processor() or "unknown"
+    fp = hashlib.sha256(cpu.encode()).hexdigest()[:12]
+    return f"{prefix}_{fp}"
+
+
+def enable_persistent_cache(min_compile_secs: float = 1.0) -> None:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir())
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_secs)

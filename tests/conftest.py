@@ -23,19 +23,8 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 # persistent compilation cache: the STARK phase programs dominate test time
-# on cold runs; cached XLA binaries make re-runs fast.  The cache dir is
-# keyed by a host-CPU fingerprint: XLA's AOT results embed machine features,
-# and loading a cache written on a different host SIGSEGVs/SIGILLs (seen as
-# "Compile machine features ... doesn't match" warnings before a crash).
-import hashlib
-import platform
+# on cold runs; cached XLA binaries make re-runs fast (host-fingerprinted —
+# see ethrex_tpu/utils/jax_cache.py for why).
+from ethrex_tpu.utils.jax_cache import enable_persistent_cache  # noqa: E402
 
-try:
-    with open("/proc/cpuinfo") as _f:
-        _cpu = [ln for ln in _f if ln.startswith("flags")][0]
-except (OSError, IndexError):
-    _cpu = platform.processor() or "unknown"
-_fp = hashlib.sha256(_cpu.encode()).hexdigest()[:12]
-jax.config.update("jax_compilation_cache_dir",
-                  f"/tmp/ethrex_tpu_jax_cache_{_fp}")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+enable_persistent_cache()

@@ -18,6 +18,8 @@ from ethrex_tpu.stark import aggregate
 from ethrex_tpu.stark.air import HostExtOps
 from ethrex_tpu.stark.prover import StarkParams
 
+pytestmark = pytest.mark.slow  # full STARK compiles
+
 RNG = np.random.default_rng(11)
 
 

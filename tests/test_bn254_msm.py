@@ -7,6 +7,8 @@ import pytest
 from ethrex_tpu.crypto import bn254
 from ethrex_tpu.ops import bn254_msm as msm_ops
 
+pytestmark = pytest.mark.slow  # full STARK compiles
+
 RNG = np.random.default_rng(5)
 G1 = (1, 2)
 

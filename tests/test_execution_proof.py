@@ -17,6 +17,8 @@ from ethrex_tpu.guest.witness import generate_witness
 from ethrex_tpu.prover.tpu_backend import TpuBackend
 from tests.test_stateless import _make_chain_with_blocks
 
+pytestmark = pytest.mark.slow  # full STARK compiles
+
 
 @pytest.fixture(scope="module")
 def batch():

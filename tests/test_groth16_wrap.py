@@ -8,6 +8,8 @@ import pytest
 
 from ethrex_tpu.prover import groth16_wrap as gw
 
+pytestmark = pytest.mark.slow  # full STARK compiles
+
 DIGEST = [123456789, 2013265920, 0, 77, 31337, 2**31 - 1, 42, 999999999]
 
 

@@ -105,6 +105,7 @@ def test_pipeline_multi_batch_and_wrong_version():
         seq.stop()
 
 
+@pytest.mark.slow
 def test_full_pipeline_tpu_backend():
     """One real TPU-prover round: DEEP-FRI STARK binding the batch output."""
     node, l1, seq = _setup([protocol.PROVER_TPU])

@@ -1,0 +1,136 @@
+"""Transfer VM AIR: host digest agreement, constraint satisfaction on the
+honest trace, and rejection of tampered transfer amounts."""
+
+import numpy as np
+import pytest
+
+from ethrex_tpu.guest import flat_model
+from ethrex_tpu.models import transfer_air as ta
+from ethrex_tpu.ops import babybear as bb
+from ethrex_tpu.ops import ext
+from ethrex_tpu.primitives.account import AccountState
+from ethrex_tpu.stark.air import HostExtOps
+
+SENDER = bytes.fromhex("11" * 20)
+RECIP = bytes.fromhex("22" * 20)
+CB = bytes.fromhex("33" * 20)
+
+
+def _mk_segs(value=1000, fee=21000 * 7, tip=21000 * 2, create=False):
+    s_old = AccountState(nonce=4, balance=10**18)
+    s_new = AccountState(nonce=5, balance=10**18 - value - fee)
+    if create:
+        r_old, r_new = None, AccountState(nonce=0, balance=value)
+    else:
+        r_old = AccountState(nonce=1, balance=500)
+        r_new = AccountState(nonce=1, balance=500 + value)
+    cb_old = AccountState(nonce=0, balance=77)
+    cb_new = AccountState(nonce=0, balance=77 + tip)
+    tx = ta.TxSeg(SENDER, RECIP, s_old, s_new, r_old, r_new,
+                  value, fee, tip, r_created=create, r_noop=False)
+    cb = ta.CbSeg(CB, cb_old, cb_new, tip, created=False, noop=False)
+    return [tx, cb]
+
+
+def _check_rows(air, trace, periodic_cols, rows=None):
+    n = trace.shape[0]
+    hops = HostExtOps()
+    bad_rows = []
+    for r in (rows if rows is not None else range(n - 1)):
+        local = [ext.h_from_base(int(v)) for v in trace[r]]
+        nxt = [ext.h_from_base(int(v)) for v in trace[(r + 1) % n]]
+        periodic = [ext.h_from_base(int(col[r % len(col)]))
+                    for col in periodic_cols]
+        cs = air.constraints(local, nxt, periodic, hops)
+        bad = [i for i, c in enumerate(cs) if c != ext.ZERO_H]
+        if bad:
+            bad_rows.append((r, bad[:6]))
+    return bad_rows
+
+
+@pytest.mark.slow
+def test_honest_trace_satisfies_constraints():
+    segs = _mk_segs()
+    air = ta.TransferAir()
+    trace = ta.generate_transfer_trace(segs)
+    n = trace.shape[0]
+    assert n == ta.segment_count(len(segs)) * ta.SEG_LEN
+
+    pub = ta.transfer_public_inputs(segs)
+    for row, col, val in air.boundaries(pub, n):
+        assert int(trace[row, col]) == val, (row, col, val)
+
+    periodic_cols = air.periodic_columns(n)
+    bad = _check_rows(air, trace, periodic_cols)
+    assert not bad, f"violated rows: {bad[:8]}"
+
+
+@pytest.mark.slow
+def test_created_recipient_trace_satisfies_constraints():
+    segs = _mk_segs(create=True)
+    air = ta.TransferAir()
+    trace = ta.generate_transfer_trace(segs)
+    periodic_cols = air.periodic_columns(trace.shape[0])
+    bad = _check_rows(air, trace, periodic_cols)
+    assert not bad, f"violated rows: {bad[:8]}"
+    pub = ta.transfer_public_inputs(segs)
+    for row, col, val in air.boundaries(pub, trace.shape[0]):
+        assert int(trace[row, col]) == val
+
+
+@pytest.mark.slow
+def test_tampered_amount_breaks_constraints():
+    segs = _mk_segs()
+    air = ta.TransferAir()
+    trace = ta.generate_transfer_trace(segs)
+    n = trace.shape[0]
+    periodic_cols = air.periodic_columns(n)
+
+    # inflate the recipient's new balance limb inside segment 0: either
+    # the add chain or the absorbed digest must break
+    bad = trace.copy()
+    seg0 = slice(0, ta.SEG_LEN)
+    col = ta.RNEW + ta.F_BAL + 10
+    bad[seg0, col] = (bad[seg0, col] + 1) % bb.P
+    assert _check_rows(air, bad, periodic_cols)
+
+    # tamper the sender debit instead
+    bad2 = trace.copy()
+    col2 = ta.SNEW + ta.F_BAL + 10
+    bad2[seg0, col2] = (bad2[seg0, col2] + 1) % bb.P
+    assert _check_rows(air, bad2, periodic_cols)
+
+
+def test_vm_digest_matches_trace_lane():
+    segs = _mk_segs()
+    trace = ta.generate_transfer_trace(segs)
+    dig = ta.vm_digest(segs)
+    assert [int(v) for v in trace[-1, ta.T:ta.T + 8]] == dig
+
+
+def test_pack_unpack_roundtrip():
+    st = AccountState(nonce=3, balance=12345678901234567890)
+    d = flat_model.account_value_digest(st)
+    assert flat_model.unpack32(flat_model.pack32(d)) == d
+    assert flat_model.digest_limbs_of_value32(b"\x00" * 32) == [0] * 8
+
+
+@pytest.mark.slow
+def test_transfer_stark_roundtrip():
+    from ethrex_tpu.stark import prover as stark_prover
+    from ethrex_tpu.stark import verifier as stark_verifier
+    from ethrex_tpu.stark.prover import StarkParams
+
+    segs = _mk_segs()
+    air = ta.TransferAir()
+    trace = ta.generate_transfer_trace(segs)
+    pub = ta.transfer_public_inputs(segs)
+    params = StarkParams(log_blowup=3, num_queries=25, log_final_size=4)
+    proof = stark_prover.prove(air, trace, pub, params)
+    assert stark_verifier.verify(air, proof, params)
+
+    bad = dict(proof)
+    bad["pub_inputs"] = [(int(v) + 1) % bb.P for v in proof["pub_inputs"]]
+    with pytest.raises(Exception):
+        if not stark_verifier.verify(air, bad, params):
+            raise ValueError("rejected")
