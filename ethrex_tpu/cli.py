@@ -236,7 +236,9 @@ def _parse_enode(url: str):
     rest = url[len("enode://"):]
     pub_hex, _, addr = rest.partition("@")
     host, _, port = addr.partition(":")
-    return bytes.fromhex(pub_hex), host, int(port or 30303)
+    from .p2p.rlpx import _pub_from_bytes
+
+    return _pub_from_bytes(bytes.fromhex(pub_hex)), host, int(port or 30303)
 
 
 def run_node(args) -> int:
@@ -294,8 +296,10 @@ def run_node(args) -> int:
 
         p2p = P2PServer(node, host=args.p2p_addr, port=args.p2p_port)
         p2p.start()
+        from .p2p.rlpx import _pub_bytes
+
         print(f"p2p listening on {p2p.host}:{p2p.port} "
-              f"(enode pubkey {p2p.pub.hex()[:16]}...)")
+              f"(enode pubkey {_pub_bytes(p2p.pub).hex()})")
         peers = []
         if args.node_config and os.path.exists(args.node_config):
             with open(args.node_config) as f:

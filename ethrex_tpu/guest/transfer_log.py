@@ -136,45 +136,50 @@ def build_transfer_batch(blocks, coarse_log: list) -> TransferBatch:
             rows.append(("acct", sender, None, s_old.encode(),
                          s_new.encode(), False))
 
-            r_old = acct(tx.to)
-            r_created = r_noop = False
-            if r_old is None:
-                if value == 0:
-                    r_noop = True
-                    r_new = None
-                else:
+            # A zero-value credit touches nothing on chain, and an
+            # untouched account never appears in the coarse log or the
+            # witness — so its true pre-state is UNKNOWN here.  No-op
+            # credits therefore emit NO log row at all (the circuit's
+            # NOP segment absorbs zero digests and constrains the amount
+            # to zero); emitting an old=absent row would make honest
+            # proofs fail the witness audit whenever the account exists.
+            r_created = False
+            r_noop = value == 0
+            if r_noop:
+                r_old = r_new = None
+            else:
+                r_old = acct(tx.to)
+                if r_old is None:
                     r_created = True
                     r_new = AccountState(nonce=0, balance=value)
-            else:
-                if r_old.code_hash != EMPTY_CODE_HASH:
-                    raise NotTransferBatch("recipient has code")
-                r_new = dataclasses.replace(
-                    r_old, balance=r_old.balance + value)
-            if not r_noop:
-                state[tx.to] = r_new
-            rows.append(("acct", tx.to, None,
-                         r_old.encode() if r_old else b"",
-                         r_new.encode() if r_new else b"", False))
-
-            cb_old = acct(h.coinbase)
-            cb_created = cb_noop = False
-            if cb_old is None:
-                if tip == 0:
-                    cb_noop = True
-                    cb_new = None
                 else:
+                    if r_old.code_hash != EMPTY_CODE_HASH:
+                        raise NotTransferBatch("recipient has code")
+                    r_new = dataclasses.replace(
+                        r_old, balance=r_old.balance + value)
+                state[tx.to] = r_new
+                rows.append(("acct", tx.to, None,
+                             r_old.encode() if r_old else b"",
+                             r_new.encode(), False))
+
+            cb_created = False
+            cb_noop = tip == 0
+            if cb_noop:
+                cb_old = cb_new = None
+            else:
+                cb_old = acct(h.coinbase)
+                if cb_old is None:
                     cb_created = True
                     cb_new = AccountState(nonce=0, balance=tip)
-            else:
-                if cb_old.code_hash != EMPTY_CODE_HASH:
-                    raise NotTransferBatch("coinbase has code")
-                cb_new = dataclasses.replace(
-                    cb_old, balance=cb_old.balance + tip)
-            if not cb_noop:
+                else:
+                    if cb_old.code_hash != EMPTY_CODE_HASH:
+                        raise NotTransferBatch("coinbase has code")
+                    cb_new = dataclasses.replace(
+                        cb_old, balance=cb_old.balance + tip)
                 state[h.coinbase] = cb_new
-            rows.append(("acct", h.coinbase, None,
-                         cb_old.encode() if cb_old else b"",
-                         cb_new.encode() if cb_new else b"", False))
+                rows.append(("acct", h.coinbase, None,
+                             cb_old.encode() if cb_old else b"",
+                             cb_new.encode(), False))
 
             segs.append(TxSeg(sender, tx.to, s_old, s_new, r_old, r_new,
                               value, fee, tip, r_created, r_noop))

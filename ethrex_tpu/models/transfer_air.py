@@ -54,7 +54,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..guest.flat_model import ACCOUNT_TAG, addr_limbs, fields_limbs
+from ..guest.flat_model import (ACCOUNT_TAG, addr_limbs, fields_limbs,
+                                int_limbs, word_limbs24)
 from ..ops import babybear as bb
 from ..ops import poseidon2 as p2
 from ..primitives.account import (EMPTY_CODE_HASH, EMPTY_TRIE_ROOT,
@@ -78,10 +79,8 @@ WIDTH = 278
 # field vector layout (36 limbs)
 F_NONCE, F_BAL, F_SR, F_CH = 0, 3, 14, 25
 
-_EMPTY_SR = [int.from_bytes(EMPTY_TRIE_ROOT[i:i + 3], "big")
-             for i in range(0, 32, 3)]
-_EMPTY_CH = [int.from_bytes(EMPTY_CODE_HASH[i:i + 3], "big")
-             for i in range(0, 32, 3)]
+_EMPTY_SR = word_limbs24(EMPTY_TRIE_ROOT)
+_EMPTY_CH = word_limbs24(EMPTY_CODE_HASH)
 
 TWO24 = 1 << 24
 
@@ -146,7 +145,8 @@ class CbSeg:
 
 
 def _limbs11(value: int) -> list[int]:
-    return [(value >> (24 * (10 - i))) & 0xFFFFFF for i in range(11)]
+    """u256-ish amount -> 11 limbs (flat_model's canonical limbing)."""
+    return int_limbs(value, 11)
 
 
 def segment_count(num_segs: int) -> int:

@@ -44,6 +44,7 @@ class RlpxPeer:
         self.node = node
         self.remote_pub = remote_pub
         self.remote_status: eth_wire.Status | None = None
+        self.snappy_active = False  # enabled after Hello (p2p v5)
         self.lock = threading.Lock()
         self._stop = threading.Event()
         self._pending: dict[int, list] = {}
@@ -69,18 +70,36 @@ class RlpxPeer:
         self.KNOWN_TX_CAP = 32768
 
     # -- framing over the socket ------------------------------------------
+    # Spec wire format: header-ct(16) || header-mac(16) || frame-ct ||
+    # frame-mac(16), no length prefix — the MAC-checked header carries the
+    # frame size.  Post-Hello message bodies are snappy-compressed (p2p
+    # protocol version >= 5), msg-id stays uncompressed.
+    MAX_DECOMPRESSED = 16 * 1024 * 1024
+
     def send_msg(self, msg_id: int, payload: bytes):
+        from ..utils import snappy
+
         with self.lock:
+            if self.snappy_active:
+                payload = snappy.compress(payload)
             frame = self.secrets.seal_frame(msg_id, payload)
-            self.sock.sendall(struct.pack(">I", len(frame)) + frame)
+            self.sock.sendall(frame)
 
     def recv_msg(self) -> tuple[int, bytes]:
-        # frames ride a 4-byte length prefix on the wire (keeps the framed
-        # MAC codec intact without incremental header decryption plumbing)
-        ln = struct.unpack(">I", _recv_exact(self.sock, 4))[0]
-        if ln > 16 * 1024 * 1024 + 64:
-            raise PeerError("frame too large")
-        return self.secrets.open_frame(_recv_exact(self.sock, ln))
+        from ..utils import snappy
+
+        # frame_size is a 3-byte field (< 2^24 <= MAX_DECOMPRESSED), so the
+        # pre-allocation bound is inherent; decompression enforces its own
+        frame_size = self.secrets.open_header(_recv_exact(self.sock, 32))
+        body = _recv_exact(self.sock, self.secrets.body_len(frame_size))
+        msg_id, payload = self.secrets.open_body(frame_size, body)
+        if self.snappy_active:
+            try:
+                payload = snappy.decompress(payload,
+                                            self.MAX_DECOMPRESSED)
+            except snappy.SnappyError as e:
+                raise PeerError(f"bad snappy payload: {e}")
+        return msg_id, payload
 
     # -- protocol ----------------------------------------------------------
     def exchange_hello(self):
@@ -96,6 +115,10 @@ class RlpxPeer:
         if ("eth", 68) not in hello["capabilities"]:
             raise PeerError("peer does not speak eth/68")
         self.capabilities = set(hello["capabilities"])
+        # devp2p: both sides at p2p version >= 5 compress every message
+        # after Hello with snappy
+        if hello["version"] >= 5:
+            self.snappy_active = True
         return hello
 
     def exchange_status(self):

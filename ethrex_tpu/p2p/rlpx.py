@@ -173,6 +173,14 @@ class _MacState:
 
 
 class Secrets:
+    """RLPx frame codec per the devp2p spec (header/frame MACs built from
+    the running keccak state whitened with AES-256-ECB(mac-secret); frames
+    are AES-256-CTR with a shared zero-IV keystream per direction).  The
+    wire layout is header-ciphertext(16) || header-mac(16) ||
+    frame-ciphertext(padded to 16) || frame-mac(16) — no length prefix;
+    readers decrypt the header to learn the frame size (reference:
+    crates/networking/p2p/rlpx/connection/codec.rs)."""
+
     def __init__(self, aes: bytes, mac: bytes, egress_seed: bytes,
                  ingress_seed: bytes):
         self.aes = aes
@@ -182,11 +190,31 @@ class Secrets:
         iv = b"\x00" * 16
         self._enc = Cipher(algorithms.AES(aes), modes.CTR(iv)).encryptor()
         self._dec = Cipher(algorithms.AES(aes), modes.CTR(iv)).decryptor()
+        # one ECB context per direction: egress MACs run on send threads
+        # (under the connection lock) while ingress MACs run on the recv
+        # loop thread — sharing one EVP context would be a data race
+        self._mac_ecb_egress = Cipher(algorithms.AES(mac),
+                                      modes.ECB()).encryptor()
+        self._mac_ecb_ingress = Cipher(algorithms.AES(mac),
+                                       modes.ECB()).encryptor()
+
+    def _mac_whiten(self, state: _MacState, data16: bytes) -> bytes:
+        """spec: seed = aes(mac-secret, keccak.digest(state)[:16]) ^ data;
+        state.update(seed); mac = keccak.digest(state)[:16]"""
+        prev = state.digest()[:16]
+        ecb = self._mac_ecb_egress if state is self.egress \
+            else self._mac_ecb_ingress
+        enc = ecb.update(prev)
+        seed = bytes(a ^ b for a, b in zip(enc, data16))
+        state.update(seed)
+        return state.digest()[:16]
 
     def _header_mac(self, state: _MacState, header_ct: bytes) -> bytes:
-        # mac = keccak-state xor-encrypt trick; simplified running keccak
-        state.update(header_ct)
-        return state.digest()[:16]
+        return self._mac_whiten(state, header_ct)
+
+    def _frame_mac(self, state: _MacState, frame_ct: bytes) -> bytes:
+        state.update(frame_ct)
+        return self._mac_whiten(state, state.digest()[:16])
 
     MAX_FRAME = (1 << 24) - 1  # 3-byte size field
 
@@ -201,29 +229,44 @@ class Secrets:
         header_mac = self._header_mac(self.egress, header_ct)
         padded = frame_data + b"\x00" * ((16 - frame_size % 16) % 16)
         frame_ct = self._enc.update(padded)
-        self.egress.update(frame_ct)
-        frame_mac = self.egress.digest()[:16]
+        frame_mac = self._frame_mac(self.egress, frame_ct)
         return header_ct + header_mac + frame_ct + frame_mac
 
-    def open_frame(self, data: bytes) -> tuple[int, bytes]:
-        if len(data) < 48:
-            raise RlpxError("short frame")
+    def open_header(self, data: bytes) -> int:
+        """First 32 wire bytes -> frame size (MAC-checked)."""
+        if len(data) != 32:
+            raise RlpxError("need 32 header bytes")
         header_ct, header_mac = data[:16], data[16:32]
         expect = self._header_mac(self.ingress, header_ct)
         if not hmac_mod.compare_digest(expect, header_mac):
             raise RlpxError("bad header MAC")
         header = self._dec.update(header_ct)
-        frame_size = int.from_bytes(header[:3], "big")
+        return int.from_bytes(header[:3], "big")
+
+    def body_len(self, frame_size: int) -> int:
+        """Wire bytes that follow the header for a frame of this size."""
+        return frame_size + ((16 - frame_size % 16) % 16) + 16
+
+    def open_body(self, frame_size: int,
+                  data: bytes) -> tuple[int, bytes]:
         padded_size = frame_size + ((16 - frame_size % 16) % 16)
-        frame_ct = data[32:32 + padded_size]
-        frame_mac = data[32 + padded_size:48 + padded_size]
-        self.ingress.update(frame_ct)
-        if not hmac_mod.compare_digest(self.ingress.digest()[:16],
-                                       frame_mac):
+        if len(data) != padded_size + 16:
+            raise RlpxError("bad body length")
+        frame_ct = data[:padded_size]
+        frame_mac = data[padded_size:]
+        expect = self._frame_mac(self.ingress, frame_ct)
+        if not hmac_mod.compare_digest(expect, frame_mac):
             raise RlpxError("bad frame MAC")
         frame = self._dec.update(frame_ct)[:frame_size]
         msg_id, rest = rlp.decode_prefix(frame)
         return rlp.decode_int(msg_id), rest
+
+    def open_frame(self, data: bytes) -> tuple[int, bytes]:
+        """Whole-frame convenience used by tests and the handshake."""
+        if len(data) < 48:
+            raise RlpxError("short frame")
+        frame_size = self.open_header(data[:32])
+        return self.open_body(frame_size, data[32:])
 
 
 def derive_secrets(initiator: bool, eph_secret: int, remote_eph_pub,

@@ -136,10 +136,8 @@ def _vm_stream_from_claims(vm_meta: dict, blocks_log: list) -> list:
     for bmeta, rows in zip(blocks, blocks_log):
         coinbase = bytes.fromhex(bmeta["coinbase"])
         base_fee = int(bmeta["base_fee"])
-        txs = bmeta["txs"]
-        if len(rows) != 3 * len(txs):
-            raise ValueError("vm log shape mismatch")
-        for i, txm in enumerate(txs):
+        cursor = 0
+        for txm in bmeta["txs"]:
             value = int(txm["value"])
             fee = int(txm["fee"])
             tip = int(txm["tip"])
@@ -149,12 +147,27 @@ def _vm_stream_from_claims(vm_meta: dict, blocks_log: list) -> list:
                 raise ValueError("vm fee does not match the base fee")
             sender = bytes.fromhex(txm["sender"])
             to = bytes.fromhex(txm["to"])
-            ks, os_, ns = acct_digests(rows[3 * i], sender)
-            kr, orr, nr = acct_digests(rows[3 * i + 1], to)
-            kc, oc, nc = acct_digests(rows[3 * i + 2], coinbase)
+            ks, os_, ns = acct_digests(rows[cursor], sender)
+            cursor += 1
+            if value == 0:
+                # no-op credit: no log row; the circuit's NOP segment
+                # absorbs zero digests and pins the amount to zero
+                kr = flat_model.account_key_digest(to)
+                orr = nr = [0] * 8
+            else:
+                kr, orr, nr = acct_digests(rows[cursor], to)
+                cursor += 1
+            if tip == 0:
+                kc = flat_model.account_key_digest(coinbase)
+                oc = nc = [0] * 8
+            else:
+                kc, oc, nc = acct_digests(rows[cursor], coinbase)
+                cursor += 1
             txf = (ta._limbs11(value), ta._limbs11(fee), ta._limbs11(tip))
             items.append(("tx", txf, (ks, os_, ns, kr, orr, nr)))
             items.append(("cb", None, (kc, oc, nc)))
+        if cursor != len(rows):
+            raise ValueError("vm log shape mismatch")
     return items
 
 

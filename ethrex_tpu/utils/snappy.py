@@ -1,0 +1,173 @@
+"""Raw snappy block format (compress/decompress), dependency-free.
+
+RLPx compresses every post-Hello message body with snappy (devp2p spec;
+reference: crates/networking/p2p/rlpx/connection/codec.rs uses the snap
+crate).  The image has no python-snappy, so this implements the block
+format directly:
+
+    preamble: uncompressed length as little-endian varint
+    elements: 2-bit tag in the low bits of the first byte
+        00 literal  (len-1 in tag bits 2..7; 60..63 mean 1..4 extra
+                     little-endian length bytes)
+        01 copy     (len-4 in tag bits 2..4, offset 11 bits: high 3 in
+                     tag bits 5..7, low 8 in the next byte)
+        10 copy     (len-1 in tag bits 2..7, offset 2 LE bytes)
+        11 copy     (len-1 in tag bits 2..7, offset 4 LE bytes)
+
+The compressor is a greedy 4-byte-hash matcher (snappy's own strategy,
+simplified); any literal/copy mix is a valid stream, so correctness never
+depends on match quality.  The decompressor validates lengths and offsets
+and enforces a caller-supplied output cap (RLPx rejects messages that
+inflate beyond the protocol limit).
+"""
+
+from __future__ import annotations
+
+
+class SnappyError(Exception):
+    pass
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while n >= 0x80:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+    return bytes(out)
+
+
+def _read_varint(data: bytes, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(data) or shift > 35:
+            raise SnappyError("bad varint")
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _emit_literal(out: bytearray, lit: bytes):
+    n = len(lit) - 1
+    if n < 60:
+        out.append(n << 2)
+    elif n < (1 << 8):
+        out.append(60 << 2)
+        out.append(n)
+    elif n < (1 << 16):
+        out.append(61 << 2)
+        out += n.to_bytes(2, "little")
+    elif n < (1 << 24):
+        out.append(62 << 2)
+        out += n.to_bytes(3, "little")
+    else:
+        out.append(63 << 2)
+        out += n.to_bytes(4, "little")
+    out += lit
+
+
+def _emit_copy(out: bytearray, offset: int, length: int):
+    while length > 0:
+        if length < 4:  # too short for any copy element: shouldn't happen
+            raise SnappyError("internal: copy too short")
+        step = min(length, 64)
+        if length - step in (1, 2, 3):
+            step = length - 4  # keep the tail >= 4
+        if 4 <= step <= 11 and offset < (1 << 11):
+            out.append(0x01 | ((step - 4) << 2) | ((offset >> 8) << 5))
+            out.append(offset & 0xFF)
+        elif offset < (1 << 16):
+            out.append(0x02 | ((step - 1) << 2))
+            out += offset.to_bytes(2, "little")
+        else:
+            out.append(0x03 | ((step - 1) << 2))
+            out += offset.to_bytes(4, "little")
+        length -= step
+
+
+def compress(data: bytes) -> bytes:
+    out = bytearray(_varint(len(data)))
+    n = len(data)
+    if n == 0:
+        return bytes(out)
+    table: dict[bytes, int] = {}
+    i = 0
+    lit_start = 0
+    while i + 4 <= n:
+        key = data[i:i + 4]
+        cand = table.get(key)
+        table[key] = i
+        if cand is not None and data[cand:cand + 4] == key \
+                and i - cand < (1 << 32):
+            # extend the match
+            length = 4
+            while i + length < n and length < 1 << 16 and \
+                    data[cand + length] == data[i + length]:
+                length += 1
+            if i > lit_start:
+                _emit_literal(out, data[lit_start:i])
+            _emit_copy(out, i - cand, length)
+            i += length
+            lit_start = i
+        else:
+            i += 1
+    if lit_start < n:
+        _emit_literal(out, data[lit_start:])
+    return bytes(out)
+
+
+def decompress(data: bytes, max_len: int = 16 * 1024 * 1024) -> bytes:
+    want, pos = _read_varint(data, 0)
+    if want > max_len:
+        raise SnappyError(f"decoded length {want} over cap")
+    out = bytearray()
+    n = len(data)
+    while pos < n:
+        tag = data[pos]
+        pos += 1
+        kind = tag & 0x03
+        if kind == 0:  # literal
+            ln = tag >> 2
+            if ln >= 60:
+                extra = ln - 59
+                if pos + extra > n:
+                    raise SnappyError("truncated literal length")
+                ln = int.from_bytes(data[pos:pos + extra], "little")
+                pos += extra
+            ln += 1
+            if pos + ln > n:
+                raise SnappyError("truncated literal")
+            out += data[pos:pos + ln]
+            pos += ln
+        else:
+            if kind == 1:
+                ln = ((tag >> 2) & 0x07) + 4
+                if pos >= n:
+                    raise SnappyError("truncated copy")
+                offset = ((tag >> 5) << 8) | data[pos]
+                pos += 1
+            elif kind == 2:
+                ln = (tag >> 2) + 1
+                if pos + 2 > n:
+                    raise SnappyError("truncated copy")
+                offset = int.from_bytes(data[pos:pos + 2], "little")
+                pos += 2
+            else:
+                ln = (tag >> 2) + 1
+                if pos + 4 > n:
+                    raise SnappyError("truncated copy")
+                offset = int.from_bytes(data[pos:pos + 4], "little")
+                pos += 4
+            if offset == 0 or offset > len(out):
+                raise SnappyError("bad copy offset")
+            for _ in range(ln):  # overlapping copies are legal
+                out.append(out[-offset])
+        if len(out) > max_len:
+            raise SnappyError("output over cap")
+    if len(out) != want:
+        raise SnappyError(f"length mismatch: {len(out)} != {want}")
+    return bytes(out)

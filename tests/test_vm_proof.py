@@ -140,3 +140,30 @@ def test_vm_downgrade_rejected_by_witness_audit(batch, monkeypatch):
     assert "vm" not in claimed
     assert backend.verify(claimed)
     assert not backend.verify_with_input(claimed, batch)
+
+
+def test_zero_tip_coinbase_emits_no_log_row():
+    """tip == 0 leaves the coinbase untouched on chain, and its pre-state
+    is unknown (not in the witness) — the builder must emit NO coinbase
+    row rather than claiming the account is absent (review finding)."""
+    node = Node(Genesis.from_json(GENESIS))
+    t = Transaction(
+        tx_type=2, chain_id=1337, nonce=0,
+        max_priority_fee_per_gas=0, max_fee_per_gas=10**10,
+        gas_limit=21000, to=OTHER, value=7,
+    ).sign(SECRET)
+    node.submit_transaction(t)
+    block = node.produce_block()
+    witness = generate_witness(node.chain, [block])
+    pi = ProgramInput(blocks=[block], witness=witness, config=node.config)
+    coarse = []
+    out = execution_program(pi, write_log=coarse)
+    tb = tl_mod.build_transfer_batch([block], coarse)
+    # sender + recipient rows only; the cb segment is a NOP in-circuit
+    assert len(tb.blocks_log[0]) == 2
+    assert tb.segs[1].kind == "cb" and tb.segs[1].noop
+    from ethrex_tpu.guest import access_log
+
+    access_log.replay_log_against_witness(
+        tb.blocks_log, witness.nodes,
+        out.initial_state_root, out.final_state_root)
