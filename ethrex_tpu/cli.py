@@ -13,6 +13,7 @@ import json
 import os
 import signal
 import sys
+import time
 
 from .node import Node
 from .primitives.genesis import Genesis
@@ -349,6 +350,110 @@ def run_node(args) -> int:
     return 0
 
 
+def run_l2(args) -> int:
+    """`ethrex-tpu l2`: launch the sequencer stack — L2 node + block
+    producer + committer + proof coordinator + proof sender (+ optional
+    in-process prover) against a datadir with durable checkpoints
+    (reference: cmd/ethrex/cli.rs:562-676 `l2` subcommand tree +
+    crates/l2/sequencer/mod.rs start_l2)."""
+    from .l2.l1_client import InMemoryL1
+    from .l2.rollup_store import PersistentRollupStore, RollupStore
+    from .l2.sequencer import Sequencer, SequencerConfig
+
+    genesis = _load_genesis(args)
+    if genesis is None:
+        print("either --dev or --network <genesis.json> is required",
+              file=sys.stderr)
+        return 1
+    coinbase = bytes.fromhex(args.coinbase.removeprefix("0x"))
+    store = _open_store(args.datadir)
+    node = Node(genesis, coinbase=coinbase, store=store)
+
+    if args.datadir:
+        rollup = PersistentRollupStore(
+            os.path.join(args.datadir, "rollup.db"))
+    else:
+        rollup = RollupStore()
+
+    prover_types = tuple(t for t in args.l2_provers.split(",") if t)
+    if args.l1_url:
+        from .l2.eth_client import EthClient
+        from .l2.l1_contract import RpcL1Client
+
+        if not (args.l1_contract and args.l1_secret):
+            print("--l1.contract and --l1.secret are required with "
+                  "--l1.url", file=sys.stderr)
+            return 1
+        l1 = RpcL1Client(
+            EthClient(args.l1_url),
+            bytes.fromhex(args.l1_contract.removeprefix("0x")),
+            int(args.l1_secret.removeprefix("0x"), 16),
+            needed_prover_types=list(prover_types))
+    elif args.datadir:
+        from .l2.l1_client import PersistentInMemoryL1
+
+        l1 = PersistentInMemoryL1(
+            os.path.join(args.datadir, "l1_dev.json"),
+            needed_prover_types=list(prover_types))
+        print("l2: using datadir-persisted dev L1 "
+              "(pass --l1.url for a real one)")
+    else:
+        l1 = InMemoryL1(needed_prover_types=list(prover_types))
+        print("l2: using in-process dev L1 (pass --l1.url for a real one)")
+
+    cfg = SequencerConfig(
+        block_time=args.block_time or 1.0,
+        commit_interval=args.commit_interval,
+        needed_prover_types=prover_types)
+    seq = Sequencer(node, l1, cfg, rollup=rollup)
+    node.sequencer = seq
+
+    server = RpcServer(node, args.http_addr, args.http_port).start()
+    print(f"genesis hash: 0x{node.genesis_header.hash.hex()}")
+    print(f"L2 JSON-RPC listening on http://{args.http_addr}:{server.port}")
+    latest = rollup.latest_batch_number()
+    if latest:
+        print(f"resuming from checkpoint: batch {latest} "
+              f"(blocks up to {seq.last_batched_block})")
+    seq.start()
+    print(f"sequencer running (block time {cfg.block_time}s, commit "
+          f"interval {cfg.commit_interval}s, proof coordinator on port "
+          f"{seq.coordinator.port})")
+
+    clients = []
+    if args.l2_run_prover:
+        from .prover.client import ProverClient
+
+        for ptype in prover_types:
+            client = ProverClient(
+                ptype, [("127.0.0.1", seq.coordinator.port)])
+            client.start()
+            clients.append(client)
+            print(f"in-process {ptype} prover polling the coordinator")
+
+    code = 0
+    try:
+        while seq.fatal is None:
+            time.sleep(0.5)
+        actor, err = seq.fatal
+        print(f"fatal sequencer actor {actor}: {err}", file=sys.stderr)
+        code = 1
+    except KeyboardInterrupt:
+        pass
+    finally:
+        for client in clients:
+            client.stop()
+        seq.stop()
+        server.stop()
+        writers_stopped = node.stop()
+        node.store.flush()
+        if hasattr(rollup, "close"):
+            rollup.close()
+        if store is not None and writers_stopped:
+            store.backend.close()
+    return code
+
+
 def main(argv=None):
     flags = argparse.ArgumentParser(add_help=False)
     _add_node_flags(flags)
@@ -371,6 +476,26 @@ def main(argv=None):
     p_rm.add_argument("--force", action="store_true")
     sub.add_parser("compute-state-root", parents=[flags],
                    help="print the genesis state root")
+    p_l2 = sub.add_parser("l2", parents=[flags],
+                          help="run the L2 sequencer stack")
+    p_l2.add_argument("--commit-interval", type=float,
+                      default=float(_env("COMMIT_INTERVAL", "2.0")),
+                      help="seconds between batch commits")
+    p_l2.add_argument("--l1.url", dest="l1_url",
+                      default=_env("L1_URL"),
+                      help="L1 JSON-RPC endpoint (omit for dev L1)")
+    p_l2.add_argument("--l1.contract", dest="l1_contract",
+                      default=_env("L1_CONTRACT"),
+                      help="OnChainProposer contract address on L1")
+    p_l2.add_argument("--l1.secret", dest="l1_secret",
+                      default=_env("L1_SECRET"),
+                      help="hex secret key for L1 commitment txs")
+    p_l2.add_argument("--provers", dest="l2_provers",
+                      default=_env("L2_PROVERS", "tpu"),
+                      help="comma-separated required prover types")
+    p_l2.add_argument("--run-prover", dest="l2_run_prover",
+                      action="store_true",
+                      help="also run in-process prover client(s)")
 
     args = parser.parse_args(argv)
     handlers = {
@@ -378,6 +503,7 @@ def main(argv=None):
         "export": cmd_export,
         "removedb": cmd_removedb,
         "compute-state-root": cmd_compute_state_root,
+        "l2": run_l2,
         None: run_node,
     }
     return handlers[args.command](args)

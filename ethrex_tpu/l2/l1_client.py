@@ -222,3 +222,107 @@ class InMemoryL1(L1Client):
     def get_deposits(self, since_index: int) -> list[Deposit]:
         with self.lock:
             return self.deposits[since_index:]
+
+
+class PersistentInMemoryL1(InMemoryL1):
+    """Dev L1 with its contract state JSON-persisted in the datadir, so a
+    kill -9'd `ethrex-tpu l2` stack resumes against the same simulated L1
+    (a real deployment points --l1.url at an actual chain instead)."""
+
+    def __init__(self, path: str, needed_prover_types: list[str],
+                 l2_chain_id: int | None = None):
+        super().__init__(needed_prover_types, l2_chain_id)
+        self.path = path
+        self._loading = True
+        try:
+            import json as _json
+            import os as _os
+
+            if _os.path.exists(path):
+                with open(path) as f:
+                    o = _json.load(f)
+                self.commitments = {
+                    int(k): (bytes.fromhex(v[0]), bytes.fromhex(v[1]))
+                    for k, v in o["commitments"].items()}
+                self.message_roots = {
+                    int(k): bytes.fromhex(v)
+                    for k, v in o["message_roots"].items()}
+                self.claimed = {bytes.fromhex(h) for h in o["claimed"]}
+                self.verified_up_to = o["verified_up_to"]
+                self.consumed_deposits = o["consumed_deposits"]
+                self.deposits = [
+                    Deposit(l1_tx_hash=bytes.fromhex(d["h"]),
+                            recipient=bytes.fromhex(d["r"]),
+                            amount=d["a"], data=bytes.fromhex(d["d"]),
+                            gas_limit=d["g"], index=d["i"])
+                    for d in o["deposits"]]
+                from .blobs import BlobsBundle
+
+                self.blob_sidecars = {
+                    int(k): BlobsBundle(
+                        blobs=[bytes.fromhex(x) for x in v["blobs"]],
+                        commitments=[bytes.fromhex(x)
+                                     for x in v["commitments"]],
+                        proofs=[bytes.fromhex(x) for x in v["proofs"]])
+                    for k, v in o["blobs"].items()}
+        finally:
+            self._loading = False
+
+    def _save(self):
+        if getattr(self, "_loading", False):
+            return
+        import json as _json
+
+        o = {
+            "commitments": {str(k): [v[0].hex(), v[1].hex()]
+                            for k, v in self.commitments.items()},
+            "message_roots": {str(k): v.hex()
+                              for k, v in self.message_roots.items()},
+            "claimed": [h.hex() for h in self.claimed],
+            "verified_up_to": self.verified_up_to,
+            "consumed_deposits": self.consumed_deposits,
+            "deposits": [{"h": d.l1_tx_hash.hex(), "r": d.recipient.hex(),
+                          "a": d.amount, "d": d.data.hex(),
+                          "g": d.gas_limit, "i": d.index}
+                         for d in self.deposits],
+            "blobs": {str(k): {"blobs": [x.hex() for x in b.blobs],
+                               "commitments": [x.hex()
+                                               for x in b.commitments],
+                               "proofs": [x.hex() for x in b.proofs]}
+                      for k, b in self.blob_sidecars.items()},
+        }
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            _json.dump(o, f)
+        import os as _os
+
+        _os.replace(tmp, self.path)
+
+    def commit_batch(self, *a, **kw):
+        out = super().commit_batch(*a, **kw)
+        with self.lock:
+            self._save()
+        return out
+
+    def publish_blobs(self, number: int, bundle) -> None:
+        super().publish_blobs(number, bundle)
+        with self.lock:
+            self._save()
+
+    def verify_batches(self, *a, **kw):
+        out = super().verify_batches(*a, **kw)
+        with self.lock:
+            self._save()
+        return out
+
+    def claim_withdrawal(self, *a, **kw):
+        out = super().claim_withdrawal(*a, **kw)
+        with self.lock:
+            self._save()
+        return out
+
+    def deposit(self, *a, **kw):
+        out = super().deposit(*a, **kw)
+        with self.lock:
+            self._save()
+        return out

@@ -67,28 +67,78 @@ class Sequencer:
     """Wires all L2 actors (reference: start_l2)."""
 
     def __init__(self, node: Node, l1: L1Client,
-                 config: SequencerConfig | None = None):
+                 config: SequencerConfig | None = None,
+                 rollup: RollupStore | None = None):
         self.node = node
         self.l1 = l1
         self.cfg = config or SequencerConfig()
-        self.rollup = RollupStore()
+        self.rollup = rollup if rollup is not None else RollupStore()
         self.coordinator = ProofCoordinator(
             self.rollup, needed_types=list(self.cfg.needed_prover_types),
             commit_hash=self.cfg.commit_hash)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
-        self._deposit_cursor = 0
+        # checkpoint resume (reference: l1_committer.rs:389 per-batch
+        # checkpoints): a persistent rollup store carries the batch chain
+        # and the deposit cursor across restarts, so a killed sequencer
+        # continues at the right batch instead of re-committing from 1
+        # the durable cursor counts only INCLUDED deposits; anything the
+        # L1 reports beyond it is re-fetched as pending after a restart,
+        # so an in-flight deposit is never lost (a crash between block
+        # production and the meta write re-creates the privileged tx,
+        # which execution then rejects on its fixed nonce = deposit index)
+        self._deposit_cursor = int(self.rollup.get_meta(
+            "deposit_cursor_included", 0))
+        latest = self.rollup.latest_batch_number()
+        self.last_batched_block = (
+            self.rollup.get_batch(latest).last_block if latest else 0)
+        if self.last_batched_block > self.node.store.latest_number():
+            # the chain lost its unflushed tail in a crash while the
+            # rollup checkpoints survived: regenerate the missing blocks
+            # from the stored batch prover inputs (reference:
+            # l1_committer.rs:1620 regenerate_state)
+            self._regenerate_chain()
         self.pending_privileged: list[Transaction] = []
-        self.last_batched_block = 0
         self._lock = threading.RLock()
         self.health: dict[str, ActorHealth] = {}
         self.fatal: tuple[str, str] | None = None
         self.on_fatal = None  # callback(actor, error) for orchestrators
 
+    def _regenerate_chain(self):
+        """Re-import committed-batch blocks the chain store lost (crash
+        between batch checkpoint and chain flush).  Every committed batch
+        carries its full ProgramInput, so the blocks are replayed through
+        normal validation and fork choice."""
+        from ..blockchain.fork_choice import apply_fork_choice
+        from ..guest.execution import ProgramInput
+
+        for number in sorted(self.rollup.batches):
+            batch = self.rollup.batches[number]
+            if batch.last_block <= self.node.store.latest_number():
+                continue
+            stored = self.rollup.get_prover_input(number,
+                                                  self.cfg.commit_hash)
+            if stored is None:
+                raise RuntimeError(
+                    f"cannot regenerate batch {number}: no stored input")
+            pi = ProgramInput.from_json(stored)
+            tip = None
+            for block in pi.blocks:
+                if block.header.number <= self.node.store.latest_number():
+                    continue
+                self.node.chain.add_block(block)
+                tip = block.hash
+            if tip is not None:
+                apply_fork_choice(self.node.store, tip, tip, tip)
+        log.info("regenerated chain state up to block %d from rollup "
+                 "checkpoints", self.node.store.latest_number())
+
     # ------------------------------------------------------------------
     # BlockProducer (reference: block_producer.rs produce_block)
     # ------------------------------------------------------------------
     def produce_block(self):
+        from ..primitives.transaction import TYPE_PRIVILEGED
+
         with self._lock:
             forced = list(self.pending_privileged)
             block = self.node.produce_block(forced_txs=forced)
@@ -96,6 +146,16 @@ class Sequencer:
             self.pending_privileged = [
                 tx for tx in self.pending_privileged
                 if tx.hash not in included]
+            # checkpoint the durable deposit cursor: a privileged tx's
+            # nonce IS its deposit index
+            done = [tx.nonce + 1 for tx in block.body.transactions
+                    if tx.tx_type == TYPE_PRIVILEGED]
+            if done:
+                cur = int(self.rollup.get_meta(
+                    "deposit_cursor_included", 0))
+                if max(done) > cur:
+                    self.rollup.set_meta("deposit_cursor_included",
+                                         max(done))
             return block
 
     # ------------------------------------------------------------------

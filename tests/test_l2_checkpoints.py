@@ -1,0 +1,130 @@
+"""L2 durability: persistent rollup store, committer checkpoints, crash
+resume, and chain regeneration from batch inputs (reference:
+l1_committer.rs:389/529/1620, cmd/ethrex/cli.rs l2 subcommand)."""
+
+import pytest
+
+from ethrex_tpu.l2.l1_client import InMemoryL1
+from ethrex_tpu.l2.rollup_store import PersistentRollupStore
+from ethrex_tpu.l2.sequencer import Sequencer, SequencerConfig
+from ethrex_tpu.node import Node
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.prover import protocol
+from ethrex_tpu.storage.persistent import PersistentBackend
+from ethrex_tpu.storage.store import Store
+from tests.test_l2_pipeline import GENESIS, _transfer
+
+CFG = SequencerConfig(needed_prover_types=(protocol.PROVER_EXEC,))
+
+
+def _open_node(tmp_path):
+    store = Store(PersistentBackend(str(tmp_path / "chain.db")))
+    return Node(Genesis.from_json(GENESIS), store=store)
+
+
+def test_rollup_store_survives_reopen(tmp_path):
+    path = str(tmp_path / "rollup.db")
+    node = _open_node(tmp_path)
+    l1 = InMemoryL1(needed_prover_types=[protocol.PROVER_EXEC])
+    rollup = PersistentRollupStore(path)
+    seq = Sequencer(node, l1, CFG, rollup=rollup)
+    node.submit_transaction(_transfer(0))
+    seq.produce_block()
+    batch = seq.commit_next_batch()
+    assert batch is not None and batch.number == 1
+    rollup.store_proof(1, protocol.PROVER_EXEC, {"backend": "exec"})
+    # simulate kill -9: no graceful sequencer stop, just drop the handles
+    node.store.flush()
+    rollup.close()
+    node.store.backend.close()
+
+    rollup2 = PersistentRollupStore(path)
+    assert rollup2.latest_batch_number() == 1
+    b = rollup2.get_batch(1)
+    assert b.committed and b.state_root == batch.state_root
+    assert rollup2.get_proof(1, protocol.PROVER_EXEC) == {"backend": "exec"}
+    assert rollup2.get_prover_input(1, CFG.commit_hash) is not None
+    assert rollup2.get_blobs_bundle(1) is not None
+    rollup2.close()
+
+
+def test_sequencer_resumes_at_next_batch(tmp_path):
+    path = str(tmp_path / "rollup.db")
+    node = _open_node(tmp_path)
+    l1 = InMemoryL1(needed_prover_types=[protocol.PROVER_EXEC])
+    rollup = PersistentRollupStore(path)
+    seq = Sequencer(node, l1, CFG, rollup=rollup)
+    node.submit_transaction(_transfer(0))
+    seq.produce_block()
+    assert seq.commit_next_batch().number == 1
+    head = node.store.latest_number()
+    node.store.flush()
+    rollup.close()
+    node.store.backend.close()
+
+    # restart: reopen both stores; the sequencer must continue at batch 2
+    # and NOT re-commit already-batched blocks
+    node2 = _open_node(tmp_path)
+    rollup2 = PersistentRollupStore(path)
+    seq2 = Sequencer(node2, l1, CFG, rollup=rollup2)
+    assert seq2.last_batched_block == head
+    assert seq2.commit_next_batch() is None  # nothing new to batch
+    node2.submit_transaction(_transfer(1))
+    seq2.produce_block()
+    batch2 = seq2.commit_next_batch()
+    assert batch2 is not None and batch2.number == 2
+    assert batch2.first_block == head + 1
+    assert l1.last_committed_batch() == 2
+    rollup2.close()
+    node2.store.backend.close()
+
+
+def test_chain_regenerated_from_rollup_checkpoints(tmp_path):
+    """Crash lost the chain's unflushed tail but the rollup checkpoints
+    survived: the sequencer re-imports the batch blocks from the stored
+    prover inputs (reference: regenerate_state)."""
+    path = str(tmp_path / "rollup.db")
+    node = Node(Genesis.from_json(GENESIS))  # chain in memory: "lost"
+    l1 = InMemoryL1(needed_prover_types=[protocol.PROVER_EXEC])
+    rollup = PersistentRollupStore(path)
+    seq = Sequencer(node, l1, CFG, rollup=rollup)
+    for n in range(2):
+        node.submit_transaction(_transfer(n))
+        seq.produce_block()
+    assert seq.commit_next_batch().number == 1
+    head = node.store.latest_number()
+    root = node.store.head_header().state_root
+    rollup.close()
+
+    # fresh chain (genesis only) + surviving rollup store
+    node2 = Node(Genesis.from_json(GENESIS))
+    rollup2 = PersistentRollupStore(path)
+    seq2 = Sequencer(node2, l1, CFG, rollup=rollup2)
+    assert node2.store.latest_number() == head
+    assert node2.store.head_header().state_root == root
+    assert seq2.last_batched_block == head
+    rollup2.close()
+
+
+def test_deposit_cursor_checkpoint(tmp_path):
+    path = str(tmp_path / "rollup.db")
+    node = _open_node(tmp_path)
+    l1 = InMemoryL1(needed_prover_types=[protocol.PROVER_EXEC])
+    l1.deposit(b"\x61" * 20, 1000)
+    rollup = PersistentRollupStore(path)
+    seq = Sequencer(node, l1, CFG, rollup=rollup)
+    seq.watch_l1()
+    block = seq.produce_block()
+    assert any(tx.tx_type == 0x7E for tx in block.body.transactions)
+    node.store.flush()
+    rollup.close()
+    node.store.backend.close()
+
+    node2 = _open_node(tmp_path)
+    rollup2 = PersistentRollupStore(path)
+    seq2 = Sequencer(node2, l1, CFG, rollup=rollup2)
+    seq2.watch_l1()
+    # the included deposit is NOT re-created after restart
+    assert not seq2.pending_privileged
+    rollup2.close()
+    node2.store.backend.close()
