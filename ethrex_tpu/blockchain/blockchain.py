@@ -123,8 +123,10 @@ class Blockchain:
         if fork >= Fork.CANCUN:
             if header.blob_gas_used is None or header.excess_blob_gas is None:
                 raise InvalidBlock("missing blob gas fields")
+            target, _, _ = self.config.blob_params_at(parent.timestamp)
             expected_excess = G.calc_excess_blob_gas(
-                parent.excess_blob_gas or 0, parent.blob_gas_used or 0)
+                parent.excess_blob_gas or 0, parent.blob_gas_used or 0,
+                target)
             if header.excess_blob_gas != expected_excess:
                 raise InvalidBlock("bad excess blob gas")
             if header.parent_beacon_block_root is None:
@@ -216,7 +218,8 @@ class Blockchain:
             receipts.append(Receipt(
                 tx_type=tx.tx_type, succeeded=result.success,
                 cumulative_gas_used=gas_used, logs=result.logs))
-        if blob_gas_used > G.MAX_BLOB_GAS_PER_BLOCK:
+        _, max_blob_gas, _ = self.config.blob_params_at(header.timestamp)
+        if blob_gas_used > max_blob_gas:
             raise InvalidBlock("blob gas above maximum")
 
         # withdrawals

@@ -45,8 +45,10 @@ def create_payload_header(parent: BlockHeader, config, *, timestamp: int,
     if fork >= Fork.SHANGHAI:
         h.withdrawals_root = None  # filled at finalize
     if fork >= Fork.CANCUN:
+        target, _, _ = config.blob_params_at(parent.timestamp)
         h.excess_blob_gas = G.calc_excess_blob_gas(
-            parent.excess_blob_gas or 0, parent.blob_gas_used or 0)
+            parent.excess_blob_gas or 0, parent.blob_gas_used or 0,
+            target)
     return h
 
 
@@ -82,7 +84,8 @@ def build_payload(chain: Blockchain, parent: BlockHeader,
         if gas_used + tx.gas_limit > header.gas_limit:
             continue
         tx_blob_gas = G.BLOB_GAS_PER_BLOB * len(tx.blob_versioned_hashes)
-        if blob_gas + tx_blob_gas > G.MAX_BLOB_GAS_PER_BLOCK:
+        _, max_blob_gas, _ = config.blob_params_at(header.timestamp)
+        if blob_gas + tx_blob_gas > max_blob_gas:
             continue
         try:
             result = execute_tx(tx, state, env, config)

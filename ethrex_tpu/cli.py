@@ -63,7 +63,9 @@ def _add_node_flags(parser: argparse.ArgumentParser):
                              "(native C++ KV store); default: in-memory")
     parser.add_argument("--network", "--genesis", dest="genesis",
                         default=_env("NETWORK"),
-                        help="path to a genesis JSON file")
+                        help="network preset (mainnet|sepolia|hoodi, with "
+                             "embedded genesis + bootnodes) or a genesis "
+                             "JSON path")
     parser.add_argument("--http.addr", dest="http_addr",
                         default=_env("HTTP_ADDR", "127.0.0.1"))
     parser.add_argument("--http.port", dest="http_port", type=int,
@@ -115,6 +117,14 @@ def _add_node_flags(parser: argparse.ArgumentParser):
 
 def _load_genesis(args) -> Genesis | None:
     if args.genesis:
+        from .config import is_preset, load_network
+
+        if is_preset(args.genesis):
+            genesis, bootnodes = load_network(args.genesis)
+            # preset bootnodes seed the dial list unless overridden
+            if hasattr(args, "bootnodes") and not args.bootnodes:
+                args.bootnodes = ",".join(bootnodes)
+            return genesis
         with open(args.genesis) as f:
             return Genesis.from_json(json.load(f))
     if args.dev:

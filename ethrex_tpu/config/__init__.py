@@ -1,0 +1,41 @@
+"""Embedded network presets: genesis + bootnodes + full fork schedule for
+mainnet / sepolia / hoodi (parity: crates/common/config/networks.rs:12-31,
+which embeds the same public chain constants at compile time).
+
+`--network hoodi` style preset names resolve here before being treated as
+a genesis-file path; each preset carries the complete EIP-2124 fork ladder
+(including DAO / glacier / blob-parameter-only points) and the EIP-7840
+blob schedule, so fork ids validate against real peers and sync targeting
+a live network becomes testable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..primitives.genesis import Genesis
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+PRESET_NAMES = ("mainnet", "sepolia", "hoodi")
+
+
+def is_preset(name: str) -> bool:
+    return name in PRESET_NAMES
+
+
+def load_genesis_json(name: str) -> dict:
+    if not is_preset(name):
+        raise ValueError(f"unknown network preset {name!r}")
+    with open(os.path.join(_HERE, "networks", name, "genesis.json")) as f:
+        return json.load(f)
+
+
+def load_network(name: str) -> tuple[Genesis, list[str]]:
+    """(Genesis, bootnode enode URLs) for an embedded preset."""
+    genesis = Genesis.from_json(load_genesis_json(name))
+    with open(os.path.join(_HERE, "networks", name,
+                           "bootnodes.json")) as f:
+        bootnodes = json.load(f)
+    return genesis, list(bootnodes)

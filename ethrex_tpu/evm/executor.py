@@ -52,9 +52,10 @@ def validate_tx(tx: Transaction, sender: bytes, state: StateDB,
             if len(h) != 32 or h[0] != 0x01:
                 raise InvalidTransaction("bad blob versioned hash")
         blob_gas = G.BLOB_GAS_PER_BLOB * len(tx.blob_versioned_hashes)
-        if blob_gas > G.MAX_BLOB_GAS_PER_BLOCK:
+        _, max_blob_gas, fraction = config.blob_params_at(block.timestamp)
+        if blob_gas > max_blob_gas:
             raise InvalidTransaction("too many blobs")
-        blob_fee = G.blob_base_fee(block.excess_blob_gas)
+        blob_fee = G.blob_base_fee(block.excess_blob_gas, fraction)
         if tx.max_fee_per_blob_gas < blob_fee:
             raise InvalidTransaction("blob fee below blob base fee")
         cost += blob_gas * tx.max_fee_per_blob_gas
@@ -153,8 +154,10 @@ def execute_tx(tx: Transaction, state: StateDB, block: BlockEnv,
     state.sub_balance(sender, tx.gas_limit * eff_price)
     if tx.tx_type == TYPE_BLOB:
         blob_gas = G.BLOB_GAS_PER_BLOB * len(tx.blob_versioned_hashes)
+        _, _, fraction = config.blob_params_at(block.timestamp)
         state.sub_balance(
-            sender, blob_gas * G.blob_base_fee(block.excess_blob_gas))
+            sender,
+            blob_gas * G.blob_base_fee(block.excess_blob_gas, fraction))
     state.increment_nonce(sender)
 
     intrinsic, floor = G.intrinsic_gas(tx, fork >= Fork.PRAGUE)

@@ -132,13 +132,14 @@ def fake_exponential(factor: int, numerator: int, denominator: int) -> int:
     return output // denominator
 
 
-def blob_base_fee(excess_blob_gas: int) -> int:
-    return fake_exponential(MIN_BLOB_BASE_FEE, excess_blob_gas,
-                            BLOB_BASE_FEE_UPDATE_FRACTION)
+def blob_base_fee(excess_blob_gas: int,
+                  fraction: int = BLOB_BASE_FEE_UPDATE_FRACTION) -> int:
+    return fake_exponential(MIN_BLOB_BASE_FEE, excess_blob_gas, fraction)
 
 
-def calc_excess_blob_gas(parent_excess: int, parent_used: int) -> int:
+def calc_excess_blob_gas(parent_excess: int, parent_used: int,
+                         target: int = TARGET_BLOB_GAS_PER_BLOCK) -> int:
     total = parent_excess + parent_used
-    if total < TARGET_BLOB_GAS_PER_BLOCK:
+    if total < target:
         return 0
-    return total - TARGET_BLOB_GAS_PER_BLOCK
+    return total - target

@@ -228,9 +228,13 @@ def _fork_points(config, genesis_time: int) -> list[tuple[bool, int]]:
     non-genesis block-number forks (sorted, deduped) followed by timestamp
     forks later than genesis (sorted, deduped).  The kind tag is kept so
     the local schedule never needs the block-vs-time heuristic."""
-    blocks = sorted({b for b in config.block_forks.values() if b > 0})
+    blocks = sorted({b for b in config.block_forks.values() if b > 0}
+                    | {b for b in getattr(config, "aux_block_forks", ())
+                       if b > 0})
     times = sorted({t for t in config.time_forks.values()
-                    if t > genesis_time})
+                    if t > genesis_time}
+                   | {t for t in getattr(config, "aux_time_forks", ())
+                      if t > genesis_time})
     return [(False, b) for b in blocks] + [(True, t) for t in times]
 
 

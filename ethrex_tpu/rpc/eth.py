@@ -120,7 +120,8 @@ class EthApi:
         from ..evm import gas as G
 
         head = self.node.store.head_header()
-        return hx(G.blob_base_fee(head.excess_blob_gas or 0))
+        _, _, fraction = self.node.config.blob_params_at(head.timestamp)
+        return hx(G.blob_base_fee(head.excess_blob_gas or 0, fraction))
 
     def block_tx_count(self, tag):
         try:

@@ -1,0 +1,87 @@
+"""Embedded network presets: genesis hashes against the canonical public
+values, full EIP-2124 fork-hash ladders against the published mainnet
+vectors, and the EIP-7840 blob schedule (reference:
+crates/common/config/networks.rs:12-31)."""
+
+import pytest
+
+from ethrex_tpu.config import PRESET_NAMES, is_preset, load_network
+from ethrex_tpu.p2p import eth_wire
+from ethrex_tpu.primitives.genesis import Fork
+from ethrex_tpu.storage.store import Store
+
+MAINNET_HASH = bytes.fromhex(
+    "d4e56740f876aef8c010b86a40d5f56745a118d0906a34e69aec8c0db1cb8fa3")
+SEPOLIA_HASH = bytes.fromhex(
+    "25a5cc106eea7138acab33231d7160d69cb777ee0c2c553fcddf5138993e6dd9")
+HOODI_HASH = bytes.fromhex(
+    "bbe312868b376a3001692a646dd2d7d1e4406380dfd86b98aa8a34d1557c971b")
+
+# Published EIP-2124 / geth forkid checksums for mainnet, in activation
+# order (genesis, homestead, DAO, tangerine, spurious, byzantium,
+# constantinople+petersburg, istanbul, muir glacier, berlin, london,
+# arrow glacier, gray glacier, shanghai, cancun, prague)
+MAINNET_FORK_HASHES = [
+    "fc64ec04", "97c2c34c", "91d1f948", "7a64da13", "3edd5b10",
+    "a00bc324", "668db0af", "879d6e30", "e029e991", "0eb440f6",
+    "b715077d", "20c327fc", "f0afd0e3", "dce96c2d", "9f3d2254",
+    "c376cf8b",
+]
+
+
+def test_preset_genesis_hashes_are_canonical():
+    want = {"mainnet": MAINNET_HASH, "sepolia": SEPOLIA_HASH,
+            "hoodi": HOODI_HASH}
+    for net in PRESET_NAMES:
+        genesis, bootnodes = load_network(net)
+        assert bootnodes and all(u.startswith("enode://")
+                                 for u in bootnodes)
+        header = Store().init_genesis(genesis)
+        assert header.hash == want[net], net
+
+
+def test_mainnet_fork_ladder_matches_published_forkid_vectors():
+    genesis, _ = load_network("mainnet")
+    cfg = genesis.config
+    points = eth_wire._fork_points(cfg, genesis.timestamp)
+    sums = [s.to_bytes(4, "big").hex()
+            for s in eth_wire._checksums(MAINNET_HASH, points)]
+    # the published ladder must be a prefix of ours (osaka/bpo points may
+    # extend it beyond the last published checkpoint)
+    assert sums[:len(MAINNET_FORK_HASHES)] == MAINNET_FORK_HASHES
+    # the DAO fork and the glacier delays are distinct points
+    assert 1920000 in cfg.aux_block_forks          # DAO
+    assert 9200000 in cfg.aux_block_forks          # muir glacier
+    assert cfg.block_forks[Fork.BERLIN] == 12244000
+
+
+def test_mainnet_live_fork_id():
+    """fork_id_for at a recent Prague-era head returns the published
+    current mainnet fork hash."""
+    genesis, _ = load_network("mainnet")
+    fh, _next = eth_wire.fork_id_for(
+        genesis.config, MAINNET_HASH,
+        head_number=22_500_000, head_time=1_747_000_000,
+        genesis_time=genesis.timestamp)
+    assert fh.hex() == "c376cf8b"
+
+
+def test_blob_schedule_parses_and_escalates():
+    genesis, _ = load_network("hoodi")
+    cfg = genesis.config
+    assert cfg.blob_schedule, "hoodi must carry an EIP-7840 blob schedule"
+    cancun_t = cfg.time_forks[Fork.CANCUN]
+    prague_t = cfg.time_forks[Fork.PRAGUE]
+    t_c, m_c, f_c = cfg.blob_params_at(cancun_t)
+    t_p, m_p, f_p = cfg.blob_params_at(prague_t)
+    assert (t_c, m_c) == (3 * 131072, 6 * 131072)      # cancun 3/6
+    assert (t_p, m_p) == (6 * 131072, 9 * 131072)      # prague 6/9
+    assert f_p > f_c
+    # bpo points extend the schedule and the forkid ladder
+    assert cfg.aux_time_forks
+    last_t, last_target, last_max, _ = cfg.blob_schedule[-1]
+    assert last_max > m_p
+
+
+def test_preset_name_detection():
+    assert is_preset("hoodi") and not is_preset("hoodi.json")
