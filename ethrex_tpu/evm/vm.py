@@ -716,7 +716,9 @@ def _jump(evm, f):
     dest = f.pop()
     if dest not in f.jumpdests:
         raise InvalidJump(str(dest))
-    f.pc = dest + 1
+    # land ON the JUMPDEST: it executes (and charges its 1 gas) like any
+    # other instruction — jumping past it undercharges every jump taken
+    f.pc = dest
 
 
 def _jumpi(evm, f):
@@ -725,7 +727,7 @@ def _jumpi(evm, f):
     if cond:
         if dest not in f.jumpdests:
             raise InvalidJump(str(dest))
-        f.pc = dest + 1
+        f.pc = dest
 
 
 def _pc(evm, f):

@@ -209,7 +209,8 @@ def _logs_hash(logs) -> bytes:
 
 
 def execute_case(case: StateTestCase):
-    """Execute one case; returns (post_root, logs_hash, error_str|None).
+    """Execute one case; returns (post_root, logs_hash, error_str|None,
+    gas_used).
 
     On an invalid transaction the post state is the untouched pre state
     (state-test semantics: rejected txs burn nothing), and error_str carries
@@ -241,14 +242,14 @@ def execute_case(case: StateTestCase):
     try:
         result = execute_tx(case.tx, state, block, config)
     except InvalidTransaction as exc:
-        return pre_root, _logs_hash([]), str(exc)
+        return pre_root, _logs_hash([]), str(exc), 0
     post_root = store.apply_account_updates(pre_root, state)
-    return post_root, _logs_hash(result.logs), None
+    return post_root, _logs_hash(result.logs), None, result.gas_used
 
 
 def run_case(case: StateTestCase) -> CaseResult:
     """Execute one case and check the post-state root + logs digest."""
-    post_root, got_logs, err = execute_case(case)
+    post_root, got_logs, err, _gas = execute_case(case)
 
     if case.expect_exception is not None:
         if err is None:

@@ -51,7 +51,7 @@ def _post_for(tx_raw, pre, fork="Prague", indexes=None,
         pre=ef_state._parse_pre(pre), env=ENV,
         expected_hash=b"\x00" * 32, expected_logs=b"\x00" * 32,
         expect_exception=expect_exception, indexes=(0, 0, 0))
-    post_root, logs_hash, err = ef_state.execute_case(case)
+    post_root, logs_hash, err, _gas = ef_state.execute_case(case)
     if expect_exception:
         assert err is not None, "expected-invalid tx was accepted"
     else:

@@ -3,54 +3,19 @@ Groth16 verifier calls hitting ecAdd/ecMul/ecPairing) from the reference's
 cached witness — the ethrex-replay conformance path
 (/root/reference/docs/ethrex_replay/ethrex_replay.md).
 
-Ground truth established by oracle probing (receipts-root sweeps + header
-logs-bloom membership + state-root sweeps, round 2):
-  * txs 0-2, 5 (blob transfers): exactly 21000 each.
-  * tx 9: exactly the EIP-7623 floor (28130).
-  * txs 4, 6, 8, 10 match the chain's gas exactly (their sum + header
-    arithmetic pins them; every log address/topic we emit is present in the
-    header bloom).
-  * txs 3 and 7 relay the SAME bridge message; on-chain tx 3 FAILED (its
-    receiver address appears in NO header-bloom log position) and tx 7
-    succeeded — our replay reproduces exactly that shape.
+FULL byte-exact consensus with the live chain: header gas total, the
+RECEIPTS ROOT, and the final STATE ROOT all match, so every per-tx gas,
+status, log (topics AND data), and storage/balance write in the block is
+pinned against Hoodi itself.
 
-Round-3 deep diagnosis of the residual (supersedes the round-2 note):
-
-  * The block's relay txs route fees through one shared beacon-proxied
-    paymaster implementation (0xd15d6cf0be3d...).  It brackets the relay
-    with `startGas = gasleft()` (depth 2) ... `used = startGas - gasleft()`
-    (depth 4, across two delegatecall boundaries) and emits a gas-derived
-    refund: amount = used*price + used*price/4 with price 0xe4ba2f80.
-  * Our tx4 measures used = 785,959 (0xbfe27); the header bloom has
-    exactly THREE bits not covered by our logs ({1565, 1819, 1857}) and
-    exactly ONE of our items absent from the bloom (our tx4 refund
-    topic).  Sweeping `used` over 400k..1.2M, a single value reproduces
-    those three bits: used' = 787,216 — the chain consumed EXACTLY
-    1,257 more gas than us inside the paymaster bracket (p < 1e-8 of a
-    bloom false positive over that sweep).
-  * Simulating a flat 1,257 surcharge at the paymaster impl entry makes
-    the tx4 refund amount byte-exact vs the bloom and shifts txs 4/6/7/8
-    by +1,257 each, leaving an 838 residual on the header total.
-    5,866 = 14 x 419 and 1,257 = 3 x 419 suggest a per-iteration
-    419-gas undercharge (3 relayers in txs 4/6/7), but no distribution
-    of 419-quanta over the txs matches the RECEIPTS ROOT, and the state
-    root also stays off after balance-only corrections — so some log
-    DATA or storage value (fee quotes / token payouts) still differs
-    from the chain beyond pure gas.
-  * Audits that came back CLEAN: every formulaic charge in tx4
-    (keccak/copy/log/exp/memory-expansion recomputed independently, 0
-    mismatches), precompile prices (ecAdd 150, ecMul 6000, pairing
-    45k+34k*k), the diamond-router dispatch SLOAD/cold-account charges,
-    intrinsic gas, and the 63/64 forwarding chain (cap inversions are
-    integer-consistent at every boundary).
-  * The dying tx3 frame burns its whole 161,467 allocation (OOG at an
-    SSTORE_SET with 12,368 left), so tx3's total is INSENSITIVE to
-    in-frame charges; its on-chain 816,911 implied a different
-    distribution across txs 4/6/7/8 all along — round 2's "tx 4/6/8
-    match exactly" was an artifact of attributing the whole residual to
-    tx3.  The hard oracles are header.gas_used, receipts_root,
-    state_root, and the bloom — the per-tx pins below reflect OUR
-    current measured values and the bloom-proven tx4 refund.
+History: rounds 1-2 carried a tracked 5,866-gas residual attributed to
+tx3.  Round 3 localized it with bloom-bit analysis (the chain's
+gas-derived paymaster refund implied exactly +1,257 gas in one metering
+bracket) and the EF matrix generator's independent gas oracle then caught
+the mechanism in a 5-byte case: the interpreter jumped to JUMPDEST + 1,
+skipping the target's 1-gas charge on every taken jump (5,866 = the
+block's taken-jump count outside OOG frames).  One line in evm/vm.py
+(_jump/_jumpi landing ON the JUMPDEST) closed every oracle at once.
 """
 
 import json
@@ -97,68 +62,35 @@ def test_hoodi_block_replay():
     assert parent.hash == h.parent_hash  # witness linkage
 
     chain = Blockchain(_GuestChainView(), cfg)
-    fork = cfg.fork_at(h.number, h.timestamp)
-    env = BlockEnv(
-        number=h.number, coinbase=h.coinbase, timestamp=h.timestamp,
-        gas_limit=h.gas_limit, prev_randao=h.prev_randao,
-        base_fee=h.base_fee_per_gas or 0,
-        excess_blob_gas=h.excess_blob_gas or 0,
-        parent_beacon_block_root=h.parent_beacon_block_root or b"\x00" * 32)
     source = WitnessSource(nodes, codes, headers, parent.state_root)
     state = StateDB(source)
-    chain._pre_tx_system_ops(state, env, h, fork)
-    results = [execute_tx(tx, state, env, cfg)
-               for tx in blk.body.transactions]
+    outcome = chain.execute_block(blk, parent, state)
 
-    # per-tx gas pins for OUR implementation (drift detectors).  The blob
-    # transfers and the EIP-7623-floor tx are chain-exact by construction;
-    # the relay txs 4/6/7/8 are our measured values — the chain's are
-    # +1257-ish each (see module docstring), tracked via the residual.
-    gases = [r.gas_used for r in results]
-    assert gases[:3] == [21000] * 3
-    assert gases[5] == 21000
-    assert gases[9] == 28130          # EIP-7623 floor, byte-exact
-    assert gases[4] == 828658
-    assert gases[6] == 818616
-    assert gases[7] == 818602
-    assert gases[8] == 921210
-    assert gases[10] == 86820
+    receipts = outcome.receipts
+    # per-tx gas, chain-exact (header gas + receipts root pin them)
+    cums = [r.cumulative_gas_used for r in receipts]
+    gases = [b - a for a, b in zip([0] + cums, cums)]
+    assert gases == [21000, 21000, 21000, 811078, 830030, 21000, 819954,
+                     819940, 922953, 28130, 86862]
+    assert outcome.gas_used == h.gas_used
     # status shape: tx3 (first relay of the duplicated message) fails,
     # tx7 (the second relay) succeeds — exactly as on-chain
-    assert [r.success for r in results] == [
+    assert [r.succeeded for r in receipts] == [
         True, True, True, False, True, True, True, True, True, True, True]
-    assert gases[3] == 811045, "tx3 residual changed — retighten this test"
-    total = sum(gases)
-    assert h.gas_used - total == 5866, (
-        f"aggregate residual changed: {h.gas_used - total}")
 
-    # bloom structure: our logs cover ALL header-bloom bits except exactly
-    # the three belonging to the true (chain) tx4 refund amount, and our
-    # only spurious item is our own tx4 refund amount — the paymaster
-    # gas-metering divergence is the SOLE topic-level log delta.
-    have = {n for n in range(2048)
-            if (h.bloom[256 - 1 - n // 8] >> (n % 8)) & 1}
+    # the receipts root: statuses, cumulative gas, blooms, and every log
+    # (addresses, topics incl. the gas-derived paymaster refund amount,
+    # and data) byte-match the chain
+    from ethrex_tpu.blockchain.blockchain import compute_receipts_root
 
-    def _bits(item: bytes) -> set:
-        h3 = keccak256(item)
-        return {((h3[i] << 8) | h3[i + 1]) & 0x7FF for i in (0, 2, 4)}
+    assert compute_receipts_root(receipts) == h.receipts_root
+    assert logs_bloom([log for r in receipts
+                       for log in r.logs]) == h.bloom
 
-    ours = set()
-    spurious = []
-    for i, r in enumerate(results):
-        for log in r.logs:
-            for item in [log.address] + [bytes(t) for t in log.topics]:
-                ours |= _bits(item)
-                if not _bloom_has(h.bloom, item):
-                    spurious.append((i, item))
-    assert have - ours == {1565, 1819, 1857}
-    assert len(spurious) == 1 and spurious[0][0] == 4
-    our_amt = int.from_bytes(spurious[0][1], "big")
+    # the final state root: every storage write and balance in the block
+    # matches Hoodi
+    from ethrex_tpu.storage.store import apply_updates_to_tries
 
-    # the chain's refund amount reproduces those three bits at
-    # used' = 787,216 = our measured 785,959 + 1,257 (and at no other
-    # used value nearby) — the bracket divergence is pinned to the gas
-    price = 0xE4BA2F80
-    assert our_amt == 785959 * price + 785959 * price // 4
-    chain_amt = 787216 * price + 787216 * price // 4
-    assert _bits(chain_amt.to_bytes(32, "big")) == {1565, 1819, 1857}
+    final_root = apply_updates_to_tries(nodes, codes, parent.state_root,
+                                        state)
+    assert final_root == h.state_root
