@@ -123,10 +123,15 @@ class Blockchain:
         if fork >= Fork.CANCUN:
             if header.blob_gas_used is None or header.excess_blob_gas is None:
                 raise InvalidBlock("missing blob gas fields")
-            target, _, _ = self.config.blob_params_at(parent.timestamp)
+            # spec + reference (block.rs validate_excess_blob_gas): the
+            # schedule and fork are resolved at the NEW block's timestamp
+            target, max_bg, fraction = self.config.blob_params_at(
+                header.timestamp)
             expected_excess = G.calc_excess_blob_gas(
                 parent.excess_blob_gas or 0, parent.blob_gas_used or 0,
-                target)
+                target, max_bg, fraction,
+                parent_base_fee=parent.base_fee_per_gas or 0,
+                eip7918=fork >= Fork.OSAKA)
             if header.excess_blob_gas != expected_excess:
                 raise InvalidBlock("bad excess blob gas")
             if header.parent_beacon_block_root is None:

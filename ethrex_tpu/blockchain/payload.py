@@ -45,10 +45,12 @@ def create_payload_header(parent: BlockHeader, config, *, timestamp: int,
     if fork >= Fork.SHANGHAI:
         h.withdrawals_root = None  # filled at finalize
     if fork >= Fork.CANCUN:
-        target, _, _ = config.blob_params_at(parent.timestamp)
+        target, max_bg, fraction = config.blob_params_at(timestamp)
         h.excess_blob_gas = G.calc_excess_blob_gas(
             parent.excess_blob_gas or 0, parent.blob_gas_used or 0,
-            target)
+            target, max_bg, fraction,
+            parent_base_fee=parent.base_fee_per_gas or 0,
+            eip7918=fork >= Fork.OSAKA)
     return h
 
 
@@ -80,11 +82,11 @@ def build_payload(chain: Blockchain, parent: BlockHeader,
     gas_used = 0
     blob_gas = 0
     fees = 0
+    _, max_blob_gas, _ = config.blob_params_at(header.timestamp)
     for tx in txs:
         if gas_used + tx.gas_limit > header.gas_limit:
             continue
         tx_blob_gas = G.BLOB_GAS_PER_BLOB * len(tx.blob_versioned_hashes)
-        _, max_blob_gas, _ = config.blob_params_at(header.timestamp)
         if blob_gas + tx_blob_gas > max_blob_gas:
             continue
         try:

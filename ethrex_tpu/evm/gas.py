@@ -137,9 +137,25 @@ def blob_base_fee(excess_blob_gas: int,
     return fake_exponential(MIN_BLOB_BASE_FEE, excess_blob_gas, fraction)
 
 
+BLOB_BASE_COST = 1 << 13  # EIP-7918
+
+
 def calc_excess_blob_gas(parent_excess: int, parent_used: int,
-                         target: int = TARGET_BLOB_GAS_PER_BLOCK) -> int:
+                         target: int = TARGET_BLOB_GAS_PER_BLOCK,
+                         max_blob_gas: int | None = None,
+                         fraction: int = BLOB_BASE_FEE_UPDATE_FRACTION,
+                         parent_base_fee: int | None = None,
+                         eip7918: bool = False) -> int:
+    """EIP-4844 excess update, with the EIP-7918 reserve-price branch
+    from Osaka: when execution gas is the better deal
+    (BLOB_BASE_COST * base_fee > GAS_PER_BLOB * blob_base_fee), excess
+    decays proportionally instead of by the full target."""
     total = parent_excess + parent_used
     if total < target:
         return 0
+    if eip7918 and parent_base_fee is not None and max_blob_gas:
+        if BLOB_BASE_COST * parent_base_fee > \
+                BLOB_GAS_PER_BLOB * blob_base_fee(parent_excess, fraction):
+            return parent_excess + parent_used * (max_blob_gas - target) \
+                // max_blob_gas
     return total - target

@@ -26,8 +26,6 @@ import pytest
 from ethrex_tpu.blockchain.blockchain import Blockchain
 from ethrex_tpu.crypto.keccak import keccak256
 from ethrex_tpu.evm.db import StateDB
-from ethrex_tpu.evm.executor import execute_tx
-from ethrex_tpu.evm.vm import BlockEnv
 from ethrex_tpu.guest.execution import WitnessSource, _GuestChainView
 from ethrex_tpu.primitives.genesis import ChainConfig
 from ethrex_tpu.primitives.receipt import logs_bloom
@@ -35,15 +33,6 @@ from ethrex_tpu.utils.replay import load_cache
 
 CACHE = "/root/reference/fixtures/cache/rpc_prover/cache_hoodi_1265656.json"
 GENESIS = "/root/reference/cmd/ethrex/networks/hoodi/genesis.json"
-
-
-def _bloom_has(bloom: bytes, item: bytes) -> bool:
-    h3 = keccak256(item)
-    for i in (0, 2, 4):
-        bit = ((h3[i] << 8) | h3[i + 1]) & 0x7FF
-        if not (bloom[256 - 1 - bit // 8] >> (bit % 8)) & 1:
-            return False
-    return True
 
 
 @pytest.mark.skipif(not os.path.exists(CACHE),

@@ -85,3 +85,32 @@ def test_blob_schedule_parses_and_escalates():
 
 def test_preset_name_detection():
     assert is_preset("hoodi") and not is_preset("hoodi.json")
+
+
+def test_excess_blob_gas_uses_new_block_schedule():
+    """Review regression: the blob target for excess validation resolves
+    at the NEW block's timestamp (spec; reference validate_excess_blob_gas),
+    and Osaka adds the EIP-7918 reserve-price branch."""
+    from ethrex_tpu.evm import gas as G
+
+    cancun_target = 3 * 131072
+    prague_target = 6 * 131072
+    parent_excess, parent_used = 5 * 131072, 4 * 131072
+    # at a Prague-era block after a Cancun parent, the Prague target rules
+    assert G.calc_excess_blob_gas(parent_excess, parent_used,
+                                  prague_target) == 3 * 131072
+    assert G.calc_excess_blob_gas(parent_excess, parent_used,
+                                  cancun_target) == 6 * 131072
+    # EIP-7918: when execution gas is the better deal, excess decays
+    # proportionally instead of by the full target
+    got = G.calc_excess_blob_gas(
+        parent_excess, parent_used, prague_target,
+        max_blob_gas=9 * 131072, fraction=5007716,
+        parent_base_fee=10**9, eip7918=True)
+    assert got == parent_excess + parent_used * (9 - 6) // 9
+    # with a tiny base fee the reserve-price branch does not bind
+    got2 = G.calc_excess_blob_gas(
+        parent_excess, parent_used, prague_target,
+        max_blob_gas=9 * 131072, fraction=5007716,
+        parent_base_fee=1, eip7918=True)
+    assert got2 == 3 * 131072
