@@ -201,8 +201,29 @@ def _build_phases(air: Air, log_n: int, lb: int, shift: int):
     return phase_commit, phase_quotient, phase_open, phase_deep
 
 
+# AIRs at least this wide produce XLA programs whose AOT serialization
+# has segfaulted inside jaxlib's persistent-cache write (seen with the
+# 278-column transfer AIR); exclude them from the on-disk cache — the
+# in-process _PHASE_CACHE still amortizes compiles within a run.
+_PERSISTENT_CACHE_MAX_WIDTH = 200
+
+
 def prove(air: Air, trace: np.ndarray, pub_inputs: list[int],
           params: StarkParams = StarkParams()) -> dict:
+    if air.width >= _PERSISTENT_CACHE_MAX_WIDTH:
+        import jax
+
+        prev = jax.config.jax_enable_compilation_cache
+        jax.config.update("jax_enable_compilation_cache", False)
+        try:
+            return _prove(air, trace, pub_inputs, params)
+        finally:
+            jax.config.update("jax_enable_compilation_cache", prev)
+    return _prove(air, trace, pub_inputs, params)
+
+
+def _prove(air: Air, trace: np.ndarray, pub_inputs: list[int],
+           params: StarkParams = StarkParams()) -> dict:
     n, w = trace.shape
     if w != air.width:
         raise ValueError(f"trace width {w} != AIR width {air.width}")
