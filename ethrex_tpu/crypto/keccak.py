@@ -23,6 +23,8 @@ _lock = threading.Lock()
 
 def _load_native():
     global _lib
+    if _lib is not None:  # lock-free fast path once resolved (hot callers)
+        return _lib
     with _lock:
         if _lib is not None:
             return _lib

@@ -76,3 +76,54 @@ class Challenger:
 
     def sample_indices(self, bits: int, n: int) -> list[int]:
         return [self.sample_bits(bits) for _ in range(n)]
+
+    # -- proof-of-work grinding -------------------------------------------
+    # Adds `bits` bits of security against transcript-grinding attacks on
+    # the query phase (see docs/SOUNDNESS.md): a nonce with
+    # keccak256(seed || nonce) having `bits` leading zero bits is found by
+    # the prover and bound into the transcript before query sampling.  The
+    # seed is squeezed from the sponge, so the nonce commits to everything
+    # absorbed so far; keccak (C extension) keeps the 2^bits-hash search
+    # off the slow Poseidon2 host permutation.
+
+    def _pow_seed(self) -> bytes:
+        return b"".join(int(self.sample()).to_bytes(4, "little")
+                        for _ in range(8))
+
+    def grind(self, bits: int) -> int:
+        """Find, absorb and return a proof-of-work nonce for `bits`."""
+        if bits <= 0:
+            return 0
+        seed = self._pow_seed()
+        nonce = 0
+        while not pow_ok(seed, nonce, bits):
+            nonce += 1
+        self.absorb_int(nonce)
+        return nonce
+
+    def check_grind(self, nonce: int, bits: int) -> bool:
+        """Verify a grinding nonce.  Absorbs any well-formed (u64) nonce —
+        pass or fail — so the transcript stays aligned with the prover;
+        a structurally invalid nonce (out of u64 range) is rejected
+        without absorbing, since no honest transcript can continue from
+        it anyway.  The caller rejects on False."""
+        if bits <= 0:
+            return True
+        nonce = int(nonce)
+        if not (0 <= nonce < 1 << 64):
+            return False
+        seed = self._pow_seed()
+        ok = pow_ok(seed, nonce, bits)
+        self.absorb_int(nonce)
+        return ok
+
+
+def pow_ok(seed: bytes, nonce: int, bits: int) -> bool:
+    """The grinding predicate — the ONE definition both prover and
+    verifier (and tests) share: keccak256(seed || nonce_le8), read as a
+    big-endian integer, has `bits` leading zero bits."""
+    from ..crypto.keccak import keccak256
+
+    return int.from_bytes(
+        keccak256(seed + nonce.to_bytes(8, "little")), "big"
+    ) < (1 << (256 - bits))

@@ -67,6 +67,7 @@ class FriParams:
     num_queries: int = 40
     log_final_size: int = 5   # stop folding at codeword length 32
     shift: int = bb.GENERATOR
+    grinding_bits: int = 16   # proof-of-work bits before query sampling
 
 
 @dataclasses.dataclass
@@ -74,6 +75,7 @@ class FriProof:
     roots: list            # canonical digests, one per committed layer
     final_coeffs: list     # canonical ext tuples, len = final codeword size
     queries: list          # per query, per layer: {"values": [lo, hi], "path"}
+    pow_nonce: int = 0     # grinding nonce (see Challenger.grind)
 
 
 class FriProver:
@@ -135,11 +137,13 @@ class FriProver:
         """Full FRI round.  Returns (FriProof, query_indices); the caller
         (the STARK prover) opens its own commitments at the same indices."""
         self.commit_phase(codeword, challenger)
+        nonce = challenger.grind(self.params.grinding_bits)
         n0 = self.layers[0][0].shape[0]
         bits = (n0 // 2).bit_length() - 1
         indices = challenger.sample_indices(bits, self.params.num_queries)
         queries = self.open_queries(indices)
-        return FriProof(self.roots, self.final_coeffs, queries), indices
+        return (FriProof(self.roots, self.final_coeffs, queries, nonce),
+                indices)
 
 
 def verify(proof: FriProof, log_n0: int, challenger: Challenger,
@@ -175,6 +179,8 @@ def verify(proof: FriProof, log_n0: int, challenger: Challenger,
             raise ValueError("FRI: final polynomial exceeds degree bound")
     for row in proof.final_coeffs:
         challenger.absorb_ext(row)
+    if not challenger.check_grind(proof.pow_nonce, p_.grinding_bits):
+        raise ValueError("FRI: proof-of-work grinding check failed")
 
     bits = log_n0 - 1
     indices = challenger.sample_indices(bits, p_.num_queries)

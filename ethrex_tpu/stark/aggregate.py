@@ -76,6 +76,8 @@ def derive_query_items(fri_proof: fri.FriProof, log_n0: int,
             raise ValueError("FRI: final polynomial exceeds degree bound")
     for row in fri_proof.final_coeffs:
         challenger.absorb_ext(tuple(row))
+    if not challenger.check_grind(fri_proof.pow_nonce, p_.grinding_bits):
+        raise ValueError("FRI: proof-of-work grinding check failed")
 
     bits = log_n0 - 1
     indices = challenger.sample_indices(bits, p_.num_queries)
@@ -181,11 +183,13 @@ def _inner_fri_items(air: Air, proof: dict, params: StarkParams,
     ch.sample_ext()   # gamma
     fparams = fri.FriParams(
         log_blowup=lb, num_queries=params.num_queries,
-        log_final_size=params.log_final_size, shift=params.shift % bb.P)
+        log_final_size=params.log_final_size, shift=params.shift % bb.P,
+        grinding_bits=params.grinding_bits)
     fri_proof = fri.FriProof(
         roots=proof["fri"]["roots"],
         final_coeffs=[tuple(c) for c in proof["fri"]["final_coeffs"]],
-        queries=proof["fri"]["queries"])
+        queries=proof["fri"]["queries"],
+        pow_nonce=int(proof["fri"].get("pow_nonce", 0)))
     return derive_query_items(fri_proof, log_N, ch, fparams, with_paths)
 
 

@@ -14,7 +14,9 @@ is ONE jitted call (cached per AIR + shape) — the device may sit behind a
 network tunnel, so eager per-op dispatch is unaffordable; everything heavy
 lives inside the four phase programs below.
 
-No proof-of-work grinding yet (documented gap).
+Proof-of-work grinding runs before query sampling (Challenger.grind);
+parameter choices and the resulting soundness budget are documented in
+docs/SOUNDNESS.md.
 """
 
 from __future__ import annotations
@@ -40,6 +42,7 @@ class StarkParams:
     num_queries: int = 40
     log_final_size: int = 5
     shift: int = bb.GENERATOR
+    grinding_bits: int = 16
 
 
 _domain_points = ntt.domain_points
@@ -280,6 +283,7 @@ def _prove(air: Air, trace: np.ndarray, pub_inputs: list[int],
     fparams = fri.FriParams(
         log_blowup=lb, num_queries=params.num_queries,
         log_final_size=params.log_final_size, shift=shift,
+        grinding_bits=params.grinding_bits,
     )
     fprover = fri.FriProver(fparams)
     fri_proof, indices = fprover.prove(F, ch)
@@ -317,6 +321,7 @@ def _prove(air: Air, trace: np.ndarray, pub_inputs: list[int],
             "roots": fri_proof.roots,
             "final_coeffs": [list(c) for c in fri_proof.final_coeffs],
             "queries": fri_proof.queries,
+            "pow_nonce": fri_proof.pow_nonce,
         },
         "openings": openings,
     }

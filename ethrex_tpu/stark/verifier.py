@@ -145,11 +145,13 @@ def _verify(air: Air, proof: dict, params: StarkParams,
     fparams = fri.FriParams(
         log_blowup=lb, num_queries=params.num_queries,
         log_final_size=params.log_final_size, shift=shift,
+        grinding_bits=params.grinding_bits,
     )
     fri_proof = fri.FriProof(
         roots=proof["fri"]["roots"],
         final_coeffs=[tuple(c) for c in proof["fri"]["final_coeffs"]],
         queries=proof["fri"]["queries"],
+        pow_nonce=int(proof["fri"].get("pow_nonce", 0)),
     )
     try:
         indices, layer0 = (fri_verify_fn or fri.verify)(
