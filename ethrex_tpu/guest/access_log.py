@@ -212,26 +212,24 @@ def replay_log_against_witness(blocks_log: list, witness_nodes: list,
 
 
 def _replay(blocks_log, nodes, root, final_root):
+    # Slot rows precede the account entry that absorbs them (the order
+    # apply_updates_to_tries emits, and the order the fine per-tx logs
+    # keep).  Within one block an address may have SEVERAL account entries
+    # (fine logs emit one per transaction): each consumes the slot rows
+    # buffered for it since the previous one, and old values chain — the
+    # first claim of a key is checked against the pre-entry storage trie,
+    # later claims against the previous new value.
     for bi, block in enumerate(blocks_log):
         trie = Trie.from_nodes(root, nodes, share=True)
-        # group the block's slot writes per account, preserving order
-        slots: dict[bytes, list] = {}
-        accts: list = []
+        pending: dict[bytes, list] = {}
+        deletes = []
         for entry in block:
             if entry[0] == "slot":
-                slots.setdefault(entry[1], []).append(entry)
-            elif entry[0] == "clear":
-                pass  # clearing is carried by the acct entry's flag
-            else:
-                accts.append(entry)
-        seen = {e[1] for e in accts}
-        for addr in slots:
-            if addr not in seen:
-                raise LogAuditError(
-                    f"block {bi}: slot writes for {addr.hex()} without an "
-                    "account entry")
-        deletes = []
-        for _, addr, _, old_rlp, new_rlp, cleared in accts:
+                pending.setdefault(entry[1], []).append(entry)
+                continue
+            if entry[0] == "clear":
+                continue  # clearing is carried by the acct entry's flag
+            _, addr, _, old_rlp, new_rlp, cleared = entry
             key = keccak256(addr)
             have = trie.get(key) or b""
             if have != old_rlp:
@@ -239,25 +237,32 @@ def _replay(blocks_log, nodes, root, final_root):
                     f"block {bi}: old account mismatch for {addr.hex()}")
             old_state = AccountState.decode(old_rlp) if old_rlp \
                 else AccountState()
-            addr_slots = slots.get(addr, [])
+            addr_slots = pending.pop(addr, [])
             if addr_slots or cleared:
                 base = EMPTY_TRIE_ROOT if cleared else \
                     old_state.storage_root
                 pre = Trie.from_nodes(old_state.storage_root, nodes,
                                       share=True)
                 st = Trie.from_nodes(base, nodes, share=True)
+                chained: dict[bytes, int] = {}
                 slot_deletes = []
                 for _, _, slot, old_v, new_v in addr_slots:
                     skey = keccak256(slot.to_bytes(32, "big"))
                     if cleared:
                         # the old trie is legitimately absent from pruned
-                        # witnesses; post-clear old values must claim 0
-                        # and only the resulting storage_root is checked
-                        if old_v != 0:
+                        # witnesses; post-clear old values must chain from
+                        # 0 and only the resulting storage_root is checked
+                        want = chained.get(skey, 0)
+                        if old_v != want:
                             raise LogAuditError(
                                 f"block {bi}: cleared-storage write at "
-                                f"{addr.hex()}[{slot:#x}] claims a "
-                                "nonzero old value")
+                                f"{addr.hex()}[{slot:#x}] breaks the "
+                                "old-value chain")
+                    elif skey in chained:
+                        if old_v != chained[skey]:
+                            raise LogAuditError(
+                                f"block {bi}: old slot chain mismatch at "
+                                f"{addr.hex()}[{slot:#x}]")
                     else:
                         have_v = pre.get(skey)
                         have_i = rlp.decode_int(rlp.decode(have_v)) \
@@ -266,8 +271,10 @@ def _replay(blocks_log, nodes, root, final_root):
                             raise LogAuditError(
                                 f"block {bi}: old slot mismatch at "
                                 f"{addr.hex()}[{slot:#x}]")
-                    if new_v:
-                        st.insert(skey, rlp.encode(new_v))
+                    chained[skey] = new_v
+                for skey, final_v in chained.items():
+                    if final_v:
+                        st.insert(skey, rlp.encode(final_v))
                     else:
                         slot_deletes.append(skey)
                 for skey in slot_deletes:
@@ -283,6 +290,11 @@ def _replay(blocks_log, nodes, root, final_root):
                 trie.insert(key, new_rlp)
             else:
                 deletes.append(key)
+        if pending:
+            addr = next(iter(pending))
+            raise LogAuditError(
+                f"block {bi}: slot writes for {addr.hex()} without an "
+                "account entry")
         for key in deletes:
             trie.remove(key)
         root = trie.commit()

@@ -142,7 +142,8 @@ class _GuestChainView:
 
 
 def execution_program(program_input: ProgramInput,
-                      write_log: list | None = None) -> ProgramOutput:
+                      write_log: list | None = None,
+                      receipts_out: list | None = None) -> ProgramOutput:
     """The stateless batch-execution program.
 
     1. rebuild pruned tries from the witness; check the initial root
@@ -152,7 +153,10 @@ def execution_program(program_input: ProgramInput,
 
     `write_log` (optional) collects every trie write across the batch in
     application order — the input to the execution proof's access-log
-    binding (guest/access_log.py).
+    binding (guest/access_log.py).  `receipts_out` (optional) collects the
+    per-block receipt lists (the fine-log builder reads per-tx gas from
+    them; their correctness is already bound by the receipts-root check
+    below).
     """
     from ..blockchain.blockchain import (Blockchain, InvalidBlock,
                                          compute_receipts_root)
@@ -214,6 +218,8 @@ def execution_program(program_input: ProgramInput,
                 block.header.receipts_root:
             raise StatelessExecutionError("receipts root mismatch")
         receipts_per_block.append(outcome.receipts)
+        if receipts_out is not None:
+            receipts_out.append(outcome.receipts)
         block_log = None if write_log is None else []
         try:
             state_root = apply_updates_to_tries(nodes, codes, state_root,

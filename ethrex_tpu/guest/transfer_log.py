@@ -1,22 +1,34 @@
-"""Fine-grained transfer log: the bridge between executed batches and the
-transfer VM circuit (models/transfer_air.py).
+"""Fine-grained VM log: the bridge between executed batches and the VM
+circuits (models/transfer_air.py for account semantics; the token/storage
+circuit consumes the TokSeg stream).
 
-For a batch whose transactions are all plain ETH transfers, this module
-re-derives the batch's state writes per transaction from first principles
-(nonce + 1, balance - value - fee, balance + value, coinbase + tip) and
-emits a per-tx ordered raw log (sender, recipient, coinbase entry per tx)
-whose per-key old/new chain is exactly what the state-update AIR and the
-witness replay audit consume — replacing the executor's per-block
-aggregated diff with an EVM-semantics-shaped one the circuit can constrain
-(reference equivalent: the zkVM executes the guest natively,
-crates/guest-program/src/common/execution.rs:42-209).
+For a batch whose transactions are all plain ETH transfers OR calls to the
+canonical token template (guest/token_template.py), this module re-derives
+the batch's state writes per transaction from first principles:
 
-Safety: the builder's final per-account states are compared against the
-executor's coarse write log.  ANY behavioral difference — a recipient with
-code, a precompile target, an EIP-7702 delegation, gas refunds beyond the
-plain-transfer model — makes the comparison fail and the prover falls back
-to the claimed-log mode, so the circuit never signs off on semantics the
-builder did not model.
+  * plain transfer:  nonce + 1, balance - value - fee, balance + value,
+                     coinbase + tip            (round 3)
+  * token transfer:  nonce + 1, balance - fee, coinbase + tip, PLUS the
+    two storage-slot writes of the template's transfer(dst, v):
+        balances[caller] -= v   (slot keccak(pad32(caller)||pad32(0)))
+        balances[dst]    += v   (slot keccak(pad32(dst)||pad32(0)))
+                                               (round 4 — SLOAD/SSTORE/CALL)
+
+and emits a per-tx ordered raw log — sender row, the tx's slot rows,
+coinbase row, with each touched token contract's account row once at block
+end — whose per-key old/new chain is exactly what the state-update AIR and
+the witness replay audit consume (reference equivalent: the zkVM executes
+the guest natively, crates/guest-program/src/common/execution.rs:42-209).
+
+Safety: the builder's final per-account AND per-slot states are compared
+against the executor's coarse write log.  ANY behavioral difference — a
+recipient with code, an EIP-7702 delegation, a token contract whose
+bytecode is not the template, a reverted call, a balance wrap — makes the
+comparison (or an explicit scope check) fail and the prover falls back to
+the claimed-log mode, so the circuits never sign off on semantics the
+builder did not model.  Per-tx gas for token calls comes from the
+executor's receipts (their correctness is bound by the receipts-root check
+in guest/execution.py); the analytic 21000 rule still covers transfers.
 """
 
 from __future__ import annotations
@@ -26,12 +38,13 @@ import dataclasses
 from ..models.transfer_air import CbSeg, TxSeg
 from ..primitives.account import EMPTY_CODE_HASH, AccountState
 from ..primitives.transaction import TYPE_PRIVILEGED, Transaction
+from . import token_template as tmpl
 
 TRANSFER_GAS = 21000
 
 
 class NotTransferBatch(Exception):
-    """The batch is outside the transfer circuit's scope."""
+    """The batch is outside the VM circuits' scope."""
 
 
 def is_plain_transfer(tx: Transaction) -> bool:
@@ -43,13 +56,29 @@ def is_plain_transfer(tx: Transaction) -> bool:
             and not tx.authorization_list)
 
 
+def is_token_call_shape(tx: Transaction) -> bool:
+    """Static shape of a provable token-template call (the target's code
+    is checked against the template hash during the build)."""
+    return (tx.tx_type in (0, 1, 2)
+            and tx.to is not None
+            and tx.value == 0
+            and tmpl.decode_transfer_calldata(tx.data) is not None
+            and not tx.access_list
+            and not tx.blob_versioned_hashes
+            and not tx.authorization_list)
+
+
 @dataclasses.dataclass
 class TxMeta:
     sender: bytes
-    recipient: bytes
+    recipient: bytes      # tx.to: transfer recipient / token contract
     value: int
     fee: int
     tip: int
+    kind: str = "xfer"    # "xfer" | "tok"
+    gas: int = TRANSFER_GAS
+    dst: bytes = b""      # token transfer destination (kind == "tok")
+    amount: int = 0       # token transfer amount (kind == "tok")
 
 
 @dataclasses.dataclass
@@ -60,10 +89,33 @@ class BlockMeta:
 
 
 @dataclasses.dataclass
-class TransferBatch:
-    blocks_log: list       # fine per-block raw log (3 acct entries per tx)
-    segs: list             # TxSeg/CbSeg stream for the circuit
+class TokSeg:
+    """One token-transfer's storage semantics (models/token_air.py)."""
+
+    amount: int
+    kf: int       # from-balance slot (mapping key as int)
+    fold: int
+    fnew: int
+    kt: int       # to-balance slot
+    told: int
+    tnew: int
+    noop: bool = False   # amount == 0: no slot rows
+
+
+@dataclasses.dataclass
+class VmBatch:
+    blocks_log: list       # fine per-tx raw log
+    segs: list             # TxSeg/CbSeg stream (account circuit)
+    tok_segs: list         # TokSeg stream (storage circuit; may be empty)
     blocks: list           # BlockMeta per block
+
+
+# Backwards-compatible alias used by round-3 call sites/tests.
+@dataclasses.dataclass
+class TransferBatch:
+    blocks_log: list
+    segs: list
+    blocks: list
 
 
 def _first_seen_olds(coarse_log: list) -> dict:
@@ -72,6 +124,17 @@ def _first_seen_olds(coarse_log: list) -> dict:
         for entry in block:
             if entry[0] == "acct" and entry[1] not in pre:
                 pre[entry[1]] = entry[3]
+    return pre
+
+
+def _first_seen_slot_olds(coarse_log: list) -> dict:
+    pre: dict[tuple, int] = {}
+    for block in coarse_log:
+        for entry in block:
+            if entry[0] == "slot":
+                k = (entry[1], entry[2])
+                if k not in pre:
+                    pre[k] = entry[3]
     return pre
 
 
@@ -84,18 +147,43 @@ def _final_news(coarse_log: list) -> dict:
     return fin
 
 
-def build_transfer_batch(blocks, coarse_log: list) -> TransferBatch:
-    """Derive the fine log + circuit segments for an all-transfer batch.
-
-    `blocks` are the executed blocks, `coarse_log` the executor's raw
-    write log (the source of batch-pre account states and the consistency
-    oracle).  Raises NotTransferBatch when out of scope."""
+def _final_slot_news(coarse_log: list) -> dict:
+    fin: dict[tuple, int] = {}
     for block in coarse_log:
         for entry in block:
-            if entry[0] != "acct":
-                raise NotTransferBatch("batch writes storage")
+            if entry[0] == "slot":
+                fin[(entry[1], entry[2])] = entry[4]
+    return fin
+
+
+def build_transfer_batch(blocks, coarse_log: list) -> TransferBatch:
+    """Round-3 entry: all-transfer batches only (token-call shapes raise
+    inside build_vm_batch — without receipts no TokSeg is ever built)."""
+    vb = build_vm_batch(blocks, coarse_log, receipts_per_block=None)
+    return TransferBatch(blocks_log=vb.blocks_log, segs=vb.segs,
+                         blocks=vb.blocks)
+
+
+def build_vm_batch(blocks, coarse_log: list,
+                   receipts_per_block: list | None) -> VmBatch:
+    """Derive the fine log + circuit segments for a transfer/token batch.
+
+    `blocks` are the executed blocks, `coarse_log` the executor's raw
+    write log (source of batch-pre states and the consistency oracle),
+    `receipts_per_block` the executor's receipts (per-tx gas for token
+    calls; may be None for batches without token calls).  Raises
+    NotTransferBatch when out of scope.
+    """
+    for block in coarse_log:
+        for entry in block:
+            if entry[0] == "clear":
+                raise NotTransferBatch("batch clears storage")
+
     state: dict[bytes, AccountState | None] = {}
     pre = _first_seen_olds(coarse_log)
+    spre = _first_seen_slot_olds(coarse_log)
+    sstate: dict[tuple, int] = {}
+    token_contracts: dict[bytes, AccountState] = {}  # validated templates
 
     def acct(addr: bytes) -> AccountState | None:
         if addr not in state:
@@ -104,26 +192,76 @@ def build_transfer_batch(blocks, coarse_log: list) -> TransferBatch:
                 else None
         return state[addr]
 
+    def sget(contract: bytes, slot: int) -> int:
+        k = (contract, slot)
+        if k not in sstate:
+            if k not in spre:
+                # a slot the coarse log never witnessed (net-zero across
+                # the block): its pre value is unknowable here
+                raise NotTransferBatch("slot without a coarse log entry")
+            sstate[k] = spre[k]
+        return sstate[k]
+
+    def validate_token_contract(addr: bytes) -> None:
+        if addr in token_contracts:
+            return
+        rlp_bytes = pre.get(addr, b"")
+        if not rlp_bytes:
+            raise NotTransferBatch("token target without a coarse entry")
+        st = AccountState.decode(rlp_bytes)
+        if st.code_hash != tmpl.TEMPLATE_CODE_HASH:
+            raise NotTransferBatch("call target is not the token template")
+        token_contracts[addr] = st
+
     blocks_log = []
     segs: list = []
+    tok_segs: list = []
     metas = []
-    for block in blocks:
+    for bi, block in enumerate(blocks):
         h = block.header
         base_fee = h.base_fee_per_gas or 0
+        receipts = receipts_per_block[bi] if receipts_per_block else None
         rows = []
         txmetas = []
-        for tx in block.body.transactions:
-            if tx.tx_type == TYPE_PRIVILEGED or not is_plain_transfer(tx):
-                raise NotTransferBatch("non-transfer tx in batch")
+        touched_contracts: list[bytes] = []
+        cum_gas = 0
+        for ti, tx in enumerate(block.body.transactions):
+            if tx.tx_type == TYPE_PRIVILEGED:
+                raise NotTransferBatch("privileged tx in batch")
+            plain = is_plain_transfer(tx)
+            token = not plain and is_token_call_shape(tx)
+            if not plain and not token:
+                raise NotTransferBatch("tx shape out of scope")
             sender = tx.sender()
             if sender is None:
                 raise NotTransferBatch("unrecoverable sender")
             price = tx.effective_gas_price(base_fee)
             if price is None or price < base_fee:
                 raise NotTransferBatch("underpriced tx")
-            fee = TRANSFER_GAS * price
-            tip = TRANSFER_GAS * (price - base_fee)
-            value = tx.value
+            if receipts is not None:
+                rec = receipts[ti]
+                gas_used = rec.cumulative_gas_used - cum_gas
+                cum_gas = rec.cumulative_gas_used
+                succeeded = rec.succeeded
+            else:
+                gas_used = TRANSFER_GAS
+                succeeded = True
+
+            if plain:
+                if gas_used != TRANSFER_GAS or not succeeded:
+                    raise NotTransferBatch("transfer gas out of model")
+                value = tx.value
+                gas = TRANSFER_GAS
+            else:
+                if receipts is None:
+                    raise NotTransferBatch("token call without receipts")
+                if not succeeded:
+                    raise NotTransferBatch("reverted token call")
+                validate_token_contract(tx.to)
+                value = 0
+                gas = gas_used
+            fee = gas * price
+            tip = gas * (price - base_fee)
 
             s_old = acct(sender)
             if s_old is None or s_old.nonce != tx.nonce \
@@ -136,31 +274,68 @@ def build_transfer_batch(blocks, coarse_log: list) -> TransferBatch:
             rows.append(("acct", sender, None, s_old.encode(),
                          s_new.encode(), False))
 
-            # A zero-value credit touches nothing on chain, and an
-            # untouched account never appears in the coarse log or the
-            # witness — so its true pre-state is UNKNOWN here.  No-op
-            # credits therefore emit NO log row at all (the circuit's
-            # NOP segment absorbs zero digests and constrains the amount
-            # to zero); emitting an old=absent row would make honest
-            # proofs fail the witness audit whenever the account exists.
-            r_created = False
-            r_noop = value == 0
-            if r_noop:
-                r_old = r_new = None
-            else:
-                r_old = acct(tx.to)
-                if r_old is None:
-                    r_created = True
-                    r_new = AccountState(nonce=0, balance=value)
+            if plain:
+                # A zero-value credit touches nothing on chain, and an
+                # untouched account never appears in the coarse log or the
+                # witness — so its true pre-state is UNKNOWN here.  No-op
+                # credits therefore emit NO log row at all (the circuit's
+                # NOP segment absorbs zero digests and pins the amount to
+                # zero); emitting an old=absent row would make honest
+                # proofs fail the witness audit whenever the account
+                # exists.
+                r_created = False
+                r_noop = tx.value == 0
+                if r_noop:
+                    r_old = r_new = None
                 else:
-                    if r_old.code_hash != EMPTY_CODE_HASH:
-                        raise NotTransferBatch("recipient has code")
-                    r_new = dataclasses.replace(
-                        r_old, balance=r_old.balance + value)
-                state[tx.to] = r_new
-                rows.append(("acct", tx.to, None,
-                             r_old.encode() if r_old else b"",
-                             r_new.encode(), False))
+                    r_old = acct(tx.to)
+                    if r_old is None:
+                        r_created = True
+                        r_new = AccountState(nonce=0, balance=value)
+                    else:
+                        if r_old.code_hash != EMPTY_CODE_HASH:
+                            raise NotTransferBatch("recipient has code")
+                        r_new = dataclasses.replace(
+                            r_old, balance=r_old.balance + value)
+                    state[tx.to] = r_new
+                    rows.append(("acct", tx.to, None,
+                                 r_old.encode() if r_old else b"",
+                                 r_new.encode(), False))
+                segs.append(TxSeg(sender, tx.to, s_old, s_new, r_old,
+                                  r_new, value, fee, tip, r_created,
+                                  r_noop))
+                txmetas.append(TxMeta(sender, tx.to, value, fee, tip))
+            else:
+                dst, amount = tmpl.decode_transfer_calldata(tx.data)
+                if amount == 0:
+                    # template SSTOREs unchanged values: no net writes
+                    tok_segs.append(TokSeg(0, 0, 0, 0, 0, 0, 0, noop=True))
+                else:
+                    kf = tmpl.balance_slot(sender)
+                    bf = sget(tx.to, kf)
+                    if bf < amount:
+                        raise NotTransferBatch(
+                            "token balance model underflow (call should "
+                            "have reverted)")
+                    sstate[(tx.to, kf)] = bf - amount
+                    rows.append(("slot", tx.to, kf, bf, bf - amount))
+                    kt = tmpl.balance_slot(dst)
+                    bt = sget(tx.to, kt)
+                    if bt + amount >= 1 << 256:
+                        raise NotTransferBatch("token balance wrap")
+                    sstate[(tx.to, kt)] = bt + amount
+                    rows.append(("slot", tx.to, kt, bt, bt + amount))
+                    if tx.to not in touched_contracts:
+                        touched_contracts.append(tx.to)
+                    tok_segs.append(TokSeg(amount, kf, bf, bf - amount,
+                                           kt, bt, bt + amount))
+                # account stream: value-0 tx with a NOP recipient; the
+                # storage semantics live in the token stream
+                segs.append(TxSeg(sender, tx.to, s_old, s_new, None, None,
+                                  0, fee, tip, False, True))
+                txmetas.append(TxMeta(sender, tx.to, 0, fee, tip,
+                                      kind="tok", gas=gas, dst=dst,
+                                      amount=amount))
 
             cb_created = False
             cb_noop = tip == 0
@@ -180,12 +355,30 @@ def build_transfer_batch(blocks, coarse_log: list) -> TransferBatch:
                 rows.append(("acct", h.coinbase, None,
                              cb_old.encode() if cb_old else b"",
                              cb_new.encode(), False))
-
-            segs.append(TxSeg(sender, tx.to, s_old, s_new, r_old, r_new,
-                              value, fee, tip, r_created, r_noop))
             segs.append(CbSeg(h.coinbase, cb_old, cb_new, tip,
                               cb_created, cb_noop))
-            txmetas.append(TxMeta(sender, tx.to, value, fee, tip))
+
+        # each touched token contract's account row, verbatim from the
+        # coarse log (its new storage_root is MPT work the witness replay
+        # re-derives from our per-tx slot rows; the circuits never see
+        # it).  Only the storage_root may change.
+        coarse_accts = {e[1]: e for e in coarse_log[bi]
+                        if e[0] == "acct"}
+        for caddr in touched_contracts:
+            centry = coarse_accts.get(caddr)
+            if centry is None:
+                raise NotTransferBatch(
+                    "token contract missing from the coarse log")
+            _, _, _, old_rlp, new_rlp, cleared = centry
+            if cleared or not old_rlp or not new_rlp:
+                raise NotTransferBatch("token contract lifecycle change")
+            o = AccountState.decode(old_rlp)
+            n = AccountState.decode(new_rlp)
+            if (o.nonce, o.balance, o.code_hash) != \
+                    (n.nonce, n.balance, n.code_hash):
+                raise NotTransferBatch(
+                    "token contract account fields changed")
+            rows.append(centry)
         blocks_log.append(rows)
         metas.append(BlockMeta(h.coinbase, base_fee, txmetas))
 
@@ -193,6 +386,8 @@ def build_transfer_batch(blocks, coarse_log: list) -> TransferBatch:
     # states exactly, or the batch is out of scope
     fin = _final_news(coarse_log)
     for addr, want in fin.items():
+        if addr in token_contracts:
+            continue  # storage_root delta audited via the witness replay
         got = state.get(addr)
         got_rlp = got.encode() if got is not None else b""
         if got_rlp != want:
@@ -204,4 +399,16 @@ def build_transfer_batch(blocks, coarse_log: list) -> TransferBatch:
             if (st.encode() if st else b"") != want_rlp:
                 raise NotTransferBatch(
                     f"model touches {addr.hex()} the executor did not")
-    return TransferBatch(blocks_log=blocks_log, segs=segs, blocks=metas)
+    sfin = _final_slot_news(coarse_log)
+    for key, want_v in sfin.items():
+        if key[0] not in token_contracts:
+            raise NotTransferBatch(
+                "storage write outside the token model")
+        if sstate.get(key) != want_v:
+            raise NotTransferBatch(
+                f"slot model diverges at {key[0].hex()}[{key[1]:#x}]")
+    # (every sstate key came through sget, which requires a coarse entry,
+    # so "model touches an unlogged slot" cannot happen — the enforcement
+    # point is sget's raise)
+    return VmBatch(blocks_log=blocks_log, segs=segs, tok_segs=tok_segs,
+                   blocks=metas)

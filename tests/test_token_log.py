@@ -1,0 +1,146 @@
+"""Token-template execution + fine-log builder: the round-4 widening of
+the VM arithmetization to SLOAD/SSTORE/CALL semantics.
+
+Differential strategy (review finding): the hand-assembled template runs
+on the real interpreter and the builder's analytic model must reproduce
+its storage writes exactly — any divergence in either direction is a
+NotTransferBatch, never a wrong proof.
+"""
+
+import pytest
+
+from ethrex_tpu.crypto import secp256k1
+from ethrex_tpu.guest import access_log
+from ethrex_tpu.guest import token_template as tt
+from ethrex_tpu.guest import transfer_log as tl
+from ethrex_tpu.guest.execution import ProgramInput, execution_program
+from ethrex_tpu.guest.witness import generate_witness
+from ethrex_tpu.node import Node
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.primitives.transaction import Transaction
+
+SECRET = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
+SENDER = secp256k1.pubkey_to_address(secp256k1.pubkey_from_secret(SECRET))
+DST = bytes.fromhex("bb" * 20)
+OTHER = bytes.fromhex("44" * 20)
+TOKEN = bytes.fromhex("7070" * 10)
+
+
+def _genesis(sender_balance=1_000_000):
+    return {
+        "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+                   "shanghaiTime": 0, "cancunTime": 0},
+        "alloc": {
+            "0x" + SENDER.hex(): {"balance": hex(10**21)},
+            "0x" + TOKEN.hex(): {
+                "balance": "0x0",
+                "code": "0x" + tt.TEMPLATE_CODE.hex(),
+                "storage": {hex(tt.balance_slot(SENDER)):
+                            hex(sender_balance)},
+            },
+        },
+        "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7",
+        "timestamp": "0x0",
+    }
+
+
+def _mk_tx(nonce, to, value=0, data=b"", gas=100_000):
+    return Transaction(
+        tx_type=2, chain_id=1337, nonce=nonce,
+        max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+        gas_limit=gas, to=to, value=value, data=data,
+    ).sign(SECRET)
+
+
+def _run_batch(txs, genesis=None):
+    node = Node(Genesis.from_json(genesis or _genesis()))
+    for t in txs:
+        node.submit_transaction(t)
+    blk = node.produce_block()
+    witness = generate_witness(node.chain, [blk])
+    pi = ProgramInput(blocks=[blk], witness=witness, config=node.config)
+    coarse, receipts = [], []
+    out = execution_program(pi, write_log=coarse, receipts_out=receipts)
+    return pi, coarse, receipts, out
+
+
+def test_template_executes_and_builder_matches():
+    """Mixed batch: token transfer, plain transfer, self-transfer,
+    zero-amount no-op — the model must reproduce the executor exactly and
+    the fine log must replay into the witness MPT."""
+    pi, coarse, receipts, out = _run_batch([
+        _mk_tx(0, TOKEN, data=tt.transfer_calldata(DST, 12345)),
+        _mk_tx(1, OTHER, value=100),
+        _mk_tx(2, TOKEN, data=tt.transfer_calldata(SENDER, 7)),
+        _mk_tx(3, TOKEN, data=tt.transfer_calldata(DST, 0)),
+    ])
+    vb = tl.build_vm_batch(pi.blocks, coarse, receipts)
+    assert [(s.amount, s.noop) for s in vb.tok_segs] == \
+        [(12345, False), (7, False), (0, True)]
+    # per-tx account stream: 4 tx segments + 4 coinbase segments
+    assert sum(1 for s in vb.segs if s.kind == "tx") == 4
+    # token txs enter the account stream as value-0 NOP-recipient txs
+    tok_meta = vb.blocks[0].txs[0]
+    assert tok_meta.kind == "tok" and tok_meta.amount == 12345 \
+        and tok_meta.dst == DST and tok_meta.gas > 21000
+    # the fine log replays against the witness like the coarse one
+    access_log.replay_log_against_witness(
+        vb.blocks_log, pi.witness.nodes,
+        out.initial_state_root, out.final_state_root)
+    # and the flat chain is self-consistent
+    entries = access_log.flatten_entries(vb.blocks_log)
+    access_log.build_access_records(entries)
+
+
+def test_builder_rejects_non_template_contract():
+    """Same call shape against different bytecode: code-hash pin."""
+    genesis = _genesis()
+    # perturb the code: swap the two selector constants
+    code = tt.TEMPLATE_CODE.replace(tt.SELECTOR_TRANSFER,
+                                    tt.SELECTOR_BALANCE_OF, 1)
+    genesis["alloc"]["0x" + TOKEN.hex()]["code"] = "0x" + code.hex()
+    node = Node(Genesis.from_json(genesis))
+    node.submit_transaction(
+        _mk_tx(0, TOKEN, data=tt.transfer_calldata(DST, 5)))
+    blk = node.produce_block()
+    witness = generate_witness(node.chain, [blk])
+    pi = ProgramInput(blocks=[blk], witness=witness, config=node.config)
+    coarse, receipts = [], []
+    execution_program(pi, write_log=coarse, receipts_out=receipts)
+    with pytest.raises(tl.NotTransferBatch):
+        tl.build_vm_batch(pi.blocks, coarse, receipts)
+
+
+def test_builder_rejects_reverted_token_call():
+    """A transfer over balance reverts on-chain; the builder refuses the
+    batch instead of modeling an impossible debit."""
+    pi, coarse, receipts, _ = _run_batch([
+        _mk_tx(0, TOKEN, data=tt.transfer_calldata(DST, 10**18)),
+    ])
+    assert not receipts[0][0].succeeded
+    with pytest.raises(tl.NotTransferBatch):
+        tl.build_vm_batch(pi.blocks, coarse, receipts)
+
+
+def test_builder_old_entry_without_receipts_refuses_token():
+    """The round-3 entry (no receipts) must refuse token calls outright."""
+    pi, coarse, receipts, _ = _run_batch([
+        _mk_tx(0, TOKEN, data=tt.transfer_calldata(DST, 5)),
+    ])
+    with pytest.raises(tl.NotTransferBatch):
+        tl.build_transfer_batch(pi.blocks, coarse)
+
+
+def test_balance_of_call_shape_is_out_of_scope():
+    """balanceOf() via eth_call doesn't make blocks; a balanceOf tx has a
+    different selector so it's not a token-call shape — and it burns gas
+    with no state effect beyond fees, diverging from the transfer model."""
+    data = tt.SELECTOR_BALANCE_OF + b"\x00" * 12 + SENDER + b"\x00" * 32
+    tx = Transaction(
+        tx_type=2, chain_id=1337, nonce=0,
+        max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+        gas_limit=100_000, to=TOKEN, value=0, data=data).sign(SECRET)
+    assert not tl.is_token_call_shape(tx)
+    pi, coarse, receipts, _ = _run_batch([tx])
+    with pytest.raises(tl.NotTransferBatch):
+        tl.build_vm_batch(pi.blocks, coarse, receipts)
