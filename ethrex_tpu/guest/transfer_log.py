@@ -307,6 +307,11 @@ def build_vm_batch(blocks, coarse_log: list,
                 txmetas.append(TxMeta(sender, tx.to, value, fee, tip))
             else:
                 dst, amount = tmpl.decode_transfer_calldata(tx.data)
+                # code-hash pin FIRST, even for zero-amount calls: a
+                # "tok"-labeled tx must always mean template semantics
+                # (review finding: a noop call to arbitrary code would
+                # otherwise pass the oracle and mislabel the metadata)
+                validate_token_contract(tx.to)
                 if amount == 0:
                     # template SSTOREs unchanged values: no net writes
                     tok_segs.append(TokSeg(0, 0, 0, 0, 0, 0, 0, noop=True))

@@ -79,10 +79,27 @@ class FriProof:
 
 
 class FriProver:
-    """Holds per-layer state so queries can be opened after index sampling."""
+    """Holds per-layer state so queries can be opened after index sampling.
 
-    def __init__(self, params: FriParams):
+    `mesh` (optional) shards each layer's codeword across the mesh's
+    row axis; the fold/hash jits inherit the input sharding, so XLA runs
+    the layer work distributed (production multi-chip path)."""
+
+    def __init__(self, params: FriParams, mesh=None):
         self.params = params
+        self.mesh = mesh
+
+    def _shard(self, codeword):
+        if self.mesh is None:
+            return codeword
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel import mesh as mesh_lib
+
+        if codeword.shape[0] < len(self.mesh.devices.flat):
+            return codeword
+        return jax.device_put(
+            codeword, NamedSharding(self.mesh, P(mesh_lib.AXIS, None)))
 
     def commit_phase(self, codeword, challenger: Challenger):
         p = self.params
@@ -91,6 +108,7 @@ class FriProver:
         inv2 = jnp.asarray(np.uint32(int(bb.to_mont_host(_INV2))))
         self.layers = []   # (canonical_np_codeword, canonical_np_levels)
         self.roots = []
+        codeword = self._shard(codeword)
         while log_n > p.log_final_size:
             leaves = _pair_leaves(codeword)
             levels = merkle.commit_levels(leaves)
@@ -103,7 +121,7 @@ class FriProver:
             self.roots.append([int(x) for x in root])
             beta = ext.to_device(challenger.sample_ext())
             inv_pts = jnp.asarray(_fold_inv_points(log_n, shift))
-            codeword = _fold(codeword, beta, inv_pts, inv2)
+            codeword = self._shard(_fold(codeword, beta, inv_pts, inv2))
             shift = (shift * shift) % bb.P
             log_n -= 1
         coeffs_dev = _ntt.coset_intt(codeword.T, shift=shift).T

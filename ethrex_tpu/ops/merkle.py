@@ -19,14 +19,24 @@ DIGEST_WIDTH = p2.RATE  # 8 limbs
 import jax
 
 
-@jax.jit
-def _build_levels(leaves):
-    digests = p2.hash_leaves(leaves)
+def build_levels_with(leaves, shard=None):
+    """Traceable level build with an optional sharding-constraint hook:
+    `shard(digests)` is applied to every level (the mesh-threaded STARK
+    phases pass a row-sharding constrainer; levels smaller than the mesh
+    pass through unchanged inside the hook).  The ONE level-build loop —
+    _build_levels is its jitted no-hook form."""
+    sh = shard if shard is not None else (lambda d: d)
+    digests = sh(p2.hash_leaves(leaves))
     levels = [digests]
     while digests.shape[0] > 1:
-        digests = p2.compress(digests[0::2], digests[1::2])
+        digests = sh(p2.compress(digests[0::2], digests[1::2]))
         levels.append(digests)
     return tuple(levels)
+
+
+@jax.jit
+def _build_levels(leaves):
+    return build_levels_with(leaves)
 
 
 def commit_levels(leaves):

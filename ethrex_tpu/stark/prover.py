@@ -67,27 +67,41 @@ def _stretch_coeffs(coeffs: np.ndarray, n: int, p_len: int) -> np.ndarray:
 _PHASE_CACHE: dict = {}
 
 
-def _phases(air: Air, log_n: int, lb: int, shift: int):
+def _mesh_key(mesh):
+    if mesh is None:
+        return None
+    return (tuple(d.id for d in mesh.devices.flat), mesh.axis_names)
+
+
+def _phases(air: Air, log_n: int, lb: int, shift: int, mesh=None):
     """Jitted phase programs, cached by *structural* AIR identity.
 
     Keyed on (type, width, degree, pub-count) rather than object identity so
     `prove(MixerAir(16), ...)` in a loop reuses compiled programs.  AIRs with
     extra structure-affecting parameters must reflect them in `cache_key()`.
     """
-    key = (air.cache_key(), log_n, lb, shift)
+    key = (air.cache_key(), log_n, lb, shift, _mesh_key(mesh))
     cached = _PHASE_CACHE.get(key)
     if cached is not None:
         return cached
-    built = _build_phases(air, log_n, lb, shift)
+    built = _build_phases(air, log_n, lb, shift, mesh)
     _PHASE_CACHE[key] = built
     return built
 
 
-def _build_phases(air: Air, log_n: int, lb: int, shift: int):
+def _build_phases(air: Air, log_n: int, lb: int, shift: int, mesh=None):
     """Build the jitted phase programs for a given AIR and trace shape.
 
     Boundary structure (rows/cols) must not depend on public-input *values*
     (values are traced inputs; structure is baked into the program).
+
+    With `mesh`, every phase annotates its large intermediates with
+    sharding constraints over the mesh's "shard" axis (column-parallel
+    NTT, row-parallel Merkle/DEEP — the same layout as the fused demo
+    core, parallel/core.py) and XLA inserts the ICI collectives.  This is
+    the PRODUCTION prover's multi-chip path (SURVEY.md §5 "shard the
+    STARK trace across the slice"); the host transcript and query
+    openings are unchanged.
     """
     n = 1 << log_n
     w = air.width
@@ -133,11 +147,36 @@ def _build_phases(air: Air, log_n: int, lb: int, shift: int):
     ))))
     pts_m_np = bb.to_mont_host(_domain_points(log_N, shift))
 
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel import mesh as mesh_lib
+
+        axis = mesh_lib.AXIS
+        ndev = len(mesh.devices.flat)
+
+        def shard(x, spec):
+            # stop constraining once the sharded dim is below the mesh
+            dim = x.shape[list(spec).index(axis)] if axis in spec else None
+            if dim is not None and dim < ndev:
+                return x
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(*spec)))
+    else:
+        axis = "shard"
+
+        def shard(x, spec):
+            return x
+
+    def row_shard(d):
+        return shard(d, (axis, None))
+
     @jax.jit
     def phase_commit(cols):
-        lde_cols = ntt.coset_lde(cols, lb, shift=shift)
-        lde_rows = lde_cols.T
-        levels = merkle._build_levels(lde_rows)
+        lde_cols = shard(ntt.coset_lde(shard(cols, (axis, None)), lb,
+                                       shift=shift), (axis, None))
+        lde_rows = shard(lde_cols.T, (axis, None))  # transpose: all-to-all
+        levels = merkle.build_levels_with(lde_rows, row_shard)
         return lde_cols, lde_rows, levels
 
     @jax.jit
@@ -147,11 +186,12 @@ def _build_phases(air: Air, log_n: int, lb: int, shift: int):
         local = [lde_cols[j] for j in range(w)]
         nxt = [rolled[j] for j in range(w)]
         periodic = [jnp.asarray(p) for p in periodic_np]
-        cons = jnp.stack(air.constraints(local, nxt, periodic, dev))  # (K, N)
+        cons = shard(jnp.stack(air.constraints(local, nxt, periodic, dev)),
+                     (None, axis))                                 # (K, N)
         apow = ext.ext_powers(alpha, K + nb)                      # (K+nb, 4)
         # random-linear-combination of constraint columns: an MXU matmul
         # (N, K) @ (K, 4) instead of materializing a (K, N, 4) product
-        acc = bb.mod_matmul(cons.T, apow[:K])                      # (N, 4)
+        acc = bb.mod_matmul(shard(cons.T, (axis, None)), apow[:K])  # (N, 4)
         inv_stack = jnp.asarray(inv_stack_np)
         inv_xn1 = jnp.tile(inv_stack[:B], N // B)
         xm = jnp.asarray(bb.to_mont_host(x_minus_glast))
@@ -163,18 +203,21 @@ def _build_phases(air: Air, log_n: int, lb: int, shift: int):
             q_acc = ext.add(q_acc, bb.mont_mul(
                 bb.mont_mul(diff, inv_x)[:, None], apow[K + j][None, :]
             ))
+        q_acc = shard(q_acc, (axis, None))
         qc = ntt.coset_intt(q_acc.T, shift=shift).T                # (N, 4)
         chunks = jnp.stack([qc[i * n:(i + 1) * n] for i in range(B)])
         q_lde = ntt.coset_evals_from_coeffs(
             jnp.moveaxis(chunks, -1, 1), N, shift=shift
         )                                                          # (B, 4, N)
-        q_rows = jnp.moveaxis(q_lde, -1, 0).reshape(N, B * 4)
-        levels = merkle._build_levels(q_rows)
+        q_lde = shard(q_lde, (None, None, axis))
+        q_rows = shard(jnp.moveaxis(q_lde, -1, 0).reshape(N, B * 4),
+                       (axis, None))
+        levels = merkle.build_levels_with(q_rows, row_shard)
         return chunks, q_lde, q_rows, levels
 
     @jax.jit
     def phase_open(cols, chunks, zeta, zeta_g):
-        tcoeffs = ntt.intt(cols)
+        tcoeffs = ntt.intt(shard(cols, (axis, None)))
         t_z = ext.eval_base_poly_at_ext(tcoeffs, zeta)
         t_zg = ext.eval_base_poly_at_ext(tcoeffs, zeta_g)
         q_z = ext.eval_ext_poly_at_ext(chunks, zeta)
@@ -188,7 +231,8 @@ def _build_phases(air: Air, log_n: int, lb: int, shift: int):
         # minimal-polynomial inverse — same restructure as the fused
         # prove step (parallel/core.py), avoiding (N, w, 4) ext tensors.
         pts_m = jnp.asarray(pts_m_np)
-        inv_xz = ext.inv_x_minus_zeta(pts_m, zeta)
+        lde_rows = shard(lde_rows, (axis, None))
+        inv_xz = shard(ext.inv_x_minus_zeta(pts_m, zeta), (axis, None))
         inv_xzg = ext.inv_x_minus_zeta(pts_m, zeta_g)
         gpow = ext.ext_powers(gamma, 2 * w + B)
         s1 = ext.sub(bb.mod_matmul(lde_rows, gpow[:w]),
@@ -198,8 +242,8 @@ def _build_phases(air: Air, log_n: int, lb: int, shift: int):
         q_ext = jnp.moveaxis(q_lde, 1, -1)                         # (B, N, 4)
         d3 = ext.sub(q_ext, q_z[:, None])
         s3 = bb.sum_mod(ext.mul(d3, gpow[2 * w:, None]), axis=0)
-        return ext.add(ext.mul(ext.add(s1, s3), inv_xz),
-                       ext.mul(s2, inv_xzg))
+        return shard(ext.add(ext.mul(ext.add(s1, s3), inv_xz),
+                             ext.mul(s2, inv_xzg)), (axis, None))
 
     return phase_commit, phase_quotient, phase_open, phase_deep
 
@@ -212,21 +256,24 @@ _PERSISTENT_CACHE_MAX_WIDTH = 200
 
 
 def prove(air: Air, trace: np.ndarray, pub_inputs: list[int],
-          params: StarkParams = StarkParams()) -> dict:
+          params: StarkParams = StarkParams(), mesh=None) -> dict:
+    """Prove one AIR.  `mesh` (optional jax.sharding.Mesh) runs every
+    device phase sharded across the mesh — the production multi-chip
+    path; proofs are bit-identical to single-device runs."""
     if air.width >= _PERSISTENT_CACHE_MAX_WIDTH:
         import jax
 
         prev = jax.config.jax_enable_compilation_cache
         jax.config.update("jax_enable_compilation_cache", False)
         try:
-            return _prove(air, trace, pub_inputs, params)
+            return _prove(air, trace, pub_inputs, params, mesh)
         finally:
             jax.config.update("jax_enable_compilation_cache", prev)
-    return _prove(air, trace, pub_inputs, params)
+    return _prove(air, trace, pub_inputs, params, mesh)
 
 
 def _prove(air: Air, trace: np.ndarray, pub_inputs: list[int],
-           params: StarkParams = StarkParams()) -> dict:
+           params: StarkParams = StarkParams(), mesh=None) -> dict:
     n, w = trace.shape
     if w != air.width:
         raise ValueError(f"trace width {w} != AIR width {air.width}")
@@ -242,7 +289,8 @@ def _prove(air: Air, trace: np.ndarray, pub_inputs: list[int],
     N = n << lb
     shift = params.shift % bb.P
     g_n = bb.root_of_unity(log_n)
-    p_commit, p_quotient, p_open, p_deep = _phases(air, log_n, lb, shift)
+    p_commit, p_quotient, p_open, p_deep = _phases(air, log_n, lb, shift,
+                                                   mesh)
 
     ch = Challenger()
     ch.absorb_elems([n, w, B])
@@ -285,7 +333,7 @@ def _prove(air: Air, trace: np.ndarray, pub_inputs: list[int],
         log_final_size=params.log_final_size, shift=shift,
         grinding_bits=params.grinding_bits,
     )
-    fprover = fri.FriProver(fparams)
+    fprover = fri.FriProver(fparams, mesh=mesh)
     fri_proof, indices = fprover.prove(F, ch)
 
     # ---- openings of trace/quotient at the query indices -----------------

@@ -1,0 +1,110 @@
+"""Token VM AIR: host digest agreement, constraint satisfaction on the
+honest trace, and rejection of tampered slot values/amounts."""
+
+import numpy as np
+import pytest
+
+from ethrex_tpu.guest.transfer_log import TokSeg
+from ethrex_tpu.models import token_air as tk
+from ethrex_tpu.ops import babybear as bb
+from ethrex_tpu.ops import ext
+from ethrex_tpu.stark.air import HostExtOps
+
+KF = int.from_bytes(b"\x11" * 32, "big")
+KT = int.from_bytes(b"\x22" * 32, "big")
+
+
+def _mk_segs():
+    v1 = 12345
+    v2 = (1 << 200) + 7   # crosses many limb boundaries
+    return [
+        TokSeg(v1, KF, 10**6, 10**6 - v1, KT, 500, 500 + v1),
+        TokSeg(v2, KT, 1 << 220, (1 << 220) - v2, KF,
+               (1 << 24) - 1, (1 << 24) - 1 + v2),
+        TokSeg(0, 0, 0, 0, 0, 0, 0, noop=True),
+    ]
+
+
+def _check_rows(air, trace, periodic_cols, rows=None):
+    n = trace.shape[0]
+    hops = HostExtOps()
+    bad_rows = []
+    for r in (rows if rows is not None else range(n - 1)):
+        local = [ext.h_from_base(int(v)) for v in trace[r]]
+        nxt = [ext.h_from_base(int(v)) for v in trace[(r + 1) % n]]
+        periodic = [ext.h_from_base(int(col[r % len(col)]))
+                    for col in periodic_cols]
+        cs = air.constraints(local, nxt, periodic, hops)
+        bad = [i for i, c in enumerate(cs) if c != ext.ZERO_H]
+        if bad:
+            bad_rows.append((r, bad[:6]))
+    return bad_rows
+
+
+def test_tok_digest_matches_trace_lane():
+    segs = _mk_segs()
+    trace = tk.generate_token_trace(segs)
+    dig = tk.token_public_inputs(segs)
+    assert [int(v) for v in trace[-1, tk.T:tk.T + 8]] == dig
+
+
+@pytest.mark.slow
+def test_honest_trace_satisfies_constraints():
+    segs = _mk_segs()
+    air = tk.TokenAir()
+    trace = tk.generate_token_trace(segs)
+    n = trace.shape[0]
+    assert n == tk.segment_count(len(segs)) * tk.SEG_LEN
+    pub = tk.token_public_inputs(segs)
+    for row, col, val in air.boundaries(pub, n):
+        assert int(trace[row, col]) == val, (row, col, val)
+    periodic_cols = air.periodic_columns(n)
+    bad = _check_rows(air, trace, periodic_cols)
+    assert not bad, f"violated rows: {bad[:8]}"
+
+
+@pytest.mark.slow
+def test_tampered_slot_values_break_constraints():
+    segs = _mk_segs()
+    air = tk.TokenAir()
+    trace = tk.generate_token_trace(segs)
+    periodic_cols = air.periodic_columns(trace.shape[0])
+    seg0 = slice(0, tk.SEG_LEN)
+
+    # inflate the recipient slot's new value: the carry chain must break
+    bad = trace.copy()
+    col = tk.TNEW + 10
+    bad[seg0, col] = (bad[seg0, col] + 1) % bb.P
+    assert _check_rows(air, bad, periodic_cols)
+
+    # deflate the sender slot's debit
+    bad2 = trace.copy()
+    col2 = tk.FNEW + 10
+    bad2[seg0, col2] = (bad2[seg0, col2] + 1) % bb.P
+    assert _check_rows(air, bad2, periodic_cols)
+
+    # underflow: amount > fold with a cooked borrow column
+    seg_under = [TokSeg(100, KF, 5, (5 - 100) % (1 << 264), KT, 0, 100)]
+    tr3 = tk.generate_token_trace(seg_under)
+    assert _check_rows(air, tr3, air.periodic_columns(tr3.shape[0]))
+
+
+@pytest.mark.slow
+def test_token_stark_roundtrip():
+    from ethrex_tpu.stark import prover as stark_prover
+    from ethrex_tpu.stark import verifier as stark_verifier
+    from ethrex_tpu.stark.prover import StarkParams
+
+    segs = _mk_segs()
+    air = tk.TokenAir()
+    trace = tk.generate_token_trace(segs)
+    pub = tk.token_public_inputs(segs)
+    params = StarkParams(log_blowup=3, num_queries=25, log_final_size=4)
+    proof = stark_prover.prove(air, trace, pub, params)
+    assert stark_verifier.verify(air, proof, params)
+
+    bad = dict(proof)
+    bad["pub_inputs"] = [(int(v) + 1) % bb.P for v in proof["pub_inputs"]]
+    with pytest.raises(Exception):
+        if not stark_verifier.verify(air, bad, params):
+            raise ValueError("rejected")

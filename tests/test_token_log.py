@@ -111,6 +111,24 @@ def test_builder_rejects_non_template_contract():
         tl.build_vm_batch(pi.blocks, coarse, receipts)
 
 
+def test_zero_amount_call_to_non_template_rejected():
+    """transfer(dst, 0) calldata to arbitrary code must NOT be labeled a
+    token call (review finding): the code-hash pin applies to noops too."""
+    genesis = _genesis()
+    genesis["alloc"]["0x" + TOKEN.hex()]["code"] = "0x00"  # STOP
+    del genesis["alloc"]["0x" + TOKEN.hex()]["storage"]
+    node = Node(Genesis.from_json(genesis))
+    node.submit_transaction(
+        _mk_tx(0, TOKEN, data=tt.transfer_calldata(DST, 0)))
+    blk = node.produce_block()
+    witness = generate_witness(node.chain, [blk])
+    pi = ProgramInput(blocks=[blk], witness=witness, config=node.config)
+    coarse, receipts = [], []
+    execution_program(pi, write_log=coarse, receipts_out=receipts)
+    with pytest.raises(tl.NotTransferBatch):
+        tl.build_vm_batch(pi.blocks, coarse, receipts)
+
+
 def test_builder_rejects_reverted_token_call():
     """A transfer over balance reverts on-chain; the builder refuses the
     batch instead of modeling an impossible debit."""
