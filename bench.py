@@ -1,28 +1,36 @@
 """Headline benchmark: BASELINE config 1 — prove a 10-transfer block
-end-to-end on one TPU chip.
+end-to-end on one TPU chip — plus BASELINE configs 2/4/5 attached to the
+same JSON line when the chip budget allows.
 
 The measured quantity is the full `--prover tpu` pipeline on a real
-committed batch: stateless re-execution, per-tx transfer-log derivation,
-and THREE DEEP-FRI STARKs (state-update circuit, transfer VM circuit,
-output binding), exactly what `TpuBackend.prove` ships to the proof
-coordinator, followed by an independent `verify`.  This replaces round
-1-2's synthetic prove-core cells/s metric and its estimated anchor
-(VERDICT.md round 2, "produce one honest end-to-end benchmark").
+committed batch: stateless re-execution, per-tx fine-log derivation, and
+the DEEP-FRI STARKs (state-update circuit, VM circuits, output binding),
+exactly what `TpuBackend.prove` ships to the proof coordinator, followed
+by an independent `verify`.
+
+Configs (BASELINE.md):
+  1 (headline)      10-transfer block, vm mode, 3 STARKs
+  2 (--measure-2)   100-tx ERC-20 batch, token mode, 4 STARKs
+  3 (BENCH_FULL=1)  1000-tx mixed transfer+token batch (opt-in: hours of
+                    compile on a cold cache)
+  4 (--measure-4)   Groth16 BN254 wrap (format=groth16 on the config-1
+                    batch: aggregation + wrap + full verify)
+  5 (--measure-5)   8-proof recursive aggregation (8 sponge STARKs in
+                    ONE outer FriVerifyAir proof, verified)
 
 vs_baseline is a measured-vs-measured gas rate: the reference's SP1-CUDA
 prover does a 7,898,434-gas mainnet block in 143 s on an RTX 4090
 (/root/reference/docs/l2/bench/prover_performance.md:7-9) = 55,234 gas/s;
-we report (batch_gas / wall_s) / 55,234.  The batch here is small (210k
-gas of transfers), so the comparison favors neither side's batching
-amortization; larger configs land as the VM AIR's scope widens.
+we report (batch_gas / wall_s) / 55,234.
 
-Resilience: the chip sits behind a flaky network tunnel.  The measurement
-runs in a child process under a hard timeout with retries; successes are
-persisted to .bench_last.json; if the end-to-end measurement cannot run,
-the prove-core microbench (cells/s) is attempted as a live fallback
-before degrading to the last-known number.
+Resilience: the chip sits behind a flaky network tunnel.  Every
+measurement runs in a child process under a hard timeout with retries;
+successes are persisted to .bench_last.json; if the end-to-end
+measurement cannot run, the prove-core microbench (cells/s) is attempted
+as a live fallback before degrading to the last-known number.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...,
+"configs": {...}}.
 """
 
 from __future__ import annotations
@@ -60,6 +68,15 @@ def probe_backend() -> bool:
 
 
 def _guard_backend() -> None:
+    if os.environ.get("BENCH_ALLOW_CPU") == "1":
+        # the axon TPU plugin ignores JAX_PLATFORMS; force CPU through
+        # jax.config before any backend is touched (CPU smoke runs only)
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
     import jax
 
     if (jax.default_backend() == "cpu"
@@ -132,6 +149,216 @@ def measure() -> None:
     }))
 
 
+def _token_genesis(sender):
+    from ethrex_tpu.guest import token_template as tt
+
+    token = bytes.fromhex("7070" * 10)
+    storage = {hex(tt.balance_slot(sender)): hex(10**15)}
+    return token, {
+        "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+                   "shanghaiTime": 0, "cancunTime": 0},
+        "alloc": {
+            "0x" + sender.hex(): {"balance": hex(10**21)},
+            "0x" + token.hex(): {"balance": "0x0",
+                                 "code": "0x" + tt.TEMPLATE_CODE.hex(),
+                                 "storage": storage},
+        },
+        "gasLimit": hex(60_000_000), "baseFeePerGas": "0x7",
+        "timestamp": "0x0",
+    }
+
+
+def measure_config2() -> None:
+    """BASELINE config 2: a 100-tx ERC-20 batch, token mode, proven
+    end-to-end (state + transfer + token + binding STARKs), verified."""
+    _guard_backend()
+
+    from ethrex_tpu.crypto import secp256k1
+    from ethrex_tpu.guest import token_template as tt
+    from ethrex_tpu.guest.execution import ProgramInput
+    from ethrex_tpu.guest.witness import generate_witness
+    from ethrex_tpu.node import Node
+    from ethrex_tpu.primitives.genesis import Genesis
+    from ethrex_tpu.primitives.transaction import Transaction
+    from ethrex_tpu.prover.tpu_backend import TpuBackend
+
+    n_txs = int(os.environ.get("BENCH_ERC20_TXS", "100"))
+    secret = 0xA11CE
+    sender = secp256k1.pubkey_to_address(
+        secp256k1.pubkey_from_secret(secret))
+    token, genesis = _token_genesis(sender)
+    node = Node(Genesis.from_json(genesis))
+    for n in range(n_txs):
+        node.submit_transaction(Transaction(
+            tx_type=2, chain_id=1337, nonce=n,
+            max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+            gas_limit=100_000, to=token, value=0,
+            data=tt.transfer_calldata(bytes([0x60 + n % 16]) * 20,
+                                      100 + n)).sign(secret))
+    block = node.produce_block()
+    gas = block.header.gas_used
+    assert len(block.body.transactions) == n_txs
+    witness = generate_witness(node.chain, [block])
+    pi = ProgramInput(blocks=[block], witness=witness, config=node.config)
+    backend = TpuBackend()
+    warm = backend.prove(pi, "stark")
+    assert warm.get("vm", {}).get("mode") == "token"
+    t0 = time.perf_counter()
+    proof = backend.prove(pi, "stark")
+    wall = time.perf_counter() - t0
+    if not backend.verify(proof):
+        print("self-verification failed", file=sys.stderr)
+        sys.exit(4)
+    print(json.dumps({
+        "metric": "erc20_batch_prove_wall_s", "value": round(wall, 3),
+        "unit": "s",
+        "vs_baseline": round((gas / wall) / BASELINE_GAS_PER_SEC, 4),
+        "batch_gas": gas, "num_txs": n_txs,
+        "gas_per_sec": round(gas / wall, 1),
+        "config": "BASELINE-2 (100-tx ERC-20 batch, token mode, 4 STARKs)",
+    }))
+
+
+def measure_config4() -> None:
+    """BASELINE config 4: Groth16 BN254 wrap — format=groth16 on the
+    config-1 batch (aggregation + R1CS wrap + pairing verify)."""
+    _guard_backend()
+
+    from ethrex_tpu.crypto import secp256k1
+    from ethrex_tpu.guest.execution import ProgramInput
+    from ethrex_tpu.guest.witness import generate_witness
+    from ethrex_tpu.node import Node
+    from ethrex_tpu.primitives.genesis import Genesis
+    from ethrex_tpu.primitives.transaction import Transaction
+    from ethrex_tpu.prover.tpu_backend import TpuBackend
+
+    secret = 0xA11CE
+    sender = secp256k1.pubkey_to_address(
+        secp256k1.pubkey_from_secret(secret))
+    genesis = {
+        "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+                   "shanghaiTime": 0, "cancunTime": 0},
+        "alloc": {"0x" + sender.hex(): {"balance": hex(10**21)}},
+        "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7",
+        "timestamp": "0x0",
+    }
+    node = Node(Genesis.from_json(genesis))
+    for n in range(NUM_TXS):
+        node.submit_transaction(Transaction(
+            tx_type=2, chain_id=1337, nonce=n,
+            max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+            gas_limit=21_000, to=bytes([0x50 + n]) * 20,
+            value=1000 + n).sign(secret))
+    block = node.produce_block()
+    witness = generate_witness(node.chain, [block])
+    pi = ProgramInput(blocks=[block], witness=witness, config=node.config)
+    backend = TpuBackend()
+    warm = backend.prove(pi, "groth16")
+    assert "groth16" in warm
+    t0 = time.perf_counter()
+    proof = backend.prove(pi, "groth16")
+    wall = time.perf_counter() - t0
+    if not backend.verify(proof):
+        print("self-verification failed", file=sys.stderr)
+        sys.exit(4)
+    print(json.dumps({
+        "metric": "groth16_wrap_prove_wall_s", "value": round(wall, 3),
+        "unit": "s", "vs_baseline": 0.0,
+        "batch_gas": block.header.gas_used,
+        "config": "BASELINE-4 (config-1 batch, compressed + Groth16 wrap)",
+    }))
+
+
+def measure_config5() -> None:
+    """BASELINE config 5: 8-proof recursive aggregation — eight sponge
+    STARKs proven, then ONE outer FriVerifyAir STARK covering every FRI
+    query opening of all eight; verify_aggregated must accept."""
+    _guard_backend()
+
+    from ethrex_tpu.models import poseidon2_air as pair
+    from ethrex_tpu.stark import aggregate as agg_mod
+    from ethrex_tpu.stark import prover as stark_prover
+    from ethrex_tpu.stark.prover import StarkParams
+
+    params = StarkParams(log_blowup=3, num_queries=40, log_final_size=4)
+    airs, proofs = [], []
+    for i in range(8):
+        limbs = pair.pad_message_limbs(list(range(16 * (i + 1))))
+        air = pair.Poseidon2SpongeAir(num_chunks=len(limbs) // 8)
+        trace = pair.generate_sponge_trace(limbs)
+        pub = pair.sponge_public_inputs(limbs)
+        proofs.append(stark_prover.prove(air, trace, pub, params))
+        airs.append(air)
+    # warm-up aggregation compiles the outer AIR's phase programs
+    agg_mod.aggregate(airs, proofs, params)
+    t0 = time.perf_counter()
+    agg = agg_mod.aggregate(airs, proofs, params)
+    wall = time.perf_counter() - t0
+    agg_mod.verify_aggregated(airs, agg, params)
+    print(json.dumps({
+        "metric": "aggregate8_prove_wall_s", "value": round(wall, 3),
+        "unit": "s", "vs_baseline": 0.0,
+        "config": "BASELINE-5 (8 STARKs -> one outer recursion proof)",
+    }))
+
+
+def measure_config3() -> None:
+    """BASELINE config 3 (opt-in, BENCH_FULL=1): 1000-tx mixed batch —
+    500 transfers + 500 token calls across blocks."""
+    _guard_backend()
+
+    from ethrex_tpu.crypto import secp256k1
+    from ethrex_tpu.guest import token_template as tt
+    from ethrex_tpu.guest.execution import ProgramInput
+    from ethrex_tpu.guest.witness import generate_witness
+    from ethrex_tpu.node import Node
+    from ethrex_tpu.primitives.genesis import Genesis
+    from ethrex_tpu.primitives.transaction import Transaction
+    from ethrex_tpu.prover.tpu_backend import TpuBackend
+
+    secret = 0xA11CE
+    sender = secp256k1.pubkey_to_address(
+        secp256k1.pubkey_from_secret(secret))
+    token, genesis = _token_genesis(sender)
+    node = Node(Genesis.from_json(genesis))
+    nonce = 0
+    blocks = []
+    for _ in range(4):   # 4 blocks x 250 txs
+        for i in range(125):
+            node.submit_transaction(Transaction(
+                tx_type=2, chain_id=1337, nonce=nonce,
+                max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+                gas_limit=21_000, to=bytes([0x50 + i % 32]) * 20,
+                value=100 + i).sign(secret))
+            nonce += 1
+            node.submit_transaction(Transaction(
+                tx_type=2, chain_id=1337, nonce=nonce,
+                max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+                gas_limit=100_000, to=token, value=0,
+                data=tt.transfer_calldata(bytes([0x60 + i % 16]) * 20,
+                                          10 + i)).sign(secret))
+            nonce += 1
+        blocks.append(node.produce_block())
+    gas = sum(b.header.gas_used for b in blocks)
+    witness = generate_witness(node.chain, blocks)
+    pi = ProgramInput(blocks=blocks, witness=witness, config=node.config)
+    backend = TpuBackend()
+    warm = backend.prove(pi, "stark")
+    assert warm.get("vm", {}).get("mode") == "token"
+    t0 = time.perf_counter()
+    proof = backend.prove(pi, "stark")
+    wall = time.perf_counter() - t0
+    if not backend.verify(proof):
+        sys.exit(4)
+    print(json.dumps({
+        "metric": "mixed1000_batch_prove_wall_s", "value": round(wall, 3),
+        "unit": "s",
+        "vs_baseline": round((gas / wall) / BASELINE_GAS_PER_SEC, 4),
+        "batch_gas": gas, "num_txs": 1000,
+        "config": "BASELINE-3 (1000-tx mixed batch)",
+    }))
+
+
 def measure_core() -> None:
     """Fallback microbench: fully-jitted prove-core throughput (the round
     1-2 metric, against its documented estimated anchor)."""
@@ -181,6 +408,26 @@ def _attempt(flag: str, timeout: int) -> dict | None:
     return {"_err": f"rc={proc.returncode} " + " | ".join(tail[-3:])[:400]}
 
 
+EXTRA_TIMEOUT = int(os.environ.get("BENCH_EXTRA_TIMEOUT", "2700"))
+
+
+def _extra_configs() -> dict:
+    """BASELINE configs 2/4/5 (and 3 with BENCH_FULL=1), each in its own
+    child attempt; failures are recorded, not fatal."""
+    out = {}
+    flags = [("2", "--measure-2"), ("4", "--measure-4"),
+             ("5", "--measure-5")]
+    if os.environ.get("BENCH_FULL") == "1":
+        flags.append(("3", "--measure-3"))
+    for name, flag in flags:
+        if not probe_backend():
+            out[name] = {"error": "backend probe failed"}
+            continue
+        res = _attempt(flag, EXTRA_TIMEOUT)
+        out[name] = res if res is not None else {"error": "no output"}
+    return out
+
+
 def main() -> None:
     last_err = ""
     for attempt in range(ATTEMPTS):
@@ -190,6 +437,8 @@ def main() -> None:
             continue
         result = _attempt("--measure", ATTEMPT_TIMEOUT)
         if result is not None and "_err" not in result:
+            if os.environ.get("BENCH_SKIP_EXTRAS") != "1":
+                result["configs"] = _extra_configs()
             try:
                 with open(LAST_PATH, "w") as f:
                     json.dump(result, f)
@@ -228,9 +477,17 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if "--measure" in sys.argv:
-        measure()
-    elif "--measure-core" in sys.argv:
+    if "--measure-core" in sys.argv:
         measure_core()
+    elif "--measure-2" in sys.argv:
+        measure_config2()
+    elif "--measure-3" in sys.argv:
+        measure_config3()
+    elif "--measure-4" in sys.argv:
+        measure_config4()
+    elif "--measure-5" in sys.argv:
+        measure_config5()
+    elif "--measure" in sys.argv:
+        measure()
     else:
         main()

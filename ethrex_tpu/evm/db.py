@@ -135,6 +135,9 @@ class StateDB:
         self.source = source
         self.accounts: dict[bytes, CachedAccount] = {}
         self.journal: list = []
+        # EIP-161 (Spurious Dragon+): delete touched-empty accounts at
+        # merkleize time; pre-161 forks keep them (executor sets this)
+        self.clear_empty = True
         # tx-scoped substate
         self.accessed_addresses: set[bytes] = set()
         self.accessed_slots: set[tuple[bytes, int]] = set()
@@ -142,6 +145,7 @@ class StateDB:
         self.logs: list = []
         self.transient: dict[tuple[bytes, int], int] = {}
         self.created_accounts: set[bytes] = set()
+        self.destroyed_accounts: set[bytes] = set()  # pre-London SD refund
         # original (pre-tx) storage values for SSTORE gas: (addr,slot) -> val
         self._tx_original: dict[tuple[bytes, int], int] = {}
         # block-scoped write-back tracking (consumed by apply_account_updates)
@@ -299,6 +303,16 @@ class StateDB:
         self.accessed_slots.add(key)
         return False
 
+    def create_empty(self, address: bytes):
+        """Pre-EIP-161 call semantics: instantiate an empty account
+        (journaled; no-op when it already exists)."""
+        acct = self._load(address)
+        if acct.exists:
+            return
+        self.journal.append(("exists", address, acct.exists))
+        acct.exists = True
+        self.dirty_accounts.add(address)
+
     def mark_created(self, address: bytes):
         self.journal.append(("created", address))
         self.created_accounts.add(address)
@@ -309,6 +323,9 @@ class StateDB:
         acct.storage = {}
 
     def destroy_account(self, address: bytes):
+        if address not in self.destroyed_accounts:
+            self.journal.append(("destroyed_set", address))
+            self.destroyed_accounts.add(address)
         acct = self._load(address)
         self.journal.append(
             ("destroy", address, acct.nonce, acct.balance, acct.code_hash,
@@ -370,6 +387,11 @@ class StateDB:
                 self.accessed_slots.discard(entry[1])
             elif kind == "created":
                 self.created_accounts.discard(entry[1])
+            elif kind == "destroyed_set":
+                self.destroyed_accounts.discard(entry[1])
+            elif kind == "exists":
+                _, addr, existed = entry
+                self.accounts[addr].exists = existed
             elif kind == "recreate":
                 _, addr, cleared, storage = entry
                 acct = self.accounts[addr]
@@ -393,6 +415,7 @@ class StateDB:
         self.logs = []
         self.transient = {}
         self.created_accounts = set()
+        self.destroyed_accounts = set()
         self._tx_original = {}
 
     def finalize_tx(self):

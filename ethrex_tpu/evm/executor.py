@@ -68,7 +68,7 @@ def validate_tx(tx: Transaction, sender: bytes, state: StateDB,
         raise InvalidTransaction("initcode too large")
     if tx.chain_id is not None and tx.chain_id != config.chain_id:
         raise InvalidTransaction("wrong chain id")
-    intrinsic, floor = G.intrinsic_gas(tx, fork >= Fork.PRAGUE)
+    intrinsic, floor = G.intrinsic_gas(tx, fork)
     if tx.gas_limit < max(intrinsic, floor):
         raise InvalidTransaction("intrinsic gas above gas limit")
     return eff_price
@@ -148,6 +148,7 @@ def execute_tx(tx: Transaction, state: StateDB, block: BlockEnv,
     if sender is None:
         raise InvalidTransaction("invalid signature")
     state.begin_tx()
+    state.clear_empty = fork >= Fork.SPURIOUS_DRAGON  # EIP-161
     eff_price = validate_tx(tx, sender, state, block, config, fork)
 
     # buy gas
@@ -160,7 +161,7 @@ def execute_tx(tx: Transaction, state: StateDB, block: BlockEnv,
             blob_gas * G.blob_base_fee(block.excess_blob_gas, fraction))
     state.increment_nonce(sender)
 
-    intrinsic, floor = G.intrinsic_gas(tx, fork >= Fork.PRAGUE)
+    intrinsic, floor = G.intrinsic_gas(tx, fork)
     gas = tx.gas_limit - intrinsic
 
     # warm-up (EIP-2929 + EIP-3651)
@@ -200,10 +201,11 @@ def execute_tx(tx: Transaction, state: StateDB, block: BlockEnv,
             msg.code_address = tx.to
         ok, gas_left, output = evm.execute_message(msg)
 
-    # refunds (EIP-3529: capped at gas_used / 5)
+    # refunds (pre-London: capped at gas_used/2; EIP-3529: gas_used/5)
     gas_used = tx.gas_limit - gas_left
     if ok:
-        refund = min(max(state.refund, 0) + auth_refund, gas_used // 5)
+        cap = gas_used // G.schedule_for(fork).refund_divisor
+        refund = min(max(state.refund, 0) + auth_refund, cap)
         gas_used -= refund
     if fork >= Fork.PRAGUE:
         gas_used = max(gas_used, floor)  # EIP-7623 calldata floor

@@ -1,7 +1,17 @@
-"""Gas schedule (Berlin through Prague; parity with the reference's
-crates/vm/levm/src/gas_cost.rs — re-derived from the EIPs)."""
+"""Gas schedule (Frontier through Prague; parity with the reference's
+crates/vm/levm/src/gas_cost.rs — re-derived from the EIPs).
+
+Round 4 adds the pre-Berlin fork variants as a per-fork `Schedule`
+(`schedule_for`): EIP-150 repricing (Tangerine), EIP-160 EXP cost +
+EIP-161 state clearing + EIP-170 code limit (Spurious Dragon), the three
+SSTORE regimes before EIP-2929 (legacy, EIP-1283 Constantinople-only,
+EIP-2200 Istanbul), EIP-1884 + EIP-2028 (Istanbul), and the pre-London
+refund rules (cap gas/2, SELFDESTRUCT refund 24000)."""
 
 from __future__ import annotations
+
+import dataclasses
+import functools
 
 # base opcode costs
 ZERO = 0
@@ -67,6 +77,96 @@ MIN_BLOB_BASE_FEE = 1
 BLOB_BASE_FEE_UPDATE_FRACTION = 3338477
 MAX_BLOB_GAS_PER_BLOCK = 786432
 
+# legacy SSTORE (pre-net-metering) and pre-London refunds
+SSTORE_LEGACY_SET = 20000
+SSTORE_LEGACY_RESET = 5000
+SSTORE_LEGACY_REFUND = 15000
+SELFDESTRUCT_REFUND = 24000     # removed by EIP-3529 (London)
+
+
+@dataclasses.dataclass(frozen=True)
+class Schedule:
+    """Fork-dependent costs and rules the interpreter consults.
+
+    Berlin+ keeps using the EIP-2929 warm/cold constants directly; the
+    flat access costs here only matter for `pre_berlin` schedules.
+    """
+
+    sload: int
+    balance: int
+    extcode: int            # EXTCODESIZE / EXTCODECOPY base
+    extcodehash: int
+    call: int
+    selfdestruct: int
+    exp_byte: int
+    tx_nonzero: int
+    tx_create: int          # 0 before Homestead (EIP-2)
+    call_63_64: bool        # EIP-150 gas cap (Tangerine+)
+    eip161: bool            # Spurious Dragon state-clearing rules
+    max_code_size: int      # 0 = unlimited (pre-EIP-170)
+    strict_deposit: bool    # Homestead+: OOG when deposit unaffordable
+    sstore_regime: str      # "legacy" | "net1283" | "net2200" | "berlin"
+    net_sload: int          # dirty-write / no-op cost for the net regimes
+    refund_divisor: int     # 2 pre-London, 5 after (EIP-3529)
+    selfdestruct_refund: int
+    pre_berlin: bool
+
+
+def _sched(**kw) -> Schedule:
+    base = dict(sload=50, balance=20, extcode=20, extcodehash=400,
+                call=40, selfdestruct=0, exp_byte=10, tx_nonzero=68,
+                tx_create=0, call_63_64=False, eip161=False,
+                max_code_size=0, strict_deposit=False,
+                sstore_regime="legacy", net_sload=200, refund_divisor=2,
+                selfdestruct_refund=SELFDESTRUCT_REFUND, pre_berlin=True)
+    base.update(kw)
+    return Schedule(**base)
+
+
+@functools.lru_cache(maxsize=None)
+def schedule_for(fork) -> Schedule:
+    from ..primitives.genesis import Fork
+
+    if fork >= Fork.LONDON:
+        return _sched(sstore_regime="berlin", refund_divisor=5,
+                      selfdestruct_refund=0, tx_nonzero=16,
+                      tx_create=TX_CREATE, call_63_64=True, eip161=True,
+                      max_code_size=MAX_CODE_SIZE, strict_deposit=True,
+                      exp_byte=50, pre_berlin=False)
+    if fork >= Fork.BERLIN:
+        return _sched(sstore_regime="berlin", tx_nonzero=16,
+                      tx_create=TX_CREATE, call_63_64=True, eip161=True,
+                      max_code_size=MAX_CODE_SIZE, strict_deposit=True,
+                      exp_byte=50, pre_berlin=False)
+    if fork >= Fork.ISTANBUL:
+        return _sched(sload=800, balance=700, extcode=700, extcodehash=700,
+                      call=700, selfdestruct=SELFDESTRUCT, exp_byte=50,
+                      tx_nonzero=16, tx_create=TX_CREATE, call_63_64=True,
+                      eip161=True, max_code_size=MAX_CODE_SIZE,
+                      strict_deposit=True, sstore_regime="net2200",
+                      net_sload=800)
+    if fork >= Fork.CONSTANTINOPLE:
+        # Constantinople activates EIP-1283 net metering; Petersburg
+        # (= Constantinople-fix) retracts it
+        regime = "net1283" if fork == Fork.CONSTANTINOPLE else "legacy"
+        return _sched(sload=200, balance=400, extcode=700, call=700,
+                      selfdestruct=SELFDESTRUCT, exp_byte=50,
+                      tx_create=TX_CREATE, call_63_64=True, eip161=True,
+                      max_code_size=MAX_CODE_SIZE, strict_deposit=True,
+                      sstore_regime=regime)
+    if fork >= Fork.SPURIOUS_DRAGON:
+        return _sched(sload=200, balance=400, extcode=700, call=700,
+                      selfdestruct=SELFDESTRUCT, exp_byte=50,
+                      tx_create=TX_CREATE, call_63_64=True, eip161=True,
+                      max_code_size=MAX_CODE_SIZE, strict_deposit=True)
+    if fork >= Fork.TANGERINE:
+        return _sched(sload=200, balance=400, extcode=700, call=700,
+                      selfdestruct=SELFDESTRUCT, tx_create=TX_CREATE,
+                      call_63_64=True, strict_deposit=True)
+    if fork >= Fork.HOMESTEAD:
+        return _sched(tx_create=TX_CREATE, strict_deposit=True)
+    return _sched()
+
 
 def memory_cost(size_words: int) -> int:
     return MEMORY * size_words + size_words * size_words // QUAD_DIVISOR
@@ -89,34 +189,42 @@ def keccak_cost(length: int) -> int:
     return KECCAK256 + KECCAK256_WORD * ((length + 31) // 32)
 
 
-def exp_cost(exponent: int) -> int:
+def exp_cost(exponent: int, exp_byte: int = EXP_BYTE) -> int:
     if exponent == 0:
         return EXP
-    return EXP + EXP_BYTE * ((exponent.bit_length() + 7) // 8)
+    return EXP + exp_byte * ((exponent.bit_length() + 7) // 8)
 
 
 def init_code_cost(length: int) -> int:
     return INITCODE_WORD * ((length + 31) // 32)
 
 
-def tx_data_cost(data: bytes) -> tuple[int, int]:
+def tx_data_cost(data: bytes,
+                 nonzero_cost: int = TX_DATA_NONZERO) -> tuple[int, int]:
     """Returns (standard_cost, tokens) — tokens feed the EIP-7623 floor."""
     zeros = data.count(0)
     nonzeros = len(data) - zeros
     tokens = zeros + nonzeros * 4
-    return TX_DATA_ZERO * zeros + TX_DATA_NONZERO * nonzeros, tokens
+    return TX_DATA_ZERO * zeros + nonzero_cost * nonzeros, tokens
 
 
-def intrinsic_gas(tx, fork_prague: bool) -> tuple[int, int]:
-    """Returns (intrinsic, floor) gas. floor only binds in Prague+ (EIP-7623)."""
-    data_cost, tokens = tx_data_cost(tx.data)
+def intrinsic_gas(tx, fork) -> tuple[int, int]:
+    """Returns (intrinsic, floor) gas for the fork's schedule; floor only
+    binds in Prague+ (EIP-7623)."""
+    from ..primitives.genesis import Fork
+
+    sched = schedule_for(fork)
+    data_cost, tokens = tx_data_cost(tx.data, sched.tx_nonzero)
     gas = TX_BASE + data_cost
     if tx.is_create:
-        gas += TX_CREATE + init_code_cost(len(tx.data))
+        gas += sched.tx_create
+        if fork >= Fork.SHANGHAI:
+            gas += init_code_cost(len(tx.data))
     for _, slots in tx.access_list:
         gas += TX_ACCESS_LIST_ADDR + TX_ACCESS_LIST_SLOT * len(slots)
     gas += PER_EMPTY_ACCOUNT_AUTH * len(tx.authorization_list)
-    floor = TX_BASE + TX_FLOOR_TOKEN_COST * tokens if fork_prague else 0
+    floor = TX_BASE + TX_FLOOR_TOKEN_COST * tokens \
+        if fork >= Fork.PRAGUE else 0
     return gas, floor
 
 

@@ -65,7 +65,8 @@ def _modexp(data: bytes, gas: int, fork):
     bsize = int.from_bytes(data[0:32].ljust(32, b"\x00"), "big")
     esize = int.from_bytes(data[32:64].ljust(32, b"\x00"), "big")
     msize = int.from_bytes(data[64:96].ljust(32, b"\x00"), "big")
-    if bsize == 0 and msize == 0:
+    eip2565 = fork >= Fork.BERLIN
+    if bsize == 0 and msize == 0 and eip2565:
         return 200, b""
     if max(bsize, esize, msize) > 1_000_000:
         # EIP-7823-style upper bound guard; also protects the host
@@ -77,13 +78,24 @@ def _modexp(data: bytes, gas: int, fork):
     exp_head = int.from_bytes(body[bsize:bsize + min(esize, 32)]
                               .ljust(min(esize, 32), b"\x00"), "big")
     max_len = max(bsize, msize)
-    mult_complexity = _words(max_len) ** 2
     if esize <= 32:
         iter_count = max(exp_head.bit_length() - 1, 0)
     else:
         iter_count = 8 * (esize - 32) + max(exp_head.bit_length() - 1, 0)
     iter_count = max(iter_count, 1)
-    cost = max(200, mult_complexity * iter_count // 3)
+    if eip2565:
+        mult_complexity = _words(max_len) ** 2
+        cost = max(200, mult_complexity * iter_count // 3)
+    else:
+        # EIP-198 multiplication-complexity schedule (pre-Berlin)
+        x = max_len
+        if x <= 64:
+            mult_complexity = x * x
+        elif x <= 1024:
+            mult_complexity = x * x // 4 + 96 * x - 3072
+        else:
+            mult_complexity = x * x // 16 + 480 * x - 199680
+        cost = mult_complexity * iter_count // 20
     if gas < cost:
         return cost, b""   # skip the pow when OOG anyway
     base = int.from_bytes(body[:bsize].ljust(bsize, b"\x00"), "big")
@@ -112,7 +124,7 @@ def _bn_point(data: bytes, off: int):
 
 
 def _ecadd(data: bytes, gas: int, fork):
-    cost = 150
+    cost = 150 if fork >= Fork.ISTANBUL else 500   # EIP-1108
     data = bytes(data).ljust(128, b"\x00")
     p1 = _bn_point(data, 0)
     p2 = _bn_point(data, 64)
@@ -123,7 +135,7 @@ def _ecadd(data: bytes, gas: int, fork):
 
 
 def _ecmul(data: bytes, gas: int, fork):
-    cost = 6000
+    cost = 6000 if fork >= Fork.ISTANBUL else 40000   # EIP-1108
     data = bytes(data).ljust(96, b"\x00")
     p1 = _bn_point(data, 0)
     k = int.from_bytes(data[64:96], "big")
@@ -138,7 +150,10 @@ def _ecpairing(data: bytes, gas: int, fork):
     if len(data) % 192 != 0:
         raise PrecompileError("pairing input not multiple of 192")
     npairs = len(data) // 192
-    cost = 45000 + 34000 * npairs
+    if fork >= Fork.ISTANBUL:
+        cost = 45000 + 34000 * npairs
+    else:
+        cost = 100000 + 80000 * npairs   # pre-EIP-1108
     if gas < cost:
         return cost, b""   # skip the expensive pairing work when OOG anyway
     pairs = []
@@ -461,6 +476,11 @@ PRECOMPILES = {
 # precompiles that only exist from a given fork onward; absent entries are
 # active on every supported fork (all pre-date our earliest target chains)
 PRECOMPILE_FORKS = {
+    _a(5): Fork.BYZANTIUM,   # modexp, EIP-198
+    _a(6): Fork.BYZANTIUM,   # bn254 add, EIP-196
+    _a(7): Fork.BYZANTIUM,   # bn254 mul
+    _a(8): Fork.BYZANTIUM,   # bn254 pairing, EIP-197
+    _a(9): Fork.ISTANBUL,    # blake2f, EIP-152
     _a(10): Fork.CANCUN,     # point evaluation, EIP-4844
     _a(0x0B): Fork.PRAGUE,   # EIP-2537 BLS12-381 suite
     _a(0x0C): Fork.PRAGUE,

@@ -3,9 +3,12 @@
 fork-gated opcode tables, substate checkpointing; re-implemented from the
 EIPs with a Python dispatch loop over a journaled StateDB).
 
-Supported semantics: Berlin → Prague (EIP-2929 warm/cold, EIP-3529 refunds,
-EIP-3860 initcode, PUSH0, Cancun transient storage/MCOPY/blob opcodes,
-EIP-6780 selfdestruct, EIP-7702 delegation).
+Supported semantics: Frontier → Prague.  Berlin+ uses the EIP-2929
+warm/cold accounting; pre-Berlin forks consult the per-fork `Schedule`
+(evm/gas.py): EIP-150 repricing, EIP-160/161/170, the legacy /
+EIP-1283 / EIP-2200 SSTORE regimes, pre-Byzantium opcode sets, and the
+pre-London refund rules.  Opcode availability is a per-fork dispatch
+table (reference: fork-gated const tables, levm/src/opcodes.rs:450-657).
 """
 
 from __future__ import annotations
@@ -200,6 +203,7 @@ class EVM:
         self.block = block
         self.config = config
         self.fork = config.fork_at(block.number, block.timestamp)
+        self.sched = G.schedule_for(self.fork)
         self.gas_price = gas_price
         self.origin = origin
         self.blob_hashes = blob_hashes or []
@@ -248,6 +252,17 @@ class EVM:
             self.state._load(to)  # touch target so existence is tracked
 
     def _execute_call(self, msg: Message) -> tuple[bool, int, bytes]:
+        if (not self.sched.eip161 and msg.transfers_value
+                and msg.kind in ("CALL", "")
+                and not self.state.account_exists(msg.to)):
+            # pre-EIP-161: calling a nonexistent account instantiates it
+            # (empty), value or not — inside this call's revert scope
+            self.state.create_empty(msg.to)
+        if msg.value and msg.kind == "CALLCODE":
+            # CALLCODE transfers nothing (to == caller) but the spec still
+            # requires the balance check (review finding)
+            if self.state.get_balance(msg.caller) < msg.value:
+                return False, msg.gas, b""
         if msg.transfers_value and msg.value:
             if self.state.get_balance(msg.caller) < msg.value:
                 return False, msg.gas, b""
@@ -309,13 +324,16 @@ class EVM:
         except VMError:
             return False, 0, b""
         # deposit code
-        if len(deployed) > G.MAX_CODE_SIZE:
-            return False, 0, b""
-        if deployed[:1] == b"\xef":  # EIP-3541
-            return False, 0, b""
+        if self.sched.max_code_size and len(deployed) > self.sched.max_code_size:
+            return False, 0, b""   # EIP-170 (Spurious Dragon+)
+        if self.fork >= Fork.LONDON and deployed[:1] == b"\xef":
+            return False, 0, b""   # EIP-3541
         try:
             frame.use_gas(G.CODE_DEPOSIT_BYTE * len(deployed))
         except OutOfGas:
+            if not self.sched.strict_deposit:
+                # Frontier: unaffordable deposit leaves an empty contract
+                return True, frame.gas, new_addr
             return False, 0, b""
         self.state.set_code(new_addr, deployed)
         return True, frame.gas, new_addr
@@ -326,7 +344,7 @@ class EVM:
     def _run(self, f: Frame):
         code = f.code
         n = len(code)
-        handlers = _HANDLERS
+        handlers = _handlers_for(self.fork)
         step = getattr(self.tracer, "step", None) if self.tracer else None
         if step is not None:
             # opcode-level tracing variant: the hot path below stays free
@@ -398,7 +416,7 @@ def _mulmod(evm, f):
 
 def _exp(evm, f):
     base, ex = f.pop(), f.pop()
-    f.use_gas(G.exp_cost(ex))
+    f.use_gas(G.exp_cost(ex, evm.sched.exp_byte))
     f.push(pow(base, ex, 1 << 256))
 
 
@@ -460,8 +478,11 @@ def _address(evm, f):
 
 def _balance(evm, f):
     addr = addr_from_u256(f.pop())
-    warm = evm.state.warm_address(addr)
-    f.use_gas(G.WARM_ACCESS if warm else G.COLD_ACCOUNT_ACCESS)
+    if evm.sched.pre_berlin:
+        f.use_gas(evm.sched.balance)
+    else:
+        warm = evm.state.warm_address(addr)
+        f.use_gas(G.WARM_ACCESS if warm else G.COLD_ACCOUNT_ACCESS)
     f.push(evm.state.get_balance(addr))
 
 
@@ -521,9 +542,12 @@ def _gasprice(evm, f):
     f.push(evm.gas_price)
 
 
-def _ext_account_gas(evm, f, addr):
-    warm = evm.state.warm_address(addr)
-    f.use_gas(G.WARM_ACCESS if warm else G.COLD_ACCOUNT_ACCESS)
+def _ext_account_gas(evm, f, addr, flat_cost=None):
+    if evm.sched.pre_berlin:
+        f.use_gas(evm.sched.extcode if flat_cost is None else flat_cost)
+    else:
+        warm = evm.state.warm_address(addr)
+        f.use_gas(G.WARM_ACCESS if warm else G.COLD_ACCOUNT_ACCESS)
 
 
 def _extcodesize(evm, f):
@@ -536,9 +560,12 @@ def _extcodecopy(evm, f):
     addr = addr_from_u256(f.pop())
     dst, off, length = f.pop(), f.pop(), f.pop()
     _check_mem_bounds(dst, length)
-    warm = evm.state.warm_address(addr)
-    f.use_gas((G.WARM_ACCESS if warm else G.COLD_ACCOUNT_ACCESS)
-              + G.copy_cost(length))
+    if evm.sched.pre_berlin:
+        base = evm.sched.extcode
+    else:
+        warm = evm.state.warm_address(addr)
+        base = G.WARM_ACCESS if warm else G.COLD_ACCOUNT_ACCESS
+    f.use_gas(base + G.copy_cost(length))
     code = evm.state.get_code(addr)
     data = code[off:off + length] if off < len(code) else b""
     f.mwrite(dst, data.ljust(length, b"\x00"))
@@ -560,7 +587,7 @@ def _returndatacopy(evm, f):
 
 def _extcodehash(evm, f):
     addr = addr_from_u256(f.pop())
-    _ext_account_gas(evm, f, addr)
+    _ext_account_gas(evm, f, addr, flat_cost=evm.sched.extcodehash)
     if not evm.state.account_exists(addr) or evm.state.is_empty(addr):
         f.push(0)
     else:
@@ -668,23 +695,69 @@ def _mstore8(evm, f):
 
 def _sload(evm, f):
     slot = f.pop()
-    warm = evm.state.warm_slot(f.msg.to, slot)
-    # EIP-2929: cold SLOAD costs 2100 TOTAL (not 2100 + warm 100)
-    f.use_gas(G.WARM_ACCESS if warm else G.COLD_SLOAD)
+    if evm.sched.pre_berlin:
+        f.use_gas(evm.sched.sload)
+    else:
+        warm = evm.state.warm_slot(f.msg.to, slot)
+        # EIP-2929: cold SLOAD costs 2100 TOTAL (not 2100 + warm 100)
+        f.use_gas(G.WARM_ACCESS if warm else G.COLD_SLOAD)
     f.push(evm.state.get_storage(f.msg.to, slot))
 
 
 def _sstore(evm, f):
     if f.msg.is_static:
         raise StaticViolation("SSTORE in static context")
-    if f.gas <= G.SSTORE_SENTRY:
-        raise OutOfGas("SSTORE sentry")
+    regime = evm.sched.sstore_regime
+    if regime == "legacy":
+        # Frontier..Byzantium and Petersburg: flat SET/RESET + clear refund
+        slot, value = f.pop(), f.pop()
+        addr = f.msg.to
+        current = evm.state.get_storage(addr, slot)
+        if current == 0 and value != 0:
+            f.use_gas(G.SSTORE_LEGACY_SET)
+        else:
+            f.use_gas(G.SSTORE_LEGACY_RESET)
+            if current != 0 and value == 0:
+                evm.state.add_refund(G.SSTORE_LEGACY_REFUND)
+        evm.state.set_storage(addr, slot, value)
+        return
+    if regime != "net1283" and f.gas <= G.SSTORE_SENTRY:
+        raise OutOfGas("SSTORE sentry")  # EIP-2200+; 1283 had no sentry
     slot, value = f.pop(), f.pop()
     addr = f.msg.to
-    warm = evm.state.warm_slot(addr, slot)
-    cost = 0 if warm else G.COLD_SLOAD
     current = evm.state.get_storage(addr, slot)
     original = evm.state.get_original_storage(addr, slot)
+    if regime in ("net1283", "net2200"):
+        # EIP-1283 (Constantinople) / EIP-2200 (Istanbul) net metering:
+        # same structure as Berlin with (no-op, dirty) = net_sload and
+        # full SSTORE_LEGACY_RESET, refund 15000, no warm/cold
+        noop = evm.sched.net_sload
+        if current == value:
+            f.use_gas(noop)
+        elif current == original:
+            if original == 0:
+                f.use_gas(G.SSTORE_LEGACY_SET)
+            else:
+                f.use_gas(G.SSTORE_LEGACY_RESET)
+                if value == 0:
+                    evm.state.add_refund(G.SSTORE_LEGACY_REFUND)
+        else:
+            f.use_gas(noop)
+            if original != 0:
+                if current == 0:
+                    evm.state.sub_refund(G.SSTORE_LEGACY_REFUND)
+                elif value == 0:
+                    evm.state.add_refund(G.SSTORE_LEGACY_REFUND)
+            if value == original:
+                if original == 0:
+                    evm.state.add_refund(G.SSTORE_LEGACY_SET - noop)
+                else:
+                    evm.state.add_refund(G.SSTORE_LEGACY_RESET - noop)
+        evm.state.set_storage(addr, slot, value)
+        return
+    # Berlin+ (EIP-2929 + EIP-3529)
+    warm = evm.state.warm_slot(addr, slot)
+    cost = 0 if warm else G.COLD_SLOAD
     if current == value:
         cost += G.WARM_ACCESS
     elif current == original:
@@ -823,12 +896,15 @@ def _make_log(ntopics):
 # --- calls / creates -------------------------------------------------------
 
 def _call_gas(evm, f, addr, value, new_account: bool):
-    warm = evm.state.warm_address(addr)
-    cost = G.WARM_ACCESS if warm else G.COLD_ACCOUNT_ACCESS
+    if evm.sched.pre_berlin:
+        cost = evm.sched.call
+    else:
+        warm = evm.state.warm_address(addr)
+        cost = G.WARM_ACCESS if warm else G.COLD_ACCOUNT_ACCESS
     if value:
         cost += G.CALL_VALUE
-        if new_account:
-            cost += G.NEW_ACCOUNT
+    if new_account:
+        cost += G.NEW_ACCOUNT
     return cost
 
 
@@ -845,13 +921,21 @@ def _do_call(evm, f, *, kind: str):
     # memory expansion first
     f.expand_memory(in_off, in_len)
     f.expand_memory(out_off, out_len)
-    new_account = (kind == "call" and value != 0
-                   and (not evm.state.account_exists(addr)
-                        or evm.state.is_empty(addr)))
+    if evm.sched.eip161:
+        new_account = (kind == "call" and value != 0
+                       and (not evm.state.account_exists(addr)
+                            or evm.state.is_empty(addr)))
+    else:
+        # pre-EIP-161: CALL to a nonexistent account charges G_newaccount
+        # and instantiates the (empty) account even for zero value
+        new_account = (kind == "call"
+                       and not evm.state.account_exists(addr))
     f.use_gas(_call_gas(evm, f, addr, value, new_account))
-    # 63/64 rule
-    max_gas = f.gas - f.gas // 64
-    gas = min(gas_req, max_gas)
+    if evm.sched.call_63_64:
+        max_gas = f.gas - f.gas // 64   # EIP-150
+        gas = min(gas_req, max_gas)
+    else:
+        gas = gas_req                   # pre-Tangerine: no cap, OOG if short
     f.use_gas(gas)
     stipend = G.CALL_STIPEND if value else 0
     data = f.mread(in_off, in_len)
@@ -931,7 +1015,10 @@ def _do_create(evm, f, *, is_create2: bool):
             or evm.state.get_nonce(f.msg.to) >= (1 << 64) - 1):
         f.push(0)
         return
-    gas = f.gas - f.gas // 64
+    if evm.sched.call_63_64:
+        gas = f.gas - f.gas // 64
+    else:
+        gas = f.gas   # pre-Tangerine: the child gets everything
     f.use_gas(gas)
     evm.state.increment_nonce(f.msg.to)
     msg = Message(caller=f.msg.to, to=b"", code_address=b"", value=value,
@@ -975,13 +1062,27 @@ def _selfdestruct(evm, f):
     if f.msg.is_static:
         raise StaticViolation("SELFDESTRUCT in static context")
     target = addr_from_u256(f.pop())
-    warm = evm.state.warm_address(target)
-    cost = G.SELFDESTRUCT + (0 if warm else G.COLD_ACCOUNT_ACCESS)
     balance = evm.state.get_balance(f.msg.to)
-    if balance and (not evm.state.account_exists(target)
-                    or evm.state.is_empty(target)):
-        cost += G.NEW_ACCOUNT
+    if evm.sched.pre_berlin:
+        cost = evm.sched.selfdestruct
+        if evm.sched.eip161:
+            if balance and (not evm.state.account_exists(target)
+                            or evm.state.is_empty(target)):
+                cost += G.NEW_ACCOUNT
+        elif evm.sched.call_63_64:
+            # EIP-150..EIP-158: charged on plain nonexistence
+            if not evm.state.account_exists(target):
+                cost += G.NEW_ACCOUNT
+    else:
+        warm = evm.state.warm_address(target)
+        cost = G.SELFDESTRUCT + (0 if warm else G.COLD_ACCOUNT_ACCESS)
+        if balance and (not evm.state.account_exists(target)
+                        or evm.state.is_empty(target)):
+            cost += G.NEW_ACCOUNT
     f.use_gas(cost)
+    if evm.sched.selfdestruct_refund \
+            and f.msg.to not in evm.state.destroyed_accounts:
+        evm.state.add_refund(evm.sched.selfdestruct_refund)
     addr = f.msg.to
     if evm.fork >= Fork.CANCUN and addr not in evm.state.created_accounts:
         # EIP-6780: only move the balance
@@ -1002,6 +1103,35 @@ def _selfdestruct(evm, f):
 # ---------------------------------------------------------------------------
 
 _HANDLERS: list = [None] * 256
+
+# opcodes by the fork that introduced them (removed from earlier forks'
+# tables; reference: fork-gated const tables, levm/src/opcodes.rs:450-657)
+_OPCODE_SINCE = {
+    Fork.HOMESTEAD: [0xF4],                        # DELEGATECALL
+    Fork.BYZANTIUM: [0x3D, 0x3E, 0xFA, 0xFD],      # RETURNDATA*, STATICCALL,
+                                                   # REVERT
+    Fork.CONSTANTINOPLE: [0x1B, 0x1C, 0x1D,        # SHL/SHR/SAR
+                          0x3F, 0xF5],             # EXTCODEHASH, CREATE2
+    Fork.ISTANBUL: [0x46, 0x47],                   # CHAINID, SELFBALANCE
+    Fork.LONDON: [0x48],                           # BASEFEE
+    Fork.SHANGHAI: [0x5F],                         # PUSH0
+    Fork.CANCUN: [0x49, 0x4A, 0x5C, 0x5D, 0x5E],   # BLOBHASH, BLOBBASEFEE,
+                                                   # TLOAD/TSTORE, MCOPY
+}
+
+_FORK_HANDLERS: dict = {}
+
+
+def _handlers_for(fork) -> list:
+    table = _FORK_HANDLERS.get(fork)
+    if table is None:
+        table = list(_HANDLERS)
+        for since, ops in _OPCODE_SINCE.items():
+            if fork < since:
+                for op in ops:
+                    table[op] = None
+        _FORK_HANDLERS[fork] = table
+    return table
 
 
 def _install():

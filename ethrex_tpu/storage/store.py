@@ -260,11 +260,13 @@ def apply_updates_to_tries(node_table: dict, code_table, parent_root: bytes,
     trie = Trie.from_nodes(parent_root, node_table, share=True)
     account_inserts = []
     account_deletes = []
+    clear_empty = getattr(state_db, "clear_empty", True)
     for addr in sorted(state_db.dirty_accounts):
         cached = state_db.accounts[addr]
         key = keccak256(addr)
-        if not cached.exists or cached.is_empty:
-            # EIP-161 state clearing / destroyed accounts
+        if not cached.exists or (cached.is_empty and clear_empty):
+            # EIP-161 state clearing / destroyed accounts (pre-Spurious
+            # forks persist touched-empty accounts: clear_empty=False)
             if write_log is not None:
                 raw = trie.get(key)
                 if raw:

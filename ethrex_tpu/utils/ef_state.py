@@ -37,12 +37,35 @@ from ..primitives.transaction import (
 from ..storage.store import Store
 
 # Fork name (EF fixture convention) -> ChainConfig JSON enabling it from
-# genesis.  Only post-Merge forks are first-class, mirroring the reference
-# runner's DEFAULT_FORKS (state_v2/src/modules/types.rs:30); Berlin/London
-# appear because our interpreter supports them for replay.
+# genesis.  Round 4 extends the runner to the full Frontier..Osaka ladder
+# (the reference runs pinned archives over every fork,
+# tooling/ef_tests/state_v2/src/runner.rs); pre-Berlin gas/opcode
+# variants live in evm/gas.py Schedule + the fork-gated dispatch table.
+# pre-Merge forks pin a huge TTD: ChainConfig treats ttd == 0 as merged
+# from genesis, which would floor every config at PARIS
+_PRE_MERGE_TTD = {"terminalTotalDifficulty": 1 << 70}
+
 _FORK_CONFIGS = {
-    "Berlin": {"berlinBlock": 0},
-    "London": {"berlinBlock": 0, "londonBlock": 0},
+    "Frontier": {**_PRE_MERGE_TTD},
+    "Homestead": {"homesteadBlock": 0, **_PRE_MERGE_TTD},
+    "EIP150": {"homesteadBlock": 0, "eip150Block": 0, **_PRE_MERGE_TTD},
+    "EIP158": {"homesteadBlock": 0, "eip150Block": 0, "eip155Block": 0,
+               **_PRE_MERGE_TTD},
+    "Byzantium": {"homesteadBlock": 0, "eip150Block": 0, "eip155Block": 0,
+                  "byzantiumBlock": 0, **_PRE_MERGE_TTD},
+    "Constantinople": {"homesteadBlock": 0, "eip150Block": 0,
+                       "eip155Block": 0, "byzantiumBlock": 0,
+                       "constantinopleBlock": 0, **_PRE_MERGE_TTD},
+    "ConstantinopleFix": {"homesteadBlock": 0, "eip150Block": 0,
+                          "eip155Block": 0, "byzantiumBlock": 0,
+                          "constantinopleBlock": 0, "petersburgBlock": 0,
+                          **_PRE_MERGE_TTD},
+    "Istanbul": {"homesteadBlock": 0, "eip150Block": 0, "eip155Block": 0,
+                 "byzantiumBlock": 0, "constantinopleBlock": 0,
+                 "petersburgBlock": 0, "istanbulBlock": 0,
+                 **_PRE_MERGE_TTD},
+    "Berlin": {"berlinBlock": 0, **_PRE_MERGE_TTD},
+    "London": {"berlinBlock": 0, "londonBlock": 0, **_PRE_MERGE_TTD},
     "Merge": {"berlinBlock": 0, "londonBlock": 0, "mergeNetsplitBlock": 0},
     "Paris": {"berlinBlock": 0, "londonBlock": 0, "mergeNetsplitBlock": 0},
     "Shanghai": {"berlinBlock": 0, "londonBlock": 0, "mergeNetsplitBlock": 0,
@@ -216,24 +239,32 @@ def execute_case(case: StateTestCase):
     (state-test semantics: rejected txs burn nothing), and error_str carries
     the rejection reason.
     """
-    config = ChainConfig.from_json(
-        dict(_FORK_CONFIGS[case.fork], chainId=1,
-             terminalTotalDifficulty=0))
+    cfg_json = dict(_FORK_CONFIGS[case.fork])
+    cfg_json.setdefault("terminalTotalDifficulty", 0)
+    cfg_json["chainId"] = 1
+    config = ChainConfig.from_json(cfg_json)
     store = Store()
     genesis = Genesis(config=config, alloc=case.pre)
     pre_root = store.init_genesis(genesis).state_root
 
     env = case.env
+    from ..primitives.genesis import Fork
+
+    number = _num(env.get("currentNumber", 1), 1)
+    timestamp = _num(env.get("currentTimestamp", 1000), 1000)
+    pre_london = config.fork_at(number, timestamp) < Fork.LONDON
     block = BlockEnv(
-        number=_num(env.get("currentNumber", 1), 1),
+        number=number,
         coinbase=_addr(env.get("currentCoinbase", "0x" + "00" * 20)),
-        timestamp=_num(env.get("currentTimestamp", 1000), 1000),
+        timestamp=timestamp,
         gas_limit=_num(env.get("currentGasLimit", 30_000_000)),
         prev_randao=_hexb(env.get("currentRandom",
                                   env.get("currentDifficulty",
                                           "0x" + "00" * 32))
                           ).rjust(32, b"\x00"),
-        base_fee=_num(env.get("currentBaseFee", 10)),
+        # no base fee before EIP-1559: the whole gas price goes to the
+        # coinbase and nothing is burned
+        base_fee=0 if pre_london else _num(env.get("currentBaseFee", 10)),
         excess_blob_gas=_num(env.get("currentExcessBlobGas", 0)),
         difficulty=_num(env.get("currentDifficulty", 0)),
     )
