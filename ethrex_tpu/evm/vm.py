@@ -346,6 +346,9 @@ class EVM:
         n = len(code)
         handlers = _handlers_for(self.fork)
         step = getattr(self.tracer, "step", None) if self.tracer else None
+        if step is None and _native_available() and (
+                n >= _NATIVE_MIN_CODE or _native_forced()):
+            return self._run_native(f, handlers)
         if step is not None:
             # opcode-level tracing variant: the hot path below stays free
             # of per-step hooks (reference: monomorphized dispatch,
@@ -367,6 +370,47 @@ class EVM:
             f.pc += 1
             handler(self, f)
         raise _Halt(b"")
+
+    def _run_native(self, f: Frame, handlers):
+        """Hybrid dispatch: the C++ loop (native/evm.cpp) runs frame-local
+        opcodes; state/env/call opcodes escape to the canonical Python
+        handlers one at a time and the loop re-enters."""
+        from . import native_vm as nv
+
+        lib = nv._load()
+        nf = nv.NativeFrame(lib, f.code, f.msg.data, f.gas,
+                            self.sched.exp_byte,
+                            _native_mask_for(self.fork))
+        try:
+            while True:
+                rc = nf.run()
+                if rc == nv.HALT_ESCAPE:
+                    nf.pull_into(f)
+                    op = f.code[f.pc]
+                    handler = handlers[op]
+                    if handler is None:
+                        raise InvalidOpcode(hex(op))
+                    f.pc += 1
+                    handler(self, f)   # may raise _Halt / VMError
+                    nf.push_from(f)
+                    continue
+                if rc in (nv.HALT_STOP, nv.HALT_CODE_END):
+                    f.gas = lib.evm_gas(nf.ptr)
+                    raise _Halt(b"")
+                if rc in (nv.HALT_RETURN, nv.HALT_REVERT):
+                    nf.pull_into(f)
+                    off, length = nf.output()
+                    raise _Halt(bytes(f.memory[off:off + length]),
+                                reverted=(rc == nv.HALT_REVERT))
+                if rc == nv.HALT_OOG:
+                    raise OutOfGas("native frame")
+                if rc == nv.HALT_INVALID_JUMP:
+                    raise InvalidJump("native frame")
+                if rc == nv.HALT_STACK:
+                    raise StackError("native frame")
+                raise InvalidOpcode("native frame")
+        finally:
+            nf.close()
 
 
 # ---------------------------------------------------------------------------
@@ -1103,6 +1147,45 @@ def _selfdestruct(evm, f):
 # ---------------------------------------------------------------------------
 
 _HANDLERS: list = [None] * 256
+
+_NATIVE_MASKS: dict = {}
+_NATIVE_STATE: list = [None]   # [None]=unprobed, [True]/[False]=resolved
+
+
+_NATIVE_MIN_CODE = 64
+
+
+def _native_available() -> bool:
+    if _NATIVE_STATE[0] is None:
+        from . import native_vm as nv
+
+        _NATIVE_STATE[0] = nv.available()
+    return _NATIVE_STATE[0]
+
+
+_NATIVE_FORCED: list = [None]
+
+
+def _native_forced() -> bool:
+    # resolved per-call from the env var but with the import cached; the
+    # tests flip the variable at runtime (and reset _NATIVE_STATE), so a
+    # full once-only cache would break them — keep just the cheap lookup
+    if _NATIVE_FORCED[0] is None:
+        from . import native_vm as nv
+
+        _NATIVE_FORCED[0] = nv.forced
+    return _NATIVE_FORCED[0]()
+
+
+def _native_mask_for(fork) -> bytes:
+    mask = _NATIVE_MASKS.get(fork)
+    if mask is None:
+        from . import native_vm as nv
+
+        mask = nv.native_op_mask(fork)
+        _NATIVE_MASKS[fork] = mask
+    return mask
+
 
 # opcodes by the fork that introduced them (removed from earlier forks'
 # tables; reference: fork-gated const tables, levm/src/opcodes.rs:450-657)

@@ -1,0 +1,149 @@
+"""Native EVM hot loop (native/evm.cpp): differential conformance against
+the pure-Python interpreter and a hot-loop throughput sanity check.
+
+The EF fixture ladder pins post-state roots + logs digests produced by
+the PYTHON interpreter; running the same fixtures with the native loop
+FORCED is a full differential test of every handled opcode's semantics
+and gas across all 14 forks.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ethrex_tpu.evm import native_vm
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures", "ef_state")
+
+
+def test_native_extension_builds():
+    assert native_vm.available()
+
+
+def test_differential_ef_forks_ladder():
+    """The whole fork-ladder fixture set, native loop forced, in a child
+    process (the force switch is read per-frame but the library state is
+    process-global; a child keeps this hermetic)."""
+    code = (
+        "import os; os.environ['ETHREX_TPU_NATIVE_EVM'] = '1';"
+        "from ethrex_tpu.utils import ef_state;"
+        f"p, f = ef_state.run_directory({FIXDIR + '/forks'!r});"
+        "print(len(p), len(f));"
+        "assert not f, [r.detail for r in f[:3]];"
+    )
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=600,
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stderr[-800:]
+    n_pass = int(proc.stdout.split()[0])
+    assert n_pass > 4000
+
+
+def _loop_code(n):
+    """Countdown loop: PUSH2 n; [JUMPDEST DUP1 ISZERO PUSH2 exit JUMPI
+    PUSH1 1 SWAP1 SUB PUSH2 3 JUMP] exit: JUMPDEST STOP."""
+    return bytes([0x61, n >> 8, n & 0xFF,
+                  0x5B, 0x80, 0x15, 0x61, 0x00, 0x12, 0x57,
+                  0x60, 0x01, 0x90, 0x03,
+                  0x61, 0x00, 0x03, 0x56,
+                  0x5B, 0x00])
+
+
+def _run_loop(iters):
+    from ethrex_tpu.evm.db import InMemorySource, StateDB
+    from ethrex_tpu.evm.vm import EVM, BlockEnv, Frame, Message, _Halt
+    from ethrex_tpu.primitives.genesis import ChainConfig
+
+    cfg = ChainConfig(chain_id=1)
+    cfg.block_forks = {}
+    cfg.terminal_total_difficulty = 0
+    evm = EVM(StateDB(InMemorySource()),
+              BlockEnv(number=1, timestamp=10**9), cfg)
+    code = _loop_code(iters)
+    msg = Message(caller=b"\x01" * 20, to=b"\x02" * 20,
+                  code_address=b"\x02" * 20, value=0, data=b"",
+                  gas=50_000_000, code=code)
+    f = Frame(msg, code)
+    t0 = time.perf_counter()
+    try:
+        evm._run(f)
+    except _Halt:
+        pass
+    return time.perf_counter() - t0, 50_000_000 - f.gas
+
+
+@pytest.mark.skipif(not native_vm.available(), reason="no native evm")
+def test_hot_loop_throughput_and_gas_parity(monkeypatch):
+    import ethrex_tpu.evm.vm as vm_mod
+
+    # python reference
+    monkeypatch.setenv("ETHREX_TPU_NATIVE_EVM", "0")
+    vm_mod._NATIVE_STATE[0] = None
+    t_py, gas_py = _run_loop(20000)
+    # native (code is 20 bytes < threshold, so force)
+    monkeypatch.setenv("ETHREX_TPU_NATIVE_EVM", "1")
+    vm_mod._NATIVE_STATE[0] = None
+    t_nat, gas_nat = _run_loop(20000)
+    vm_mod._NATIVE_STATE[0] = None
+    monkeypatch.delenv("ETHREX_TPU_NATIVE_EVM")
+
+    assert gas_py == gas_nat          # exact gas parity
+    # the native loop must be dramatically faster on hot code; 5x is a
+    # deliberately loose floor for contended CI boxes (measured 28-60x)
+    assert t_nat * 5 < t_py, (t_nat, t_py)
+    assert gas_nat / t_nat > 50e6     # >= 50 Mgas/s on the hot loop
+
+
+@pytest.mark.skipif(not native_vm.available(), reason="no native evm")
+def test_escape_roundtrip_preserves_state(monkeypatch):
+    """A contract mixing native ops with escaping SLOAD/SSTORE: the
+    hybrid must produce the same storage and gas as pure Python."""
+    from ethrex_tpu.evm.db import InMemorySource, StateDB
+    from ethrex_tpu.evm.vm import EVM, BlockEnv, Frame, Message, _Halt
+    from ethrex_tpu.primitives.genesis import ChainConfig
+
+    # for i in 0..63: sstore(i, i*3+1)   (SSTORE escapes, arithmetic is
+    # native); pad to >= 64 bytes so the auto heuristic kicks in
+    code = bytearray()
+    for i in range(64):
+        v = i * 3 + 1
+        code += bytes([0x61, v >> 8, v & 0xFF])   # PUSH2 v
+        code += bytes([0x60, i])                  # PUSH1 i
+        code += bytes([0x55])                     # SSTORE
+    code += b"\x00"
+
+    def run(native):
+        monkeypatch.setenv("ETHREX_TPU_NATIVE_EVM",
+                           "1" if native else "0")
+        import ethrex_tpu.evm.vm as vm_mod
+
+        vm_mod._NATIVE_STATE[0] = None
+        cfg = ChainConfig(chain_id=1)
+        cfg.block_forks = {}
+        cfg.terminal_total_difficulty = 0
+        state = StateDB(InMemorySource())
+        evm = EVM(state, BlockEnv(number=1, timestamp=10**9), cfg)
+        msg = Message(caller=b"\x01" * 20, to=b"\x02" * 20,
+                      code_address=b"\x02" * 20, value=0, data=b"",
+                      gas=10_000_000, code=bytes(code))
+        f = Frame(msg, bytes(code))
+        try:
+            evm._run(f)
+        except _Halt:
+            pass
+        storage = {s: state.get_storage(b"\x02" * 20, s)
+                   for s in range(64)}
+        return f.gas, storage
+
+    gas_py, st_py = run(False)
+    gas_nat, st_nat = run(True)
+    import ethrex_tpu.evm.vm as vm_mod
+
+    vm_mod._NATIVE_STATE[0] = None
+    monkeypatch.delenv("ETHREX_TPU_NATIVE_EVM")
+    assert gas_py == gas_nat
+    assert st_py == st_nat == {i: i * 3 + 1 for i in range(64)}
