@@ -270,6 +270,34 @@ class Blockchain:
                 raise InvalidBlock(
                     f"requests hash mismatch in block {header.number}")
 
+    def regenerate_head_state(self) -> int:
+        """Re-execute the canonical tail whose trie nodes never reached
+        the durable backend (diff layering keeps unfinalized state in
+        RAM; a restart must rebuild it — the reference makes the same
+        trade, ethrex.rs:62-64 / initializers regenerate_head_state).
+
+        Walks back from the head to the newest ancestor whose state root
+        resolves, then re-imports forward.  Layers flatten oldest-first
+        and atomically per block, so root presence implies completeness.
+        Returns the number of re-imported blocks."""
+        head = self.store.head_header()
+        if head is None or self.store.nodes.get(head.state_root) is not None:
+            return 0
+        tail = []
+        cursor = head
+        while cursor.number > 0 and \
+                self.store.nodes.get(cursor.state_root) is None:
+            body = self.store.get_body(cursor.hash)
+            if body is None:
+                break
+            tail.append(Block(header=cursor, body=body))
+            cursor = self.store.get_header(cursor.parent_hash)
+            if cursor is None:
+                break
+        for block in reversed(tail):
+            self.add_block(block)
+        return len(tail)
+
     def add_block(self, block: Block) -> None:
         header = block.header
         parent = self.store.get_header(header.parent_hash)
@@ -277,14 +305,24 @@ class Blockchain:
             raise InvalidBlock("unknown parent")
         self.validate_header(header, parent)
         self._validate_body_roots(block)
-        outcome = self.execute_block(block, parent)
-        self._validate_block_outcome(header, outcome)
-        new_root = self.store.apply_account_updates(
-            parent.state_root, outcome.state_db)
-        if new_root != header.state_root:
-            raise InvalidBlock(
-                f"state root mismatch: {new_root.hex()} != "
-                f"{header.state_root.hex()}")
+        # diff layering (storage/layering.py): this block's trie nodes go
+        # into a per-block in-memory layer; settling flattens layers to
+        # the durable backend once finalized (or past the settle window)
+        self.store.push_node_layer(header.number, header.hash)
+        try:
+            outcome = self.execute_block(block, parent)
+            self._validate_block_outcome(header, outcome)
+            new_root = self.store.apply_account_updates(
+                parent.state_root, outcome.state_db)
+            if new_root != header.state_root:
+                raise InvalidBlock(
+                    f"state root mismatch: {new_root.hex()} != "
+                    f"{header.state_root.hex()}")
+        except BaseException:
+            # a failed import must not leak an orphaned top layer that
+            # would absorb unrelated writes (review finding)
+            self.store.discard_node_layer(header.number, header.hash)
+            raise
         self.store.add_block(block, outcome.receipts)
 
     def add_blocks_pipelined(self, blocks: list[Block]) -> None:

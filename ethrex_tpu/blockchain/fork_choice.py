@@ -42,4 +42,9 @@ def apply_fork_choice(store: Store, head_hash: bytes,
         store.meta["safe"] = safe_hash
     if finalized_hash:
         store.meta["finalized"] = finalized_hash
+        fin = store.get_header(finalized_hash)
+        if fin is not None:
+            # flatten finalized canonical layers to the durable backend;
+            # demote finalized-height stale-branch layers to RAM only
+            store.finalize_node_layers(fin.number)
     return head

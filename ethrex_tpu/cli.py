@@ -139,7 +139,11 @@ def _open_store(datadir: str | None):
     from .storage.store import Store
 
     os.makedirs(datadir, exist_ok=True)
-    return Store(PersistentBackend(os.path.join(datadir, "chain.db")))
+    store = Store(PersistentBackend(os.path.join(datadir, "chain.db")))
+    # diff layering: trie nodes reach the durable log only once finalized
+    # (stale branches stay RAM-only; storage/layering.py)
+    store.enable_layering()
+    return store
 
 
 def _decode_chain_file(path: str):

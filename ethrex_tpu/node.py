@@ -23,6 +23,7 @@ class Node:
         self.genesis_header = self.store.init_genesis(genesis)
         self.config = genesis.config
         self.chain = Blockchain(self.store, self.config)
+        self.chain.regenerate_head_state()
         self.mempool = Mempool()
         self.coinbase = coinbase
         self._producer_thread = None

@@ -33,6 +33,7 @@ from ..primitives.account import (AccountState, EMPTY_CODE_HASH,
                                   EMPTY_TRIE_ROOT)
 from ..primitives import rlp
 from ..trie.trie import Trie, hp_decode
+from ..trie.trie_sorted import build_from_sorted
 from ..trie.verify_range import RangeProofError, verify_range
 from .snap import MAX_RESPONSE_ITEMS, SnapError
 
@@ -156,20 +157,29 @@ class SnapSyncer:
         if acct.storage_root == EMPTY_TRIE_ROOT or \
                 acct.storage_root in self.store.nodes:
             return
-        st = Trie.from_nodes(EMPTY_TRIE_ROOT, self.store.nodes, share=True)
+        # pages arrive key-sorted and disjoint: the whole storage trie
+        # bulk-builds in one sorted pass (trie/trie_sorted.py — the
+        # reference's trie_sorted.rs seat; ~8x faster via the C++ engine)
+        all_slots: list = []
         origin = b"\x00" * 32
         while True:
             slots, _proof = peer.snap_get_storage_range(
                 self.pivot_root, account_hash, origin)
             if not slots:
                 break
-            for k, v in slots:
-                st.insert(k, v)
+            all_slots.extend(slots)
             if len(slots) < MAX_RESPONSE_ITEMS:
                 break
             origin = (int.from_bytes(slots[-1][0], "big") + 1) \
                 .to_bytes(32, "big")
-        if st.commit() != acct.storage_root:
+        try:
+            built_root, _ = build_from_sorted(all_slots, self.store.nodes)
+        except ValueError:
+            # peer-controlled pages can be unsorted/duplicated/empty —
+            # malformed input routes to healing like any mismatch
+            # instead of aborting the sync (review finding)
+            built_root = None
+        if built_root != acct.storage_root:
             # the peer may have re-pivoted mid-pagination; the healing
             # phase re-fetches this account's storage from its root (the
             # account leaf itself is range-proven, so the state-trie walk

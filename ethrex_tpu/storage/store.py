@@ -142,6 +142,62 @@ class Store:
         """Durability barrier (persistent backends); no-op in memory."""
         self.backend.flush()
 
+    # -- node-table diff layering (storage/layering.py) --------------------
+    def enable_layering(self) -> None:
+        """Stack per-block diff layers over the trie-node table: nodes
+        reach the durable backend only when their block finalizes
+        (reference seat: crates/storage/layering.rs)."""
+        from .layering import LayeredTable
+
+        if not isinstance(self.nodes, LayeredTable):
+            self.nodes = LayeredTable(self.nodes)
+
+    def layering_enabled(self) -> bool:
+        from .layering import LayeredTable
+
+        return isinstance(self.nodes, LayeredTable)
+
+    # chains without a finality signal (dev mode) still settle layers
+    # once they fall this many blocks behind the tip — bounding both the
+    # RAM window and the restart re-import tail
+    MAX_NODE_LAYERS = 64
+
+    def push_node_layer(self, number: int, block_hash: bytes) -> None:
+        if not self.layering_enabled():
+            return
+        self.nodes.push_layer((number, block_hash))
+        if len(self.nodes.layers) > self.MAX_NODE_LAYERS:
+            self._settle_node_layers(number - self.MAX_NODE_LAYERS)
+
+    def discard_node_layer(self, number: int, block_hash: bytes) -> None:
+        """Fold a failed import's layer into its surroundings."""
+        if self.layering_enabled():
+            self.nodes.merge_down((number, block_hash))
+
+    def finalize_node_layers(self, finalized_number: int) -> None:
+        """Flatten every layer at or below the finalized height into the
+        backend — INCLUDING stale-branch layers.  Dropping stale layers
+        would be unsound here: the node tables are content-addressed and
+        the native MPT engine de-duplicates, so a node first written by a
+        stale branch may be silently shared by the canonical chain
+        (review finding); selective dropping needs per-node refcounting,
+        which is future work.  What layering buys today is WRITE
+        BATCHING (one durable burst per settle instead of a per-block
+        trickle) and a bounded restart-regeneration tail."""
+        if self.layering_enabled():
+            self._settle_node_layers(finalized_number)
+
+    def _settle_node_layers(self, cutoff_number: int) -> None:
+        settled = False
+        for tag in list(self.nodes.layer_tags()):
+            number, _block_hash = tag
+            if number > cutoff_number:
+                continue
+            self.nodes.flatten_layer(tag)
+            settled = True
+        if settled:
+            self.flush()
+
     def head_header(self) -> BlockHeader:
         return self.headers[self.meta["head"]]
 

@@ -1,0 +1,90 @@
+"""Sorted bulk MPT construction: build a trie bottom-up from an ordered
+(key, value) stream in one pass — no per-insert path walks.
+
+The seat of the reference's `trie_sorted.rs` (crates/common/trie/
+trie_sorted.rs, used by snap-sync finalize): range downloads arrive
+key-sorted, so the trie's shape can be derived divide-and-conquer — the
+common nibble prefix of a sorted slice becomes an extension, the first
+divergent nibble splits it into branch children, and single items become
+leaves.  Every node is constructed exactly once (O(n) constructions vs
+O(n·depth) re-walks for repeated insert()), and the result is
+byte-identical to incremental insertion (tested against Trie.insert over
+randomized sets).
+"""
+
+from __future__ import annotations
+
+from .trie import EMPTY_TRIE_ROOT, Trie, bytes_to_nibbles
+
+
+def _build(items: list, lo: int, hi: int, depth: int):
+    """Node for the sorted slice items[lo:hi] below `depth` nibbles.
+    items = [(nibbles_tuple, value_bytes)]."""
+    if hi - lo == 1:
+        nibs, value = items[lo]
+        return ("leaf", nibs[depth:], value)
+    first = items[lo][0]
+    last = items[hi - 1][0]
+    # common prefix beyond depth (sorted slice: first/last bound all keys)
+    cp = 0
+    maxcp = min(len(first), len(last)) - depth
+    while cp < maxcp and first[depth + cp] == last[depth + cp]:
+        cp += 1
+    if cp > 0:
+        child = _build(items, lo, hi, depth + cp)
+        return ("ext", first[depth:depth + cp], child)
+    # branch at this depth: group by nibble; a key that ends exactly here
+    # supplies the branch value
+    children: list = [None] * 16
+    bval = b""
+    i = lo
+    if len(first) == depth:
+        bval = items[lo][1]
+        i += 1
+    while i < hi:
+        nib = items[i][0][depth]
+        j = i + 1
+        while j < hi and items[j][0][depth] == nib:
+            j += 1
+        children[nib] = _build(items, i, j, depth + 1)
+        i = j
+    return ("branch", children, bval)
+
+
+def build_from_sorted(pairs, nodes: dict | None = None,
+                      use_native: bool = True):
+    """Build an MPT from sorted, de-duplicated (key, value) pairs.
+
+    Returns (root_hash, trie) with every node encoded into `nodes` (a
+    shared node table when given).  Pairs must be strictly increasing by
+    key and carry non-empty values; violations raise ValueError.
+
+    When the C++ MPT engine is available the batch goes through it (the
+    same engine the importer's merkleize step uses — ~an order of
+    magnitude faster than Python node construction); the Python
+    bottom-up builder is the fallback and the differential reference.
+    """
+    store = nodes if nodes is not None else {}
+    items = []
+    prev = None
+    for key, value in pairs:
+        if prev is not None and key <= prev:
+            raise ValueError("keys must be strictly increasing")
+        if not value:
+            raise ValueError("empty value in sorted build")
+        prev = key
+        items.append((bytes(key), bytes(value)))
+    if not items:
+        return EMPTY_TRIE_ROOT, Trie(store)
+    if use_native:
+        from . import native_mpt
+
+        if native_mpt.available():
+            eng = native_mpt.NativeMpt()
+            root = eng.apply(store, EMPTY_TRIE_ROOT, items)
+            return root, Trie.from_nodes(root, store, share=True)
+    trie = Trie(store)
+    trie._root = _build([(bytes_to_nibbles(k), v) for k, v in items],
+                        0, len(items), 0)
+    root = trie.commit()
+    return root, trie

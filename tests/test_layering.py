@@ -1,0 +1,138 @@
+"""Node-table diff layering (storage/layering.py): finalized layers
+flatten to the durable backend, stale branches stay RAM-only, and a
+restart regenerates the unflattened tail by re-execution."""
+
+import os
+
+from ethrex_tpu.blockchain.fork_choice import apply_fork_choice
+from ethrex_tpu.crypto import secp256k1
+from ethrex_tpu.node import Node
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.primitives.transaction import Transaction
+from ethrex_tpu.storage.layering import LayeredTable
+from ethrex_tpu.storage.persistent import PersistentBackend
+from ethrex_tpu.storage.store import Store
+
+SECRET = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
+SENDER = secp256k1.pubkey_to_address(secp256k1.pubkey_from_secret(SECRET))
+GENESIS = {
+    "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+               "shanghaiTime": 0, "cancunTime": 0},
+    "alloc": {"0x" + SENDER.hex(): {"balance": hex(10**21)}},
+    "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7", "timestamp": "0x0",
+}
+
+
+def test_layered_table_semantics():
+    base = {b"a": b"1"}
+    t = LayeredTable(base)
+    t[b"direct"] = b"0"           # no layer open: straight to base
+    assert base[b"direct"] == b"0"
+    t.push_layer("blk1")
+    t[b"b"] = b"2"
+    t.push_layer("blk2")
+    t[b"c"] = b"3"
+    t[b"b"] = b"2'"               # newer layer shadows older
+    assert t[b"a"] == b"1" and t[b"b"] == b"2'" and t[b"c"] == b"3"
+    assert b"b" not in base
+    assert t.flatten_layer("blk1") == 1
+    assert base[b"b"] == b"2"     # blk1's value landed; blk2 still shadows
+    assert t[b"b"] == b"2'"
+    assert t.demote_layer("blk2") == 2
+    assert not t.layers
+    assert t[b"c"] == b"3" and b"c" not in base   # RAM overlay only
+
+
+def _tx(nonce, value=100):
+    return Transaction(
+        tx_type=2, chain_id=1337, nonce=nonce,
+        max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+        gas_limit=21_000, to=bytes([0x42]) * 20, value=value).sign(SECRET)
+
+
+def test_finalization_flattens_and_restart_regenerates(tmp_path):
+    db = os.path.join(tmp_path, "chain.db")
+    store = Store(PersistentBackend(db))
+    store.enable_layering()
+    node = Node(Genesis.from_json(GENESIS), store=store)
+    hashes = []
+    for n in range(4):
+        node.submit_transaction(_tx(n))
+        blk = node.produce_block()
+        hashes.append(blk.header.hash)
+    # nothing finalized yet: all four block layers are unflattened
+    assert len(store.nodes.layers) == 4
+    # finalize block 2: layers 1-2 flatten, 3-4 remain
+    apply_fork_choice(store, hashes[-1], finalized_hash=hashes[1])
+    assert [t for t, _ in store.nodes.layers] == \
+        [(3, hashes[2]), (4, hashes[3])]
+    head_root = node.head_state_root()
+    head_bal = store.account_state(head_root, bytes([0x42]) * 20).balance
+    assert head_bal == 400
+    store.flush()
+    store.backend.close()
+
+    # crash: reopen the database; the unflattened tail (blocks 3-4) must
+    # regenerate by re-execution
+    store2 = Store(PersistentBackend(db))
+    store2.enable_layering()
+    assert store2.nodes.get(head_root) is None   # tail wasn't persisted
+    node2 = Node(Genesis.from_json(GENESIS), store=store2)
+    assert node2.head_state_root() == head_root
+    bal = store2.account_state(head_root, bytes([0x42]) * 20).balance
+    assert bal == 400
+
+
+def test_settle_flattens_side_branches_too(tmp_path):
+    """Settling flattens EVERY layer at or below the cutoff — stale
+    branches included: content-addressed node tables plus the native
+    engine's de-duplication mean a node first written by a stale branch
+    can be shared by the canonical chain, so selective dropping would be
+    unsound (review finding; refcounting is future work)."""
+    db = os.path.join(tmp_path, "chain.db")
+    store = Store(PersistentBackend(db))
+    store.enable_layering()
+    node = Node(Genesis.from_json(GENESIS), store=store)
+    node.submit_transaction(_tx(0))
+    b1 = node.produce_block()
+    # a side block at the same height (different timestamp/coinbase)
+    from ethrex_tpu.blockchain.payload import (build_payload,
+                                               create_payload_header)
+
+    parent = store.get_header(b1.header.parent_hash)
+    side_header = create_payload_header(
+        parent, node.config, timestamp=b1.header.timestamp + 1,
+        coinbase=bytes([0x99]) * 20)
+    side = build_payload(node.chain, parent, side_header,
+                         [_tx(0, value=7)], []).block
+    node.chain.add_block(side)
+    assert len(store.nodes.layers) == 2
+    apply_fork_choice(store, b1.header.hash, finalized_hash=b1.header.hash)
+    assert not store.nodes.layers
+    # both states durable and readable
+    assert store.nodes.base.get(b1.header.state_root) is not None
+    assert store.nodes.get(side.header.state_root) is not None
+
+
+def test_failed_import_does_not_leak_a_layer(tmp_path):
+    import dataclasses
+
+    import pytest
+
+    from ethrex_tpu.blockchain.blockchain import InvalidBlock
+
+    db = os.path.join(tmp_path, "chain.db")
+    store = Store(PersistentBackend(db))
+    store.enable_layering()
+    node = Node(Genesis.from_json(GENESIS), store=store)
+    node.submit_transaction(_tx(0))
+    b1 = node.produce_block()
+    n_layers = len(store.nodes.layers)
+    # same block, corrupted state root: import must fail WITHOUT leaving
+    # an orphaned top layer behind
+    bad_header = dataclasses.replace(b1.header, timestamp=b1.header.timestamp + 1,
+                                     state_root=b"\x13" * 32)
+    bad = dataclasses.replace(b1, header=bad_header)
+    with pytest.raises(InvalidBlock):
+        node.chain.add_block(bad)
+    assert len(store.nodes.layers) == n_layers
