@@ -344,6 +344,13 @@ class Blockchain:
 
         if not blocks:
             return
+        self.store.push_node_layer(blocks[-1].header.number,
+                                   blocks[-1].header.hash)
+        # one diff layer per BATCH, tagged by its tail block: bulk-imported
+        # nodes settle when the tail settles instead of being attributed
+        # to whatever unrelated layer was open (review finding)
+        self.store.push_node_layer(blocks[-1].header.number,
+                                   blocks[-1].header.hash)
         parent = self.store.get_header(blocks[0].header.parent_hash)
         if parent is None:
             raise InvalidBlock("unknown parent")

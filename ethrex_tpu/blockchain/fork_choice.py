@@ -18,9 +18,14 @@ def apply_fork_choice(store: Store, head_hash: bytes,
     head = store.get_header(head_hash)
     if head is None:
         raise ForkChoiceError("unknown head block")
+    fin = None
     for name, h in (("safe", safe_hash), ("finalized", finalized_hash)):
-        if h and store.get_header(h) is None:
-            raise ForkChoiceError(f"unknown {name} block")
+        if h:
+            hdr = store.get_header(h)
+            if hdr is None:
+                raise ForkChoiceError(f"unknown {name} block")
+            if name == "finalized":
+                fin = hdr
 
     # collect the branch from head back to a canonical ancestor
     branch = []
@@ -42,9 +47,7 @@ def apply_fork_choice(store: Store, head_hash: bytes,
         store.meta["safe"] = safe_hash
     if finalized_hash:
         store.meta["finalized"] = finalized_hash
-        fin = store.get_header(finalized_hash)
-        if fin is not None:
-            # flatten finalized canonical layers to the durable backend;
-            # demote finalized-height stale-branch layers to RAM only
-            store.finalize_node_layers(fin.number)
+        # flatten every layer at or below the finalized height to the
+        # durable backend (see Store.finalize_node_layers)
+        store.finalize_node_layers(fin.number)
     return head

@@ -1,0 +1,358 @@
+"""OnChainProposer + CommonBridge settlement state machine — a
+rule-for-rule behavioral port of the reference's L1 contracts
+(/root/reference/crates/l2/contracts/src/l1/OnChainProposer.sol:226-687,
+CommonBridge.sol:135-687), re-expressed in Python with the SAME revert
+conditions under the SAME identifiers so every guard is testable
+case-by-case (tests/test_proposer_rules.py).
+
+This is the semantic core the in-process dev L1 (l2/l1_client.InMemoryL1
+and the RPC-deployable rule engine in l2/l1_contract.py) enforces; a
+future round compiles the real .sol artifacts, but the STATE MACHINE —
+commit succession, versioned-hash binding of privileged txs, the
+expiry-forces-inclusion rule, verify-time queue consumption, withdrawal
+claims against verified batches, pause/revert flows — is what settlement
+correctness rests on, and it lives here in one auditable place.
+
+Conventions mirrored from the contracts:
+  * versioned hash = bytes2(count) || low-30-bytes(keccak(hash_0..count))
+    (CommonBridge.getPendingTransactionsVersionedHash:341-360);
+  * privileged tx hash = keccak(chain_id32 || from20 || to20 || id32 ||
+    value32 || gas_limit32 || keccak(data)32) (_sendToL2:253-270);
+  * withdrawal leaf = keccak(l2_bridge20 || msg_hash32 || id32) proven
+    into the batch's published withdrawal-log Merkle root
+    (_verifyMessageProof:640-655);
+  * commitments of verified batches are pruned (n-1 on verify).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..crypto.keccak import keccak256
+
+ETH_TOKEN = b"\x00" * 20
+ADDRESS_ALIASING = 0xEE110000000000000000000000000000000011FF
+
+
+class Revert(Exception):
+    """A contract-rule violation; `ident` matches the reference's custom
+    error / require message identity."""
+
+    def __init__(self, ident: str):
+        super().__init__(ident)
+        self.ident = ident
+
+
+def alias_sender(addr: bytes, is_contract: bool) -> bytes:
+    """L1->L2 address aliasing for contract callers (CommonBridge
+    _getSenderAlias:239-251; EOAs and EIP-7702 delegates pass through)."""
+    if not is_contract:
+        return addr
+    return ((int.from_bytes(addr, "big") + ADDRESS_ALIASING)
+            % (1 << 160)).to_bytes(20, "big")
+
+
+def versioned_hash(count: int, hashes: list[bytes]) -> bytes:
+    """bytes2(count) | uint240(keccak(concat(hashes[:count])))."""
+    digest = keccak256(b"".join(hashes[:count]))
+    return count.to_bytes(2, "big") + digest[2:]
+
+
+def privileged_tx_hash(chain_id: int, from_addr: bytes, to: bytes,
+                       tx_id: int, value: int, gas_limit: int,
+                       data: bytes) -> bytes:
+    return keccak256(
+        chain_id.to_bytes(32, "big") + from_addr + to
+        + tx_id.to_bytes(32, "big") + value.to_bytes(32, "big")
+        + gas_limit.to_bytes(32, "big") + keccak256(data))
+
+
+def withdrawal_leaf(l2_bridge: bytes, msg_hash: bytes,
+                    message_id: int) -> bytes:
+    return keccak256(l2_bridge + msg_hash + message_id.to_bytes(32, "big"))
+
+
+def merkle_verify(proof: list[bytes], root: bytes, leaf: bytes) -> bool:
+    """OpenZeppelin MerkleProof.verify: sorted-pair hashing."""
+    node = leaf
+    for sib in proof:
+        a, b = (node, sib) if node <= sib else (sib, node)
+        node = keccak256(a + b)
+    return node == root
+
+
+@dataclasses.dataclass
+class BatchCommitment:
+    new_state_root: bytes
+    blob_versioned_hash: bytes
+    privileged_rolling_hash: bytes
+    withdrawals_root: bytes
+    last_block_hash: bytes
+    non_privileged_count: int
+    commit_hash: bytes
+
+
+class CommonBridgeRules:
+    """The bridge's queue/claim state (CommonBridge.sol)."""
+
+    def __init__(self, chain_id: int, l2_bridge: bytes,
+                 l2_gas_limit: int = 21_000 * 5,
+                 privileged_wait: int = 60 * 60 * 24 * 15):
+        self.chain_id = chain_id
+        self.l2_bridge = l2_bridge
+        self.l2_gas_limit = l2_gas_limit
+        self.privileged_wait = privileged_wait
+        self.pending_tx_hashes: list[bytes] = []
+        self.pending_index = 0
+        self.tx_deadline: dict[bytes, int] = {}
+        self.transaction_id = 0
+        self.deposits_pool = 0          # ETH locked (deposits mapping)
+        self.withdrawal_roots: dict[int, bytes] = {}
+        self.claimed_ids: set[int] = set()
+        self.proposer = None            # set by wire-up
+        self.paused = False
+
+    # -- L1 -> L2 ----------------------------------------------------------
+    def send_to_l2(self, sender: bytes, to: bytes, value: int,
+                   gas_limit: int, data: bytes, now: int,
+                   is_contract: bool = False) -> bytes:
+        if self.paused:
+            raise Revert("EnforcedPause")
+        if gas_limit > self.l2_gas_limit:
+            raise Revert("CommonBridge: gasLimit exceeds l2GasLimit")
+        from_addr = alias_sender(sender, is_contract)
+        h = privileged_tx_hash(self.chain_id, from_addr, to,
+                               self.transaction_id, value, gas_limit, data)
+        self.pending_tx_hashes.append(h)
+        self.tx_deadline[h] = now + self.privileged_wait
+        self.transaction_id += 1
+        return h
+
+    def deposit(self, sender: bytes, l2_recipient: bytes, value: int,
+                now: int, is_contract: bool = False) -> bytes:
+        self.deposits_pool += value
+        return self.send_to_l2(sender, l2_recipient, value,
+                               self.l2_gas_limit, b"", now,
+                               is_contract=is_contract)
+
+    # -- queue views / consumption ----------------------------------------
+    def _pending_len(self) -> int:
+        return len(self.pending_tx_hashes) - self.pending_index
+
+    def pending_versioned_hash(self, count: int) -> bytes:
+        if count == 0:
+            raise Revert("CommonBridge: number is zero (get)")
+        if count > self._pending_len():
+            raise Revert("CommonBridge: number is greater than the length "
+                         "of pendingTxHashes (get)")
+        window = self.pending_tx_hashes[
+            self.pending_index:self.pending_index + count]
+        return versioned_hash(count, window)
+
+    def remove_pending(self, count: int, caller_is_proposer: bool) -> None:
+        if not caller_is_proposer:
+            raise Revert("onlyOnChainProposer")
+        if count > self._pending_len():
+            raise Revert("CommonBridge: number is greater than the length "
+                         "of pendingTxHashes (remove)")
+        self.pending_index += count
+
+    def has_expired_privileged(self, now: int) -> bool:
+        if self._pending_len() == 0:
+            return False
+        head = self.pending_tx_hashes[self.pending_index]
+        return now > self.tx_deadline[head]
+
+    # -- withdrawals -------------------------------------------------------
+    def publish_withdrawals(self, batch: int, root: bytes,
+                            caller_is_proposer: bool) -> None:
+        if not caller_is_proposer:
+            raise Revert("onlyOnChainProposer")
+        if self.withdrawal_roots.get(batch):
+            raise Revert("CommonBridge: withdrawal logs already published")
+        self.withdrawal_roots[batch] = root
+
+    def claim_withdrawal(self, claimer: bytes, amount: int, batch: int,
+                         message_id: int, proof: list[bytes]) -> None:
+        if self.paused:
+            raise Revert("EnforcedPause")
+        if self.deposits_pool < amount:
+            raise Revert("CommonBridge: trying to withdraw more tokens/ETH "
+                         "than were deposited")
+        msg_hash = keccak256(ETH_TOKEN + ETH_TOKEN + claimer
+                             + amount.to_bytes(32, "big"))
+        root = self.withdrawal_roots.get(batch)
+        if not root:
+            raise Revert("CommonBridge: the batch that emitted the "
+                         "withdrawal logs was not committed")
+        if self.proposer is None or batch > self.proposer.last_verified:
+            raise Revert("CommonBridge: the batch that emitted the "
+                         "withdrawal logs was not verified")
+        if message_id in self.claimed_ids:
+            raise Revert("CommonBridge: the withdrawal was already claimed")
+        self.claimed_ids.add(message_id)
+        leaf = withdrawal_leaf(self.l2_bridge, msg_hash, message_id)
+        if not merkle_verify(proof, root, leaf):
+            raise Revert("CommonBridge: Invalid proof")
+        self.deposits_pool -= amount
+
+
+class OnChainProposerRules:
+    """The proposer's commit/verify/revert state (OnChainProposer.sol)."""
+
+    def __init__(self, bridge: CommonBridgeRules, owner: bytes,
+                 needed_proof_types: list[str], validium: bool = False):
+        self.bridge = bridge
+        bridge.proposer = self
+        self.owner = owner
+        self.needed = list(needed_proof_types)
+        self.validium = validium
+        self.paused = False
+        self.last_committed = 0
+        self.last_verified = 0
+        self.commitments: dict[int, BatchCommitment] = {}
+        # verificationKeys[commit_hash][prover_type]
+        self.verification_keys: dict[bytes, dict[str, bytes]] = {}
+        # the verifier seam: type -> fn(vk, public_inputs, proof) -> bool
+        self.verifiers: dict[str, object] = {}
+
+    # -- admin -------------------------------------------------------------
+    def _only_owner(self, caller: bytes) -> None:
+        if caller != self.owner:
+            raise Revert("OwnableUnauthorizedAccount")
+
+    def _when_not_paused(self) -> None:
+        if self.paused:
+            raise Revert("EnforcedPause")
+
+    def pause(self, caller: bytes) -> None:
+        self._only_owner(caller)
+        self.paused = True
+
+    def unpause(self, caller: bytes) -> None:
+        self._only_owner(caller)
+        self.paused = False
+
+    def set_verification_key(self, caller: bytes, commit_hash: bytes,
+                             prover_type: str, vk: bytes) -> None:
+        self._only_owner(caller)
+        if commit_hash == b"\x00" * 32:
+            raise Revert("CommitHashIsZero")
+        self.verification_keys.setdefault(commit_hash, {})[prover_type] = vk
+
+    # -- commit ------------------------------------------------------------
+    def commit_batch(self, caller: bytes, batch_number: int,
+                     new_state_root: bytes, withdrawals_root: bytes,
+                     privileged_rolling_hash: bytes, last_block_hash: bytes,
+                     non_privileged_count: int, commit_hash: bytes,
+                     blob_versioned_hash: bytes = b"") -> None:
+        self._only_owner(caller)
+        self._when_not_paused()
+        if batch_number != self.last_committed + 1:
+            raise Revert("BatchNumberNotSuccessor")
+        if batch_number in self.commitments:
+            raise Revert("BatchAlreadyCommitted")
+        if last_block_hash == b"\x00" * 32 or not last_block_hash:
+            raise Revert("LastBlockHashIsZero")
+        if privileged_rolling_hash and \
+                privileged_rolling_hash != b"\x00" * 32:
+            count = int.from_bytes(privileged_rolling_hash[:2], "big")
+            if self.bridge.pending_versioned_hash(count) != \
+                    privileged_rolling_hash:
+                raise Revert("InvalidPrivilegedTransactionLogs")
+        if withdrawals_root and withdrawals_root != b"\x00" * 32:
+            self.bridge.publish_withdrawals(batch_number, withdrawals_root,
+                                            caller_is_proposer=True)
+        if self.validium:
+            if blob_versioned_hash:
+                raise Revert("ValidiumBlobPublished")
+        else:
+            if not blob_versioned_hash:
+                raise Revert("RollupBlobNotPublished")
+        if not commit_hash or commit_hash == b"\x00" * 32:
+            raise Revert("CommitHashIsZero")
+        keys = self.verification_keys.get(commit_hash, {})
+        for t in self.needed:
+            if not keys.get(t):
+                raise Revert("MissingVerificationKeyForCommit")
+        self.commitments[batch_number] = BatchCommitment(
+            new_state_root=new_state_root,
+            blob_versioned_hash=blob_versioned_hash,
+            privileged_rolling_hash=privileged_rolling_hash or b"",
+            withdrawals_root=withdrawals_root or b"",
+            last_block_hash=last_block_hash,
+            non_privileged_count=non_privileged_count,
+            commit_hash=commit_hash)
+        self.last_committed = batch_number
+
+    # -- verify ------------------------------------------------------------
+    def public_inputs(self, batch_number: int) -> bytes:
+        """The statement the proofs bind (commitment reconstruction,
+        _getPublicInputsFromCommitment): previous root || new root ||
+        withdrawals root || privileged rolling hash || last block hash ||
+        blob versioned hash."""
+        cur = self.commitments[batch_number]
+        prev = self.commitments.get(batch_number - 1)
+        prev_root = prev.new_state_root if prev else b"\x00" * 32
+        return (prev_root + cur.new_state_root
+                + (cur.withdrawals_root or b"\x00" * 32)
+                + (cur.privileged_rolling_hash or b"\x00" * 32)
+                + cur.last_block_hash
+                + (cur.blob_versioned_hash or b"\x00" * 32).ljust(32, b"\x00"))
+
+    def verify_batches(self, caller: bytes, first_batch: int,
+                       proofs: dict[str, list[bytes]], now: int = 0) -> None:
+        """proofs: prover_type -> per-batch proof bytes list."""
+        self._only_owner(caller)
+        self._when_not_paused()
+        counts = {len(v) for v in proofs.values()} or {0}
+        if counts == {0}:
+            raise Revert("EmptyBatchArray")
+        if len(counts) != 1:
+            raise Revert("BatchArrayLengthMismatch")
+        n = counts.pop()
+        for i in range(n):
+            self._verify_one(first_batch + i,
+                             {t: v[i] for t, v in proofs.items()}, now)
+
+    def _verify_one(self, batch_number: int, proofs: dict[str, bytes],
+                    now: int) -> None:
+        if batch_number != self.last_verified + 1:
+            raise Revert("BatchNotSequential")
+        cur = self.commitments.get(batch_number)
+        if cur is None:
+            raise Revert("BatchNotCommitted")
+        count = int.from_bytes((cur.privileged_rolling_hash or b"\x00" * 2)
+                               [:2], "big")
+        if count > 0:
+            self.bridge.remove_pending(count, caller_is_proposer=True)
+        if self.bridge.has_expired_privileged(now) and \
+                cur.non_privileged_count != 0:
+            raise Revert("ExpiredPrivilegedTransactionDeadline")
+        pub = self.public_inputs(batch_number)
+        for t in self.needed:
+            vk = self.verification_keys.get(cur.commit_hash, {}).get(t)
+            verifier = self.verifiers.get(t)
+            ok = False
+            if verifier is not None:
+                try:
+                    ok = bool(verifier(vk, pub, proofs.get(t, b"")))
+                except Exception:
+                    ok = False
+            if not ok:
+                raise Revert(f"Invalid{t.capitalize()}Proof")
+        self.last_verified = batch_number
+        self.commitments.pop(batch_number - 1, None)
+
+    # -- revert (pause-gated rollback of uncommitted work) -----------------
+    def revert_batch(self, caller: bytes, batch_number: int) -> None:
+        self._only_owner(caller)
+        if not self.paused:
+            raise Revert("ExpectedPause")
+        if batch_number <= self.last_verified:
+            raise Revert("CannotRevertVerifiedBatch")
+        if batch_number > self.last_committed:
+            raise Revert("NoBatchesToRevert")
+        for i in range(batch_number, self.last_committed + 1):
+            self.commitments.pop(i, None)
+        self.last_committed = batch_number - 1

@@ -93,7 +93,13 @@ class LayeredTable:
 
     # -- dict protocol -----------------------------------------------------
     def _lookup(self, key):
-        for _, writes in reversed(self.layers):
+        # snapshot the layer list: settling (RPC fork-choice thread) may
+        # delete entries concurrently with reader threads, and a list
+        # iterator racing a del can skip LIVE layers entirely (review
+        # finding).  flatten writes base BEFORE deleting the layer, so a
+        # snapshot reader always finds the value in one place or the
+        # other.
+        for _, writes in reversed(tuple(self.layers)):
             v = writes.get(key, _MISSING)
             if v is not _MISSING:
                 return v
@@ -155,7 +161,7 @@ class LayeredTable:
 
     def keys(self):
         seen = set(self.base.keys()) | set(self.overlay.keys())
-        for _, w in self.layers:
+        for _, w in tuple(self.layers):
             seen |= set(w.keys())
         return seen
 

@@ -159,14 +159,19 @@ class Store:
 
     # chains without a finality signal (dev mode) still settle layers
     # once they fall this many blocks behind the tip — bounding both the
-    # RAM window and the restart re-import tail
+    # RAM window and the restart re-import tail.  STRIDE adds hysteresis
+    # so a full window settles ~once per STRIDE blocks in one burst
+    # instead of re-introducing a per-block fsync trickle (review
+    # finding)
     MAX_NODE_LAYERS = 64
+    SETTLE_STRIDE = 16
 
     def push_node_layer(self, number: int, block_hash: bytes) -> None:
         if not self.layering_enabled():
             return
         self.nodes.push_layer((number, block_hash))
-        if len(self.nodes.layers) > self.MAX_NODE_LAYERS:
+        if len(self.nodes.layers) > \
+                self.MAX_NODE_LAYERS + self.SETTLE_STRIDE:
             self._settle_node_layers(number - self.MAX_NODE_LAYERS)
 
     def discard_node_layer(self, number: int, block_hash: bytes) -> None:

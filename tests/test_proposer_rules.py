@@ -1,0 +1,259 @@
+"""Settlement state machine (l2/proposer_rules.py): every revert
+condition of the reference's OnChainProposer/CommonBridge exercised
+case-by-case (OnChainProposer.sol:226-687, CommonBridge.sol:135-687)."""
+
+import pytest
+
+from ethrex_tpu.crypto.keccak import keccak256
+from ethrex_tpu.l2.proposer_rules import (CommonBridgeRules,
+                                          OnChainProposerRules, Revert,
+                                          alias_sender, merkle_verify,
+                                          versioned_hash, withdrawal_leaf)
+
+OWNER = b"\xaa" * 20
+OTHER = b"\xbb" * 20
+L2_BRIDGE = b"\xfe" * 20
+USER = b"\xcc" * 20
+ROOT1 = b"\x11" * 32
+HASH1 = b"\x22" * 32
+COMMIT = b"\x33" * 32
+BLOB = b"\x01" + b"\x44" * 31
+
+
+def _fixture(needed=("tpu",), validium=False):
+    bridge = CommonBridgeRules(chain_id=1337, l2_bridge=L2_BRIDGE)
+    prop = OnChainProposerRules(bridge, OWNER, list(needed),
+                                validium=validium)
+    prop.set_verification_key(OWNER, COMMIT, "tpu", b"\x77" * 32)
+    prop.verifiers["tpu"] = lambda vk, pub, proof: proof == b"ok"
+    return bridge, prop
+
+
+def _commit(prop, n, *, priv=b"", wroot=b"", blob=BLOB, count=1,
+            commit=COMMIT, last_hash=HASH1, root=ROOT1, caller=OWNER):
+    prop.commit_batch(caller, n, root, wroot, priv, last_hash, count,
+                      commit, blob_versioned_hash=blob)
+
+
+# ---- commitBatch reverts ---------------------------------------------------
+
+def test_commit_happy_and_succession():
+    _, prop = _fixture()
+    _commit(prop, 1)
+    assert prop.last_committed == 1
+    with pytest.raises(Revert, match="BatchNumberNotSuccessor"):
+        _commit(prop, 3)
+    with pytest.raises(Revert, match="BatchNumberNotSuccessor"):
+        _commit(prop, 1)
+
+
+def test_commit_only_owner_and_pause():
+    _, prop = _fixture()
+    with pytest.raises(Revert, match="OwnableUnauthorizedAccount"):
+        _commit(prop, 1, caller=OTHER)
+    prop.pause(OWNER)
+    with pytest.raises(Revert, match="EnforcedPause"):
+        _commit(prop, 1)
+
+
+def test_commit_zero_last_block_hash():
+    _, prop = _fixture()
+    with pytest.raises(Revert, match="LastBlockHashIsZero"):
+        _commit(prop, 1, last_hash=b"\x00" * 32)
+
+
+def test_commit_privileged_rolling_hash_binding():
+    bridge, prop = _fixture()
+    h1 = bridge.deposit(USER, USER, 100, now=1000)
+    h2 = bridge.deposit(USER, USER, 200, now=1000)
+    good = bridge.pending_versioned_hash(2)
+    assert good == versioned_hash(2, [h1, h2])
+    _commit(prop, 1, priv=good)
+    # tampered rolling hash: count prefix right, digest wrong
+    bad = good[:2] + b"\x00" * 30
+    with pytest.raises(Revert, match="InvalidPrivilegedTransactionLogs"):
+        _commit(prop, 2, priv=bad)
+    # claiming more pending txs than exist
+    overcount = versioned_hash(3, [h1, h2, h2])
+    with pytest.raises(Revert, match="greater than the length"):
+        _commit(prop, 2, priv=overcount)
+
+
+def test_commit_blob_rules_rollup_vs_validium():
+    _, prop = _fixture()
+    with pytest.raises(Revert, match="RollupBlobNotPublished"):
+        _commit(prop, 1, blob=b"")
+    _, vprop = _fixture(validium=True)
+    with pytest.raises(Revert, match="ValidiumBlobPublished"):
+        _commit(vprop, 1)          # blob present in validium mode
+    _commit(vprop, 1, blob=b"")    # and absent is fine
+
+
+def test_commit_hash_and_vk_rules():
+    _, prop = _fixture()
+    with pytest.raises(Revert, match="CommitHashIsZero"):
+        _commit(prop, 1, commit=b"\x00" * 32)
+    with pytest.raises(Revert, match="MissingVerificationKeyForCommit"):
+        _commit(prop, 1, commit=b"\x55" * 32)   # no vk registered
+    with pytest.raises(Revert, match="CommitHashIsZero"):
+        prop.set_verification_key(OWNER, b"\x00" * 32, "tpu", b"\x01")
+
+
+def test_commit_publishes_withdrawals_once():
+    bridge, prop = _fixture()
+    _commit(prop, 1, wroot=b"\x66" * 32)
+    assert bridge.withdrawal_roots[1] == b"\x66" * 32
+    with pytest.raises(Revert, match="already published"):
+        bridge.publish_withdrawals(1, b"\x67" * 32,
+                                   caller_is_proposer=True)
+    with pytest.raises(Revert, match="onlyOnChainProposer"):
+        bridge.publish_withdrawals(2, b"\x67" * 32,
+                                   caller_is_proposer=False)
+
+
+# ---- verifyBatches reverts -------------------------------------------------
+
+def test_verify_happy_path_and_pruning():
+    _, prop = _fixture()
+    _commit(prop, 1)
+    _commit(prop, 2, root=b"\x12" * 32)
+    prop.verify_batches(OWNER, 1, {"tpu": [b"ok", b"ok"]})
+    assert prop.last_verified == 2
+    # verified predecessors pruned (n-1 on each verify)
+    assert 1 not in prop.commitments and 2 in prop.commitments
+
+
+def test_verify_rejects_bad_proof_and_sequence():
+    _, prop = _fixture()
+    _commit(prop, 1)
+    with pytest.raises(Revert, match="InvalidTpuProof"):
+        prop.verify_batches(OWNER, 1, {"tpu": [b"bad"]})
+    with pytest.raises(Revert, match="BatchNotSequential"):
+        prop.verify_batches(OWNER, 2, {"tpu": [b"ok"]})
+    with pytest.raises(Revert, match="EmptyBatchArray"):
+        prop.verify_batches(OWNER, 1, {"tpu": []})
+    prop.verify_batches(OWNER, 1, {"tpu": [b"ok"]})
+    with pytest.raises(Revert, match="BatchNotCommitted"):
+        prop.verify_batches(OWNER, 2, {"tpu": [b"ok"]})
+
+
+def test_verify_consumes_privileged_queue():
+    bridge, prop = _fixture()
+    bridge.deposit(USER, USER, 100, now=1000)
+    bridge.deposit(USER, USER, 200, now=1000)
+    rolling = bridge.pending_versioned_hash(2)
+    _commit(prop, 1, priv=rolling)
+    assert bridge._pending_len() == 2
+    prop.verify_batches(OWNER, 1, {"tpu": [b"ok"]}, now=1001)
+    assert bridge._pending_len() == 0
+
+
+def test_expired_privileged_deadline_forces_inclusion():
+    """Once a privileged tx sits past its deadline, batches carrying
+    ordinary transactions cannot verify until the privileged queue is
+    drained (censorship resistance, OnChainProposer.sol:348-353)."""
+    bridge, prop = _fixture()
+    bridge.deposit(USER, USER, 100, now=1000)
+    deadline = 1000 + bridge.privileged_wait
+    # batch WITHOUT the privileged tx but with ordinary txs
+    _commit(prop, 1, count=3)
+    with pytest.raises(Revert, match="ExpiredPrivilegedTransactionDeadline"):
+        prop.verify_batches(OWNER, 1, {"tpu": [b"ok"]}, now=deadline + 1)
+    # an all-privileged batch (non_privileged == 0) still verifies...
+    _, prop2 = _fixture()
+    bridge2 = prop2.bridge
+    bridge2.deposit(USER, USER, 100, now=1000)
+    rolling = bridge2.pending_versioned_hash(1)
+    prop2.commit_batch(OWNER, 1, ROOT1, b"", rolling, HASH1, 0, COMMIT,
+                       blob_versioned_hash=BLOB)
+    prop2.verify_batches(OWNER, 1, {"tpu": [b"ok"]}, now=deadline + 1)
+    assert prop2.last_verified == 1
+
+
+# ---- revertBatch -----------------------------------------------------------
+
+def test_revert_batch_rules():
+    _, prop = _fixture()
+    _commit(prop, 1)
+    _commit(prop, 2, root=b"\x12" * 32)
+    with pytest.raises(Revert, match="ExpectedPause"):
+        prop.revert_batch(OWNER, 2)
+    prop.pause(OWNER)
+    with pytest.raises(Revert, match="NoBatchesToRevert"):
+        prop.revert_batch(OWNER, 3)
+    prop.revert_batch(OWNER, 2)
+    assert prop.last_committed == 1 and 2 not in prop.commitments
+    prop.unpause(OWNER)
+    prop.verify_batches(OWNER, 1, {"tpu": [b"ok"]})
+    prop.pause(OWNER)
+    with pytest.raises(Revert, match="CannotRevertVerifiedBatch"):
+        prop.revert_batch(OWNER, 1)
+
+
+# ---- bridge: deposits, aliasing, claims ------------------------------------
+
+def test_gas_limit_cap_and_aliasing():
+    bridge, _ = _fixture()
+    with pytest.raises(Revert, match="gasLimit exceeds l2GasLimit"):
+        bridge.send_to_l2(USER, USER, 0, bridge.l2_gas_limit + 1, b"",
+                          now=0)
+    assert alias_sender(USER, is_contract=False) == USER
+    aliased = alias_sender(USER, is_contract=True)
+    assert aliased != USER and len(aliased) == 20
+
+
+def _withdrawal_tree(leaves):
+    level = list(leaves)
+    layers = [level]
+    while len(level) > 1:
+        if len(level) % 2:
+            level = level + [level[-1]]
+        level = [keccak256(min(a, b) + max(a, b))
+                 for a, b in zip(level[0::2], level[1::2])]
+        layers.append(level)
+    return layers[-1][0], layers
+
+
+def _proof_for(layers, idx):
+    proof = []
+    for level in layers[:-1]:
+        if len(level) % 2:
+            level = level + [level[-1]]
+        sib = idx ^ 1
+        proof.append(level[sib])
+        idx //= 2
+    return proof
+
+
+def test_claim_withdrawal_full_lifecycle():
+    bridge, prop = _fixture()
+    bridge.deposit(USER, USER, 1000, now=0)
+    amount = 400
+    msg_hash = keccak256(b"\x00" * 20 + b"\x00" * 20 + USER
+                         + amount.to_bytes(32, "big"))
+    leaves = [withdrawal_leaf(L2_BRIDGE, msg_hash, 0),
+              withdrawal_leaf(L2_BRIDGE, keccak256(b"other"), 1)]
+    root, layers = _withdrawal_tree(leaves)
+    proof = _proof_for(layers, 0)
+    assert merkle_verify(proof, root, leaves[0])
+    _commit(prop, 1, wroot=root)
+    # before verification: claim refused
+    with pytest.raises(Revert, match="was not verified"):
+        bridge.claim_withdrawal(USER, amount, 1, 0, proof)
+    prop.verify_batches(OWNER, 1, {"tpu": [b"ok"]})
+    bridge.claim_withdrawal(USER, amount, 1, 0, proof)
+    assert bridge.deposits_pool == 600
+    with pytest.raises(Revert, match="already claimed"):
+        bridge.claim_withdrawal(USER, amount, 1, 0, proof)
+    with pytest.raises(Revert, match="Invalid proof"):
+        bridge.claim_withdrawal(USER, amount, 1, 2, proof)
+    with pytest.raises(Revert, match="more tokens/ETH than were deposited"):
+        bridge.claim_withdrawal(USER, 10**9, 1, 3, proof)
+    with pytest.raises(Revert, match="was not committed"):
+        bridge.claim_withdrawal(USER, amount, 9, 4, proof)
+
+
+def test_versioned_hash_shape():
+    h = versioned_hash(2, [b"\x01" * 32, b"\x02" * 32])
+    assert h[:2] == (2).to_bytes(2, "big")
+    assert h[2:] == keccak256(b"\x01" * 32 + b"\x02" * 32)[2:]
