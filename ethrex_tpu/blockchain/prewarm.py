@@ -1,0 +1,61 @@
+"""Speculative mempool prewarming (the seat of the reference's
+crates/blockchain/prewarm.rs): during the idle gap between blocks, run
+pending transactions against a THROWAWAY state layered on the head root
+and discard every result.  The side effect is the point — account/storage
+trie paths, contract code and persistent-backend pages are pulled into
+the node/code table caches, so the real block build hits warm caches.
+
+Differences from the reference, by architecture: the reference prewarms
+on rayon workers inside the node process; here the producer loop calls
+`prewarm_transactions` in its idle window (Node._producer_loop), and the
+warmed state is the Store's table caches (the persistent backend's read
+cache when --datadir is set; the shared in-memory tables otherwise) —
+the StateDB scratch layer itself is dropped.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..evm.db import StateDB
+from ..evm.executor import execute_tx
+from ..evm.vm import BlockEnv
+
+
+def prewarm_transactions(chain, parent_header, txs,
+                         deadline: float | None = None,
+                         max_txs: int = 256) -> int:
+    """Speculatively execute up to `max_txs` transactions against the
+    parent state; returns how many ran.  Never mutates canonical state
+    (scratch StateDB, discarded) and never raises — a failing tx just
+    stops warming that sender's lane."""
+    from ..storage.store import StoreSource
+
+    if not txs:
+        return 0
+    try:
+        source = StoreSource(chain.store, parent_header.state_root)
+    except Exception:
+        return 0
+    state = StateDB(source)
+    env = BlockEnv(
+        number=parent_header.number + 1,
+        coinbase=parent_header.coinbase,
+        timestamp=parent_header.timestamp + 1,
+        gas_limit=parent_header.gas_limit,
+        base_fee=parent_header.base_fee_per_gas or 0,
+        excess_blob_gas=parent_header.excess_blob_gas or 0,
+        prev_randao=parent_header.prev_randao or b"\x00" * 32,
+    )
+    ran = 0
+    for tx in txs[:max_txs]:
+        if deadline is not None and time.monotonic() >= deadline:
+            break
+        try:
+            execute_tx(tx, state, env, chain.config)
+            ran += 1
+        except Exception:
+            # speculation only: any failure (InvalidTransaction or a bug
+            # surfaced by a malformed tx) just skips this warm lane
+            continue
+    return ran

@@ -510,14 +510,36 @@ def main(argv=None):
     p_l2.add_argument("--run-prover", dest="l2_run_prover",
                       action="store_true",
                       help="also run in-process prover client(s)")
+    p_repl = sub.add_parser(
+        "repl", help="interactive JSON-RPC shell against a running node")
+    p_repl.add_argument("--url", default=_env("RPC_URL",
+                                              "http://127.0.0.1:8545"))
+    p_mon = sub.add_parser(
+        "monitor", help="terminal dashboard for a running node")
+    p_mon.add_argument("--url", default=_env("RPC_URL",
+                                             "http://127.0.0.1:8545"))
+    p_mon.add_argument("--interval", type=float, default=2.0)
 
     args = parser.parse_args(argv)
+
+    def cmd_repl(a):
+        from .utils.repl import run as repl_run
+
+        return repl_run(a.url)
+
+    def cmd_monitor(a):
+        from .utils.monitor import run as monitor_run
+
+        return monitor_run(a.url, a.interval)
+
     handlers = {
         "import": cmd_import,
         "export": cmd_export,
         "removedb": cmd_removedb,
         "compute-state-root": cmd_compute_state_root,
         "l2": run_l2,
+        "repl": cmd_repl,
+        "monitor": cmd_monitor,
         None: run_node,
     }
     return handlers[args.command](args)

@@ -131,12 +131,42 @@ class Node:
         self._gossip(block)  # transitive relay (terminates: peers that
         return True          # already have it import nothing and don't relay
 
-    def start_dev_producer(self, block_time: float = 1.0):
+    def pending_txs(self, parent) -> list:
+        """Mempool transactions executable on top of `parent`, filtered by
+        the NEXT block's base fee (shared by the payload build and the
+        prewarmer so both see the same tx set)."""
+        from .blockchain.blockchain import next_base_fee
+        from .primitives.genesis import Fork
+
+        fork = self.config.fork_at(parent.number + 1, parent.timestamp + 1)
+        base_fee = next_base_fee(parent) if fork >= Fork.LONDON else 0
+
+        def get_nonce(sender):
+            acct = self.store.account_state(parent.state_root, sender)
+            return acct.nonce if acct else 0
+
+        return self.mempool.pending(base_fee or 0, get_nonce)
+
+    def start_dev_producer(self, block_time: float = 1.0,
+                           prewarm: bool = True):
+        from .blockchain.prewarm import prewarm_transactions
+
         def loop():
             while not self._stop.wait(block_time):
                 try:
                     if len(self.mempool):
                         self.produce_block()
+                        if prewarm:
+                            # AFTER producing: the genuinely idle window
+                            # before the next tick warms trie/code/backend
+                            # caches for the NEXT build without delaying
+                            # this one (blockchain/prewarm.py)
+                            parent = self.store.head_header()
+                            prewarm_transactions(
+                                self.chain, parent,
+                                self.pending_txs(parent),
+                                deadline=time.monotonic()
+                                + block_time / 2)
                 except Exception as e:  # noqa: BLE001 — keep producing
                     print(f"block production failed: {e}")
 

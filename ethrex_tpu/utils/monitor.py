@@ -1,0 +1,133 @@
+"""Terminal monitor for a running node (the seat of the reference's
+ratatui monitor, /root/reference/tooling/monitor — re-imagined as a
+stdlib-curses dashboard over JSON-RPC, so it attaches to ANY node URL
+rather than living inside the sequencer process).
+
+`ethrex-tpu monitor [--url ...] [--interval 2]`
+
+Panels: chain head + gas, recent blocks, txpool status, L2 batches and
+per-actor sequencer health (when the node exposes the ethrex_* L2
+namespace).  One RPC snapshot per refresh; `q` quits.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .repl import RpcSession
+
+
+def snapshot(rpc: RpcSession, blocks: int = 8) -> dict:
+    """One monitor refresh's data (pure RPC; drives the render and the
+    tests)."""
+    out: dict = {"ts": time.time()}
+    head = rpc.call("eth_getBlockByNumber", ["latest", False])
+    number = int(head["number"], 16)
+    out["head"] = {
+        "number": number,
+        "hash": head["hash"],
+        "gas_used": int(head["gasUsed"], 16),
+        "gas_limit": int(head["gasLimit"], 16),
+        "txs": len(head["transactions"]),
+        "base_fee": int(head.get("baseFeePerGas", "0x0"), 16),
+        "timestamp": int(head["timestamp"], 16),
+    }
+    recents = []
+    for n in range(max(0, number - blocks + 1), number + 1):
+        b = rpc.call("eth_getBlockByNumber", [hex(n), False])
+        if b:
+            recents.append({"number": n, "txs": len(b["transactions"]),
+                            "gas_used": int(b["gasUsed"], 16),
+                            "hash": b["hash"]})
+    out["recent"] = recents
+    try:
+        st = rpc.call("txpool_status", [])
+        out["txpool"] = {k: int(v, 16) if isinstance(v, str) else int(v)
+                         for k, v in st.items()}
+    except Exception:
+        out["txpool"] = None
+    try:
+        out["batch"] = rpc.call("ethrex_latestBatch", [])
+    except Exception:
+        out["batch"] = None
+    try:
+        out["health"] = rpc.call("ethrex_health", [])
+    except Exception:
+        out["health"] = None
+    try:
+        out["peers"] = len(rpc.call("admin_peers", []))
+    except Exception:
+        out["peers"] = None
+    return out
+
+
+def render_lines(snap: dict, width: int = 100) -> list[str]:
+    """Snapshot -> dashboard lines (pure; the curses loop just blits)."""
+    h = snap["head"]
+    lines = []
+    lines.append("ethrex-tpu monitor".center(width, "─"))
+    pct = 100.0 * h["gas_used"] / max(h["gas_limit"], 1)
+    lines.append(
+        f" head #{h['number']}  txs {h['txs']}  gas {h['gas_used']:,}"
+        f" ({pct:.1f}%)  base fee {h['base_fee']}"
+        + (f"  peers {snap['peers']}" if snap.get("peers") is not None
+           else ""))
+    lines.append(f" {h['hash']}")
+    lines.append("─" * width)
+    lines.append(" recent blocks")
+    for b in reversed(snap["recent"]):
+        lines.append(f"   #{b['number']:<8} txs {b['txs']:<5} "
+                     f"gas {b['gas_used']:<12,} {b['hash'][:18]}…")
+    if snap.get("txpool"):
+        tp = snap["txpool"]
+        lines.append("─" * width)
+        lines.append(" txpool  " + "  ".join(f"{k}: {v}"
+                                             for k, v in tp.items()))
+    if snap.get("batch"):
+        lines.append("─" * width)
+        b = snap["batch"]
+        lines.append(" L2 latest batch  " + "  ".join(
+            f"{k}: {v}" for k, v in list(b.items())[:6]))
+    if snap.get("health"):
+        lines.append("─" * width)
+        lines.append(" sequencer health")
+        hl = snap["health"]
+        items = hl.items() if isinstance(hl, dict) else enumerate(hl)
+        for k, v in items:
+            lines.append(f"   {k}: {v}")
+    lines.append("─" * width)
+    lines.append(" q quits · refreshes every interval")
+    return lines
+
+
+def run(url: str, interval: float = 2.0) -> int:
+    import curses
+
+    # a short per-call timeout keeps `q`/redraw responsive when the node
+    # stalls (snapshot makes ~a dozen serial calls per refresh)
+    rpc = RpcSession(url, timeout=3.0)
+
+    def loop(stdscr):
+        curses.curs_set(0)
+        stdscr.nodelay(True)
+        last = 0.0
+        lines: list[str] = []
+        while True:
+            now = time.time()
+            if now - last >= interval or not lines:
+                try:
+                    lines = render_lines(snapshot(rpc),
+                                         width=stdscr.getmaxyx()[1] - 1)
+                except Exception as e:
+                    lines = [f"rpc error: {e}", "retrying…"]
+                last = now
+                stdscr.erase()
+                maxy, maxx = stdscr.getmaxyx()
+                for i, line in enumerate(lines[:maxy - 1]):
+                    stdscr.addnstr(i, 0, line, maxx - 1)
+                stdscr.refresh()
+            if stdscr.getch() in (ord("q"), 27):
+                return 0
+            time.sleep(0.05)
+
+    return curses.wrapper(loop)

@@ -1,0 +1,140 @@
+"""Interactive JSON-RPC REPL (the seat of the reference's tooling/repl).
+
+`ethrex-tpu repl [--url http://...]` opens a readline loop against a
+running node.  Shorthand commands cover the common queries; anything
+else is `raw <method> [json-args...]` or a bare `eth_*`-style method
+name with arguments.
+
+    bn                      block number
+    head                    latest block (summary)
+    block <n|hash>          block by number/hash
+    bal <addr> [tag]        balance
+    nonce <addr> [tag]      transaction count
+    code <addr> [tag]       code size + prefix
+    tx <hash>               transaction by hash
+    receipt <hash>          transaction receipt
+    peers                   admin_peers
+    batch [n]               L2 batch (latest without n)
+    health                  sequencer health
+    raw <method> [args...]  arbitrary call; args parsed as JSON
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+
+class RpcSession:
+    def __init__(self, url: str, timeout: float = 30.0):
+        self.url = url
+        self.timeout = timeout
+        self._id = 0
+
+    def call(self, method: str, params: list):
+        self._id += 1
+        payload = json.dumps({"jsonrpc": "2.0", "id": self._id,
+                              "method": method, "params": params}).encode()
+        req = urllib.request.Request(
+            self.url, data=payload,
+            headers={"Content-Type": "application/json"})
+        resp = json.loads(
+            urllib.request.urlopen(req, timeout=self.timeout).read())
+        if "error" in resp:
+            raise RuntimeError(resp["error"].get("message", str(resp)))
+        return resp.get("result")
+
+
+def _arg(a: str):
+    try:
+        return json.loads(a)
+    except ValueError:
+        return a
+
+
+def _fmt(v) -> str:
+    return json.dumps(v, indent=2, sort_keys=True) \
+        if isinstance(v, (dict, list)) else str(v)
+
+
+def dispatch(rpc: RpcSession, line: str) -> str:
+    """One REPL command -> printable output (separated from the loop so
+    tests drive it directly)."""
+    parts = line.strip().split()
+    if not parts:
+        return ""
+    cmd, args = parts[0], parts[1:]
+    if cmd == "bn":
+        return str(int(rpc.call("eth_blockNumber", []), 16))
+    if cmd == "head":
+        b = rpc.call("eth_getBlockByNumber", ["latest", False])
+        return (f"#{int(b['number'], 16)} {b['hash']} "
+                f"txs={len(b['transactions'])} "
+                f"gasUsed={int(b['gasUsed'], 16)}")
+    if cmd == "block":
+        ref = args[0] if args else "latest"
+        if ref.startswith("0x") and len(ref) == 66:
+            return _fmt(rpc.call("eth_getBlockByHash", [ref, False]))
+        tag = ref if ref in ("latest", "earliest", "pending") \
+            else hex(int(ref, 0))
+        return _fmt(rpc.call("eth_getBlockByNumber", [tag, False]))
+    if cmd == "bal":
+        tag = args[1] if len(args) > 1 else "latest"
+        return str(int(rpc.call("eth_getBalance", [args[0], tag]), 16))
+    if cmd == "nonce":
+        tag = args[1] if len(args) > 1 else "latest"
+        return str(int(rpc.call("eth_getTransactionCount",
+                                [args[0], tag]), 16))
+    if cmd == "code":
+        tag = args[1] if len(args) > 1 else "latest"
+        code = rpc.call("eth_getCode", [args[0], tag])
+        nbytes = (len(code) - 2) // 2
+        return f"{nbytes} bytes: {code[:66]}{'...' if nbytes > 32 else ''}"
+    if cmd == "tx":
+        return _fmt(rpc.call("eth_getTransactionByHash", [args[0]]))
+    if cmd == "receipt":
+        return _fmt(rpc.call("eth_getTransactionReceipt", [args[0]]))
+    if cmd == "peers":
+        return _fmt(rpc.call("admin_peers", []))
+    if cmd == "batch":
+        if args:
+            return _fmt(rpc.call("ethrex_getBatchByNumber",
+                                 [int(args[0], 0)]))
+        return _fmt(rpc.call("ethrex_latestBatch", []))
+    if cmd == "health":
+        return _fmt(rpc.call("ethrex_health", []))
+    if cmd == "raw":
+        return _fmt(rpc.call(args[0], [_arg(a) for a in args[1:]]))
+    if cmd in ("help", "?"):
+        return __doc__.split("\n\n", 1)[1]
+    # bare method name fallthrough: `eth_chainId`, `net_version 1`, ...
+    if "_" in cmd:
+        return _fmt(rpc.call(cmd, [_arg(a) for a in args]))
+    return f"unknown command {cmd!r} (try `help`)"
+
+
+def run(url: str) -> int:
+    try:
+        import readline  # noqa: F401  (history/arrow keys)
+    except ImportError:
+        pass
+    rpc = RpcSession(url)
+    try:
+        chain = rpc.call("eth_chainId", [])
+        print(f"connected to {url} (chain {int(chain, 16)}) — "
+              "`help` for commands, ^D to exit")
+    except Exception as e:
+        print(f"cannot reach {url}: {e}")
+        return 1
+    while True:
+        try:
+            line = input("ethrex> ")
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return 0
+        try:
+            out = dispatch(rpc, line)
+            if out:
+                print(out)
+        except Exception as e:
+            print(f"error: {e}")

@@ -1,0 +1,85 @@
+"""REPL, monitor and prewarm tooling (reference inventory rows:
+tooling/repl, tooling/monitor, crates/blockchain/prewarm.rs)."""
+
+from ethrex_tpu.blockchain.prewarm import prewarm_transactions
+from ethrex_tpu.crypto import secp256k1
+from ethrex_tpu.node import Node
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.primitives.transaction import Transaction
+from ethrex_tpu.rpc.server import RpcServer
+from ethrex_tpu.utils.monitor import render_lines, snapshot
+from ethrex_tpu.utils.repl import RpcSession, dispatch
+
+SECRET = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
+SENDER = secp256k1.pubkey_to_address(secp256k1.pubkey_from_secret(SECRET))
+GENESIS = {
+    "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+               "shanghaiTime": 0, "cancunTime": 0},
+    "alloc": {"0x" + SENDER.hex(): {"balance": hex(10**21)}},
+    "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7", "timestamp": "0x0",
+}
+
+
+def _tx(nonce, value=100):
+    return Transaction(
+        tx_type=2, chain_id=1337, nonce=nonce,
+        max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+        gas_limit=21_000, to=bytes([0x42]) * 20, value=value).sign(SECRET)
+
+
+def _node_with_rpc():
+    node = Node(Genesis.from_json(GENESIS))
+    server = RpcServer(node, host="127.0.0.1", port=0).start()
+    return node, server, f"http://127.0.0.1:{server.port}"
+
+
+def test_repl_dispatch_commands():
+    node, server, url = _node_with_rpc()
+    try:
+        node.submit_transaction(_tx(0))
+        node.produce_block()
+        rpc = RpcSession(url)
+        assert dispatch(rpc, "bn") == "1"
+        assert "#1" in dispatch(rpc, "head")
+        assert dispatch(rpc, f"bal 0x{'42' * 20}") == "100"
+        assert "gasUsed" in dispatch(rpc, "block 1")
+        assert "pending" in dispatch(rpc, "raw txpool_status")
+        assert dispatch(rpc, "eth_chainId") == "0x539"
+        assert "unknown command" in dispatch(rpc, "nosuch")
+        assert "bn" in dispatch(rpc, "help")
+    finally:
+        server._httpd.shutdown()
+
+
+def test_monitor_snapshot_and_render():
+    node, server, url = _node_with_rpc()
+    try:
+        for n in range(3):
+            node.submit_transaction(_tx(n))
+            node.produce_block()
+        snap = snapshot(RpcSession(url), blocks=4)
+        assert snap["head"]["number"] == 3
+        assert [b["number"] for b in snap["recent"]] == [0, 1, 2, 3]
+        assert snap["txpool"] == {"pending": 0, "queued": 0}
+        lines = render_lines(snap, width=80)
+        assert any("head #3" in ln for ln in lines)
+        assert any("recent blocks" in ln for ln in lines)
+    finally:
+        server._httpd.shutdown()
+
+
+def test_prewarm_is_side_effect_free_and_counts():
+    node = Node(Genesis.from_json(GENESIS))
+    parent = node.store.head_header()
+    txs = [_tx(n) for n in range(5)]
+    root_before = node.head_state_root()
+    ran = prewarm_transactions(node.chain, parent, txs)
+    assert ran == 5
+    # canonical state untouched
+    assert node.head_state_root() == root_before
+    assert node.store.head_header().number == 0
+    # the real block still builds and includes the txs
+    for t in txs:
+        node.submit_transaction(t)
+    blk = node.produce_block()
+    assert len(blk.body.transactions) == 5
