@@ -44,6 +44,11 @@ class RlpxPeer:
         self.node = node
         self.remote_pub = remote_pub
         self.remote_status: eth_wire.Status | None = None
+        # set for real during exchange_hello; eth/68 defaults keep the
+        # attribute lifecycle explicit
+        self.eth_version = 68
+        self.snap_offset = snap.SNAP_OFFSET_ETH68
+        self.peer_block_range = None
         self.snappy_active = False  # enabled after Hello (p2p v5)
         self.lock = threading.Lock()
         self._stop = threading.Event()
@@ -106,14 +111,22 @@ class RlpxPeer:
         node_id = rlpx._pub_bytes(
             secp256k1.pubkey_from_secret(self.node.p2p_secret))
         self.send_msg(eth_wire.HELLO,
-                      rlpx.make_hello_payload(CLIENT_ID, node_id,
-                                              (("eth", 68), ("snap", 1))))
+                      rlpx.make_hello_payload(
+                          CLIENT_ID, node_id,
+                          (("eth", 68), ("eth", 69), ("snap", 1))))
         msg_id, payload = self.recv_msg()
         if msg_id != eth_wire.HELLO:
             raise PeerError(f"expected hello, got {msg_id}")
         hello = rlpx.parse_hello_payload(payload)
-        if ("eth", 68) not in hello["capabilities"]:
-            raise PeerError("peer does not speak eth/68")
+        mutual = [v for v in eth_wire.ETH_VERSIONS
+                  if ("eth", v) in hello["capabilities"]]
+        if not mutual:
+            raise PeerError("no mutual eth version (need 68 or 69)")
+        self.eth_version = mutual[0]   # ETH_VERSIONS is preference-ordered
+        # devp2p multiplexing: snap's id space starts after eth's, whose
+        # size depends on the negotiated version (BlockRangeUpdate)
+        self.snap_offset = (snap.SNAP_OFFSET_ETH69 if self.eth_version >= 69
+                            else snap.SNAP_OFFSET_ETH68)
         self.capabilities = set(hello["capabilities"])
         # devp2p: both sides at p2p version >= 5 compress every message
         # after Hello with snappy
@@ -125,21 +138,35 @@ class RlpxPeer:
         store = self.node.store
         head = store.head_header()
         genesis_hash = store.meta["genesis"]
-        status = eth_wire.Status(
-            version=eth_wire.ETH_VERSION,
-            network_id=self.node.config.chain_id,
-            total_difficulty=0,
-            head_hash=head.hash,
-            genesis_hash=genesis_hash,
-            fork_id=eth_wire.fork_id_for(
-                self.node.config, genesis_hash, head.number, head.timestamp,
-                genesis_time=self.node.genesis_header.timestamp),
-        )
+        fork_id = eth_wire.fork_id_for(
+            self.node.config, genesis_hash, head.number, head.timestamp,
+            genesis_time=self.node.genesis_header.timestamp)
+        version = self.eth_version
+        if version >= 69:
+            status = eth_wire.Status69(
+                version=version,
+                network_id=self.node.config.chain_id,
+                genesis_hash=genesis_hash,
+                fork_id=fork_id,
+                earliest_block=0,
+                latest_block=head.number,
+                latest_block_hash=head.hash,
+            )
+        else:
+            status = eth_wire.Status(
+                version=version,
+                network_id=self.node.config.chain_id,
+                total_difficulty=0,
+                head_hash=head.hash,
+                genesis_hash=genesis_hash,
+                fork_id=fork_id,
+            )
         self.send_msg(eth_wire.STATUS, status.encode())
         msg_id, payload = self.recv_msg()
         if msg_id != eth_wire.STATUS:
             raise PeerError(f"expected status, got {msg_id}")
-        remote = eth_wire.Status.decode(payload)
+        remote = (eth_wire.Status69.decode(payload) if version >= 69
+                  else eth_wire.Status.decode(payload))
         if remote.genesis_hash != genesis_hash:
             raise PeerError("genesis mismatch")
         if remote.network_id != self.node.config.chain_id:
@@ -149,8 +176,21 @@ class RlpxPeer:
                 remote.fork_id,
                 genesis_time=self.node.genesis_header.timestamp):
             raise PeerError("fork id mismatch")
+        if version >= 69:
+            self.peer_block_range = (remote.earliest_block,
+                                     remote.latest_block)
         self.remote_status = remote
         return remote
+
+    def send_block_range_update(self):
+        """eth/69 BlockRangeUpdate: advertise the served range after the
+        head moves (update.rs)."""
+        if self.eth_version < 69:
+            return
+        head = self.node.store.head_header()
+        self.send_msg(eth_wire.BLOCK_RANGE_UPDATE,
+                      eth_wire.encode_block_range_update(
+                          0, head.number, head.hash))
 
     # -- request/response -------------------------------------------------
     def _next_request_id(self) -> int:
@@ -223,7 +263,7 @@ class RlpxPeer:
         self._require_snap()
         rid = self._next_request_id()
         payload = snap.encode_get_account_range(rid, root, origin, limit)
-        return self.request(snap.GET_ACCOUNT_RANGE, payload, rid)
+        return self.request(self.snap_offset + snap.GET_ACCOUNT_RANGE, payload, rid)
 
     def snap_get_storage_range(self, root: bytes, account_hash: bytes,
                                origin: bytes = b""):
@@ -231,19 +271,19 @@ class RlpxPeer:
         rid = self._next_request_id()
         payload = snap.encode_get_storage_ranges(rid, root, [account_hash],
                                                  origin)
-        slots, proofs = self.request(snap.GET_STORAGE_RANGES, payload, rid)
+        slots, proofs = self.request(self.snap_offset + snap.GET_STORAGE_RANGES, payload, rid)
         return (slots[0] if slots else []), (proofs[0] if proofs else [])
 
     def snap_get_byte_codes(self, hashes):
         rid = self._next_request_id()
         payload = snap.encode_get_byte_codes(rid, hashes)
-        return self.request(snap.GET_BYTE_CODES, payload, rid)
+        return self.request(self.snap_offset + snap.GET_BYTE_CODES, payload, rid)
 
     def snap_get_trie_nodes(self, root: bytes, paths):
         self._require_snap()
         rid = self._next_request_id()
         payload = snap.encode_get_trie_nodes(rid, root, paths)
-        return self.request(snap.GET_TRIE_NODES, payload, rid)
+        return self.request(self.snap_offset + snap.GET_TRIE_NODES, payload, rid)
 
     def announce_pooled_txs(self, txs):
         for tx in txs:
@@ -301,11 +341,29 @@ class RlpxPeer:
         elif msg_id == eth_wire.GET_RECEIPTS:
             rid, hashes = eth_wire.decode_get_receipts(payload)
             receipts = [store.get_receipts(h) or [] for h in hashes[:1024]]
-            self.send_msg(eth_wire.RECEIPTS,
-                          eth_wire.encode_receipts(rid, receipts))
+            if self.eth_version >= 69:
+                # eth/69: served receipts omit the bloom (recomputable)
+                body = eth_wire.encode_receipts69(rid, receipts)
+            else:
+                body = eth_wire.encode_receipts(rid, receipts)
+            self.send_msg(eth_wire.RECEIPTS, body)
         elif msg_id == eth_wire.RECEIPTS:
-            rid, receipts = eth_wire.decode_receipts(payload)
+            if self.eth_version >= 69:
+                rid, receipts = eth_wire.decode_receipts69(payload)
+            else:
+                rid, receipts = eth_wire.decode_receipts(payload)
             self._resolve(rid, receipts)
+        elif msg_id == eth_wire.BLOCK_RANGE_UPDATE \
+                and self.eth_version >= 69:
+            # NOT gated => 0x21 would shadow snap GetAccountRange on
+            # eth/68 connections (review finding)
+            try:
+                earliest, latest, latest_hash = \
+                    eth_wire.decode_block_range_update(payload)
+            except ValueError:
+                self.record_failure(10)  # inverted range: misbehaving peer
+            else:
+                self.peer_block_range = (earliest, latest)
         elif msg_id == eth_wire.NEW_POOLED_TX_HASHES:
             types, sizes, hashes = \
                 eth_wire.decode_new_pooled_tx_hashes(payload)
@@ -366,17 +424,17 @@ class RlpxPeer:
                     self.node.submit_transaction(tx)
                 except Exception:  # noqa: BLE001 — invalid gossip is dropped
                     pass
-        elif msg_id == snap.GET_ACCOUNT_RANGE:
+        elif msg_id == self.snap_offset + snap.GET_ACCOUNT_RANGE:
             rid, root, origin, limit = \
                 snap.decode_get_account_range(payload)
             accounts, proof = snap.serve_account_range(
                 store, root, origin, limit)
-            self.send_msg(snap.ACCOUNT_RANGE,
+            self.send_msg(self.snap_offset + snap.ACCOUNT_RANGE,
                           snap.encode_account_range(rid, accounts, proof))
-        elif msg_id == snap.ACCOUNT_RANGE:
+        elif msg_id == self.snap_offset + snap.ACCOUNT_RANGE:
             rid, accounts, proof = snap.decode_account_range(payload)
             self._resolve(rid, (accounts, proof))
-        elif msg_id == snap.GET_STORAGE_RANGES:
+        elif msg_id == self.snap_offset + snap.GET_STORAGE_RANGES:
             rid, root, hashes, origin = \
                 snap.decode_get_storage_ranges(payload)
             slots_all, proofs_all = [], []
@@ -385,26 +443,26 @@ class RlpxPeer:
                                                         origin)
                 slots_all.append(slots)
                 proofs_all.append(proof)
-            self.send_msg(snap.STORAGE_RANGES, snap.encode_storage_ranges(
+            self.send_msg(self.snap_offset + snap.STORAGE_RANGES, snap.encode_storage_ranges(
                 rid, slots_all, proofs_all))
-        elif msg_id == snap.STORAGE_RANGES:
+        elif msg_id == self.snap_offset + snap.STORAGE_RANGES:
             rid, slots, proofs = snap.decode_storage_ranges(payload)
             self._resolve(rid, (slots, proofs))
-        elif msg_id == snap.GET_BYTE_CODES:
+        elif msg_id == self.snap_offset + snap.GET_BYTE_CODES:
             rid, hashes = snap.decode_get_byte_codes(payload)
             codes = [store.code[h] for h in hashes[:1024]
                      if h in store.code]
-            self.send_msg(snap.BYTE_CODES,
+            self.send_msg(self.snap_offset + snap.BYTE_CODES,
                           snap.encode_byte_codes(rid, codes))
-        elif msg_id == snap.BYTE_CODES:
+        elif msg_id == self.snap_offset + snap.BYTE_CODES:
             rid, codes = snap.decode_byte_codes(payload)
             self._resolve(rid, codes)
-        elif msg_id == snap.GET_TRIE_NODES:
+        elif msg_id == self.snap_offset + snap.GET_TRIE_NODES:
             rid, root, paths = snap.decode_get_trie_nodes(payload)
             nodes = snap.serve_trie_nodes(store, root, paths)
-            self.send_msg(snap.TRIE_NODES,
+            self.send_msg(self.snap_offset + snap.TRIE_NODES,
                           snap.encode_trie_nodes(rid, nodes))
-        elif msg_id == snap.TRIE_NODES:
+        elif msg_id == self.snap_offset + snap.TRIE_NODES:
             rid, nodes = snap.decode_trie_nodes(payload)
             self._resolve(rid, nodes)
         elif msg_id == eth_wire.NEW_BLOCK_HASHES:
@@ -581,6 +639,9 @@ class P2PServer:
                     peer.announce_block(block)
                 else:
                     peer.announce_block_hash(block)
+                # eth/69: advertise the extended served range alongside
+                # the head gossip (update.rs)
+                peer.send_block_range_update()
             except (OSError, rlpx.RlpxError):
                 pass
 

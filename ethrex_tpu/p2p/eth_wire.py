@@ -1,6 +1,8 @@
-"""eth/68 wire protocol messages over RLPx framing (parity target:
-crates/networking/p2p/rlpx/eth/* — status handshake, header/body exchange,
-transaction gossip, new-block announcement).
+"""eth/68 + eth/69 wire protocol messages over RLPx framing (parity
+target: crates/networking/p2p/rlpx/eth/* — status handshake, header/body
+exchange, transaction gossip, new-block announcement; eth/69 drops the
+total-difficulty from Status, removes the bloom from served receipts and
+adds BlockRangeUpdate, crates/networking/p2p/rlpx/eth/eth69/).
 """
 
 from __future__ import annotations
@@ -12,6 +14,7 @@ from ..primitives.block import Block, BlockBody, BlockHeader
 from ..primitives.transaction import Transaction
 
 ETH_VERSION = 68
+ETH_VERSIONS = (69, 68)   # advertised; highest mutual wins
 
 # devp2p base protocol (msg ids 0x00-0x0f)
 HELLO = 0x00
@@ -34,6 +37,7 @@ GET_POOLED_TRANSACTIONS = ETH_OFFSET + 0x09
 POOLED_TRANSACTIONS = ETH_OFFSET + 0x0A
 GET_RECEIPTS = ETH_OFFSET + 0x0F
 RECEIPTS = ETH_OFFSET + 0x10
+BLOCK_RANGE_UPDATE = ETH_OFFSET + 0x11   # eth/69+
 
 
 @dataclasses.dataclass
@@ -63,6 +67,63 @@ class Status:
             genesis_hash=bytes(f[4]),
             fork_id=(bytes(f[5][0]), rlp.decode_int(f[5][1])),
         )
+
+
+@dataclasses.dataclass
+class Status69:
+    """eth/69 status: total difficulty gone, the served block range in
+    (reference: eth69/status.rs StatusMessage69 / StatusDataPost68)."""
+
+    version: int
+    network_id: int
+    genesis_hash: bytes
+    fork_id: tuple
+    earliest_block: int
+    latest_block: int
+    latest_block_hash: bytes
+
+    @property
+    def head_hash(self) -> bytes:
+        """Uniform interface with the eth/68 Status (sync code reads the
+        peer's head hash regardless of the negotiated version)."""
+        return self.latest_block_hash
+
+    def encode(self) -> bytes:
+        return rlp.encode([
+            self.version, self.network_id, self.genesis_hash,
+            [self.fork_id[0], self.fork_id[1]],
+            self.earliest_block, self.latest_block,
+            self.latest_block_hash,
+        ])
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "Status69":
+        f = rlp.decode(payload)
+        return cls(
+            version=rlp.decode_int(f[0]),
+            network_id=rlp.decode_int(f[1]),
+            genesis_hash=bytes(f[2]),
+            fork_id=(bytes(f[3][0]), rlp.decode_int(f[3][1])),
+            earliest_block=rlp.decode_int(f[4]),
+            latest_block=rlp.decode_int(f[5]),
+            latest_block_hash=bytes(f[6]),
+        )
+
+
+def encode_block_range_update(earliest: int, latest: int,
+                              latest_hash: bytes) -> bytes:
+    return rlp.encode([earliest, latest, latest_hash])
+
+
+def decode_block_range_update(payload: bytes):
+    """Returns (earliest, latest, latest_hash); raises ValueError on an
+    inverted range (the reference disconnects such peers,
+    eth/update.rs validate)."""
+    f = rlp.decode(payload)
+    earliest, latest = rlp.decode_int(f[0]), rlp.decode_int(f[1])
+    if earliest > latest:
+        raise ValueError("inverted block range")
+    return earliest, latest, bytes(f[2])
 
 
 def encode_get_block_headers(request_id: int, start, limit: int,
@@ -152,6 +213,38 @@ def encode_receipts(request_id: int, receipts_per_block) -> bytes:
         request_id,
         [[embed(r) for r in receipts] for receipts in receipts_per_block],
     ])
+
+
+def encode_receipts69(request_id: int, receipts_per_block) -> bytes:
+    """eth/69 receipts: flat [tx-type, status, cumulative-gas, logs] lists
+    with the bloom OMITTED (recomputable; saving 256 bytes/receipt is the
+    point of the change — eth69/receipts.rs)."""
+    def embed(r):
+        return [r.tx_type, b"\x01" if r.succeeded else b"",
+                r.cumulative_gas_used, [log.to_fields() for log in r.logs]]
+
+    return rlp.encode([
+        request_id,
+        [[embed(r) for r in receipts] for receipts in receipts_per_block],
+    ])
+
+
+def decode_receipts69(payload: bytes):
+    from ..primitives.receipt import Log, Receipt
+
+    def parse(item):
+        tx_type, status, cum_gas, logs = item
+        return Receipt(
+            tx_type=rlp.decode_int(tx_type),
+            succeeded=rlp.decode_int(status) == 1,
+            cumulative_gas_used=rlp.decode_int(cum_gas),
+            logs=[Log.from_fields(lf) for lf in logs],
+        )
+
+    f = rlp.decode(payload)
+    return (rlp.decode_int(f[0]),
+            [[parse(r) for r in block_receipts]
+             for block_receipts in f[1]])
 
 
 def decode_receipts(payload: bytes):

@@ -4,7 +4,10 @@ snap-sync client that verifies every range proof and rebuilds the state
 snap_sync flow; the verify_range primitive does the soundness work).
 
 Message ids ride above the eth subprotocol space (devp2p capability
-multiplexing: eth/68 occupies 0x10..0x20, snap/1 starts at 0x21).
+multiplexing: the snap/1 message space starts right after eth's —
+0x21 after eth/68 (17 messages), 0x22 after eth/69+ (BlockRangeUpdate
+grows the eth space by one); the per-connection offset is resolved at
+capability negotiation (connection.snap_offset)).
 """
 
 from __future__ import annotations
@@ -15,15 +18,17 @@ from ..primitives.account import AccountState, EMPTY_CODE_HASH, EMPTY_TRIE_ROOT
 from ..trie.trie import Trie
 from ..trie.verify_range import RangeProofError, verify_range
 
-SNAP_OFFSET = 0x21
-GET_ACCOUNT_RANGE = SNAP_OFFSET + 0x00
-ACCOUNT_RANGE = SNAP_OFFSET + 0x01
-GET_STORAGE_RANGES = SNAP_OFFSET + 0x02
-STORAGE_RANGES = SNAP_OFFSET + 0x03
-GET_BYTE_CODES = SNAP_OFFSET + 0x04
-BYTE_CODES = SNAP_OFFSET + 0x05
-GET_TRIE_NODES = SNAP_OFFSET + 0x06
-TRIE_NODES = SNAP_OFFSET + 0x07
+SNAP_OFFSET_ETH68 = 0x21
+SNAP_OFFSET_ETH69 = 0x22
+# RELATIVE ids; a connection adds its negotiated snap_offset
+GET_ACCOUNT_RANGE = 0x00
+ACCOUNT_RANGE = 0x01
+GET_STORAGE_RANGES = 0x02
+STORAGE_RANGES = 0x03
+GET_BYTE_CODES = 0x04
+BYTE_CODES = 0x05
+GET_TRIE_NODES = 0x06
+TRIE_NODES = 0x07
 
 MAX_RESPONSE_ITEMS = 512
 

@@ -275,3 +275,44 @@ def test_peer_scoring(two_nodes):
     while time.time() < deadline and srv_a.peers:
         time.sleep(0.05)
     assert a_view not in srv_a.peers
+
+
+def test_eth69_negotiation_and_messages(two_nodes):
+    """Round 4: eth/69 — highest mutual version wins, Status69 carries the
+    block range instead of the TD, the snap id space shifts by one, and
+    receipts are served bloom-less (eth69/receipts.rs)."""
+    node_a, node_b, srv_a, srv_b = two_nodes
+    node_a.submit_transaction(_tx(0))
+    node_a.produce_block()
+    peer = srv_b.dial(srv_a.host, srv_a.port, srv_a.pub)
+    assert peer.eth_version == 69
+    assert peer.snap_offset == 0x22
+    assert peer.remote_status.latest_block == 1
+    assert peer.peer_block_range == (0, 1)
+    # bloom-less receipts round-trip over the live session
+    head_hash = node_a.store.head_header().hash
+    receipts = peer.get_receipts([head_hash])
+    want = node_a.store.get_receipts(head_hash)
+    assert len(receipts[0]) == len(want) == 1
+    got, exp = receipts[0][0], want[0]
+    assert (got.succeeded, got.cumulative_gas_used) == \
+        (exp.succeeded, exp.cumulative_gas_used)
+    # the recomputed bloom matches (it never crossed the wire)
+    assert got.bloom == exp.bloom
+
+
+def test_eth69_wire_shapes():
+    from ethrex_tpu.p2p import eth_wire as ew
+
+    st = ew.Status69(version=69, network_id=7, genesis_hash=b"\x01" * 32,
+                     fork_id=(b"\xaa" * 4, 99), earliest_block=3,
+                     latest_block=12, latest_block_hash=b"\x02" * 32)
+    rt = ew.Status69.decode(st.encode())
+    assert rt == st and rt.head_hash == b"\x02" * 32
+    payload = ew.encode_block_range_update(1, 9, b"\x03" * 32)
+    assert ew.decode_block_range_update(payload) == (1, 9, b"\x03" * 32)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        ew.decode_block_range_update(ew.encode_block_range_update(
+            9, 1, b"\x03" * 32))
