@@ -337,20 +337,29 @@ class Blockchain:
         Execution state chains through one shared StateDB cache; each
         block's dirty writes are snapshotted (DirtySnapshot) at handoff,
         and the worker chains the trie roots block by block."""
-        import queue as queue_mod
-
-        from ..evm.db import StateDB
-        from ..storage.store import StoreSource
-
         if not blocks:
             return
-        self.store.push_node_layer(blocks[-1].header.number,
-                                   blocks[-1].header.hash)
         # one diff layer per BATCH, tagged by its tail block: bulk-imported
         # nodes settle when the tail settles instead of being attributed
         # to whatever unrelated layer was open (review finding)
         self.store.push_node_layer(blocks[-1].header.number,
                                    blocks[-1].header.hash)
+        try:
+            self._add_blocks_pipelined(blocks)
+        except BaseException:
+            # mirror add_block: a failed pipelined import must not leak
+            # the batch layer (it would absorb unrelated writes and stall
+            # their durability behind a never-imported tail block)
+            self.store.discard_node_layer(blocks[-1].header.number,
+                                          blocks[-1].header.hash)
+            raise
+
+    def _add_blocks_pipelined(self, blocks: list[Block]) -> None:
+        import queue as queue_mod
+
+        from ..evm.db import StateDB
+        from ..storage.store import StoreSource
+
         parent = self.store.get_header(blocks[0].header.parent_hash)
         if parent is None:
             raise InvalidBlock("unknown parent")

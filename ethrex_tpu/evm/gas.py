@@ -107,6 +107,7 @@ class Schedule:
     strict_deposit: bool    # Homestead+: OOG when deposit unaffordable
     sstore_regime: str      # "legacy" | "net1283" | "net2200" | "berlin"
     net_sload: int          # dirty-write / no-op cost for the net regimes
+    sstore_clear_refund: int  # 15000 through Berlin; 4800 London+ (EIP-3529)
     refund_divisor: int     # 2 pre-London, 5 after (EIP-3529)
     selfdestruct_refund: int
     pre_berlin: bool
@@ -118,6 +119,7 @@ def _sched(**kw) -> Schedule:
                 tx_create=0, call_63_64=False, eip161=False,
                 max_code_size=0, strict_deposit=False,
                 sstore_regime="legacy", net_sload=200, refund_divisor=2,
+                sstore_clear_refund=SSTORE_LEGACY_REFUND,
                 selfdestruct_refund=SELFDESTRUCT_REFUND, pre_berlin=True)
     base.update(kw)
     return Schedule(**base)
@@ -129,6 +131,7 @@ def schedule_for(fork) -> Schedule:
 
     if fork >= Fork.LONDON:
         return _sched(sstore_regime="berlin", refund_divisor=5,
+                      sstore_clear_refund=SSTORE_CLEARS_REFUND,
                       selfdestruct_refund=0, tx_nonzero=16,
                       tx_create=TX_CREATE, call_63_64=True, eip161=True,
                       max_code_size=MAX_CODE_SIZE, strict_deposit=True,

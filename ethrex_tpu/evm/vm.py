@@ -810,14 +810,15 @@ def _sstore(evm, f):
         else:
             cost += G.SSTORE_RESET
             if value == 0:
-                evm.state.add_refund(G.SSTORE_CLEARS_REFUND)
+                # 15000 on Berlin (EIP-2200); 4800 only from London
+                evm.state.add_refund(evm.sched.sstore_clear_refund)
     else:
         cost += G.WARM_ACCESS
         if original != 0:
             if current == 0:
-                evm.state.sub_refund(G.SSTORE_CLEARS_REFUND)
+                evm.state.sub_refund(evm.sched.sstore_clear_refund)
             elif value == 0:
-                evm.state.add_refund(G.SSTORE_CLEARS_REFUND)
+                evm.state.add_refund(evm.sched.sstore_clear_refund)
         if value == original:
             if original == 0:
                 evm.state.add_refund(G.SSTORE_SET - G.WARM_ACCESS)

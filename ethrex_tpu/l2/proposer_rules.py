@@ -190,10 +190,12 @@ class CommonBridgeRules:
                          "withdrawal logs was not verified")
         if message_id in self.claimed_ids:
             raise Revert("CommonBridge: the withdrawal was already claimed")
-        self.claimed_ids.add(message_id)
         leaf = withdrawal_leaf(self.l2_bridge, msg_hash, message_id)
         if not merkle_verify(proof, root, leaf):
             raise Revert("CommonBridge: Invalid proof")
+        # effects only after every check: Solidity reverts roll state back,
+        # Python does not, so a failed claim must not consume the id
+        self.claimed_ids.add(message_id)
         self.deposits_pool -= amount
 
 
@@ -260,9 +262,13 @@ class OnChainProposerRules:
             if self.bridge.pending_versioned_hash(count) != \
                     privileged_rolling_hash:
                 raise Revert("InvalidPrivilegedTransactionLogs")
-        if withdrawals_root and withdrawals_root != b"\x00" * 32:
-            self.bridge.publish_withdrawals(batch_number, withdrawals_root,
-                                            caller_is_proposer=True)
+        publish_root = bool(withdrawals_root
+                            and withdrawals_root != b"\x00" * 32)
+        if publish_root and self.bridge.withdrawal_roots.get(batch_number):
+            # the publish-time guard, checked here but the publication
+            # itself is deferred until all commit checks pass (a Solidity
+            # revert would undo it; Python must not publish early)
+            raise Revert("CommonBridge: withdrawal logs already published")
         if self.validium:
             if blob_versioned_hash:
                 raise Revert("ValidiumBlobPublished")
@@ -275,6 +281,9 @@ class OnChainProposerRules:
         for t in self.needed:
             if not keys.get(t):
                 raise Revert("MissingVerificationKeyForCommit")
+        if publish_root:
+            self.bridge.publish_withdrawals(batch_number, withdrawals_root,
+                                            caller_is_proposer=True)
         self.commitments[batch_number] = BatchCommitment(
             new_state_root=new_state_root,
             blob_versioned_hash=blob_versioned_hash,
@@ -311,9 +320,19 @@ class OnChainProposerRules:
         if len(counts) != 1:
             raise Revert("BatchArrayLengthMismatch")
         n = counts.pop()
-        for i in range(n):
-            self._verify_one(first_batch + i,
-                             {t: v[i] for t, v in proofs.items()}, now)
+        # all-or-nothing like the contract: a revert anywhere in the loop
+        # (including after remove_pending consumed queue entries) must leave
+        # proposer + bridge state untouched, so snapshot and restore
+        snap = (self.last_verified, dict(self.commitments),
+                self.bridge.pending_index)
+        try:
+            for i in range(n):
+                self._verify_one(first_batch + i,
+                                 {t: v[i] for t, v in proofs.items()}, now)
+        except Revert:
+            (self.last_verified, self.commitments,
+             self.bridge.pending_index) = snap
+            raise
 
     def _verify_one(self, batch_number: int, proofs: dict[str, bytes],
                     now: int) -> None:

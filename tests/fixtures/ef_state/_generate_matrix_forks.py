@@ -265,7 +265,9 @@ class Asm:
                     self.refund += (20000 - noop) if original == 0 \
                         else (5000 - noop)
             return self.op("SSTORE", cost)
-        # berlin
+        # berlin — clear refund is still 15000 (EIP-2200) on Berlin
+        # itself; EIP-3529 lowers it to 4800 only at London
+        clear_ref = 4800 if self.s.london else 15000
         cost = 0
         if slot not in self.warm_slots:
             cost += 2100
@@ -275,14 +277,14 @@ class Asm:
         elif current == original:
             cost += 20000 if original == 0 else 2900
             if original != 0 and new == 0:
-                self.refund += 4800
+                self.refund += clear_ref
         else:
             cost += 100
             if original != 0:
                 if current == 0:
-                    self.refund -= 4800
+                    self.refund -= clear_ref
                 elif new == 0:
-                    self.refund += 4800
+                    self.refund += clear_ref
             if new == original:
                 self.refund += (20000 - 100) if original == 0 \
                     else (5000 - 2100 - 100)

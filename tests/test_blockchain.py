@@ -249,3 +249,40 @@ def test_mempool_pending_queued_split():
     # account nonce advancing drops the low run from pending
     pending, queued = pool.split(lambda s: 3)
     assert sorted(pending[sender]) == [3, 4]
+
+
+def test_pipelined_import_failure_discards_layer():
+    """A failed pipelined import must not leak its batch node layer
+    (an orphaned open layer absorbs unrelated writes and stalls their
+    durability behind a never-imported tail block)."""
+    import dataclasses
+
+    from ethrex_tpu.primitives.block import Block
+
+    store, chain, gh = _setup()
+    store.enable_layering()
+    header = create_payload_header(
+        gh, chain.config, timestamp=12, coinbase=COINBASE)
+    result = build_payload(chain, gh, header, [_tx(0)], [])
+    bad = Block(dataclasses.replace(result.block.header,
+                                    state_root=b"\x11" * 32),
+                result.block.body)
+    layers_before = list(store.nodes.layer_tags())
+    with pytest.raises(InvalidBlock, match="state root"):
+        chain.add_blocks_pipelined([bad])
+    assert list(store.nodes.layer_tags()) == layers_before
+    # the good block still imports cleanly afterwards
+    chain.add_blocks_pipelined([result.block])
+    assert store.latest_number() == 0  # head moves only on fork choice
+    assert store.get_header(result.block.hash) is not None
+
+
+def test_berlin_clear_refund_schedule():
+    """EIP-3529 lowered the SSTORE clear refund to 4800 at LONDON; Berlin
+    itself still refunds 15000 (EIP-2200 value under EIP-2929 pricing)."""
+    from ethrex_tpu.evm import gas as G
+    from ethrex_tpu.primitives.genesis import Fork
+
+    assert G.schedule_for(Fork.BERLIN).sstore_clear_refund == 15000
+    assert G.schedule_for(Fork.LONDON).sstore_clear_refund == 4800
+    assert G.schedule_for(Fork.CANCUN).sstore_clear_refund == 4800

@@ -257,3 +257,74 @@ def test_versioned_hash_shape():
     h = versioned_hash(2, [b"\x01" * 32, b"\x02" * 32])
     assert h[:2] == (2).to_bytes(2, "big")
     assert h[2:] == keccak256(b"\x01" * 32 + b"\x02" * 32)[2:]
+
+
+# ---- revert rollback semantics (Python has no implicit state rollback) ----
+
+def test_failed_claim_does_not_brick_withdrawal():
+    """A claim attempt with a bad proof must not consume the message id:
+    the subsequent legitimate claim has to succeed (Solidity reverts roll
+    claimed state back; checks-before-effects must emulate that)."""
+    bridge, prop = _fixture()
+    bridge.deposit(USER, USER, 1000, now=0)
+    amount = 400
+    msg_hash = keccak256(b"\x00" * 20 + b"\x00" * 20 + USER
+                         + amount.to_bytes(32, "big"))
+    leaves = [withdrawal_leaf(L2_BRIDGE, msg_hash, 0),
+              withdrawal_leaf(L2_BRIDGE, keccak256(b"other"), 1)]
+    root, layers = _withdrawal_tree(leaves)
+    proof = _proof_for(layers, 0)
+    _commit(prop, 1, wroot=root)
+    prop.verify_batches(OWNER, 1, {"tpu": [b"ok"]})
+    bad_proof = [b"\x00" * 32] + proof[1:]
+    with pytest.raises(Revert, match="Invalid proof"):
+        bridge.claim_withdrawal(USER, amount, 1, 0, bad_proof)
+    # id 0 must NOT be marked claimed by the failed attempt
+    bridge.claim_withdrawal(USER, amount, 1, 0, proof)
+    assert bridge.deposits_pool == 600
+
+
+def test_failed_verify_keeps_privileged_queue():
+    """verify_batches is all-or-nothing: a bad proof mid-call must leave
+    the privileged queue, last_verified and pruning untouched."""
+    bridge, prop = _fixture()
+    bridge.deposit(USER, USER, 100, now=1000)
+    bridge.deposit(USER, USER, 200, now=1000)
+    rolling = bridge.pending_versioned_hash(2)
+    _commit(prop, 1, priv=rolling)
+    before_pending = bridge._pending_len()
+    with pytest.raises(Revert, match="InvalidTpuProof"):
+        prop.verify_batches(OWNER, 1, {"tpu": [b"bad"]}, now=1001)
+    assert bridge._pending_len() == before_pending
+    assert prop.last_verified == 0
+    assert 1 in prop.commitments
+    # the legitimate retry succeeds and consumes the queue
+    prop.verify_batches(OWNER, 1, {"tpu": [b"ok"]}, now=1001)
+    assert bridge._pending_len() == 0
+
+
+def test_failed_multi_batch_verify_rolls_back_all():
+    """A failure on batch k of a multi-batch verifyBatches call must
+    roll back batches < k too (non-atomic loop divergence)."""
+    bridge, prop = _fixture()
+    _commit(prop, 1)
+    _commit(prop, 2, root=b"\x12" * 32, last_hash=b"\x23" * 32)
+    with pytest.raises(Revert, match="InvalidTpuProof"):
+        prop.verify_batches(OWNER, 1, {"tpu": [b"ok", b"bad"]})
+    assert prop.last_verified == 0
+    assert 1 in prop.commitments  # batch 1 not pruned by the failed call
+    prop.verify_batches(OWNER, 1, {"tpu": [b"ok", b"ok"]})
+    assert prop.last_verified == 2
+
+
+def test_failed_commit_does_not_publish_withdrawals():
+    """commit_batch publishing the withdrawal root before a later revert
+    check would block the retry with 'already published'."""
+    _, prop = _fixture()
+    wroot = b"\x55" * 32
+    # zero commit hash trips a check AFTER the old publish point
+    with pytest.raises(Revert, match="CommitHashIsZero"):
+        _commit(prop, 1, wroot=wroot, commit=b"\x00" * 32)
+    assert not prop.bridge.withdrawal_roots
+    _commit(prop, 1, wroot=wroot)  # retry must not hit 'already published'
+    assert prop.bridge.withdrawal_roots[1] == wroot
