@@ -68,17 +68,33 @@ def is_token_call_shape(tx: Transaction) -> bool:
             and not tx.authorization_list)
 
 
+def is_generic_call_shape(tx: Transaction) -> bool:
+    """Static shape of a potentially-provable generic bytecode call
+    (round 5, models/bytecode_air.py).  Over-approximates: whether the
+    EXECUTED trace stays inside the circuit's opcode subset and machine
+    envelope is only known after running guest/bytecode_vm.run_trace."""
+    return (tx.tx_type in (0, 1, 2)
+            and tx.to is not None
+            and tx.value == 0
+            and not tx.access_list
+            and not tx.blob_versioned_hashes
+            and not tx.authorization_list)
+
+
 @dataclasses.dataclass
 class TxMeta:
     sender: bytes
-    recipient: bytes      # tx.to: transfer recipient / token contract
+    recipient: bytes      # tx.to: transfer recipient / called contract
     value: int
     fee: int
     tip: int
-    kind: str = "xfer"    # "xfer" | "tok"
+    kind: str = "xfer"    # "xfer" | "tok" | "gen"
     gas: int = TRANSFER_GAS
     dst: bytes = b""      # token transfer destination (kind == "tok")
     amount: int = 0       # token transfer amount (kind == "tok")
+    data: bytes = b""     # calldata (kind == "gen")
+    code: bytes = b""     # contract bytecode (kind == "gen")
+    steps: list = dataclasses.field(default_factory=list)  # StepRecs
 
 
 @dataclasses.dataclass
@@ -103,11 +119,20 @@ class TokSeg:
 
 
 @dataclasses.dataclass
+class BcCall:
+    """One generic call's circuit witness (models/bytecode_air.py)."""
+
+    steps: list            # bytecode_vm.StepRec
+    snaps: list            # bytecode_vm.Snapshot
+
+
+@dataclasses.dataclass
 class VmBatch:
     blocks_log: list       # fine per-tx raw log
     segs: list             # TxSeg/CbSeg stream (account circuit)
     tok_segs: list         # TokSeg stream (storage circuit; may be empty)
     blocks: list           # BlockMeta per block
+    bc_calls: list = dataclasses.field(default_factory=list)  # BcCall
 
 
 # Backwards-compatible alias used by round-3 call sites/tests.
@@ -165,15 +190,23 @@ def build_transfer_batch(blocks, coarse_log: list) -> TransferBatch:
 
 
 def build_vm_batch(blocks, coarse_log: list,
-                   receipts_per_block: list | None) -> VmBatch:
-    """Derive the fine log + circuit segments for a transfer/token batch.
+                   receipts_per_block: list | None,
+                   oracles=None) -> VmBatch:
+    """Derive the fine log + circuit segments for a transfer/token/
+    generic batch.
 
     `blocks` are the executed blocks, `coarse_log` the executor's raw
     write log (source of batch-pre states and the consistency oracle),
     `receipts_per_block` the executor's receipts (per-tx gas for token
-    calls; may be None for batches without token calls).  Raises
+    and generic calls; may be None for batches without calls).
+    `oracles` (optional, guest/witness_oracles.WitnessOracles-shaped)
+    resolves batch-pre account RLPs / storage slots / code for the
+    GENERIC call class — reads the coarse log never witnessed; without
+    it, generic calls fall back to claimed-log mode.  Raises
     NotTransferBatch when out of scope.
     """
+    from . import bytecode_vm as bv
+
     for block in coarse_log:
         for entry in block:
             if entry[0] == "clear":
@@ -183,7 +216,10 @@ def build_vm_batch(blocks, coarse_log: list,
     pre = _first_seen_olds(coarse_log)
     spre = _first_seen_slot_olds(coarse_log)
     sstate: dict[tuple, int] = {}
+    sread: dict[tuple, int] = {}          # oracle-resolved batch-pre reads
     token_contracts: dict[bytes, AccountState] = {}  # validated templates
+    contract_rlp: dict[bytes, bytes] = {}  # generic targets: current RLP
+    contract_code: dict[bytes, bytes] = {}
 
     def acct(addr: bytes) -> AccountState | None:
         if addr not in state:
@@ -202,6 +238,40 @@ def build_vm_batch(blocks, coarse_log: list,
             sstate[k] = spre[k]
         return sstate[k]
 
+    def gen_sget(contract: bytes, slot: int) -> int:
+        """Current value of a slot for the generic interpreter: model
+        state, else the coarse log's batch-pre, else the witness
+        oracle (a slot only ever READ never surfaces in any write log)."""
+        k = (contract, slot)
+        if k in sstate:
+            return sstate[k]
+        if k in spre:
+            return spre[k]
+        if k not in sread:
+            v = None if oracles is None else oracles.sload(contract, slot)
+            if v is None:
+                raise NotTransferBatch("generic read outside the oracle")
+            sread[k] = int(v)
+        return sread[k]
+
+    def gen_contract(addr: bytes) -> bytes:
+        """The generic target's CURRENT account RLP + cached code."""
+        if addr not in contract_rlp:
+            rlp_bytes = pre.get(addr, b"")
+            if not rlp_bytes and oracles is not None:
+                rlp_bytes = oracles.account_rlp(addr) or b""
+            if not rlp_bytes:
+                raise NotTransferBatch("generic target unresolvable")
+            contract_rlp[addr] = rlp_bytes
+        if addr not in contract_code:
+            st = AccountState.decode(contract_rlp[addr])
+            code = b"" if st.code_hash == EMPTY_CODE_HASH else (
+                None if oracles is None else oracles.code(st.code_hash))
+            if code is None:
+                raise NotTransferBatch("generic target code unresolvable")
+            contract_code[addr] = code
+        return contract_code[addr]
+
     def validate_token_contract(addr: bytes) -> None:
         if addr in token_contracts:
             return
@@ -216,6 +286,8 @@ def build_vm_batch(blocks, coarse_log: list,
     blocks_log = []
     segs: list = []
     tok_segs: list = []
+    bc_calls: list = []
+    gen_targets: set[bytes] = set()
     metas = []
     for bi, block in enumerate(blocks):
         h = block.header
@@ -230,8 +302,17 @@ def build_vm_batch(blocks, coarse_log: list,
                 raise NotTransferBatch("privileged tx in batch")
             plain = is_plain_transfer(tx)
             token = not plain and is_token_call_shape(tx)
-            if not plain and not token:
+            generic = (not plain and not token
+                       and is_generic_call_shape(tx))
+            if not plain and not token and not generic:
                 raise NotTransferBatch("tx shape out of scope")
+            if plain and receipts_per_block is not None:
+                # a data-less value-0 call to a CONTRACT is statically a
+                # transfer shape; the executor's gas betrays the code run
+                rec_gas = (receipts_per_block[bi][ti].cumulative_gas_used
+                           - cum_gas)
+                if rec_gas != TRANSFER_GAS and is_generic_call_shape(tx):
+                    plain, generic = False, True
             sender = tx.sender()
             if sender is None:
                 raise NotTransferBatch("unrecoverable sender")
@@ -254,10 +335,11 @@ def build_vm_batch(blocks, coarse_log: list,
                 gas = TRANSFER_GAS
             else:
                 if receipts is None:
-                    raise NotTransferBatch("token call without receipts")
+                    raise NotTransferBatch("call without receipts")
                 if not succeeded:
-                    raise NotTransferBatch("reverted token call")
-                validate_token_contract(tx.to)
+                    raise NotTransferBatch("reverted call")
+                if token:
+                    validate_token_contract(tx.to)
                 value = 0
                 gas = gas_used
             fee = gas * price
@@ -305,6 +387,43 @@ def build_vm_batch(blocks, coarse_log: list,
                                   r_new, value, fee, tip, r_created,
                                   r_noop))
                 txmetas.append(TxMeta(sender, tx.to, value, fee, tip))
+            elif generic:
+                if oracles is None:
+                    raise NotTransferBatch("generic call without oracles")
+                code = gen_contract(tx.to)
+                try:
+                    gsteps, gsnaps, gwrites = bv.run_trace(
+                        code, tx.data, sender, 0,
+                        lambda slot, _to=tx.to: gen_sget(_to, slot))
+                except bv.UnsupportedTrace as e:
+                    raise NotTransferBatch(f"generic trace: {e}")
+                # per-tx slot rows in first-touch order; reads emit no-op
+                # rows so their values are bound into r_pre and audited
+                # by the witness replay
+                txold: dict[int, int] = {}
+                order: list[int] = []
+                for st in gsteps:
+                    if st.op in (bv.OP_SLOAD, bv.OP_SSTORE) \
+                            and st.a not in txold:
+                        txold[st.a] = gen_sget(tx.to, st.a)
+                        order.append(st.a)
+                txnew = dict(txold)
+                for slot, v in gwrites:
+                    txnew[slot] = v
+                for slot in order:
+                    rows.append(("slot", tx.to, slot, txold[slot],
+                                 txnew[slot]))
+                for slot in order:
+                    sstate[(tx.to, slot)] = txnew[slot]
+                if tx.to not in touched_contracts:
+                    touched_contracts.append(tx.to)
+                gen_targets.add(tx.to)
+                segs.append(TxSeg(sender, tx.to, s_old, s_new, None, None,
+                                  0, fee, tip, False, True))
+                bc_calls.append(BcCall(gsteps, gsnaps))
+                txmetas.append(TxMeta(sender, tx.to, 0, fee, tip,
+                                      kind="gen", gas=gas, data=tx.data,
+                                      code=code, steps=gsteps))
             else:
                 dst, amount = tmpl.decode_transfer_calldata(tx.data)
                 # code-hash pin FIRST, even for zero-amount calls: a
@@ -372,18 +491,28 @@ def build_vm_batch(blocks, coarse_log: list,
         for caddr in touched_contracts:
             centry = coarse_accts.get(caddr)
             if centry is None:
-                raise NotTransferBatch(
-                    "token contract missing from the coarse log")
+                if caddr not in gen_targets:
+                    raise NotTransferBatch(
+                        "token contract missing from the coarse log")
+                # read-only this block: a no-op account row still binds
+                # the contract's code_hash + storage_root into r_pre (the
+                # pure verifier pins the claimed code to this row)
+                cur = contract_rlp.get(caddr) or pre.get(caddr, b"")
+                if not cur:
+                    raise NotTransferBatch("contract row unresolvable")
+                rows.append(("acct", caddr, None, cur, cur, False))
+                continue
             _, _, _, old_rlp, new_rlp, cleared = centry
             if cleared or not old_rlp or not new_rlp:
-                raise NotTransferBatch("token contract lifecycle change")
+                raise NotTransferBatch("contract lifecycle change")
             o = AccountState.decode(old_rlp)
             n = AccountState.decode(new_rlp)
             if (o.nonce, o.balance, o.code_hash) != \
                     (n.nonce, n.balance, n.code_hash):
                 raise NotTransferBatch(
-                    "token contract account fields changed")
+                    "called contract account fields changed")
             rows.append(centry)
+            contract_rlp[caddr] = new_rlp
         blocks_log.append(rows)
         metas.append(BlockMeta(h.coinbase, base_fee, txmetas))
 
@@ -391,7 +520,7 @@ def build_vm_batch(blocks, coarse_log: list,
     # states exactly, or the batch is out of scope
     fin = _final_news(coarse_log)
     for addr, want in fin.items():
-        if addr in token_contracts:
+        if addr in token_contracts or addr in gen_targets:
             continue  # storage_root delta audited via the witness replay
         got = state.get(addr)
         got_rlp = got.encode() if got is not None else b""
@@ -406,14 +535,22 @@ def build_vm_batch(blocks, coarse_log: list,
                     f"model touches {addr.hex()} the executor did not")
     sfin = _final_slot_news(coarse_log)
     for key, want_v in sfin.items():
-        if key[0] not in token_contracts:
+        if key[0] not in token_contracts and key[0] not in gen_targets:
             raise NotTransferBatch(
-                "storage write outside the token model")
+                "storage write outside the model")
         if sstate.get(key) != want_v:
             raise NotTransferBatch(
                 f"slot model diverges at {key[0].hex()}[{key[1]:#x}]")
-    # (every sstate key came through sget, which requires a coarse entry,
-    # so "model touches an unlogged slot" cannot happen — the enforcement
-    # point is sget's raise)
+    # slots the model touched but the coarse log netted out: the model's
+    # final value must equal the batch-pre value (else it diverges from
+    # the executor, which saw no net write there).  Token-path keys came
+    # through sget (coarse-seeded); generic keys may be oracle-seeded.
+    for key, v in sstate.items():
+        if key in sfin:
+            continue
+        base = spre.get(key, sread.get(key))
+        if base is None or v != base:
+            raise NotTransferBatch(
+                "model writes a slot the executor did not")
     return VmBatch(blocks_log=blocks_log, segs=segs, tok_segs=tok_segs,
-                   blocks=metas)
+                   blocks=metas, bc_calls=bc_calls)

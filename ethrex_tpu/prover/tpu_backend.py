@@ -44,23 +44,37 @@ log's slot rows + the claimed calldata (slot keys re-derived by keccak
 from the claimed sender/dst), so tampering any storage slot's NEW value
 in the write log leaves no satisfiable proof either.
 
+Round-5: the generic bytecode AIR.  Transactions calling ARBITRARY
+bytecode are provable when the executed trace stays inside the supported
+opcode subset and machine envelope (guest/bytecode_vm.py): each such
+call gets its own STARK (models/bytecode_air.py) proving every step's
+stack/memory/storage/control-flow semantics, with the step records
+absorbed into a public digest the verifier recomputes from the claimed
+step list — checking opcodes/immediates against the claimed code
+(pinned by keccak to the code_hash inside the contract's account row,
+which r_pre commits), calldata/env values against the claimed tx, and
+storage records against the SAME write-log rows the state circuit
+applies.  Reads enter the fine log as no-op rows so r_pre commits them
+and the witness replay audits them.
+
 Residual trust gaps in vm mode, all closed natively by
 `verify_with_input` and documented here for the wire verifier:
   * tx-list authenticity (the claimed senders/values/calldata vs the
     signed txs in the committed blocks) — the circuit binds the claimed
     list, the witness check compares it against the batch's blocks;
   * fee/tip vs base fee: for transfers verify checks fee - tip ==
-    21000 * base_fee on the claimed per-block base fee; for token calls
-    fee = g*price is checked against the CLAIMED per-tx gas g (bounded
-    below by 21000), whose truth is witness-checked (a wrong g shifts
-    balances and breaks the replayed state root);
-  * the token contract's code hash: pure verify only sees the claimed
-    log (the template pin needs the witness);
-  * the token contract's account row may change only its storage_root
+    21000 * base_fee on the claimed per-block base fee; for token and
+    generic calls fee = g*price is checked against the CLAIMED per-tx
+    gas g (bounded below by 21000), whose truth is witness-checked (a
+    wrong g shifts balances and breaks the replayed state root);
+  * gas/refund accounting inside generic calls is NOT in-circuit (the
+    executed path's semantics are gas-independent once the receipt says
+    it succeeded; the receipt itself is bound by the receipts root);
+  * the contract account rows may change only their storage_root
     (natively checked); the root's VALUE is MPT work left to the witness
     replay;
-  * batches outside the transfer+token class still use the claimed-log
-    mode (state proof + binding only) — the next arithmetization stage.
+  * batches outside the transfer/token/generic-subset class still use
+    the claimed-log mode (state proof + binding only).
 """
 
 from __future__ import annotations
@@ -92,14 +106,20 @@ def output_to_limbs(output_bytes: bytes) -> list[int]:
 def binding_limbs(output_bytes: bytes, r_pre: list[int], r_post: list[int],
                   digest: list[int],
                   vmdigest: list[int] | None = None,
-                  tokdigest: list[int] | None = None) -> list[int]:
+                  tokdigest: list[int] | None = None,
+                  bcdigests: list | None = None) -> list[int]:
     """Message of the binding sponge: output bytes, the state proof's 24
-    public limbs, then a mode limb + statement digest for each VM circuit
-    (zeroed in claimed-log mode) — one padded stream."""
+    public limbs, a mode limb + statement digest for each VM circuit
+    (zeroed in claimed-log mode), then the generic-call digests prefixed
+    by their count — one padded stream."""
     limbs = output_to_limbs(output_bytes) + list(r_pre) + list(r_post) \
         + list(digest)
     for d in (vmdigest, tokdigest):
         limbs += [0] * 9 if d is None else [1] + list(d)
+    bcdigests = bcdigests or []
+    limbs += [len(bcdigests)]
+    for d in bcdigests:
+        limbs += list(d)
     return pair.pad_message_limbs(limbs)
 
 
@@ -110,10 +130,18 @@ def _schedule_for(depth: int) -> int:
     return max(8, 1 << (need - 1).bit_length())
 
 
+def _mode_of(vm_batch) -> str:
+    """The single classifier both the prover's metadata and the
+    committer's expected_vm_mode derive from — one definition, because
+    check_coverage demands strict equality between the two."""
+    return "generic" if vm_batch.bc_calls else (
+        "token" if vm_batch.tok_segs else "transfer")
+
+
 def _vm_meta_json(vm_batch) -> dict:
-    mode = "token" if vm_batch.tok_segs else "transfer"
     blocks = []
-    for b in vm_batch.blocks:
+    codes: dict[str, str] = {}   # contract addr -> bytecode (one per
+    for b in vm_batch.blocks:    # contract, however many calls hit it)
         txs = []
         for t in b.txs:
             row = {"sender": t.sender.hex(), "to": t.recipient.hex(),
@@ -121,23 +149,36 @@ def _vm_meta_json(vm_batch) -> dict:
             if t.kind == "tok":
                 row.update({"kind": "tok", "gas": t.gas,
                             "dst": t.dst.hex(), "amount": t.amount})
+            elif t.kind == "gen":
+                row.update({"kind": "gen", "gas": t.gas,
+                            "data": t.data.hex(),
+                            "steps": [s.to_json() for s in t.steps]})
+                codes[t.recipient.hex()] = t.code.hex()
             txs.append(row)
         blocks.append({"coinbase": b.coinbase.hex(),
                        "base_fee": b.base_fee, "txs": txs})
-    return {"mode": mode, "blocks": blocks}
+    out = {"mode": _mode_of(vm_batch), "blocks": blocks}
+    if codes:
+        out["codes"] = codes
+    return out
 
 
 def _vm_stream_from_claims(vm_meta: dict, blocks_log: list):
     """Build the VM digest streams a verifier recomputes from the claimed
     tx list + the claimed write log; performs the native structural and
-    fee-relation checks of vm mode.  Returns (transfer_items, tok_items).
+    fee-relation checks of vm mode.  Returns (transfer_items, tok_items,
+    bc_pubs) where bc_pubs holds one 8-limb digest per generic call (the
+    claimed step lists are pinned to the claimed code/calldata/log by
+    guest/bytecode_vm.check_steps — data indexing, no EVM execution).
     Raises ValueError on any mismatch."""
+    from ..guest import bytecode_vm as bv
     from ..guest import flat_model
     from ..guest import token_template as tmpl
+    from ..models import bytecode_air as bca
     from ..models import transfer_air as ta
 
     mode = vm_meta.get("mode")
-    if mode not in ("transfer", "token"):
+    if mode not in ("transfer", "token", "generic"):
         raise ValueError("unknown vm mode")
     blocks = vm_meta["blocks"]
     if len(blocks) != len(blocks_log):
@@ -166,13 +207,21 @@ def _vm_stream_from_claims(vm_meta: dict, blocks_log: list):
             raise ValueError("vm slot value out of range")
         return old_v, new_v
 
+    # untrusted-size guards, mirroring the 1MB write_log cap in _check
+    claimed_codes = vm_meta.get("codes", {})
+    if len(claimed_codes) > 1024 or any(
+            len(c) > 2 * 0x40000 for c in claimed_codes.values()):
+        raise ValueError("vm code claims too large")
+
     items = []
     tok_items = []
+    bc_pubs: list = []
     for bmeta, rows in zip(blocks, blocks_log):
         coinbase = bytes.fromhex(bmeta["coinbase"])
         base_fee = int(bmeta["base_fee"])
         cursor = 0
         touched_contracts: list[bytes] = []
+        gen_codes: dict[bytes, bytes] = {}
         for txm in bmeta["txs"]:
             value = int(txm["value"])
             fee = int(txm["fee"])
@@ -183,7 +232,7 @@ def _vm_stream_from_claims(vm_meta: dict, blocks_log: list):
             sender = bytes.fromhex(txm["sender"])
             to = bytes.fromhex(txm["to"])
             if kind == "tok":
-                if mode != "token":
+                if mode not in ("token", "generic"):
                     raise ValueError("token tx outside token mode")
                 if value != 0:
                     raise ValueError("token call with value")
@@ -194,11 +243,54 @@ def _vm_stream_from_claims(vm_meta: dict, blocks_log: list):
                 if g < 21000 or fee - tip != g * base_fee \
                         or fee % g or tip % g:
                     raise ValueError("vm token fee out of model")
+            elif kind == "gen":
+                if mode != "generic":
+                    raise ValueError("generic tx outside generic mode")
+                if value != 0:
+                    raise ValueError("generic call with value")
+                g = int(txm["gas"])
+                if g < 21000 or fee - tip != g * base_fee \
+                        or fee % g or tip % g:
+                    raise ValueError("vm generic fee out of model")
             elif fee - tip != 21000 * base_fee:
                 raise ValueError("vm fee does not match the base fee")
             ks, os_, ns = acct_digests(rows[cursor], sender)
             cursor += 1
-            if kind == "tok":
+            if kind == "gen":
+                code_hex = claimed_codes.get(txm["to"])
+                if code_hex is None:
+                    raise ValueError("vm generic call without code claim")
+                if len(txm["steps"]) > bv.MAX_STEPS \
+                        or len(txm["data"]) > 2_000_000:
+                    raise ValueError("vm generic claims too large")
+                code = bytes.fromhex(code_hex)
+                data = bytes.fromhex(txm["data"])
+                steps = [bv.StepRec.from_json(s) for s in txm["steps"]]
+                touched: list[int] = []
+                seen: set[int] = set()
+                for st in steps:
+                    if st.op in (bv.OP_SLOAD, bv.OP_SSTORE) \
+                            and st.a not in seen:
+                        seen.add(st.a)
+                        touched.append(st.a)
+                slot_rows = []
+                for slot in touched:
+                    old_v, new_v = slot_row(rows[cursor], to, slot)
+                    cursor += 1
+                    slot_rows.append((slot, old_v, new_v))
+                try:
+                    bv.check_steps(code, data, sender, 0, steps,
+                                   slot_rows)
+                except bv.StepCheckError as e:
+                    raise ValueError(f"vm generic steps: {e}")
+                bc_pubs.append(bca.bc_digest_stream(steps))
+                if to not in touched_contracts:
+                    touched_contracts.append(to)
+                if gen_codes.setdefault(to, code) != code:
+                    raise ValueError("vm generic code claim inconsistent")
+                kr = flat_model.account_key_digest(to)
+                orr = nr = [0] * 8
+            elif kind == "tok":
                 amount = int(txm["amount"])
                 dst = bytes.fromhex(txm["dst"])
                 if not (0 <= amount < 1 << 256):
@@ -242,20 +334,60 @@ def _vm_stream_from_claims(vm_meta: dict, blocks_log: list):
             entry = rows[cursor]
             cursor += 1
             if entry[0] != "acct" or entry[1] != caddr or entry[5]:
-                raise ValueError("vm token contract row mismatch")
+                raise ValueError("vm contract row mismatch")
             old_rlp, new_rlp = entry[3], entry[4]
             if not old_rlp or not new_rlp:
-                raise ValueError("vm token contract lifecycle change")
+                raise ValueError("vm contract lifecycle change")
             o = flat_model.AccountState.decode(old_rlp)
             n = flat_model.AccountState.decode(new_rlp)
             if (o.nonce, o.balance, o.code_hash) != \
                     (n.nonce, n.balance, n.code_hash):
-                raise ValueError("vm token contract fields changed")
+                raise ValueError("vm contract fields changed")
+            code = gen_codes.get(caddr)
+            if code is not None:
+                # pin the claimed bytecode to the account row r_pre binds
+                from ..crypto.keccak import keccak256
+                from ..primitives.account import EMPTY_CODE_HASH
+
+                want = EMPTY_CODE_HASH if not code else keccak256(code)
+                if o.code_hash != want:
+                    raise ValueError("vm generic code hash mismatch")
         if cursor != len(rows):
             raise ValueError("vm log shape mismatch")
     if mode == "token" and not tok_items:
         raise ValueError("token mode without token txs")
-    return items, tok_items
+    if mode == "generic" and not bc_pubs:
+        raise ValueError("generic mode without generic txs")
+    return items, tok_items, bc_pubs
+
+
+def vm_mode_from_artifacts(blocks, coarse_log, receipts, witness,
+                           initial_root: bytes) -> str:
+    """The VM-circuit coverage an honest prover reaches on this batch,
+    classified from execution artifacts already in hand (the committer
+    captures them during witness generation — no extra execution)."""
+    from ..guest import transfer_log as tl_mod
+    from ..guest.witness_oracles import WitnessOracles
+
+    try:
+        oracles = WitnessOracles(witness, initial_root)
+        vb = tl_mod.build_vm_batch(blocks, coarse_log, receipts,
+                                   oracles=oracles)
+    except tl_mod.NotTransferBatch:
+        return "claimed"
+    return _mode_of(vb)
+
+
+def expected_vm_mode(program_input: ProgramInput) -> str:
+    """The classifier over a bare ProgramInput (stateless re-execution;
+    committers with live artifacts use vm_mode_from_artifacts)."""
+    blocks_log: list = []
+    receipts: list = []
+    output = execution_program(program_input, write_log=blocks_log,
+                               receipts_out=receipts)
+    return vm_mode_from_artifacts(program_input.blocks, blocks_log,
+                                  receipts, program_input.witness,
+                                  output.initial_state_root)
 
 
 class TpuBackend(ProverBackend):
@@ -270,6 +402,7 @@ class TpuBackend(ProverBackend):
 
     def prove(self, program_input: ProgramInput, proof_format: str) -> dict:
         from ..guest import transfer_log as tl_mod
+        from ..guest.witness_oracles import WitnessOracles
         from ..models import token_air as tka
         from ..models import transfer_air as ta
 
@@ -281,8 +414,11 @@ class TpuBackend(ProverBackend):
 
         vm_batch = None
         try:
+            oracles = WitnessOracles(program_input.witness,
+                                     output.initial_state_root)
             vm_batch = tl_mod.build_vm_batch(program_input.blocks,
-                                             blocks_log, receipts)
+                                             blocks_log, receipts,
+                                             oracles=oracles)
             blocks_log = vm_batch.blocks_log
         except tl_mod.NotTransferBatch:
             pass
@@ -304,6 +440,9 @@ class TpuBackend(ProverBackend):
         tok_pub = None
         tok_proof = None
         tok_air = None
+        bc_pubs: list = []
+        bc_proofs: list = []
+        bc_airs: list = []
         if vm_batch is not None:
             vm_air = ta.TransferAir()
             vm_trace = ta.generate_transfer_trace(vm_batch.segs)
@@ -317,9 +456,21 @@ class TpuBackend(ProverBackend):
                 tok_proof = stark_prover.prove(tok_air, tok_trace,
                                                tok_pub, PARAMS,
                                                mesh=self.mesh)
+            if vm_batch.bc_calls:
+                from ..models import bytecode_air as bca
+
+                for call in vm_batch.bc_calls:
+                    air_bc = bca.BytecodeAir()
+                    bc_trace = bca.generate_bytecode_trace(call.steps,
+                                                           call.snaps)
+                    pub_bc = bca.bytecode_public_inputs(call.steps)
+                    bc_airs.append(air_bc)
+                    bc_pubs.append(pub_bc)
+                    bc_proofs.append(stark_prover.prove(
+                        air_bc, bc_trace, pub_bc, PARAMS, mesh=self.mesh))
 
         limbs = binding_limbs(encoded, r_pre, r_post, digest, vm_pub,
-                              tok_pub)
+                              tok_pub, bc_pubs)
         bind_air = pair.Poseidon2SpongeAir(num_chunks=len(limbs) // 8)
         bind_trace = pair.generate_sponge_trace(limbs)
         bind_pub = pair.sponge_public_inputs(limbs)
@@ -340,6 +491,8 @@ class TpuBackend(ProverBackend):
             proof["vm_proof"] = vm_proof
             if tok_proof is not None:
                 proof["tok_proof"] = tok_proof
+            if bc_proofs:
+                proof["bc_proofs"] = bc_proofs
         if proof_format in (protocol.FORMAT_COMPRESSED,
                             protocol.FORMAT_GROTH16):
             # recursion: one outer STARK proves every inner proof's FRI
@@ -354,12 +507,20 @@ class TpuBackend(ProverBackend):
             if tok_proof is not None:
                 airs.append(tok_air)
                 proofs.append(tok_proof)
+            airs.extend(bc_airs)
+            proofs.extend(bc_proofs)
             agg = agg_mod.aggregate(airs, proofs, PARAMS)
             proof["state_proof"], proof["proof"] = agg.inners[:2]
+            cursor = 2
             if vm_batch is not None:
-                proof["vm_proof"] = agg.inners[2]
+                proof["vm_proof"] = agg.inners[cursor]
+                cursor += 1
             if tok_proof is not None:
-                proof["tok_proof"] = agg.inners[3]
+                proof["tok_proof"] = agg.inners[cursor]
+                cursor += 1
+            if bc_proofs:
+                proof["bc_proofs"] = agg.inners[cursor:cursor
+                                                + len(bc_proofs)]
             proof["aggregate"] = {
                 "outer": agg.outer, "max_depth": agg.max_depth,
                 "seg_periods": agg.seg_periods,
@@ -415,11 +576,15 @@ class TpuBackend(ProverBackend):
         tok_air = None
         tok_proof = None
         tok_pub = None
+        bc_pubs: list = []
+        bc_proofs: list = []
+        bc_airs: list = []
         if vm_meta is not None:
             from ..models import token_air as tka
             from ..models import transfer_air as ta
 
-            items, tok_items = _vm_stream_from_claims(vm_meta, blocks_log)
+            items, tok_items, bc_pubs = _vm_stream_from_claims(vm_meta,
+                                                               blocks_log)
             vm_pub = ta.vm_digest_stream(items)
             vm_proof = proof["vm_proof"]
             if [int(v) % bb.P for v in vm_proof["pub_inputs"]] != vm_pub:
@@ -432,9 +597,20 @@ class TpuBackend(ProverBackend):
                         tok_pub:
                     raise ValueError("token proof does not bind this log")
                 tok_air = tka.TokenAir()
+            if bc_pubs:
+                from ..models import bytecode_air as bca
+
+                bc_proofs = proof.get("bc_proofs") or []
+                if len(bc_proofs) != len(bc_pubs):
+                    raise ValueError("generic proof count mismatch")
+                for p, pub in zip(bc_proofs, bc_pubs):
+                    if [int(v) % bb.P for v in p["pub_inputs"]] != pub:
+                        raise ValueError(
+                            "generic proof does not bind its steps")
+                    bc_airs.append(bca.BytecodeAir())
 
         limbs = binding_limbs(encoded, r_pre, r_post, digest, vm_pub,
-                              tok_pub)
+                              tok_pub, bc_pubs)
         bind = proof["proof"]
         if [int(v) for v in bind["pub_inputs"][:len(limbs)]] != limbs:
             raise ValueError("binding proof does not bind this statement")
@@ -448,6 +624,8 @@ class TpuBackend(ProverBackend):
         if tok_air is not None:
             airs.append(tok_air)
             proofs.append(tok_proof)
+        airs.extend(bc_airs)
+        proofs.extend(bc_proofs)
 
         agg_info = proof.get("aggregate")
         if agg_info is not None:
@@ -483,6 +661,18 @@ class TpuBackend(ProverBackend):
                 stark_verifier.VerificationError):
             return False
 
+    def check_coverage(self, proof: dict, expected_mode: str) -> bool:
+        """Reject mode downgrades WITHOUT the witness: the committer
+        derived `expected_mode` by running the same deterministic
+        classifier the honest prover runs, so any other mode on the wire
+        is a forgery attempt (most importantly claimed-log for a batch
+        the circuits cover)."""
+        if not expected_mode:
+            return True    # pre-metadata batches: no constraint
+        vm = proof.get("vm")
+        actual = vm.get("mode") if isinstance(vm, dict) else "claimed"
+        return actual == expected_mode
+
     def verify_with_input(self, proof: dict,
                           program_input: ProgramInput) -> bool:
         """Full audit: every STARK + the witness MPT replay (trie ops
@@ -495,8 +685,10 @@ class TpuBackend(ProverBackend):
         the vm proofs."""
         from ..guest.execution import ProgramOutput
         from ..guest.transfer_log import (NotTransferBatch, build_vm_batch,
+                                          is_generic_call_shape,
                                           is_plain_transfer,
                                           is_token_call_shape)
+        from ..guest.witness_oracles import WitnessOracles
 
         try:
             blocks_log, encoded = self._check(proof)
@@ -504,14 +696,17 @@ class TpuBackend(ProverBackend):
             access_log.replay_log_against_witness(
                 blocks_log, program_input.witness.nodes,
                 output.initial_state_root, output.final_state_root)
+            oracles = WitnessOracles(program_input.witness,
+                                     output.initial_state_root)
             vm_meta = proof.get("vm")
             if vm_meta is None:
                 # downgrade check: a batch the circuits cover must carry
                 # the vm proofs.  The static predicate over-approximates
-                # the circuits' scope (e.g. a plain call to a contract
-                # address), so on ambiguity re-derive applicability
-                # exactly as the prover would.
+                # the circuits' scope (a generic-shape call may still
+                # leave the executed subset), so on ambiguity re-derive
+                # applicability exactly as the prover would.
                 if not all(is_plain_transfer(tx) or is_token_call_shape(tx)
+                           or is_generic_call_shape(tx)
                            for blk in program_input.blocks
                            for tx in blk.body.transactions):
                     return True
@@ -520,7 +715,8 @@ class TpuBackend(ProverBackend):
                     receipts: list = []
                     execution_program(program_input, write_log=coarse,
                                       receipts_out=receipts)
-                    build_vm_batch(program_input.blocks, coarse, receipts)
+                    build_vm_batch(program_input.blocks, coarse, receipts,
+                                   oracles=oracles)
                 except NotTransferBatch:
                     return True
                 return False
@@ -532,7 +728,7 @@ class TpuBackend(ProverBackend):
                 execution_program(program_input, write_log=coarse,
                                   receipts_out=receipts)
                 rebuilt = build_vm_batch(program_input.blocks, coarse,
-                                         receipts)
+                                         receipts, oracles=oracles)
             except NotTransferBatch:
                 return False
             return _vm_meta_json(rebuilt) == vm_meta

@@ -1,0 +1,232 @@
+"""Generic bytecode VM class (round 5, VERDICT #1): interpreter/checker
+parity with the real executor, fine-log integration incl. no-op read
+rows, witness replay, and (slow tier) the BytecodeAir STARK — a batch
+containing a contract the templates don't cover proven with NO
+claimed-log fallback, where tampering the write log defeats pure
+`verify`."""
+
+import pytest
+
+from ethrex_tpu.crypto import secp256k1
+from ethrex_tpu.guest import access_log
+from ethrex_tpu.guest import bytecode_vm as bv
+from ethrex_tpu.guest import transfer_log as tl
+from ethrex_tpu.guest.execution import ProgramInput, execution_program
+from ethrex_tpu.guest.witness import generate_witness
+from ethrex_tpu.guest.witness_oracles import WitnessOracles
+from ethrex_tpu.models import bytecode_air as bca
+from ethrex_tpu.node import Node
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.primitives.transaction import Transaction
+from ethrex_tpu.prover import tpu_backend as tb
+
+SECRET = 0xA11CE
+SENDER = secp256k1.pubkey_to_address(secp256k1.pubkey_from_secret(SECRET))
+CONTRACT = bytes.fromhex("c0de" * 10)
+RO = bytes.fromhex("0c0c" * 10)
+
+# registry-with-guard: key=cdload(0), val=cdload(32);
+# if sload(key) < val: sstore(key, val) else sstore(1000, val)
+CODE = bytes([
+    0x60, 0x00, 0x35, 0x60, 0x20, 0x35, 0x80, 0x82, 0x54, 0x10,
+    0x61, 0x00, 0x14, 0x57, 0x61, 0x03, 0xE8, 0x55, 0x50, 0x00,
+    0x5B, 0x90, 0x55, 0x00,
+])
+RO_CODE = bytes([0x60, 0x00, 0x54, 0x50, 0x00])   # sload(0); pop; stop
+
+GENESIS = {
+    "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+               "shanghaiTime": 0, "cancunTime": 0},
+    "alloc": {
+        "0x" + SENDER.hex(): {"balance": hex(10**21)},
+        "0x" + CONTRACT.hex(): {"balance": "0x0",
+                                "code": "0x" + CODE.hex(),
+                                "storage": {hex(5): hex(10)}},
+        "0x" + RO.hex(): {"balance": "0x0", "code": "0x" + RO_CODE.hex()},
+    },
+    "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7", "timestamp": "0x0",
+}
+
+
+def _cd(key, val):
+    return key.to_bytes(32, "big") + val.to_bytes(32, "big")
+
+
+def _tx(nonce, to, data):
+    return Transaction(
+        tx_type=2, chain_id=1337, nonce=nonce, max_priority_fee_per_gas=1,
+        max_fee_per_gas=10**10, gas_limit=200_000, to=to, value=0,
+        data=data).sign(SECRET)
+
+
+def _batch():
+    node = Node(Genesis.from_json(GENESIS))
+    node.submit_transaction(_tx(0, CONTRACT, _cd(5, 42)))   # store branch
+    node.submit_transaction(_tx(1, RO, b""))                 # read-only
+    node.submit_transaction(_tx(2, CONTRACT, _cd(5, 7)))     # alt branch
+    block = node.produce_block()
+    assert len(block.body.transactions) == 3
+    witness = generate_witness(node.chain, [block])
+    return node, ProgramInput(blocks=[block], witness=witness,
+                              config=node.config)
+
+
+@pytest.fixture(scope="module")
+def built():
+    node, pi = _batch()
+    coarse, receipts = [], []
+    out = execution_program(pi, write_log=coarse, receipts_out=receipts)
+    oracles = WitnessOracles(pi.witness, out.initial_state_root)
+    vb = tl.build_vm_batch(pi.blocks, coarse, receipts, oracles=oracles)
+    return pi, out, vb
+
+
+def test_interpreter_matches_executor(built):
+    _, _, vb = built
+    assert len(vb.bc_calls) == 3
+    # first call takes the store branch, third the alt branch
+    ops0 = [s.op for s in vb.bc_calls[0].steps]
+    ops2 = [s.op for s in vb.bc_calls[2].steps]
+    assert bv.OP_SSTORE in ops0 and bv.OP_SSTORE in ops2
+    assert ops0 != ops2     # different branches taken
+
+
+def test_stream_recompute_and_code_pin(built):
+    _, _, vb = built
+    meta = tb._vm_meta_json(vb)
+    assert meta["mode"] == "generic"
+    items, tok_items, bc_pubs = tb._vm_stream_from_claims(meta,
+                                                          vb.blocks_log)
+    assert len(bc_pubs) == 3
+    for call, pub in zip(vb.bc_calls, bc_pubs):
+        assert pub == bca.bc_digest_stream(call.steps)
+    # tamper the claimed code: the code-hash pin must reject
+    bad = tb._vm_meta_json(vb)
+    bad["codes"][CONTRACT.hex()] = (CODE + b"\x00").hex()
+    with pytest.raises(ValueError):
+        tb._vm_stream_from_claims(bad, vb.blocks_log)
+    # tamper a stored value in the write log: step replay must reject
+    bad_log = [list(rows) for rows in vb.blocks_log]
+    for i, e in enumerate(bad_log[0]):
+        if e[0] == "slot" and e[4] != e[3]:
+            bad_log[0][i] = (e[0], e[1], e[2], e[3], e[4] ^ 1)
+            break
+    with pytest.raises(ValueError):
+        tb._vm_stream_from_claims(tb._vm_meta_json(vb), bad_log)
+
+
+def test_witness_replay_with_noop_rows(built):
+    pi, out, vb = built
+    access_log.replay_log_against_witness(
+        vb.blocks_log, pi.witness.nodes,
+        out.initial_state_root, out.final_state_root)
+
+
+def test_checker_pins_control_flow(built):
+    _, _, vb = built
+    call = vb.bc_calls[0]
+    meta = tb._vm_meta_json(vb)
+    txm = meta["blocks"][0]["txs"][0]
+    code = bytes.fromhex(meta["codes"][txm["to"]])
+    data = bytes.fromhex(txm["data"])
+    rows = [(s.a, 0, 0) for s in []]  # rebuilt below
+    touched, seen = [], set()
+    for s in call.steps:
+        if s.op in (bv.OP_SLOAD, bv.OP_SSTORE) and s.a not in seen:
+            seen.add(s.a)
+            touched.append(s.a)
+    rows = []
+    cur = {}
+    for e in vb.blocks_log[0]:
+        if e[0] == "slot" and e[1] == CONTRACT and e[2] in touched \
+                and e[2] not in cur:
+            cur[e[2]] = True
+            rows.append((e[2], e[3], e[4]))
+    # legit passes
+    bv.check_steps(code, data, SENDER, 0, call.steps, rows)
+    # a step list that lands a jump off a JUMPDEST is rejected
+    steps = [bv.StepRec.from_json(s.to_json()) for s in call.steps]
+    for i, s in enumerate(steps):
+        if s.op == bv.OP_JUMPI and i + 1 < len(steps) \
+                and steps[i + 1].pc != s.pc + 1:
+            steps[i + 1].pc = s.pc + 1  # claim fall-through instead
+            break
+    with pytest.raises(bv.StepCheckError):
+        bv.check_steps(code, data, SENDER, 0, steps, rows)
+
+
+def test_value_transfer_to_contract_falls_back():
+    node = Node(Genesis.from_json(GENESIS))
+    t = Transaction(
+        tx_type=2, chain_id=1337, nonce=0, max_priority_fee_per_gas=1,
+        max_fee_per_gas=10**10, gas_limit=200_000, to=RO, value=5,
+        data=b"").sign(SECRET)
+    node.submit_transaction(t)
+    block = node.produce_block()
+    assert len(block.body.transactions) == 1
+    witness = generate_witness(node.chain, [block])
+    pi = ProgramInput(blocks=[block], witness=witness, config=node.config)
+    coarse, receipts = [], []
+    out = execution_program(pi, write_log=coarse, receipts_out=receipts)
+    oracles = WitnessOracles(pi.witness, out.initial_state_root)
+    with pytest.raises(tl.NotTransferBatch):
+        tl.build_vm_batch(pi.blocks, coarse, receipts, oracles=oracles)
+
+
+@pytest.mark.slow
+def test_bytecode_air_prove_verify():
+    """The registry program proven by the BytecodeAir STARK; a trace that
+    lies about the stored value cannot satisfy the constraints."""
+    import numpy as np
+
+    from ethrex_tpu.ops import babybear as bb
+    from ethrex_tpu.stark import prover as sp
+    from ethrex_tpu.stark import verifier as sv
+    from ethrex_tpu.stark.prover import StarkParams
+
+    pre = {5: 10}
+    cd = _cd(5, 42)
+    steps, snaps, writes = bv.run_trace(CODE, cd, SENDER, 0,
+                                        lambda s: pre.get(s, 0))
+    params = StarkParams(log_blowup=3, num_queries=40, log_final_size=4)
+    air = bca.BytecodeAir()
+    trace = bca.generate_bytecode_trace(steps, snaps)
+    pub = bca.bytecode_public_inputs(steps)
+    proof = sp.prove(air, trace, pub, params)
+    assert sv.verify(air, proof, params)
+    # flip one limb of the SSTORE record in the trace: no valid proof
+    bad = trace.copy()
+    k = next(i for i, s in enumerate(steps) if s.op == bv.OP_SSTORE)
+    rows = slice(k * bca.SEG_LEN, (k + 1) * bca.SEG_LEN)
+    bad[rows, bca.RB + 10] = (bad[rows, bca.RB + 10].astype(np.int64)
+                              + 1) % bb.P
+    p2 = sp.prove(air, bad, pub, params)
+    assert not sv.verify(air, p2, params)
+
+
+@pytest.mark.slow
+def test_generic_batch_end_to_end():
+    """TpuBackend on a batch with non-template contracts: NO claimed-log
+    fallback (vm.mode == generic), pure verify accepts, tampering the
+    write log's stored value makes pure verify reject, and
+    verify_with_input audits the real witness."""
+    node, pi = _batch()
+    backend = tb.TpuBackend()
+    proof = backend.prove(pi, "stark")
+    assert proof["vm"]["mode"] == "generic"
+    assert len(proof["bc_proofs"]) == 3
+    assert backend.verify(proof)
+    assert backend.verify_with_input(proof, pi)
+    # tamper a stored value in the wire write log
+    import copy
+
+    bad = copy.deepcopy(proof)
+    for rows in bad["write_log"]:
+        for row in rows:
+            if row[0] == "s" and row[3] != row[4]:
+                row[4] = "%064x" % (int(row[4], 16) ^ 1)
+                break
+        else:
+            continue
+        break
+    assert not backend.verify(bad)
