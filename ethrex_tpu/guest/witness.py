@@ -74,11 +74,16 @@ class ExecutionWitness:
         )
 
 
-def generate_witness(chain, blocks: list[Block]) -> ExecutionWitness:
+def generate_witness(chain, blocks: list[Block],
+                     write_log: list | None = None,
+                     receipts_out: list | None = None) -> ExecutionWitness:
     """Re-execute `blocks` recording every touched node/code/header.
 
     `chain` is a Blockchain whose store contains the blocks' ancestors and
-    the pre-state of blocks[0].
+    the pre-state of blocks[0].  `write_log`/`receipts_out` (optional)
+    capture the per-block raw trie writes and receipts during the same
+    pass — the committer derives the batch's VM coverage from them
+    without a second execution (review finding).
     """
     from ..evm.db import StateDB
     from ..storage.store import StoreSource
@@ -106,9 +111,15 @@ def generate_witness(chain, blocks: list[Block]) -> ExecutionWitness:
         src = StoreSource(store, state_root, nodes=recorder,
                           on_code=on_code, on_block_hash=on_block_hash)
         state_db = StateDB(src)
-        chain.execute_block(block, prev, state_db)
+        outcome = chain.execute_block(block, prev, state_db)
+        if receipts_out is not None:
+            receipts_out.append(outcome.receipts)
+        block_log = None if write_log is None else []
         state_root = store.apply_account_updates(state_root, state_db,
-                                                 nodes=recorder)
+                                                 nodes=recorder,
+                                                 write_log=block_log)
+        if write_log is not None:
+            write_log.append(block_log)
         prev = block.header
 
     # the guest validates ancestor headers as a hash-linked chain, so fill

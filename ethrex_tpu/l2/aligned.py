@@ -38,12 +38,25 @@ class AlignedLayer:
         self._submit_count = 0
         self.lock = threading.RLock()
 
-    def submit(self, first: int, last: int, proofs: dict) -> int:
-        """Validate and enqueue an aggregation request; returns its id."""
+    def submit(self, first: int, last: int, proofs: dict,
+               expected_modes: dict | None = None) -> int:
+        """Validate and enqueue an aggregation request; returns its id.
+
+        `expected_modes` (batch number -> committer-derived vm mode)
+        hardens against mode downgrades: a claimed-log tpu proof for a
+        batch the VM circuits cover is rejected here, before it can
+        settle (review finding — the stand-in previously accepted the
+        weak form)."""
         with self.lock:
             for prover_type, batch_proofs in proofs.items():
                 backend = get_backend(prover_type)
-                for proof in batch_proofs:
+                for i, proof in enumerate(batch_proofs):
+                    if expected_modes is not None and \
+                            not backend.check_coverage(
+                                proof, expected_modes.get(first + i, "")):
+                        raise ValueError(
+                            f"aligned: {prover_type} proof for batch "
+                            f"{first + i} downgrades its vm coverage")
                     if not backend.verify(proof):
                         raise ValueError(
                             f"aligned: invalid {prover_type} proof")
@@ -110,8 +123,17 @@ class L1ProofVerifier:
         }
         return first, last, proofs
 
+    def _expected_modes(self, first, last):
+        modes = {}
+        for n in range(first, last + 1):
+            batch = self.rollup.get_batch(n)
+            if batch is not None:
+                modes[n] = batch.vm_mode
+        return modes
+
     def _submit(self, first, last, proofs):
-        sid = self.aligned.submit(first, last, proofs)
+        sid = self.aligned.submit(first, last, proofs,
+                                  self._expected_modes(first, last))
         self.inflight = {"sid": sid, "first": first, "last": last,
                          "proofs": proofs, "submitted_at": time.time()}
 

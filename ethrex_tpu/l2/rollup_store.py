@@ -22,6 +22,11 @@ class Batch:
     commitment: bytes = b""        # commitment tx data hash (L1)
     committed: bool = False
     verified: bool = False
+    # VM-circuit coverage the committer derived for this batch
+    # ("transfer" | "token" | "generic" | "claimed"); wire verifiers
+    # reject tpu proofs whose mode differs — a prover cannot downgrade a
+    # circuit-covered batch to the claimed-log form (review finding)
+    vm_mode: str = ""
 
 
 class RollupStore:
@@ -135,6 +140,7 @@ class PersistentRollupStore(RollupStore):
             "last": b.last_block, "root": b.state_root.hex(),
             "commitment": b.commitment.hex(),
             "committed": b.committed, "verified": b.verified,
+            "vm_mode": b.vm_mode,
         }).encode()
 
     @staticmethod
@@ -144,7 +150,8 @@ class PersistentRollupStore(RollupStore):
                      last_block=o["last"],
                      state_root=bytes.fromhex(o["root"]),
                      commitment=bytes.fromhex(o["commitment"]),
-                     committed=o["committed"], verified=o["verified"])
+                     committed=o["committed"], verified=o["verified"],
+                     vm_mode=o.get("vm_mode", ""))
 
     @staticmethod
     def _bundle_json(bundle) -> bytes:

@@ -184,7 +184,11 @@ class Sequencer:
         if any(b is None for b in blocks):
             return None
         number = self.rollup.latest_batch_number() + 1
-        witness = generate_witness(self.node.chain, blocks)
+        coarse_log: list = []
+        batch_receipts: list = []
+        witness = generate_witness(self.node.chain, blocks,
+                                   write_log=coarse_log,
+                                   receipts_out=batch_receipts)
         program_input = ProgramInput(blocks=blocks, witness=witness,
                                      config=self.node.config)
         state_root = blocks[-1].header.state_root
@@ -208,6 +212,22 @@ class Sequencer:
             + b"".join(b.hash for b in blocks)
             + b"".join(privileged_hashes) + msgs_root
             + b"".join(bundle.versioned_hashes))
+        # VM-circuit coverage this batch admits (anti-downgrade metadata
+        # for wire verifiers) — classified from the artifacts captured
+        # during witness generation (no second execution), and derived
+        # BEFORE the L1 call so a classifier error cannot break the
+        # L1-first commit ordering below
+        vm_mode = ""
+        from ..prover import protocol as proto
+
+        if proto.PROVER_TPU in self.cfg.needed_prover_types:
+            from ..prover.tpu_backend import vm_mode_from_artifacts
+
+            parent = self.node.store.get_header(
+                blocks[0].header.parent_hash)
+            vm_mode = vm_mode_from_artifacts(
+                blocks, coarse_log, batch_receipts, witness,
+                parent.state_root)
         # L1 first: only persist the batch once the commitment is accepted,
         # otherwise a transient L1 failure would desync the batch counter
         self.l1.commit_batch(number, state_root, commitment,
@@ -221,7 +241,7 @@ class Sequencer:
             pass
         batch = Batch(number=number, first_block=first,
                       last_block=head, state_root=state_root,
-                      commitment=commitment)
+                      commitment=commitment, vm_mode=vm_mode)
         self.rollup.store_batch(batch)
         self.rollup.store_blobs_bundle(number, bundle)
         self.rollup.store_prover_input(number, self.cfg.commit_hash,
@@ -254,6 +274,13 @@ class Sequencer:
 
             def check(n: int) -> bool:
                 proof = self.rollup.get_proof(n, t)
+                # anti-downgrade: the committer recorded the VM-circuit
+                # coverage this batch admits; a claimed-log proof for a
+                # circuit-covered batch is rejected without the witness
+                batch = self.rollup.get_batch(n)
+                if batch is not None and not backend.check_coverage(
+                        proof, batch.vm_mode):
+                    return False
                 # full audit when the backend supports it: the stored
                 # ProverInput lets the proof's write log be replayed
                 # against the witness MPT (no re-execution)

@@ -21,6 +21,12 @@ class ProverBackend:
     def verify(self, proof: dict) -> bool:
         raise NotImplementedError
 
+    def check_coverage(self, proof: dict, expected_mode: str) -> bool:
+        """Anti-downgrade hook: does this proof carry the VM-circuit
+        coverage the batch's committer derived?  Backends without VM
+        modes accept everything."""
+        return True
+
     def to_proof_bytes(self, proof: dict) -> bytes:
         import json
 

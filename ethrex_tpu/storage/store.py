@@ -256,13 +256,16 @@ class Store:
 
     # ---------------- state write-back ----------------
     def apply_account_updates(self, parent_root: bytes, state_db: StateDB,
-                              nodes: dict | None = None) -> bytes:
+                              nodes: dict | None = None,
+                              write_log: list | None = None) -> bytes:
         """Write dirty accounts/slots from an executed block into the tries;
         returns the new state root (the merkleize step of the reference's
         add_block pipeline, blockchain.rs apply_account_updates_batch).
 
         `nodes` overrides the node table (witness recording / stateless
-        execution use a recording or witness-only table)."""
+        execution use a recording or witness-only table); `write_log`
+        (optional list) collects the raw trie writes exactly like the
+        stateless path's log."""
         with self.lock:
             if nodes is None:
                 # persistent native engine over the store's own table: the
@@ -272,7 +275,8 @@ class Store:
                 native = _make_native_engine()
             return apply_updates_to_tries(
                 nodes if nodes is not None else self.nodes,
-                self.code, parent_root, state_db, native=native)
+                self.code, parent_root, state_db, native=native,
+                write_log=write_log)
 
     def _native_engine(self):
         engine = getattr(self, "_native_mpt", "unset")

@@ -139,3 +139,35 @@ def test_based_follower_records_fatal_divergence():
     assert not fetcher.healthy()
     assert "committed" in str(fetcher.fatal)
     fetcher.stop()
+
+
+def test_check_coverage_rejects_downgrade():
+    """The anti-downgrade hook (review finding): a tpu proof whose vm
+    mode differs from the committer-recorded coverage is rejected —
+    most importantly a claimed-log proof for a circuit-covered batch."""
+    from ethrex_tpu.prover.tpu_backend import TpuBackend
+
+    backend = TpuBackend()
+    claimed = {"backend": protocol.PROVER_TPU, "output": "0x"}
+    transfer = dict(claimed, vm={"mode": "transfer"})
+    generic = dict(claimed, vm={"mode": "generic"})
+    assert backend.check_coverage(transfer, "transfer")
+    assert backend.check_coverage(generic, "generic")
+    assert not backend.check_coverage(claimed, "transfer")
+    assert not backend.check_coverage(claimed, "generic")
+    assert not backend.check_coverage(transfer, "generic")
+    # pre-metadata batches put no constraint
+    assert backend.check_coverage(claimed, "")
+
+
+def test_aligned_rejects_downgraded_transfer_batch():
+    """AlignedLayer.submit refuses a claimed-log proof for a batch the
+    committer marked transfer-covered, before any settlement."""
+    aligned = AlignedLayer()
+    downgraded = {"backend": protocol.PROVER_TPU, "format": "stark",
+                  "output": "0x", "write_log": [],
+                  "depth": 1, "seg_periods": 8,
+                  "state_proof": {}, "proof": {}}
+    with pytest.raises(ValueError, match="downgrades its vm coverage"):
+        aligned.submit(7, 7, {protocol.PROVER_TPU: [downgraded]},
+                       expected_modes={7: "transfer"})
