@@ -191,7 +191,13 @@ class Blockchain:
     # execution
     # ------------------------------------------------------------------
     def execute_block(self, block: Block, parent: BlockHeader,
-                      state_db: StateDB | None = None) -> ExecutionOutcome:
+                      state_db: StateDB | None = None,
+                      bal_recorder=None) -> ExecutionOutcome:
+        """`bal_recorder` (primitives/bal.BalRecorder, optional) collects
+        the EIP-7928 Block Access List from the per-phase journals —
+        index 0 = pre-exec system ops, 1..n = txs, n+1 = post
+        (withdrawals + requests), mirroring the reference recorder
+        (block_access_list.rs:791-795)."""
         header = block.header
         fork = self.config.fork_at(header.number, header.timestamp)
         env = BlockEnv(
@@ -205,7 +211,11 @@ class Blockchain:
             difficulty=header.difficulty,
         )
         state = state_db or self.store.state_db(parent.state_root)
+        if bal_recorder is not None:
+            bal_recorder.attach(state)
         self._pre_tx_system_ops(state, env, header, fork)
+        if bal_recorder is not None:
+            bal_recorder.record_phase(state, 0)
 
         receipts = []
         gas_used = 0
@@ -215,6 +225,8 @@ class Blockchain:
                 result = execute_tx(tx, state, env, self.config)
             except InvalidTransaction as e:
                 raise InvalidBlock(f"tx {i} invalid: {e}")
+            if bal_recorder is not None:
+                bal_recorder.record_phase(state, i + 1)
             gas_used += result.gas_used
             if gas_used > header.gas_limit:
                 raise InvalidBlock("block gas limit exceeded")
@@ -227,14 +239,25 @@ class Blockchain:
         if blob_gas_used > max_blob_gas:
             raise InvalidBlock("blob gas above maximum")
 
+        post_index = len(block.body.transactions) + 1
         # withdrawals
+        had_post_ops = False
         if block.body.withdrawals:
             for wd in block.body.withdrawals:
                 if wd.amount:
                     state.begin_tx()
                     state.add_balance(wd.address, wd.amount * GWEI)
                     state.finalize_tx()
+                    had_post_ops = True
         requests = self._post_tx_requests(state, env, receipts, fork)
+        # ONE record for the whole post-exec phase (withdrawals +
+        # requests): per-withdrawal records would emit duplicate
+        # block_access_index entries for a shared withdrawal address and
+        # the honest BAL would fail its own ordering check (review
+        # finding); the journal sink accumulates across the windows
+        if bal_recorder is not None and \
+                (had_post_ops or fork >= Fork.PRAGUE):
+            bal_recorder.record_phase(state, post_index)
         return ExecutionOutcome(receipts=receipts, state_db=state,
                                 gas_used=gas_used,
                                 blob_gas_used=blob_gas_used,
@@ -298,7 +321,13 @@ class Blockchain:
             self.add_block(block)
         return len(tail)
 
-    def add_block(self, block: Block) -> None:
+    def add_block(self, block: Block, bal=None) -> None:
+        """`bal` (primitives/bal.BlockAccessList, optional): the claimed
+        EIP-7928 Block Access List.  When given, the import prefetches
+        the listed state in parallel (warm_from_bal), re-derives the BAL
+        during execution, and REJECTS the block if the claim does not
+        match — a tampered list cannot ride a valid block (reference:
+        blockchain.rs:552 BAL validation)."""
         header = block.header
         parent = self.store.get_header(header.parent_hash)
         if parent is None:
@@ -310,8 +339,24 @@ class Blockchain:
         # the durable backend once finalized (or past the settle window)
         self.store.push_node_layer(header.number, header.hash)
         try:
-            outcome = self.execute_block(block, parent)
+            recorder = None
+            state_db = None
+            if bal is not None:
+                from ..primitives.bal import BalRecorder
+
+                try:
+                    bal.validate_ordering()
+                except ValueError as e:
+                    raise InvalidBlock(f"block access list: {e}")
+                recorder = BalRecorder()
+                state_db = self.store.state_db(parent.state_root)
+                self.warm_from_bal(state_db, bal)
+            outcome = self.execute_block(block, parent, state_db,
+                                         bal_recorder=recorder)
             self._validate_block_outcome(header, outcome)
+            if recorder is not None and \
+                    recorder.build().hash() != bal.hash():
+                raise InvalidBlock("block access list mismatch")
             new_root = self.store.apply_account_updates(
                 parent.state_root, outcome.state_db)
             if new_root != header.state_root:
@@ -324,6 +369,56 @@ class Blockchain:
             self.store.discard_node_layer(header.number, header.hash)
             raise
         self.store.add_block(block, outcome.receipts)
+
+    def generate_bal(self, block: Block, parent: BlockHeader):
+        """Derive the block's EIP-7928 Block Access List (builder side:
+        the reference generates it during payload building,
+        blockchain.rs:552)."""
+        from ..primitives.bal import BalRecorder
+
+        recorder = BalRecorder()
+        self.execute_block(block, parent, bal_recorder=recorder)
+        return recorder.build()
+
+    def warm_from_bal(self, state_db: StateDB, bal) -> None:
+        """BAL-driven state prefetch (the reference's warm_block_from_bal
+        seat, levm/mod.rs:2817): pull every listed account, its code and
+        its listed slots into the execution cache before the first tx
+        runs.  On a multi-core host the per-account fetches fan out over
+        a thread pool — the trie-walk keccaks and the native extensions
+        drop the GIL; single-core hosts prefetch inline (same cache
+        effect, no fan-out)."""
+        import os
+
+        accounts = bal.accounts
+        if not accounts:
+            return
+        # warm the SOURCE layer only (trie objects + node caches), never
+        # the StateDB account cache: a pre-seeded StateDB slot skips the
+        # read journal during execution, so the derived BAL would lose
+        # honest reads — and journaled warming loads would let a claimed
+        # list padded with bogus reads self-certify (review findings)
+        src = state_db.source
+
+        def prefetch(ac):
+            try:
+                src.get_account_state(ac.address)
+                for slot in ac.storage_reads:
+                    src.get_storage(ac.address, slot)
+                for slot in ac.storage_changes:
+                    src.get_storage(ac.address, slot)
+            except Exception:
+                pass  # missing state surfaces during execution
+
+        cpus = os.cpu_count() or 1
+        if cpus > 1 and len(accounts) > 8:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(8, cpus)) as pool:
+                list(pool.map(prefetch, accounts))
+        else:
+            for ac in accounts:
+                prefetch(ac)
 
     def add_blocks_pipelined(self, blocks: list[Block]) -> None:
         """Pipelined import: execute block N+1 WHILE block N merkleizes

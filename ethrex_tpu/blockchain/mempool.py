@@ -15,12 +15,22 @@ class MempoolError(Exception):
     pass
 
 
+MAX_BLOB_MEMPOOL_SIZE = 512   # reference: mempool.rs:49
+
+
 class Mempool:
-    def __init__(self, capacity: int = 10_000):
+    def __init__(self, capacity: int = 10_000,
+                 blob_capacity: int = MAX_BLOB_MEMPOOL_SIZE):
         self.capacity = capacity
+        self.blob_capacity = blob_capacity
         self.by_hash: dict[bytes, Transaction] = {}
         self.by_sender: dict[bytes, dict[int, Transaction]] = {}
         self.blobs_bundles: dict[bytes, object] = {}  # tx_hash -> bundle
+        # arrival order of REGULAR (non-blob) txs: the FIFO eviction
+        # queue (reference: mempool.rs txs_order +
+        # remove_oldest_regular_transaction:462-475); stale entries for
+        # already-removed txs are skipped at pop time
+        self.txs_order: list[bytes] = []
         self.lock = threading.RLock()
         # arrival hooks (e.g. pending-tx RPC filters); invoked OUTSIDE
         # self.lock so subscribers may take their own locks freely
@@ -43,8 +53,6 @@ class Mempool:
         if tx.tx_type == TYPE_BLOB and blobs_bundle is None:
             raise MempoolError("blob tx requires blobs bundle")
         with self.lock:
-            if len(self.by_hash) >= self.capacity:
-                raise MempoolError("mempool full")
             queue = self.by_sender.setdefault(sender, {})
             existing = queue.get(tx.nonce)
             if existing is not None:
@@ -57,22 +65,78 @@ class Mempool:
             self.by_hash[tx.hash] = tx
             if blobs_bundle is not None:
                 self.blobs_bundles[tx.hash] = blobs_bundle
+                self._evict_worst_blob()
+            else:
+                self.txs_order.append(tx.hash)
+                self._evict_oldest_regular()
+                # amortized compaction: stale entries (mined/replaced
+                # txs) are skipped at pop time, but the list must stay
+                # bounded on a long-running node (review finding; the
+                # reference's mempool_prune_threshold seat)
+                if len(self.txs_order) > 2 * self.capacity + 1024:
+                    self.txs_order = [
+                        h for h in self.txs_order
+                        if h in self.by_hash
+                        and h not in self.blobs_bundles]
         for hook in list(self.on_add):
             hook(tx.hash)
         return tx.hash
 
+    def _regular_tx_count(self) -> int:
+        return len(self.by_hash) - len(self.blobs_bundles)
+
+    def _evict_oldest_regular(self) -> None:
+        """FIFO-evict regular txs past the cap; blob txs never feel
+        regular-pool pressure (reference: mempool.rs:462-475)."""
+        while self._regular_tx_count() > self.capacity and self.txs_order:
+            oldest = self.txs_order.pop(0)
+            if oldest in self.by_hash and oldest not in self.blobs_bundles:
+                self._remove_locked(oldest)
+
+    def _evict_worst_blob(self) -> None:
+        """Evict the LEAST INCLUDABLE blob tx past the blob sub-pool cap:
+        deepest per-sender nonce offset first (it cannot be included
+        until earlier same-sender blobs clear), ties broken by lowest
+        blob fee (reference: mempool.rs:477-530)."""
+        while len(self.blobs_bundles) > self.blob_capacity:
+            min_nonce: dict[bytes, int] = {}
+            for h in self.blobs_bundles:
+                tx = self.by_hash.get(h)
+                if tx is None:
+                    continue
+                s = tx.sender()
+                if s not in min_nonce or tx.nonce < min_nonce[s]:
+                    min_nonce[s] = tx.nonce
+            worst = None
+            worst_key = None
+            for h in self.blobs_bundles:
+                tx = self.by_hash.get(h)
+                if tx is None:
+                    continue
+                offset = tx.nonce - min_nonce[tx.sender()]
+                key = (offset, -(tx.max_fee_per_blob_gas or 0))
+                if worst_key is None or key > worst_key:
+                    worst_key = key
+                    worst = h
+            if worst is None:
+                break
+            self._remove_locked(worst)
+
+    def _remove_locked(self, tx_hash: bytes):
+        tx = self.by_hash.pop(tx_hash, None)
+        if tx is None:
+            return
+        self.blobs_bundles.pop(tx_hash, None)
+        sender = tx.sender()
+        queue = self.by_sender.get(sender)
+        if queue and queue.get(tx.nonce) is tx:
+            del queue[tx.nonce]
+            if not queue:
+                del self.by_sender[sender]
+
     def remove_transaction(self, tx_hash: bytes):
         with self.lock:
-            tx = self.by_hash.pop(tx_hash, None)
-            if tx is None:
-                return
-            self.blobs_bundles.pop(tx_hash, None)
-            sender = tx.sender()
-            queue = self.by_sender.get(sender)
-            if queue and queue.get(tx.nonce) is tx:
-                del queue[tx.nonce]
-                if not queue:
-                    del self.by_sender[sender]
+            self._remove_locked(tx_hash)
 
     def get_transaction(self, tx_hash: bytes) -> Transaction | None:
         return self.by_hash.get(tx_hash)

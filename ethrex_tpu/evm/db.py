@@ -135,6 +135,10 @@ class StateDB:
         self.source = source
         self.accounts: dict[bytes, CachedAccount] = {}
         self.journal: list = []
+        # optional drain target: begin/finalize_tx move journal entries
+        # here instead of dropping them (the BAL recorder's feed —
+        # primitives/bal.py; None = off, zero cost)
+        self.journal_sink: list | None = None
         # EIP-161 (Spurious Dragon+): delete touched-empty accounts at
         # merkleize time; pre-161 forks keep them (executor sets this)
         self.clear_empty = True
@@ -408,6 +412,8 @@ class StateDB:
 
     # ---------------- tx lifecycle ----------------
     def begin_tx(self):
+        if self.journal_sink is not None:
+            self.journal_sink.extend(self.journal)
         self.journal.clear()
         self.accessed_addresses = set()
         self.accessed_slots = set()
@@ -420,6 +426,8 @@ class StateDB:
 
     def finalize_tx(self):
         """Clear journal; keep account cache for the rest of the block."""
+        if self.journal_sink is not None:
+            self.journal_sink.extend(self.journal)
         self.journal.clear()
 
     def drain_dirty(self):

@@ -286,3 +286,48 @@ def test_berlin_clear_refund_schedule():
     assert G.schedule_for(Fork.BERLIN).sstore_clear_refund == 15000
     assert G.schedule_for(Fork.LONDON).sstore_clear_refund == 4800
     assert G.schedule_for(Fork.CANCUN).sstore_clear_refund == 4800
+
+
+def test_mempool_fifo_eviction_regular():
+    """Regular txs FIFO-evict past the cap instead of rejecting new ones
+    (reference mempool.rs:462-475); blob txs never feel the pressure."""
+    from ethrex_tpu.blockchain.mempool import Mempool
+
+    pool = Mempool(capacity=3)
+    hashes = []
+    for n in range(5):
+        tx = _tx(n)
+        hashes.append(pool.add_transaction(tx, n, 10**21, 7))
+    assert len(pool) == 3
+    # the two oldest were evicted
+    assert pool.get_transaction(hashes[0]) is None
+    assert pool.get_transaction(hashes[1]) is None
+    assert pool.get_transaction(hashes[4]) is not None
+
+
+def test_mempool_blob_eviction_least_includable():
+    """The blob sub-pool evicts the deepest per-sender nonce offset
+    first, ties by lowest blob fee (reference mempool.rs:477-530)."""
+    from ethrex_tpu.blockchain.mempool import Mempool
+    from ethrex_tpu.primitives.transaction import Transaction
+
+    pool = Mempool(capacity=100, blob_capacity=2)
+
+    def blob_tx(nonce, blob_fee):
+        return Transaction(
+            tx_type=3, chain_id=1337, nonce=nonce,
+            max_priority_fee_per_gas=2, max_fee_per_gas=10**10,
+            gas_limit=21000, to=OTHER, value=0,
+            max_fee_per_blob_gas=blob_fee,
+            blob_versioned_hashes=[b"\x01" + bytes(31)]).sign(SECRET)
+
+    h0 = pool.add_transaction(blob_tx(0, 10), 0, 10**21, 7,
+                              blobs_bundle=object())
+    h1 = pool.add_transaction(blob_tx(1, 99), 0, 10**21, 7,
+                              blobs_bundle=object())
+    # third blob: nonce offset 2 is the deepest -> IT is evicted at cap 2
+    h2 = pool.add_transaction(blob_tx(2, 50), 0, 10**21, 7,
+                              blobs_bundle=object())
+    assert pool.get_transaction(h2) is None
+    assert pool.get_transaction(h0) is not None
+    assert pool.get_transaction(h1) is not None
