@@ -208,6 +208,10 @@ class EVM:
         self.origin = origin
         self.blob_hashes = blob_hashes or []
         self.tracer = None  # optional frame-level tracer (evm/tracing.py)
+        # per-instance precompile overlay (dev chains register custom
+        # verifier hooks here — l2/l1_evm.py; consensus execution never
+        # sets it)
+        self.extra_precompiles: dict = {}
 
     def fork_at_least(self, fork: Fork) -> bool:
         return self.fork >= fork
@@ -267,7 +271,8 @@ class EVM:
             if self.state.get_balance(msg.caller) < msg.value:
                 return False, msg.gas, b""
             self._transfer(msg.caller, msg.to, msg.value)
-        pre = precompiles.get_precompile(msg.code_address, self.fork)
+        pre = self.extra_precompiles.get(msg.code_address) \
+            or precompiles.get_precompile(msg.code_address, self.fork)
         if pre is not None:
             try:
                 gas_cost, output = pre(msg.data, msg.gas, self.fork)
@@ -1011,7 +1016,8 @@ def _do_call(evm, f, *, kind: str):
                       depth=f.msg.depth + 1, is_static=True, code=code,
                       kind="STATICCALL")
     # precompiles execute against the *call target* address
-    if (precompiles.get_precompile(addr, evm.fork) is not None
+    if ((addr in evm.extra_precompiles
+         or precompiles.get_precompile(addr, evm.fork) is not None)
             and kind in ("call", "staticcall")):
         msg.code_address = addr
     ok, gas_left, output = evm.execute_message(msg)

@@ -1,0 +1,74 @@
+"""Tiny EVM assembler: mnemonic streams with labels -> runtime bytecode.
+
+Used to build the settlement contracts' bytecode in-repo (no solc in the
+toolchain): l2/proposer_evm.py assembles the OnChainProposer state
+machine from the rule-for-rule port in l2/proposer_rules.py, and the L2
+integration tests settle through the resulting code executed by our own
+EVM (reference seat: crates/l2/contracts/src/l1/OnChainProposer.sol +
+the deployer, cmd/ethrex/l2/deployer.rs).
+
+Instruction stream items:
+  "MNEMONIC"              plain opcode
+  ("PUSH", int|bytes)     smallest PUSHk fitting the value
+  ("PUSHL", "label")      PUSH2 placeholder patched to the label offset
+  ("LABEL", "name")       defines a jump target (emits JUMPDEST)
+"""
+
+from __future__ import annotations
+
+OPS = {
+    "STOP": 0x00, "ADD": 0x01, "MUL": 0x02, "SUB": 0x03, "DIV": 0x04,
+    "MOD": 0x06, "LT": 0x10, "GT": 0x11, "EQ": 0x14, "ISZERO": 0x15,
+    "AND": 0x16, "OR": 0x17, "XOR": 0x18, "NOT": 0x19, "SHL": 0x1B,
+    "SHR": 0x1C, "KECCAK256": 0x20, "ADDRESS": 0x30, "CALLER": 0x33,
+    "CALLVALUE": 0x34, "CALLDATALOAD": 0x35, "CALLDATASIZE": 0x36,
+    "POP": 0x50, "MLOAD": 0x51, "MSTORE": 0x52, "SLOAD": 0x54,
+    "SSTORE": 0x55, "JUMP": 0x56, "JUMPI": 0x57, "JUMPDEST": 0x5B,
+    "RETURN": 0xF3, "REVERT": 0xFD, "STATICCALL": 0xFA, "GAS": 0x5A,
+    "RETURNDATASIZE": 0x3D, "RETURNDATACOPY": 0x3E,
+}
+for _i in range(1, 17):
+    OPS[f"DUP{_i}"] = 0x80 + _i - 1
+    OPS[f"SWAP{_i}"] = 0x90 + _i - 1
+
+
+def assemble(items: list) -> bytes:
+    """Two-pass assembly with 2-byte label operands."""
+    # pass 1: offsets
+    offsets: dict[str, int] = {}
+    pc = 0
+    for it in items:
+        if isinstance(it, str):
+            pc += 1
+        elif it[0] == "LABEL":
+            offsets[it[1]] = pc
+            pc += 1                      # JUMPDEST
+        elif it[0] == "PUSHL":
+            pc += 3                      # PUSH2 xx xx
+        elif it[0] == "PUSH":
+            pc += 1 + len(_imm(it[1]))
+        else:
+            raise ValueError(f"bad asm item {it!r}")
+    # pass 2: emit
+    out = bytearray()
+    for it in items:
+        if isinstance(it, str):
+            out.append(OPS[it])
+        elif it[0] == "LABEL":
+            out.append(OPS["JUMPDEST"])
+        elif it[0] == "PUSHL":
+            target = offsets[it[1]]
+            out += bytes([0x61, target >> 8, target & 0xFF])
+        else:
+            imm = _imm(it[1])
+            out += bytes([0x5F + len(imm)]) + imm
+    return bytes(out)
+
+
+def _imm(v) -> bytes:
+    if isinstance(v, bytes):
+        return v if v else b""
+    v = int(v)
+    if v == 0:
+        return b""                       # PUSH0
+    return v.to_bytes((v.bit_length() + 7) // 8, "big")

@@ -1,0 +1,162 @@
+"""EvmL1: the dev L1 whose settlement path runs the OnChainProposer
+BYTECODE (l2/proposer_evm.py) through our own EVM.
+
+Drop-in for InMemoryL1 everywhere the sequencer settles: commitBatch /
+verifyBatches are real contract transactions — selector dispatch,
+storage mappings, revert identifiers, and a STATICCALL into the
+registered verifier (a dev precompile hook running the in-process proof
+checks, the seat of the reference's on-chain verifier contracts).  The
+CommonBridge surface (deposits, withdrawal claims, blob sidecars) stays
+on the Python rules from the round-4 port.
+
+Reference: crates/l2/contracts/src/l1/OnChainProposer.sol + the
+deployment flow in cmd/ethrex/l2/deployer.rs.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..evm.db import InMemorySource, StateDB
+from ..evm.vm import EVM, BlockEnv, Message
+from ..primitives.account import Account
+from ..primitives.genesis import ChainConfig, Fork
+from .l1_client import InMemoryL1, L1Error, make_deposit_tx
+from .proposer_evm import (PROPOSER_ADDRESS, SEL_COMMIT, SEL_VERIFY,
+                           VERIFIER_ADDRESS, build_runtime, decode_revert)
+
+OWNER = bytes.fromhex("aa" * 20)
+
+
+def _word(v) -> bytes:
+    if isinstance(v, bytes):
+        return v.rjust(32, b"\x00")
+    return int(v).to_bytes(32, "big")
+
+
+class EvmL1(InMemoryL1):
+    def __init__(self, needed_prover_types, l2_chain_id=None):
+        super().__init__(needed_prover_types, l2_chain_id=l2_chain_id)
+        cfg = ChainConfig(chain_id=1)
+        cfg.time_forks = {Fork.SHANGHAI: 0, Fork.CANCUN: 0}
+        self._config = cfg
+        src = InMemorySource(accounts={
+            PROPOSER_ADDRESS: Account.new(
+                code=build_runtime(),
+                storage={3: int.from_bytes(OWNER, "big")}),
+            OWNER: Account.new(balance=10**21),
+        })
+        self.state = StateDB(src)
+        self._pending_proofs: dict[int, dict] = {}
+
+    # ---- EVM plumbing ----------------------------------------------------
+    def _verifier_precompile(self, data: bytes, gas: int, fork):
+        """The registered-verifier seat: (number, stateRoot, messagesRoot,
+        commitHash) -> 1 iff every needed prover type's submitted proof
+        binds the CONTRACT-stored roots for that batch."""
+        from ..guest.execution import ProgramOutput
+
+        ok = b"\x00" * 32
+        try:
+            number = int.from_bytes(data[0:32], "big")
+            root = data[32:64]
+            msgs = data[64:96]
+            batch_proofs = self._pending_proofs.get(number)
+            if batch_proofs is not None:
+                good = True
+                for t in self.needed:
+                    raw = batch_proofs.get(t)
+                    if raw is None:
+                        good = False
+                        break
+                    obj = json.loads(raw)
+                    out = ProgramOutput.decode(
+                        bytes.fromhex(obj["output"][2:]))
+                    if out.final_state_root != root or \
+                            out.messages_root != msgs:
+                        good = False
+                        break
+                if good:
+                    ok = _word(1)
+        except (ValueError, KeyError, TypeError):
+            pass
+        return 100, ok
+
+    def _tx(self, data: bytes, sender: bytes = OWNER) -> bytes:
+        env = BlockEnv(number=1, coinbase=b"\x00" * 20, timestamp=1,
+                       gas_limit=30_000_000, prev_randao=b"\x00" * 32,
+                       base_fee=0)
+        evm = EVM(self.state, env, self._config)
+        evm.extra_precompiles[VERIFIER_ADDRESS] = self._verifier_precompile
+        self.state.begin_tx()
+        ok, _gas, out = evm.execute_message(Message(
+            caller=sender, to=PROPOSER_ADDRESS,
+            code_address=PROPOSER_ADDRESS, value=0, data=data,
+            gas=10_000_000, kind="CALL"))
+        self.state.finalize_tx()
+        if not ok:
+            raise L1Error(f"proposer reverted: {decode_revert(out)}")
+        return out
+
+    def _slot(self, slot: int) -> int:
+        return self.state.get_storage(PROPOSER_ADDRESS, slot)
+
+    # ---- OnChainProposer through the bytecode ---------------------------
+    def commit_batch(self, number, new_state_root, commitment,
+                     privileged_tx_hashes=(),
+                     messages_root=b"\x00" * 32) -> bytes:
+        with self.lock:
+            # CommonBridge seat: privileged txs must match the deposit
+            # queue (read-only pre-check; python bookkeeping below)
+            cursor = self.consumed_deposits
+            for h in privileged_tx_hashes:
+                if cursor >= len(self.deposits):
+                    raise L1Error("privileged tx without matching deposit")
+                if self.l2_chain_id is not None:
+                    expected = make_deposit_tx(
+                        self.l2_chain_id, self.deposits[cursor]).hash
+                    if h != expected:
+                        raise L1Error(
+                            f"privileged tx {h.hex()} does not match "
+                            f"deposit {cursor}")
+                cursor += 1
+            data = (SEL_COMMIT.to_bytes(4, "big") + _word(number)
+                    + _word(new_state_root) + _word(messages_root)
+                    + _word(commitment))
+            self._tx(data)
+            self.consumed_deposits = cursor
+            self.commitments[number] = (new_state_root, commitment)
+            self.message_roots[number] = bytes(messages_root)
+            from ..crypto.keccak import keccak256
+
+            return keccak256(b"commit" + number.to_bytes(8, "big")
+                             + commitment)
+
+    def verify_batches(self, first, last, proofs) -> bytes:
+        with self.lock:
+            pending: dict[int, dict] = {}
+            for t in self.needed:
+                batch_proofs = proofs.get(t)
+                if not batch_proofs or \
+                        len(batch_proofs) != last - first + 1:
+                    raise L1Error(f"missing {t} proofs")
+                for offset, raw in enumerate(batch_proofs):
+                    pending.setdefault(first + offset, {})[t] = raw
+            self._pending_proofs = pending
+            try:
+                data = (SEL_VERIFY.to_bytes(4, "big") + _word(first)
+                        + _word(last - first + 1))
+                self._tx(data)
+            finally:
+                self._pending_proofs = {}
+            self.verified_up_to = last
+            from ..crypto.keccak import keccak256
+
+            return keccak256(b"verify" + first.to_bytes(8, "big")
+                             + last.to_bytes(8, "big"))
+
+    def last_committed_batch(self) -> int:
+        return self._slot(0)
+
+    def last_verified_batch(self) -> int:
+        return self._slot(1)

@@ -1,0 +1,144 @@
+"""OnChainProposer as EVM BYTECODE, settled through our own EVM.
+
+The round-4 port (l2/proposer_rules.py) re-expressed the reference's
+Solidity state machine in Python; this module closes the remaining gap
+(VERDICT #8): the commit/verify state machine is hand-assembled to EVM
+bytecode (l2/evm_asm.py — no solc in the toolchain) and the dev L1
+executes it with the SAME interpreter that runs L2 blocks, so settlement
+exercises real contract code: selector dispatch, storage mappings via
+KECCAK256, revert identifiers, only-owner/pause guards, the
+batch-succession and sequential-verify rules, and a STATICCALL into a
+registered verifier (the on-chain verifier seat — here a dev precompile
+hook that runs the in-process proof checks).
+
+Reference seat: crates/l2/contracts/src/l1/OnChainProposer.sol:226-687
+(commitBatch/verifyBatches guards) + cmd/ethrex/l2/deployer.rs.
+
+Storage layout:
+    slot 0  lastCommittedBatch          slot 3  owner
+    slot 1  lastVerifiedBatch           map 4   batch -> state root
+    slot 2  paused                      map 5   batch -> messages root
+                                        map 6   batch -> commit hash
+"""
+
+from __future__ import annotations
+
+from ..crypto.keccak import keccak256
+from .evm_asm import assemble
+
+VERIFIER_ADDRESS = bytes.fromhex("00000000000000000000000000000000000000f1")
+PROPOSER_ADDRESS = bytes.fromhex("000000000000000000000000000000000000c0de")
+
+
+def selector(sig: str) -> int:
+    return int.from_bytes(keccak256(sig.encode())[:4], "big")
+
+SEL_COMMIT = selector("commitBatch(uint256,bytes32,bytes32,bytes32)")
+SEL_VERIFY = selector("verifyBatches(uint256,uint256)")
+SEL_LAST_COMMITTED = selector("lastCommittedBatch()")
+SEL_LAST_VERIFIED = selector("lastVerifiedBatch()")
+SEL_BATCH_ROOT = selector("batchStateRoot(uint256)")
+SEL_PAUSE = selector("pause()")
+SEL_UNPAUSE = selector("unpause()")
+
+
+def _rv(ident: str) -> list:
+    """revert with the padded ascii identifier (one 32-byte word)."""
+    return [("PUSH", int.from_bytes(ident.encode(), "big")),
+            ("PUSH", 0), "MSTORE", ("PUSH", 32), ("PUSH", 0), "REVERT"]
+
+
+def _only_owner(tag: str) -> list:
+    return ["CALLER", ("PUSH", 3), "SLOAD", "EQ", ("PUSHL", tag), "JUMPI",
+            *_rv("OwnableUnauthorizedAccount"), ("LABEL", tag)]
+
+
+def _not_paused(tag: str) -> list:
+    return [("PUSH", 2), "SLOAD", "ISZERO", ("PUSHL", tag), "JUMPI",
+            *_rv("EnforcedPause"), ("LABEL", tag)]
+
+
+def _map_hash(slot: int, scratch: int = 0x80) -> list:
+    """keccak(key || slot) with the key already at mem[scratch]."""
+    return [("PUSH", slot), ("PUSH", scratch + 32), "MSTORE",
+            ("PUSH", 64), ("PUSH", scratch), "KECCAK256"]
+
+
+def build_runtime() -> bytes:
+    a: list = []
+    # ---- dispatch --------------------------------------------------------
+    a += [("PUSH", 0), "CALLDATALOAD", ("PUSH", 224), "SHR"]
+    for sel, tag in ((SEL_COMMIT, "fn_commit"), (SEL_VERIFY, "fn_verify"),
+                     (SEL_LAST_COMMITTED, "fn_lc"),
+                     (SEL_LAST_VERIFIED, "fn_lv"),
+                     (SEL_BATCH_ROOT, "fn_root"),
+                     (SEL_PAUSE, "fn_pause"), (SEL_UNPAUSE, "fn_unpause")):
+        a += ["DUP1", ("PUSH", sel), "EQ", ("PUSHL", tag), "JUMPI"]
+    a += _rv("UnknownSelector")
+
+    # ---- commitBatch(number, newStateRoot, messagesRoot, commitHash) ----
+    a += [("LABEL", "fn_commit")]
+    a += _only_owner("cm_own")
+    a += _not_paused("cm_pse")
+    a += [("PUSH", 4), "CALLDATALOAD"]                       # [n]
+    a += ["DUP1", ("PUSH", 0), "SLOAD", ("PUSH", 1), "ADD", "EQ",
+          ("PUSHL", "cm_seq"), "JUMPI",
+          *_rv("BatchNumberNotSuccessor"), ("LABEL", "cm_seq")]
+    a += [("PUSH", 100), "CALLDATALOAD", "ISZERO", "ISZERO",
+          ("PUSHL", "cm_chz"), "JUMPI",
+          *_rv("CommitHashIsZero"), ("LABEL", "cm_chz")]
+    # roots[n] / msgs[n] / commits[n]
+    a += ["DUP1", ("PUSH", 0x80), "MSTORE"]                  # scratch key
+    for slot, arg in ((4, 36), (5, 68), (6, 100)):
+        a += _map_hash(slot)                                 # [n, h]
+        a += [("PUSH", arg), "CALLDATALOAD", "SWAP1", "SSTORE"]
+    a += [("PUSH", 0), "SSTORE", "STOP"]                     # lastCommitted
+
+    # ---- verifyBatches(first, count) ------------------------------------
+    a += [("LABEL", "fn_verify")]
+    a += _only_owner("vf_own")
+    a += _not_paused("vf_pse")
+    a += [("PUSH", 4), "CALLDATALOAD"]                       # [f]
+    a += ["DUP1", ("PUSH", 1), "SLOAD", ("PUSH", 1), "ADD", "EQ",
+          ("PUSHL", "vf_seq"), "JUMPI",
+          *_rv("BatchNotSequential"), ("LABEL", "vf_seq")]
+    a += [("PUSH", 36), "CALLDATALOAD"]                      # [f, c]
+    a += ["DUP1", "ISZERO", "ISZERO", ("PUSHL", "vf_ne"), "JUMPI",
+          *_rv("EmptyBatchArray"), ("LABEL", "vf_ne")]
+    a += ["DUP2", "ADD", ("PUSH", 1), "SWAP1", "SUB"]        # [f, last]
+    a += ["DUP1", ("PUSH", 0), "SLOAD", "LT", "ISZERO",
+          ("PUSHL", "vf_cm"), "JUMPI",
+          *_rv("BatchNotCommitted"), ("LABEL", "vf_cm")]
+    a += ["DUP2"]                                            # [f, last, i]
+    a += [("LABEL", "vf_loop")]
+    a += ["DUP2", "DUP2", "GT", ("PUSHL", "vf_done"), "JUMPI"]
+    # calldata for the verifier: [i, root, msgs, commit] at 0..128
+    a += ["DUP1", ("PUSH", 0), "MSTORE"]
+    a += ["DUP1", ("PUSH", 0x80), "MSTORE"]
+    for slot, off in ((4, 32), (5, 64), (6, 96)):
+        a += _map_hash(slot) + ["SLOAD", ("PUSH", off), "MSTORE"]
+    a += [("PUSH", 32), ("PUSH", 0xC0), ("PUSH", 128), ("PUSH", 0),
+          ("PUSH", int.from_bytes(VERIFIER_ADDRESS, "big")), "GAS",
+          "STATICCALL"]
+    a += [("PUSH", 0xC0), "MLOAD", ("PUSH", 1), "EQ", "AND",
+          ("PUSHL", "vf_next"), "JUMPI",
+          *_rv("InvalidProof"), ("LABEL", "vf_next")]
+    a += [("PUSH", 1), "ADD", ("PUSHL", "vf_loop"), "JUMP"]
+    a += [("LABEL", "vf_done"), "POP", ("PUSH", 1), "SSTORE", "STOP"]
+
+    # ---- getters / admin ------------------------------------------------
+    for tag, slot in (("fn_lc", 0), ("fn_lv", 1)):
+        a += [("LABEL", tag), ("PUSH", slot), "SLOAD", ("PUSH", 0),
+              "MSTORE", ("PUSH", 32), ("PUSH", 0), "RETURN"]
+    a += [("LABEL", "fn_root"), ("PUSH", 4), "CALLDATALOAD",
+          ("PUSH", 0x80), "MSTORE", *_map_hash(4), "SLOAD",
+          ("PUSH", 0), "MSTORE", ("PUSH", 32), ("PUSH", 0), "RETURN"]
+    a += [("LABEL", "fn_pause"), *_only_owner("ps_own"),
+          ("PUSH", 1), ("PUSH", 2), "SSTORE", "STOP"]
+    a += [("LABEL", "fn_unpause"), *_only_owner("up_own"),
+          ("PUSH", 0), ("PUSH", 2), "SSTORE", "STOP"]
+    return assemble(a)
+
+
+def decode_revert(output: bytes) -> str:
+    return output.lstrip(b"\x00").decode("ascii", "replace")

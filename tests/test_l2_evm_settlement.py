@@ -1,0 +1,100 @@
+"""L2 settlement through the OnChainProposer BYTECODE executed by our
+own EVM (VERDICT #8): the full pipeline — sequencer -> commit tx ->
+TCP prover -> verify tx with the STATICCALL'd verifier — against
+l2/l1_evm.EvmL1, plus the contract's revert identities."""
+
+import json
+
+import pytest
+
+from ethrex_tpu.guest.execution import ProgramOutput
+from ethrex_tpu.l2.l1_client import L1Error
+from ethrex_tpu.l2.l1_evm import EvmL1
+from ethrex_tpu.l2.sequencer import Sequencer, SequencerConfig
+from ethrex_tpu.node import Node
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.prover import protocol
+from ethrex_tpu.prover.client import ProverClient
+
+from tests.test_l2_pipeline import DEPOSITEE, GENESIS, _transfer
+
+
+def test_full_pipeline_settles_through_bytecode():
+    node = Node(Genesis.from_json(GENESIS))
+    l1 = EvmL1([protocol.PROVER_EXEC], l2_chain_id=65536999)
+    seq = Sequencer(node, l1, SequencerConfig(
+        needed_prover_types=(protocol.PROVER_EXEC,)))
+    seq.coordinator.start()
+    try:
+        l1.deposit(DEPOSITEE, 5 * 10**18)
+        seq.watch_l1()
+        node.submit_transaction(_transfer(0))
+        block1 = seq.produce_block()
+        assert any(tx.tx_type == 0x7E for tx in block1.body.transactions)
+        batch = seq.commit_next_batch()
+        assert batch.number == 1
+        # the CONTRACT's storage is the source of truth
+        assert l1.last_committed_batch() == 1
+        assert l1.last_verified_batch() == 0
+        client = ProverClient(protocol.PROVER_EXEC,
+                              [("127.0.0.1", seq.coordinator.port)])
+        assert client.poll_once() == 1
+        assert seq.send_proofs() == (1, 1)
+        assert l1.last_verified_batch() == 1
+        # contract kept the committed state root
+        assert l1._slot(0) == 1
+    finally:
+        seq.stop()
+
+
+def _fake_proof(root, msgs=b"\x00" * 32):
+    out = ProgramOutput(
+        initial_state_root=b"\x00" * 32, final_state_root=root,
+        last_block_hash=b"\x33" * 32, first_block_number=1,
+        last_block_number=1, messages_root=msgs)
+    return json.dumps({"backend": "exec",
+                       "output": "0x" + out.encode().hex()}).encode()
+
+
+def test_bytecode_revert_identities():
+    l1 = EvmL1([protocol.PROVER_EXEC])
+    with pytest.raises(L1Error, match="BatchNumberNotSuccessor"):
+        l1.commit_batch(5, b"\x11" * 32, b"\x22" * 32)
+    with pytest.raises(L1Error, match="CommitHashIsZero"):
+        l1.commit_batch(1, b"\x11" * 32, b"\x00" * 32)
+    l1.commit_batch(1, b"\x11" * 32, b"\x22" * 32)
+    l1.commit_batch(2, b"\x44" * 32, b"\x55" * 32)
+    with pytest.raises(L1Error, match="BatchNotSequential"):
+        l1.verify_batches(2, 2,
+                          {protocol.PROVER_EXEC: [_fake_proof(b"\x44" * 32)]})
+    with pytest.raises(L1Error, match="InvalidProof"):
+        l1.verify_batches(1, 1,
+                          {protocol.PROVER_EXEC: [_fake_proof(b"\x99" * 32)]})
+    # multi-batch verify in ONE call; second has a bad proof -> the whole
+    # tx reverts and lastVerified is untouched (contract-enforced
+    # atomicity, the Solidity semantics the Python port emulates)
+    with pytest.raises(L1Error, match="InvalidProof"):
+        l1.verify_batches(1, 2, {protocol.PROVER_EXEC: [
+            _fake_proof(b"\x11" * 32), _fake_proof(b"\x00" * 32)]})
+    assert l1.last_verified_batch() == 0
+    l1.verify_batches(1, 2, {protocol.PROVER_EXEC: [
+        _fake_proof(b"\x11" * 32), _fake_proof(b"\x44" * 32)]})
+    assert l1.last_verified_batch() == 2
+
+
+def test_bytecode_pause_and_ownership():
+    from ethrex_tpu.l2.l1_evm import OWNER
+    from ethrex_tpu.l2.proposer_evm import SEL_PAUSE
+
+    l1 = EvmL1([protocol.PROVER_EXEC])
+    # non-owner cannot pause or commit
+    with pytest.raises(L1Error, match="OwnableUnauthorizedAccount"):
+        l1._tx(SEL_PAUSE.to_bytes(4, "big"), sender=b"\xbb" * 20)
+    l1._tx(SEL_PAUSE.to_bytes(4, "big"), sender=OWNER)
+    with pytest.raises(L1Error, match="EnforcedPause"):
+        l1.commit_batch(1, b"\x11" * 32, b"\x22" * 32)
+    from ethrex_tpu.l2.proposer_evm import SEL_UNPAUSE
+
+    l1._tx(SEL_UNPAUSE.to_bytes(4, "big"), sender=OWNER)
+    l1.commit_batch(1, b"\x11" * 32, b"\x22" * 32)
+    assert l1.last_committed_batch() == 1
