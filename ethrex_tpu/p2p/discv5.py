@@ -1,0 +1,544 @@
+"""Node discovery v5 (discv5-wire + v4 identity scheme).
+
+Parity target: the reference's discv5 stack
+(/root/reference/crates/networking/p2p/discv5/{messages,session,server}.rs
+and discovery/discv5_handlers.rs) — packet masking, the
+WHOAREYOU/handshake session establishment, AES-GCM message encryption,
+PING/PONG/FINDNODE/NODES, and EIP-778 ENRs:
+
+  packet        = masking-iv(16) || masked-header || message
+  static-header = "discv5" || version(2) || flag(1) || nonce(12)
+                  || authdata-size(2)
+  masking       = AES-128-CTR(key = dest-id[:16], iv = masking-iv)
+  message       = AES-128-GCM(session key, nonce,
+                              ad = masking-iv || static-header || authdata)
+  session keys  = HKDF-SHA256(salt = challenge-data, ikm = ecdh,
+                  info = "discovery v5 key agreement" || id-A || id-B)
+  id-signature  = sign(sha256("discovery v5 identity proof" ||
+                  challenge-data || eph-pubkey || node-id-B))
+
+Flags: 0 ordinary (authdata = src-id), 1 WHOAREYOU (authdata =
+id-nonce(16) || enr-seq(8)), 2 handshake (authdata = src-id || sig-size
+|| eph-key-size || id-signature || eph-pubkey || record?).
+Messages: 0x01 PING [req-id, enr-seq]; 0x02 PONG [req-id, enr-seq, ip,
+port]; 0x03 FINDNODE [req-id, [distances]]; 0x04 NODES [req-id, total,
+[ENRs]].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import ipaddress
+import os
+import socket
+import threading
+
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+import hashlib
+import hmac as hmac_mod
+
+from ..crypto import secp256k1
+from ..crypto.keccak import keccak256
+from ..primitives import rlp
+
+PROTOCOL_ID = b"discv5"
+VERSION = 1
+MIN_PACKET_SIZE = 63
+MAX_PACKET_SIZE = 1280
+MAX_ENRS_PER_NODES = 3          # discv5_handlers.rs MAX_ENRS_PER_MESSAGE
+DISTANCES_PER_FINDNODE = 3
+
+MSG_PING, MSG_PONG, MSG_FINDNODE, MSG_NODES = 0x01, 0x02, 0x03, 0x04
+
+
+class Discv5Error(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# identity: node ids, ENRs (EIP-778, "v4" scheme)
+# ---------------------------------------------------------------------------
+
+def node_id_from_pubkey(pub) -> bytes:
+    x, y = pub
+    return keccak256(x.to_bytes(32, "big") + y.to_bytes(32, "big"))
+
+
+def compress_pubkey(pub) -> bytes:
+    x, y = pub
+    return bytes([0x02 if y % 2 == 0 else 0x03]) + x.to_bytes(32, "big")
+
+
+def decompress_pubkey(data: bytes):
+    if len(data) != 33 or data[0] not in (2, 3):
+        raise Discv5Error("bad compressed pubkey")
+    x = int.from_bytes(data[1:], "big")
+    p = secp256k1.P
+    y2 = (pow(x, 3, p) + 7) % p
+    y = pow(y2, (p + 1) // 4, p)
+    if y % 2 != data[0] % 2:
+        y = p - y
+    pt = (x, y)
+    if not secp256k1.is_on_curve(pt):
+        raise Discv5Error("pubkey not on curve")
+    return pt
+
+
+@dataclasses.dataclass
+class Enr:
+    """EIP-778 node record, v4 identity scheme."""
+
+    seq: int
+    pairs: dict              # key(bytes) -> value(bytes)
+    signature: bytes = b""
+
+    @classmethod
+    def make(cls, secret: int, seq: int, ip: str, udp_port: int,
+             tcp_port: int | None = None) -> "Enr":
+        pub = secp256k1.pubkey_from_secret(secret)
+        pairs = {
+            b"id": b"v4",
+            b"ip": ipaddress.ip_address(ip).packed,
+            b"secp256k1": compress_pubkey(pub),
+            b"udp": udp_port.to_bytes(2, "big").lstrip(b"\x00") or b"\x00",
+        }
+        if tcp_port:
+            pairs[b"tcp"] = tcp_port.to_bytes(2, "big")
+        enr = cls(seq=seq, pairs=pairs)
+        content = enr._content()
+        r, s, _ = secp256k1.sign(keccak256(rlp.encode(content)), secret)
+        enr.signature = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+        return enr
+
+    def _content(self):
+        out = [self.seq]
+        for k in sorted(self.pairs):
+            out += [k, self.pairs[k]]
+        return out
+
+    def encode(self) -> bytes:
+        return rlp.encode([self.signature] + self._content())
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Enr":
+        f = rlp.decode(data)
+        if len(f) < 2 or len(f) % 2 != 0:
+            raise Discv5Error("bad ENR shape")
+        sig = bytes(f[0])
+        seq = rlp.decode_int(f[1])
+        pairs = {}
+        for i in range(2, len(f), 2):
+            pairs[bytes(f[i])] = bytes(f[i + 1])
+        enr = cls(seq=seq, pairs=pairs, signature=sig)
+        enr.verify()
+        return enr
+
+    def verify(self) -> None:
+        if self.pairs.get(b"id") != b"v4":
+            raise Discv5Error("unsupported identity scheme")
+        pub = decompress_pubkey(self.pairs[b"secp256k1"])
+        digest = keccak256(rlp.encode(self._content()))
+        r = int.from_bytes(self.signature[:32], "big")
+        s = int.from_bytes(self.signature[32:64], "big")
+        if not secp256k1.verify(digest, r, s, pub):
+            raise Discv5Error("bad ENR signature")
+
+    @property
+    def pubkey(self):
+        return decompress_pubkey(self.pairs[b"secp256k1"])
+
+    @property
+    def node_id(self) -> bytes:
+        return node_id_from_pubkey(self.pubkey)
+
+    @property
+    def udp_endpoint(self) -> tuple[str, int]:
+        ip = str(ipaddress.ip_address(self.pairs[b"ip"]))
+        return ip, int.from_bytes(self.pairs[b"udp"], "big")
+
+
+def log2_distance(a: bytes, b: bytes) -> int:
+    d = int.from_bytes(a, "big") ^ int.from_bytes(b, "big")
+    return d.bit_length()
+
+
+# ---------------------------------------------------------------------------
+# packet codec
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Header:
+    flag: int
+    nonce: bytes             # 12
+    authdata: bytes
+
+    def static_header(self) -> bytes:
+        return (PROTOCOL_ID + VERSION.to_bytes(2, "big")
+                + bytes([self.flag]) + self.nonce
+                + len(self.authdata).to_bytes(2, "big"))
+
+
+def mask(dest_id: bytes, masking_iv: bytes, header_bytes: bytes) -> bytes:
+    enc = Cipher(algorithms.AES(dest_id[:16]),
+                 modes.CTR(masking_iv)).encryptor()
+    return enc.update(header_bytes)
+
+
+def encode_packet(dest_id: bytes, header: Header, message: bytes,
+                  masking_iv: bytes | None = None) -> bytes:
+    masking_iv = masking_iv or os.urandom(16)
+    hdr = header.static_header() + header.authdata
+    return masking_iv + mask(dest_id, masking_iv, hdr) + message
+
+
+def decode_packet(local_id: bytes, datagram: bytes):
+    """-> (masking_iv, Header, encrypted_message).  The header is
+    unmasked with OUR node id (packets not addressed to us turn to
+    garbage and fail the protocol-id check)."""
+    if not MIN_PACKET_SIZE <= len(datagram) <= MAX_PACKET_SIZE:
+        raise Discv5Error("bad packet size")
+    masking_iv = datagram[:16]
+    dec = Cipher(algorithms.AES(local_id[:16]),
+                 modes.CTR(masking_iv)).decryptor()
+    static = dec.update(datagram[16:16 + 23])
+    if static[:6] != PROTOCOL_ID:
+        raise Discv5Error("bad protocol id")
+    if int.from_bytes(static[6:8], "big") != VERSION:
+        raise Discv5Error("bad version")
+    flag = static[8]
+    nonce = static[9:21]
+    authdata_size = int.from_bytes(static[21:23], "big")
+    if len(datagram) < 16 + 23 + authdata_size:
+        raise Discv5Error("truncated authdata")
+    authdata = dec.update(datagram[16 + 23:16 + 23 + authdata_size])
+    message = datagram[16 + 23 + authdata_size:]
+    return masking_iv, Header(flag, nonce, authdata), message
+
+
+def gcm_encrypt(key: bytes, nonce: bytes, plaintext: bytes,
+                ad: bytes) -> bytes:
+    return AESGCM(key).encrypt(nonce, plaintext, ad)
+
+
+def gcm_decrypt(key: bytes, nonce: bytes, ciphertext: bytes,
+                ad: bytes) -> bytes:
+    from cryptography.exceptions import InvalidTag
+
+    try:
+        return AESGCM(key).decrypt(nonce, ciphertext, ad)
+    except InvalidTag:
+        raise Discv5Error("message authentication failed")
+
+
+# ---------------------------------------------------------------------------
+# session crypto (discv5-theory, v4 identity scheme)
+# ---------------------------------------------------------------------------
+
+def ecdh(pub, secret: int) -> bytes:
+    x, y = secp256k1._mul(pub, secret)
+    return bytes([0x02 if y % 2 == 0 else 0x03]) + x.to_bytes(32, "big")
+
+
+def _hkdf_sha256(salt: bytes, ikm: bytes, info: bytes, length: int) -> bytes:
+    prk = hmac_mod.new(salt, ikm, hashlib.sha256).digest()
+    out = b""
+    block = b""
+    i = 1
+    while len(out) < length:
+        block = hmac_mod.new(prk, block + info + bytes([i]),
+                             hashlib.sha256).digest()
+        out += block
+        i += 1
+    return out[:length]
+
+
+def derive_session_keys(secret: int, pub, node_id_a: bytes,
+                        node_id_b: bytes, challenge_data: bytes,
+                        is_initiator: bool):
+    """-> (outbound_key, inbound_key), 16 bytes each."""
+    shared = ecdh(pub, secret)
+    info = b"discovery v5 key agreement" + node_id_a + node_id_b
+    key_data = _hkdf_sha256(challenge_data, shared, info, 32)
+    initiator_key, recipient_key = key_data[:16], key_data[16:]
+    return (initiator_key, recipient_key) if is_initiator \
+        else (recipient_key, initiator_key)
+
+
+def id_signature_input(challenge_data: bytes, eph_pubkey: bytes,
+                       node_id_b: bytes) -> bytes:
+    return (b"discovery v5 identity proof" + challenge_data + eph_pubkey
+            + node_id_b)
+
+
+def create_id_signature(secret: int, challenge_data: bytes,
+                        eph_pubkey: bytes, node_id_b: bytes) -> bytes:
+    digest = hashlib.sha256(
+        id_signature_input(challenge_data, eph_pubkey, node_id_b)).digest()
+    r, s, _ = secp256k1.sign(digest, secret)
+    return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+def verify_id_signature(pub, challenge_data: bytes, eph_pubkey: bytes,
+                        node_id_b: bytes, sig: bytes) -> bool:
+    if len(sig) != 64:
+        return False
+    digest = hashlib.sha256(
+        id_signature_input(challenge_data, eph_pubkey, node_id_b)).digest()
+    return secp256k1.verify(digest,
+                            int.from_bytes(sig[:32], "big"),
+                            int.from_bytes(sig[32:64], "big"), pub)
+
+
+# ---------------------------------------------------------------------------
+# messages
+# ---------------------------------------------------------------------------
+
+def encode_message(msg_type: int, fields) -> bytes:
+    return bytes([msg_type]) + rlp.encode(fields)
+
+
+def decode_message(data: bytes):
+    if not data:
+        raise Discv5Error("empty message")
+    return data[0], rlp.decode(data[1:])
+
+
+# ---------------------------------------------------------------------------
+# the server: sessions, handshakes, PING/FINDNODE serving
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Session:
+    outbound_key: bytes
+    inbound_key: bytes
+    remote_enr: Enr | None = None
+
+
+class Discv5Server:
+    """UDP discv5 node: answers PING with PONG and FINDNODE with NODES
+    from its ENR table; initiates sessions via the WHOAREYOU handshake
+    (reference: discovery/discv5_handlers.rs + discv5/server.rs)."""
+
+    def __init__(self, secret: int, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.secret = secret
+        self.pub = secp256k1.pubkey_from_secret(secret)
+        self.local_id = node_id_from_pubkey(self.pub)
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind((host, port))
+        self.sock.settimeout(0.2)
+        self.host, self.port = self.sock.getsockname()
+        self.enr_seq = 1
+        self.enr = Enr.make(secret, self.enr_seq, self.host, self.port)
+        self.sessions: dict[bytes, Session] = {}
+        self.table: dict[bytes, Enr] = {}       # node_id -> ENR
+        # pending outbound messages awaiting a handshake, keyed by the
+        # nonce of the random packet that solicited WHOAREYOU
+        self._pending: dict[bytes, tuple[bytes, tuple, bytes]] = {}
+        self._challenges: dict[bytes, bytes] = {}  # src-id -> challenge
+        self.received: list = []                # (node_id, msg_type, fields)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ---- plumbing --------------------------------------------------------
+    def _send(self, dest_id: bytes, addr, header: Header, message: bytes):
+        self.sock.sendto(encode_packet(dest_id, header, message), addr)
+
+    def _send_encrypted(self, dest_id: bytes, addr, msg_type: int,
+                        fields):
+        sess = self.sessions.get(dest_id)
+        if sess is None:
+            # no session: fire a random packet to solicit WHOAREYOU
+            nonce = os.urandom(12)
+            self._pending[nonce] = (dest_id, addr,
+                                    encode_message(msg_type, fields))
+            header = Header(0, nonce, self.local_id)
+            self._send(dest_id, addr, header, os.urandom(32))
+            return
+        nonce = os.urandom(12)
+        header = Header(0, nonce, self.local_id)
+        masking_iv = os.urandom(16)
+        ad = masking_iv + header.static_header() + header.authdata
+        ct = gcm_encrypt(sess.outbound_key, nonce,
+                         encode_message(msg_type, fields), ad)
+        self.sock.sendto(
+            masking_iv + mask(dest_id, masking_iv,
+                              header.static_header() + header.authdata)
+            + ct, addr)
+
+    # ---- public API ------------------------------------------------------
+    def ping(self, enr: Enr):
+        self.table.setdefault(enr.node_id, enr)
+        self._send_encrypted(enr.node_id, enr.udp_endpoint, MSG_PING,
+                             [os.urandom(2), self.enr_seq])
+
+    def find_node(self, enr: Enr, distances: list[int]):
+        self.table.setdefault(enr.node_id, enr)
+        self._send_encrypted(enr.node_id, enr.udp_endpoint, MSG_FINDNODE,
+                             [os.urandom(2), list(distances)])
+
+    # ---- handlers --------------------------------------------------------
+    def _handle(self, datagram: bytes, addr):
+        masking_iv, header, message = decode_packet(self.local_id,
+                                                    datagram)
+        if header.flag == 0:
+            self._on_ordinary(masking_iv, header, message, addr)
+        elif header.flag == 1:
+            self._on_whoareyou(masking_iv, header, message, addr)
+        elif header.flag == 2:
+            self._on_handshake(masking_iv, header, message, addr)
+        else:
+            raise Discv5Error(f"bad flag {header.flag}")
+
+    def _on_ordinary(self, masking_iv, header, message, addr):
+        if len(header.authdata) != 32:
+            raise Discv5Error("bad ordinary authdata")
+        src_id = header.authdata
+        sess = self.sessions.get(src_id)
+        if sess is None:
+            # unknown session: answer WHOAREYOU (challenge referencing
+            # the packet's nonce)
+            id_nonce = os.urandom(16)
+            why = Header(1, header.nonce,
+                         id_nonce + self.enr_seq.to_bytes(8, "big"))
+            masking_iv2 = os.urandom(16)
+            hdr_bytes = why.static_header() + why.authdata
+            self._challenges[src_id] = (masking_iv2 + hdr_bytes)
+            self.sock.sendto(
+                masking_iv2 + mask(src_id, masking_iv2, hdr_bytes),
+                addr)
+            return
+        ad = masking_iv + header.static_header() + header.authdata
+        try:
+            pt = gcm_decrypt(sess.inbound_key, header.nonce, message, ad)
+        except Discv5Error:
+            # stale keys: restart via WHOAREYOU
+            self.sessions.pop(src_id, None)
+            return self._on_ordinary(masking_iv, header, message, addr)
+        self._on_message(src_id, addr, pt)
+
+    def _on_whoareyou(self, masking_iv, header, message, addr):
+        if len(header.authdata) != 24:
+            raise Discv5Error("bad WHOAREYOU authdata")
+        # find the request this challenges (by nonce)
+        pending = self._pending.pop(header.nonce, None)
+        if pending is None:
+            return
+        dest_id, dest_addr, queued_msg = pending
+        remote_enr = self.table.get(dest_id)
+        if remote_enr is None:
+            return
+        challenge_data = (masking_iv + header.static_header()
+                          + header.authdata)
+        eph_secret = int.from_bytes(os.urandom(32), "big") % secp256k1.N
+        eph_pub = secp256k1.pubkey_from_secret(eph_secret)
+        eph_compressed = compress_pubkey(eph_pub)
+        id_sig = create_id_signature(self.secret, challenge_data,
+                                     eph_compressed, dest_id)
+        out_key, in_key = derive_session_keys(
+            eph_secret, remote_enr.pubkey, self.local_id, dest_id,
+            challenge_data, is_initiator=True)
+        self.sessions[dest_id] = Session(out_key, in_key, remote_enr)
+        # handshake packet carrying the queued message + our ENR
+        record = self.enr.encode()
+        authdata = (self.local_id + bytes([64])
+                    + bytes([len(eph_compressed)]) + id_sig
+                    + eph_compressed + record)
+        nonce = os.urandom(12)
+        hs = Header(2, nonce, authdata)
+        masking_iv2 = os.urandom(16)
+        ad = masking_iv2 + hs.static_header() + hs.authdata
+        ct = gcm_encrypt(out_key, nonce, queued_msg, ad)
+        self.sock.sendto(
+            masking_iv2 + mask(dest_id, masking_iv2,
+                               hs.static_header() + hs.authdata) + ct,
+            dest_addr)
+
+    def _on_handshake(self, masking_iv, header, message, addr):
+        a = header.authdata
+        if len(a) < 34:
+            raise Discv5Error("short handshake authdata")
+        src_id, sig_size, eph_size = a[:32], a[32], a[33]
+        off = 34
+        id_sig = a[off:off + sig_size]
+        off += sig_size
+        eph_compressed = a[off:off + eph_size]
+        off += eph_size
+        record = a[off:]
+        challenge = self._challenges.pop(src_id, None)
+        if challenge is None:
+            raise Discv5Error("handshake without a challenge")
+        remote_enr = Enr.decode(record) if record else \
+            self.table.get(src_id)
+        if remote_enr is None or remote_enr.node_id != src_id:
+            raise Discv5Error("handshake without a usable ENR")
+        if not verify_id_signature(remote_enr.pubkey, challenge,
+                                   eph_compressed, self.local_id, id_sig):
+            raise Discv5Error("bad id signature")
+        eph_pub = decompress_pubkey(eph_compressed)
+        out_key, in_key = derive_session_keys(
+            self.secret, eph_pub, src_id, self.local_id, challenge,
+            is_initiator=False)
+        self.sessions[src_id] = Session(out_key, in_key, remote_enr)
+        self.table[src_id] = remote_enr
+        ad = masking_iv + header.static_header() + header.authdata
+        pt = gcm_decrypt(in_key, header.nonce, message, ad)
+        self._on_message(src_id, addr, pt)
+
+    def _on_message(self, src_id: bytes, addr, plaintext: bytes):
+        msg_type, fields = decode_message(plaintext)
+        self.received.append((src_id, msg_type, fields))
+        if msg_type == MSG_PING:
+            req_id = bytes(fields[0])
+            self._send_encrypted(src_id, addr, MSG_PONG, [
+                req_id, self.enr_seq,
+                ipaddress.ip_address(addr[0]).packed, addr[1]])
+        elif msg_type == MSG_FINDNODE:
+            req_id = bytes(fields[0])
+            distances = [rlp.decode_int(d) for d in fields[1]]
+            matches = []
+            for nid, enr in self.table.items():
+                if log2_distance(self.local_id, nid) in distances:
+                    matches.append(enr)
+            if 0 in distances:
+                matches.append(self.enr)
+            chunks = [matches[i:i + MAX_ENRS_PER_NODES]
+                      for i in range(0, len(matches),
+                                     MAX_ENRS_PER_NODES)] or [[]]
+            for chunk in chunks:
+                self._send_encrypted(src_id, addr, MSG_NODES, [
+                    req_id, len(chunks),
+                    [rlp.decode(e.encode()) for e in chunk]])
+        elif msg_type == MSG_NODES:
+            for raw in fields[2]:
+                try:
+                    enr = Enr.decode(rlp.encode(raw))
+                    self.table.setdefault(enr.node_id, enr)
+                except Discv5Error:
+                    continue
+
+    # ---- loop ------------------------------------------------------------
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                datagram, addr = self.sock.recvfrom(2048)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            try:
+                self._handle(datagram, addr)
+            except Discv5Error:
+                continue
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+        self.sock.close()
