@@ -1,0 +1,178 @@
+"""Self-generated BlockchainTest-format smoke fixtures for the
+ef_blockchain runner (ethrex_tpu/utils/ef_blockchain.py).
+
+These are the runner's harness, NOT independent conformance: expected
+hashes come from this repo's own executor (public EF archives plug into
+the same runner unchanged; they are not redistributable inside this
+image).  Units: a valid Cancun transfer+contract chain with postState,
+plus declared-invalid variants (tampered state root, wrong base fee,
+undecodable RLP, tampered gas used).
+
+Run:  python tests/fixtures/ef_blockchain/_generate.py
+"""
+
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+from ethrex_tpu.crypto import secp256k1  # noqa: E402
+from ethrex_tpu.node import Node  # noqa: E402
+from ethrex_tpu.primitives.block import Block  # noqa: E402
+from ethrex_tpu.primitives.genesis import Genesis  # noqa: E402
+from ethrex_tpu.primitives.transaction import Transaction  # noqa: E402
+
+SECRET = 0xA11CE
+SENDER = secp256k1.pubkey_to_address(secp256k1.pubkey_from_secret(SECRET))
+OTHER = bytes.fromhex("44" * 20)
+# sstore(0, calldataload(0)): 600035 5f 55 00
+CODE = bytes.fromhex("6000355f5500")
+CONTRACT = bytes.fromhex("c0de" * 10)
+
+GENESIS_JSON = {
+    "config": {"chainId": 1, "terminalTotalDifficulty": 0,
+               "shanghaiTime": 0, "cancunTime": 0},
+    "alloc": {
+        "0x" + SENDER.hex(): {"balance": hex(10**21)},
+        "0x" + CONTRACT.hex(): {"balance": "0x0",
+                                "code": "0x" + CODE.hex()},
+    },
+    "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7", "timestamp": "0x0",
+}
+
+
+def _hdr_json(h):
+    out = {
+        "parentHash": "0x" + h.parent_hash.hex(),
+        "coinbase": "0x" + h.coinbase.hex(),
+        "stateRoot": "0x" + h.state_root.hex(),
+        "difficulty": hex(h.difficulty),
+        "number": hex(h.number),
+        "gasLimit": hex(h.gas_limit),
+        "gasUsed": hex(h.gas_used),
+        "timestamp": hex(h.timestamp),
+        "extraData": "0x" + h.extra_data.hex(),
+        "mixHash": "0x" + h.prev_randao.hex(),
+        "nonce": "0x" + h.nonce.hex(),
+        "hash": "0x" + h.hash.hex(),
+    }
+    if h.base_fee_per_gas is not None:
+        out["baseFeePerGas"] = hex(h.base_fee_per_gas)
+    if h.excess_blob_gas is not None:
+        out["excessBlobGas"] = hex(h.excess_blob_gas)
+    if h.blob_gas_used is not None:
+        out["blobGasUsed"] = hex(h.blob_gas_used)
+    return out
+
+
+def _build_chain():
+    node = Node(Genesis.from_json(GENESIS_JSON))
+    blocks = []
+    nonce = 0
+    for n in range(3):
+        node.submit_transaction(Transaction(
+            tx_type=2, chain_id=1, nonce=nonce,
+            max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+            gas_limit=21000, to=OTHER, value=1000 + n).sign(SECRET))
+        nonce += 1
+        node.submit_transaction(Transaction(
+            tx_type=2, chain_id=1, nonce=nonce,
+            max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+            gas_limit=90_000, to=CONTRACT, value=0,
+            data=(7 + n).to_bytes(32, "big")).sign(SECRET))
+        nonce += 1
+        blocks.append(node.produce_block())
+    return node, blocks
+
+
+def main():
+    node, blocks = _build_chain()
+    store = node.store
+    genesis = Genesis.from_json(GENESIS_JSON)
+    gh = store.get_header(blocks[0].header.parent_hash)
+    genesis_rlp = Block(gh, dataclasses.replace(
+        blocks[0].body, transactions=[], withdrawals=[])).encode()
+
+    head = blocks[-1].header
+    root = head.state_root
+    post = {}
+    for addr in (SENDER, OTHER, CONTRACT):
+        st = store.account_state(root, addr)
+        entry = {"nonce": hex(st.nonce), "balance": hex(st.balance)}
+        if addr == CONTRACT:
+            entry["storage"] = {"0x00": hex(store.storage_at(root, addr, 0))}
+        post["0x" + addr.hex()] = entry
+
+    pre = GENESIS_JSON["alloc"]
+    base = {
+        "network": "Cancun",
+        "genesisBlockHeader": _hdr_json(gh),
+        "genesisRLP": "0x" + genesis_rlp.hex(),
+        "pre": pre,
+        "sealEngine": "NoProof",
+    }
+
+    units = {}
+    units["valid_transfer_contract_chain"] = dict(
+        base,
+        blocks=[{"rlp": "0x" + b.encode().hex()} for b in blocks],
+        lastblockhash="0x" + head.hash.hex(),
+        postState=post,
+    )
+    # declared-invalid variants: the prefix chain is valid, the final
+    # block is tampered and must be rejected
+    bad_root = Block(dataclasses.replace(blocks[2].header,
+                                         state_root=b"\x11" * 32),
+                     blocks[2].body)
+    units["invalid_state_root"] = dict(
+        base,
+        blocks=[{"rlp": "0x" + blocks[0].encode().hex()},
+                {"rlp": "0x" + blocks[1].encode().hex()},
+                {"rlp": "0x" + bad_root.encode().hex(),
+                 "expectException": "InvalidStateRoot"}],
+        lastblockhash="0x" + blocks[1].header.hash.hex(),
+        postStateHash="0x" + blocks[1].header.state_root.hex(),
+    )
+    bad_fee = Block(dataclasses.replace(blocks[2].header,
+                                        base_fee_per_gas=1234),
+                    blocks[2].body)
+    units["invalid_base_fee"] = dict(
+        base,
+        blocks=[{"rlp": "0x" + blocks[0].encode().hex()},
+                {"rlp": "0x" + blocks[1].encode().hex()},
+                {"rlp": "0x" + bad_fee.encode().hex(),
+                 "expectException": "InvalidBaseFee"}],
+        lastblockhash="0x" + blocks[1].header.hash.hex(),
+        postStateHash="0x" + blocks[1].header.state_root.hex(),
+    )
+    bad_gas = Block(dataclasses.replace(blocks[2].header,
+                                        gas_used=head.gas_used + 1),
+                    blocks[2].body)
+    units["invalid_gas_used"] = dict(
+        base,
+        blocks=[{"rlp": "0x" + blocks[0].encode().hex()},
+                {"rlp": "0x" + blocks[1].encode().hex()},
+                {"rlp": "0x" + bad_gas.encode().hex(),
+                 "expectException": "InvalidGasUsed"}],
+        lastblockhash="0x" + blocks[1].header.hash.hex(),
+        postStateHash="0x" + blocks[1].header.state_root.hex(),
+    )
+    units["undecodable_block_rlp"] = dict(
+        base,
+        blocks=[{"rlp": "0x" + blocks[0].encode().hex()},
+                {"rlp": "0xdeadbeef",
+                 "expectException": "BlockRLPDecodeError"}],
+        lastblockhash="0x" + blocks[0].header.hash.hex(),
+        postStateHash="0x" + blocks[0].header.state_root.hex(),
+    )
+
+    out = os.path.join(os.path.dirname(__file__), "smoke.json")
+    with open(out, "w") as f:
+        json.dump(units, f, indent=1)
+    print(f"wrote {len(units)} units to {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""EF BlockchainTest-format runner (VERDICT #5): the suite the public
+archives plug into, exercised with self-generated smoke fixtures, plus
+bit-exact decode parity with the reference's own chain.rlp fixture."""
+
+import json
+import os
+
+import pytest
+
+from ethrex_tpu.utils import ef_blockchain
+
+FIX = os.path.join(os.path.dirname(__file__), "fixtures", "ef_blockchain")
+REF = "/root/reference/fixtures/blockchain"
+
+
+def test_smoke_fixture_file():
+    res = ef_blockchain.run_fixture_file(os.path.join(FIX, "smoke.json"))
+    assert res["failures"] == []
+    assert res["passed"] == 5
+
+
+def test_runner_catches_wrong_lastblockhash():
+    with open(os.path.join(FIX, "smoke.json")) as f:
+        units = json.load(f)
+    unit = units["valid_transfer_contract_chain"]
+    bad = dict(unit, lastblockhash="0x" + "ab" * 32)
+    with pytest.raises(ef_blockchain.FixtureFailure, match="last valid"):
+        ef_blockchain.run_unit("bad-last", bad)
+
+
+def test_runner_catches_missing_exception():
+    """A block marked expectException that imports cleanly must fail the
+    unit (the reference runner's 'test expected failure' arm)."""
+    with open(os.path.join(FIX, "smoke.json")) as f:
+        units = json.load(f)
+    unit = json.loads(json.dumps(units["valid_transfer_contract_chain"]))
+    unit["blocks"][-1]["expectException"] = "InvalidStateRoot"
+    with pytest.raises(ef_blockchain.FixtureFailure, match="accepted"):
+        ef_blockchain.run_unit("should-fail", unit)
+
+
+def test_runner_catches_post_state_mismatch():
+    with open(os.path.join(FIX, "smoke.json")) as f:
+        units = json.load(f)
+    unit = json.loads(json.dumps(units["valid_transfer_contract_chain"]))
+    for addr, acct in unit["postState"].items():
+        acct["balance"] = hex(int(acct["balance"], 16) + 1)
+        break
+    with pytest.raises(ef_blockchain.FixtureFailure, match="balance"):
+        ef_blockchain.run_unit("bad-post", unit)
+
+
+# ---- the reference's own chain fixtures -----------------------------------
+
+@pytest.mark.skipif(not os.path.isdir(REF),
+                    reason="reference fixtures not available")
+def test_reference_chain_rlp_decode_parity():
+    """Reproduces the reference's decode test on fixtures/blockchain/
+    chain.rlp — 20 blocks, numbers 1..20, and the same three block
+    hashes (/root/reference/test/tests/cmd/decode_tests.rs:9-40).  Full
+    replay is impossible hermetically: the chain's genesis (parent
+    414c6377..) matches none of the vendored genesis files; the
+    reference itself only decodes this fixture in tests."""
+    from ethrex_tpu.primitives import rlp
+    from ethrex_tpu.primitives.block import Block
+
+    with open(f"{REF}/chain.rlp", "rb") as f:
+        rest = f.read()
+    blocks = []
+    while rest:
+        item, rest = rlp.decode_prefix(rest)
+        blocks.append(Block.decode(rlp.encode(item)))
+    assert len(blocks) == 20
+    assert blocks[0].header.number == 1
+    assert blocks[0].hash.hex() == ("ac5c61edb087a51279674fe01d5c1f65"
+                                    "eac3fd8597f9bea215058e745df8088e")
+    assert blocks[1].hash.hex() == ("a111ce2477e1dd45173ba93cac819e62"
+                                    "947e62a63a7d561b6f4825fb31c22645")
+    assert blocks[19].hash.hex() == ("8f64c4436f7213cfdf02cfb9f45d012f"
+                                     "1774dfb329b8803de5e7479b11586902")
+    # round-trip: re-encoding every block reproduces the fixture bytes
+    with open(f"{REF}/chain.rlp", "rb") as f:
+        raw = f.read()
+    assert b"".join(b.encode() for b in blocks) == raw
+
+
+@pytest.mark.skipif(not os.path.isdir(REF),
+                    reason="reference fixtures not available")
+def test_lfs_pointer_chains_documented():
+    """2000-blocks.rlp and l2-1k-erc20.rlp are git-lfs POINTER files in
+    the vendored reference (67 MB / 288 MB payloads never fetched —
+    zero-egress image), so they cannot be replayed here.  This test
+    documents that fact; if real payloads ever appear, it fails so they
+    get wired into the replay suite."""
+    for name in ("2000-blocks.rlp", "l2-1k-erc20.rlp"):
+        with open(f"{REF}/{name}", "rb") as f:
+            head = f.read(64)
+        assert head.startswith(b"version https://git-lfs"), name
