@@ -359,6 +359,75 @@ def measure_config3() -> None:
     }))
 
 
+def measure_mgas() -> None:
+    """L1 execution-throughput microbench (reference anchor: ~669 Mgas/s
+    live import on its bench box, docs/perf/README.md:126-131): build a
+    chain of full transfer blocks, then re-import it through the
+    PIPELINED path (execute N+1 while N merkleizes in the native C++
+    MPT engine) into a fresh store.  Host CPU only — no TPU needed."""
+    import os as _os
+
+    _os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")  # axon ignores the env
+    except Exception:
+        pass
+    from ethrex_tpu.blockchain.blockchain import Blockchain
+    from ethrex_tpu.blockchain.fork_choice import apply_fork_choice
+    from ethrex_tpu.crypto import secp256k1
+    from ethrex_tpu.node import Node
+    from ethrex_tpu.primitives.genesis import Genesis
+    from ethrex_tpu.primitives.transaction import Transaction
+    from ethrex_tpu.storage.store import Store
+
+    num_blocks = int(os.environ.get("BENCH_MGAS_BLOCKS", "20"))
+    txs_per_block = int(os.environ.get("BENCH_MGAS_TXS", "400"))
+    secret = 0xA11CE
+    sender = secp256k1.pubkey_to_address(
+        secp256k1.pubkey_from_secret(secret))
+    genesis = {
+        "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+                   "shanghaiTime": 0, "cancunTime": 0},
+        "alloc": {"0x" + sender.hex(): {"balance": hex(10**24)}},
+        "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7",
+        "timestamp": "0x0",
+    }
+    node = Node(Genesis.from_json(genesis))
+    nonce = 0
+    blocks = []
+    for _ in range(num_blocks):
+        for i in range(txs_per_block):
+            node.submit_transaction(Transaction(
+                tx_type=2, chain_id=1337, nonce=nonce,
+                max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+                gas_limit=21_000, to=bytes([0x50 + i % 64]) * 20,
+                value=1 + i).sign(secret))
+            nonce += 1
+        blocks.append(node.produce_block())
+    gas = sum(b.header.gas_used for b in blocks)
+    # fresh store, re-import through full validation (pipelined)
+    store = Store()
+    gh = store.init_genesis(Genesis.from_json(genesis))
+    chain = Blockchain(store, node.config)
+    t0 = time.perf_counter()
+    chain.add_blocks_pipelined(blocks)
+    wall = time.perf_counter() - t0
+    apply_fork_choice(store, blocks[-1].hash)
+    assert store.head_header().hash == blocks[-1].hash
+    print(json.dumps({
+        "metric": "l1_import_mgas_per_sec",
+        "value": round(gas / wall / 1e6, 2),
+        "unit": "Mgas/s",
+        "vs_baseline": round((gas / wall / 1e6) / 669.0, 4),
+        "blocks": num_blocks, "txs": num_blocks * txs_per_block,
+        "batch_gas": gas, "wall_s": round(wall, 3),
+        "config": "L1 pipelined import, ETH transfers (ref anchor "
+                  "669 Mgas/s, docs/perf/README.md:126-131)",
+    }))
+
+
 def measure_core() -> None:
     """Fallback microbench: fully-jitted prove-core throughput (the round
     1-2 metric, against its documented estimated anchor)."""
@@ -428,6 +497,12 @@ def _extra_configs() -> dict:
     return out
 
 
+def _mgas_config() -> dict:
+    """The L1-side number (host CPU, chip-independent)."""
+    res = _attempt("--measure-mgas", min(EXTRA_TIMEOUT, 1200))
+    return res if res is not None else {"error": "no output"}
+
+
 def main() -> None:
     last_err = ""
     for attempt in range(ATTEMPTS):
@@ -439,6 +514,7 @@ def main() -> None:
         if result is not None and "_err" not in result:
             if os.environ.get("BENCH_SKIP_EXTRAS") != "1":
                 result["configs"] = _extra_configs()
+                result["configs"]["mgas"] = _mgas_config()
             try:
                 with open(LAST_PATH, "w") as f:
                     json.dump(result, f)
@@ -473,12 +549,17 @@ def main() -> None:
         pass
     result["degraded"] = True
     result["error"] = last_err
+    if os.environ.get("BENCH_SKIP_EXTRAS") != "1":
+        # the L1-side number needs no chip: measure it even degraded
+        result.setdefault("configs", {})["mgas"] = _mgas_config()
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
     if "--measure-core" in sys.argv:
         measure_core()
+    elif "--measure-mgas" in sys.argv:
+        measure_mgas()
     elif "--measure-2" in sys.argv:
         measure_config2()
     elif "--measure-3" in sys.argv:
