@@ -113,7 +113,8 @@ class RlpxPeer:
         self.send_msg(eth_wire.HELLO,
                       rlpx.make_hello_payload(
                           CLIENT_ID, node_id,
-                          (("eth", 68), ("eth", 69), ("snap", 1))))
+                          tuple([("eth", v) for v in sorted(eth_wire.ETH_VERSIONS)]
+                                + [("snap", 1)])))
         msg_id, payload = self.recv_msg()
         if msg_id != eth_wire.HELLO:
             raise PeerError(f"expected hello, got {msg_id}")
@@ -121,12 +122,16 @@ class RlpxPeer:
         mutual = [v for v in eth_wire.ETH_VERSIONS
                   if ("eth", v) in hello["capabilities"]]
         if not mutual:
-            raise PeerError("no mutual eth version (need 68 or 69)")
+            raise PeerError("no mutual eth version (need 68..71)")
         self.eth_version = mutual[0]   # ETH_VERSIONS is preference-ordered
         # devp2p multiplexing: snap's id space starts after eth's, whose
-        # size depends on the negotiated version (BlockRangeUpdate)
-        self.snap_offset = (snap.SNAP_OFFSET_ETH69 if self.eth_version >= 69
-                            else snap.SNAP_OFFSET_ETH68)
+        # size depends on the negotiated version (BlockRangeUpdate at 69,
+        # the EIP-8159 BAL messages at 71; eth/70 adds no codes)
+        self.snap_offset = {
+            68: snap.SNAP_OFFSET_ETH68,
+            69: snap.SNAP_OFFSET_ETH69,
+            70: snap.SNAP_OFFSET_ETH70,
+        }.get(self.eth_version, snap.SNAP_OFFSET_ETH71)
         self.capabilities = set(hello["capabilities"])
         # devp2p: both sides at p2p version >= 5 compress every message
         # after Hello with snappy
@@ -248,9 +253,71 @@ class RlpxPeer:
         return self.request(eth_wire.GET_BLOCK_BODIES, payload, rid)
 
     def get_receipts(self, hashes):
+        """Receipts for `hashes`; on eth/70+ (EIP-7975) responses are
+        size-capped and resumable, so this loops with
+        firstBlockReceiptIndex until every requested block completes."""
+        if self.eth_version < 70:
+            return self._get_receipts_legacy(hashes)
+        hashes = list(hashes)
+        out = []          # completed lists, aligned with `hashes`
+        partial = []      # receipts so far for hashes[len(out)]
+        while len(out) < len(hashes):
+            rid = self._next_request_id()
+            payload = eth_wire.encode_get_receipts70(
+                rid, len(partial), hashes[len(out):])
+            incomplete, lists = self.request(
+                eth_wire.GET_RECEIPTS, payload, rid)
+            if not lists or (incomplete
+                             and sum(len(x) for x in lists) == 0):
+                break     # peer has nothing / is stalling
+            partial.extend(lists[0])
+            rest = lists[1:]
+            if rest or not incomplete:
+                out.append(partial)
+                partial = []
+            for j, lst in enumerate(rest):
+                if j == len(rest) - 1 and incomplete:
+                    partial = list(lst)   # truncated tail: resume
+                else:
+                    out.append(lst)
+            if not incomplete and len(out) < len(hashes):
+                break     # fewer complete blocks than asked: unknown tail
+        out.extend([[] for _ in range(len(hashes) - len(out))])
+        return out
+
+    def _get_receipts_legacy(self, hashes):
         rid = self._next_request_id()
         payload = eth_wire.encode_get_receipts(rid, hashes)
         return self.request(eth_wire.GET_RECEIPTS, payload, rid)
+
+    def get_block_access_lists(self, hashes):
+        """eth/71 (EIP-8159): fetch per-block BALs; None for blocks the
+        peer does not know or cannot derive."""
+        if self.eth_version < 71:
+            raise PeerError("peer negotiated below eth/71")
+        rid = self._next_request_id()
+        payload = eth_wire.encode_get_block_access_lists(rid, hashes)
+        return self.request(eth_wire.GET_BLOCK_ACCESS_LISTS, payload, rid)
+
+    def _derive_bal(self, block_hash: bytes):
+        """Serving seat for BlockAccessLists: derive the canonical
+        block's BAL on demand (BALs become header-bound under EIP-7928
+        activation; until then they are re-derivable state)."""
+        store = self.node.store
+        header = store.get_header(block_hash)
+        body = store.get_body(block_hash) if header is not None else None
+        if header is None or body is None or header.number == 0:
+            return None
+        parent = store.get_header(header.parent_hash)
+        if parent is None:
+            return None
+        from ..primitives.block import Block
+
+        try:
+            return self.node.chain.generate_bal(Block(header, body),
+                                                parent)
+        except Exception:  # noqa: BLE001 — unknown/unexecutable: empty
+            return None
 
     # -- snap/1 client -----------------------------------------------------
     def _require_snap(self):
@@ -339,20 +406,68 @@ class RlpxPeer:
             self.send_msg(eth_wire.BLOCK_BODIES,
                           eth_wire.encode_block_bodies(rid, bodies))
         elif msg_id == eth_wire.GET_RECEIPTS:
-            rid, hashes = eth_wire.decode_get_receipts(payload)
-            receipts = [store.get_receipts(h) or [] for h in hashes[:1024]]
-            if self.eth_version >= 69:
+            if self.eth_version >= 70:
+                # EIP-7975: resume offset into the first block, serve up
+                # to the soft size cap, flag a truncated tail block
+                rid, first_index, hashes = \
+                    eth_wire.decode_get_receipts70(payload)
+                served = []
+                size = 0
+                incomplete = False
+                for i, h in enumerate(hashes[:1024]):
+                    block_receipts = store.get_receipts(h) or []
+                    if i == 0 and first_index:
+                        block_receipts = block_receipts[first_index:]
+                    kept = []
+                    for r in block_receipts:
+                        r_size = len(r.encode()) + 64
+                        if size + r_size > eth_wire.SOFT_RECEIPTS_LIMIT \
+                                and served:
+                            incomplete = True
+                            break
+                        kept.append(r)
+                        size += r_size
+                    served.append(kept)
+                    if incomplete:
+                        break
+                body = eth_wire.encode_receipts70(rid, incomplete, served)
+            elif self.eth_version >= 69:
                 # eth/69: served receipts omit the bloom (recomputable)
+                rid, hashes = eth_wire.decode_get_receipts(payload)
+                receipts = [store.get_receipts(h) or []
+                            for h in hashes[:1024]]
                 body = eth_wire.encode_receipts69(rid, receipts)
             else:
+                rid, hashes = eth_wire.decode_get_receipts(payload)
+                receipts = [store.get_receipts(h) or []
+                            for h in hashes[:1024]]
                 body = eth_wire.encode_receipts(rid, receipts)
             self.send_msg(eth_wire.RECEIPTS, body)
         elif msg_id == eth_wire.RECEIPTS:
-            if self.eth_version >= 69:
+            if self.eth_version >= 70:
+                rid, incomplete, receipts = \
+                    eth_wire.decode_receipts70(payload)
+                self._resolve(rid, (incomplete, receipts))
+            elif self.eth_version >= 69:
                 rid, receipts = eth_wire.decode_receipts69(payload)
+                self._resolve(rid, receipts)
             else:
                 rid, receipts = eth_wire.decode_receipts(payload)
-            self._resolve(rid, receipts)
+                self._resolve(rid, receipts)
+        elif msg_id == eth_wire.GET_BLOCK_ACCESS_LISTS \
+                and self.eth_version >= 71:
+            # EIP-8159: serve BALs for canonical blocks we can derive;
+            # the RLP empty string marks unknown blocks
+            rid, hashes = eth_wire.decode_get_block_access_lists(payload)
+            bals = []
+            for h in hashes[:128]:
+                bals.append(self._derive_bal(h))
+            self.send_msg(eth_wire.BLOCK_ACCESS_LISTS,
+                          eth_wire.encode_block_access_lists(rid, bals))
+        elif msg_id == eth_wire.BLOCK_ACCESS_LISTS \
+                and self.eth_version >= 71:
+            rid, bals = eth_wire.decode_block_access_lists(payload)
+            self._resolve(rid, bals)
         elif msg_id == eth_wire.BLOCK_RANGE_UPDATE \
                 and self.eth_version >= 69:
             # NOT gated => 0x21 would shadow snap GetAccountRange on

@@ -14,7 +14,7 @@ from ..primitives.block import Block, BlockBody, BlockHeader
 from ..primitives.transaction import Transaction
 
 ETH_VERSION = 68
-ETH_VERSIONS = (69, 68)   # advertised; highest mutual wins
+ETH_VERSIONS = (71, 70, 69, 68)   # advertised; highest mutual wins
 
 # devp2p base protocol (msg ids 0x00-0x0f)
 HELLO = 0x00
@@ -38,6 +38,12 @@ POOLED_TRANSACTIONS = ETH_OFFSET + 0x0A
 GET_RECEIPTS = ETH_OFFSET + 0x0F
 RECEIPTS = ETH_OFFSET + 0x10
 BLOCK_RANGE_UPDATE = ETH_OFFSET + 0x11   # eth/69+
+GET_BLOCK_ACCESS_LISTS = ETH_OFFSET + 0x12   # eth/71 (EIP-8159)
+BLOCK_ACCESS_LISTS = ETH_OFFSET + 0x13
+
+# EIP-7975 (eth/70): complete receipt lists can exceed the devp2p 10 MiB
+# cap at high gas limits, so responses are size-capped and resumable
+SOFT_RECEIPTS_LIMIT = 10 * 1024 * 1024
 
 
 @dataclasses.dataclass
@@ -259,6 +265,84 @@ def decode_receipts(payload: bytes):
     return (rlp.decode_int(f[0]),
             [[parse(r) for r in block_receipts]
              for block_receipts in f[1]])
+
+
+def encode_get_receipts70(request_id: int, first_index: int,
+                          hashes) -> bytes:
+    """eth/70 GetReceipts (EIP-7975): [id, firstBlockReceiptIndex,
+    [hashes]] — the index resumes a previously truncated first block
+    (eth70/receipts.rs GetReceipts70)."""
+    return rlp.encode([request_id, first_index,
+                       [bytes(h) for h in hashes]])
+
+
+def decode_get_receipts70(payload: bytes):
+    f = rlp.decode(payload)
+    return (rlp.decode_int(f[0]), rlp.decode_int(f[1]),
+            [bytes(h) for h in f[2]])
+
+
+def encode_receipts70(request_id: int, last_block_incomplete: bool,
+                      receipts_per_block) -> bytes:
+    """eth/70 Receipts: [id, lastBlockIncomplete, [[receipts]...]] with
+    the eth/69 bloom-less receipt embedding."""
+    def embed(r):
+        return [r.tx_type, b"\x01" if r.succeeded else b"",
+                r.cumulative_gas_used, [log.to_fields() for log in r.logs]]
+
+    return rlp.encode([
+        request_id, 1 if last_block_incomplete else 0,
+        [[embed(r) for r in receipts] for receipts in receipts_per_block],
+    ])
+
+
+def decode_receipts70(payload: bytes):
+    from ..primitives.receipt import Log, Receipt
+
+    def parse(item):
+        tx_type, status, cum_gas, logs = item
+        return Receipt(
+            tx_type=rlp.decode_int(tx_type),
+            succeeded=rlp.decode_int(status) == 1,
+            cumulative_gas_used=rlp.decode_int(cum_gas),
+            logs=[Log.from_fields(lf) for lf in logs],
+        )
+
+    f = rlp.decode(payload)
+    return (rlp.decode_int(f[0]), rlp.decode_int(f[1]) == 1,
+            [[parse(r) for r in block_receipts]
+             for block_receipts in f[2]])
+
+
+def encode_get_block_access_lists(request_id: int, hashes) -> bytes:
+    """eth/71 GetBlockAccessLists (EIP-8159, 0x12)."""
+    return rlp.encode([request_id, [bytes(h) for h in hashes]])
+
+
+def decode_get_block_access_lists(payload: bytes):
+    f = rlp.decode(payload)
+    return rlp.decode_int(f[0]), [bytes(h) for h in f[1]]
+
+
+def encode_block_access_lists(request_id: int, bals) -> bytes:
+    """eth/71 BlockAccessLists (0x13): per requested hash, the encoded
+    BAL or the RLP empty string for unknown blocks (EIP-8159)."""
+    items = [bal.to_rlp_obj() if bal is not None else b"" for bal in bals]
+    return rlp.encode([request_id, items])
+
+
+def decode_block_access_lists(payload: bytes):
+    """-> (request_id, [BlockAccessList | None, ...])."""
+    from ..primitives.bal import BlockAccessList
+
+    f = rlp.decode(payload)
+    out = []
+    for item in f[1]:
+        if isinstance(item, (bytes, bytearray)) and not item:
+            out.append(None)
+        else:
+            out.append(BlockAccessList.decode(rlp.encode(item)))
+    return rlp.decode_int(f[0]), out
 
 
 def encode_new_pooled_tx_hashes(txs) -> bytes:

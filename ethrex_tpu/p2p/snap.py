@@ -20,6 +20,8 @@ from ..trie.verify_range import RangeProofError, verify_range
 
 SNAP_OFFSET_ETH68 = 0x21
 SNAP_OFFSET_ETH69 = 0x22
+SNAP_OFFSET_ETH70 = 0x22   # eth/70 adds no message codes (EIP-7975)
+SNAP_OFFSET_ETH71 = 0x24   # eth/71 adds 0x13/0x14 (EIP-8159)
 # RELATIVE ids; a connection adds its negotiated snap_offset
 GET_ACCOUNT_RANGE = 0x00
 ACCOUNT_RANGE = 0x01

@@ -277,10 +277,13 @@ def test_peer_scoring(two_nodes):
     assert a_view not in srv_a.peers
 
 
-def test_eth69_negotiation_and_messages(two_nodes):
+def test_eth69_negotiation_and_messages(two_nodes, monkeypatch):
     """Round 4: eth/69 — highest mutual version wins, Status69 carries the
     block range instead of the TD, the snap id space shifts by one, and
-    receipts are served bloom-less (eth69/receipts.rs)."""
+    receipts are served bloom-less (eth69/receipts.rs).  Round 5 raised
+    the ceiling to 71, so this pins both ends at 69 to keep exercising
+    the negotiation."""
+    monkeypatch.setattr(eth_wire, "ETH_VERSIONS", (69, 68))
     node_a, node_b, srv_a, srv_b = two_nodes
     node_a.submit_transaction(_tx(0))
     node_a.produce_block()
@@ -316,3 +319,44 @@ def test_eth69_wire_shapes():
     with _pytest.raises(ValueError):
         ew.decode_block_range_update(ew.encode_block_range_update(
             9, 1, b"\x03" * 32))
+
+
+def test_eth71_negotiation_receipts_and_bals(two_nodes):
+    """eth/71 is the highest mutual version: EIP-7975 resumable receipts
+    (driven with a tiny soft cap to force the continuation loop) and
+    EIP-8159 BlockAccessLists served + fetched over live RLPx."""
+    node_a, node_b, srv_a, srv_b = two_nodes
+    for i in range(3):
+        node_a.submit_transaction(_tx(i))
+        node_a.produce_block()
+    peer = srv_b.dial(srv_a.host, srv_a.port, srv_a.pub)
+    assert peer.eth_version == 71
+    from ethrex_tpu.p2p import snap as snap_mod
+
+    assert peer.snap_offset == snap_mod.SNAP_OFFSET_ETH71
+    hashes = [node_a.store.get_canonical_block(n).hash
+              for n in range(1, 4)]
+    receipts = peer.get_receipts(hashes)
+    assert len(receipts) == 3
+    assert all(len(r) == 1 and r[0].succeeded for r in receipts)
+    # force the EIP-7975 truncation/resume path with a tiny soft cap
+    import ethrex_tpu.p2p.eth_wire as ew
+
+    old_limit = ew.SOFT_RECEIPTS_LIMIT
+    ew.SOFT_RECEIPTS_LIMIT = 1   # every receipt after the first truncates
+    try:
+        receipts2 = peer.get_receipts(hashes)
+    finally:
+        ew.SOFT_RECEIPTS_LIMIT = old_limit
+    assert [len(r) for r in receipts2] == [1, 1, 1]
+    assert all(r2[0].cumulative_gas_used == r1[0].cumulative_gas_used
+               for r1, r2 in zip(receipts, receipts2))
+    # EIP-8159 BALs: served for known blocks, None for unknown
+    bals = peer.get_block_access_lists(hashes + [b"\xee" * 32])
+    assert bals[3] is None
+    for n, bal in zip(range(1, 4), bals[:3]):
+        assert bal is not None
+        bal.validate_ordering()
+        block = node_a.store.get_canonical_block(n)
+        parent = node_a.store.get_header(block.header.parent_hash)
+        assert bal.hash() == node_a.chain.generate_bal(block, parent).hash()
