@@ -360,3 +360,64 @@ def test_eth71_negotiation_receipts_and_bals(two_nodes):
         block = node_a.store.get_canonical_block(n)
         parent = node_a.store.get_header(block.header.parent_hash)
         assert bal.hash() == node_a.chain.generate_bal(block, parent).hash()
+
+
+def test_adversarial_payloads_do_not_kill_the_server(two_nodes):
+    """A misbehaving peer sending garbage payloads for every eth/snap
+    message id must not crash the serving node or poison other
+    sessions (the reference's malformed-message handling seat).  The
+    server may drop a session on garbage — the attacker re-dials so
+    every message id actually reaches a live handler."""
+    node_a, node_b, srv_a, srv_b = two_nodes
+    node_a.submit_transaction(_tx(0))
+    node_a.produce_block()
+    garbage = [b"", b"\x00", b"\xff" * 8, b"\xc1\x80",
+               bytes(range(64)), b"\xf8\x42" + b"\x99" * 0x42]
+    evil = srv_b.dial(srv_a.host, srv_a.port, srv_a.pub)
+    for msg_id in range(0x10, 0x29):
+        for g in garbage:
+            try:
+                evil.send_msg(msg_id, g)
+            except Exception:  # noqa: BLE001 — session dropped: re-dial
+                try:
+                    evil = srv_b.dial(srv_a.host, srv_a.port, srv_a.pub)
+                    evil.send_msg(msg_id, g)
+                except Exception:  # noqa: BLE001
+                    pass
+    time.sleep(0.5)
+    # the server still serves a FRESH well-behaved session
+    good = srv_b.dial(srv_a.host, srv_a.port, srv_a.pub)
+    head = node_a.store.head_header()
+    headers = good.get_block_headers(1, 1)
+    assert headers and headers[0].hash == head.hash
+    receipts = good.get_receipts([head.hash])
+    assert receipts and len(receipts[0]) == 1
+
+
+def test_oversized_and_lying_length_claims(two_nodes):
+    """Serving stays bounded under absurd request sizes, verified on the
+    RAW responses (not the client helper's padding): header serving is
+    capped, and a mixed known/unknown receipts request returns aligned
+    per-hash lists with the known block's receipts in position."""
+    node_a, node_b, srv_a, srv_b = two_nodes
+    node_a.submit_transaction(_tx(0))
+    node_a.produce_block()
+    peer = srv_b.dial(srv_a.host, srv_a.port, srv_a.pub)
+    # ask for 100k headers: serving is capped, request completes
+    rid = peer._next_request_id()
+    payload = eth_wire.encode_get_block_headers(rid, 0, 100_000)
+    headers = peer.request(eth_wire.GET_BLOCK_HEADERS, payload, rid)
+    assert len(headers) <= 1024
+    # RAW eth/70 receipts request: unknown hashes sandwiching the head
+    head = node_a.store.head_header()
+    hashes = [b"\x01" * 32, head.hash, b"\x02" * 32]
+    rid = peer._next_request_id()
+    payload = eth_wire.encode_get_receipts70(rid, 0, hashes)
+    incomplete, lists = peer.request(eth_wire.GET_RECEIPTS, payload, rid)
+    assert not incomplete
+    assert [len(x) for x in lists] == [0, 1, 0]
+    assert lists[1][0].succeeded
+    # and a huge unknown-only request through the helper stays aligned
+    many = [bytes([i % 256]) * 32 for i in range(2000)]
+    receipts = peer.get_receipts(many)
+    assert len(receipts) == 2000 and all(r == [] for r in receipts)

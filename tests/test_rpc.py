@@ -324,3 +324,71 @@ def test_post_merge_constants(rpc):
                 "0x" + "77" * 32)["result"] is None
     assert call("eth_getUncleCountByBlockNumber",
                 "0x999999")["result"] is None
+
+
+def test_error_surfaces_jsonrpc_spec(rpc):
+    """JSON-RPC error-code conformance beyond the happy path: parse
+    errors (-32700), invalid params, and survival after garbage."""
+    call, node = rpc
+    # invalid params: wrong arity/type must not 500 the server
+    r = call("eth_getBalance")
+    assert "error" in r and r["error"]["code"] in (-32602, -32000)
+    assert "error" in call("eth_getBlockByNumber", {"bogus": True}, False)
+    assert "error" in call("eth_getTransactionByHash", "0xnothex")
+    # malformed JSON -> parse error on a dedicated server instance
+    server = RpcServer(node, port=0).start()
+    try:
+        url = f"http://127.0.0.1:{server.port}"
+        req = urllib.request.Request(
+            url, data=b"{this is not json",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            out = json.loads(resp.read())
+        assert out["error"]["code"] == -32700
+        # and the server still serves valid traffic afterwards
+        good = json.dumps({"jsonrpc": "2.0", "id": 1,
+                           "method": "web3_clientVersion",
+                           "params": []}).encode()
+        req = urllib.request.Request(
+            url, data=good,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert "result" in json.loads(resp.read())
+    finally:
+        server.stop()
+
+
+def test_concurrent_rpc_requests(rpc):
+    """The HTTP server must survive concurrent mixed valid/invalid
+    traffic without cross-talk between responses."""
+    import threading
+
+    call, node = rpc
+    errors = []
+    results = [None] * 24
+
+    def worker(i):
+        try:
+            if i % 3 == 0:
+                r = call("eth_blockNumber")
+            elif i % 3 == 1:
+                r = call("eth_fooBar")
+            else:
+                r = call("eth_getBalance", "0x" + "11" * 20, "latest")
+            results[i] = r
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(24)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=15)
+    assert not errors
+    for i, r in enumerate(results):
+        assert r is not None
+        if i % 3 == 1:
+            assert r["error"]["code"] == -32601
+        else:
+            assert "result" in r
