@@ -114,6 +114,10 @@ class RpcServer:
             "ethrex_latestBatch": lambda: _latest_batch(node),
             "ethrex_getBatchByNumber": lambda n: _get_batch(node, n),
             "ethrex_health": lambda: _health(node),
+            "ethrex_getL1MessageProof":
+                lambda h: _l1_message_proof(node, h),
+            "ethrex_batchNumberByBlock":
+                lambda n: _batch_by_block(node, n),
         }
 
     def handle(self, request: dict):
@@ -326,6 +330,68 @@ def _get_batch(node, n):
     seq = _rollup(node)
     batch = seq.rollup.get_batch(parse_quantity(n))
     return _batch_json(batch, seq.rollup) if batch else None
+
+
+def _find_batch_for_block(seq, block_number):
+    with seq.rollup.lock:
+        for n in sorted(seq.rollup.batches):
+            b = seq.rollup.batches[n]
+            if b.first_block <= block_number <= b.last_block:
+                return b
+    return None
+
+
+def _batch_by_block(node, n):
+    """ethrex_batchNumberByBlock: which batch carries an L2 block."""
+    from .serializers import hx, parse_quantity
+
+    seq = _rollup(node)
+    batch = _find_batch_for_block(seq, parse_quantity(n))
+    return hx(batch.number) if batch else None
+
+
+def _l1_message_proof(node, tx_hash_hex):
+    """ethrex_getL1MessageProof: the withdrawal claim data for a tx —
+    its batch, message index, leaf hash and Merkle path against the
+    batch's message root (reference:
+    crates/l2/networking/rpc/l2/messages.rs GetL1MessageProof)."""
+    from ..l2.messages import collect_messages, message_proof, message_root
+    from .serializers import hb, hx, parse_bytes
+
+    seq = _rollup(node)
+    tx_hash = parse_bytes(tx_hash_hex)
+    loc = node.store.tx_index.get(tx_hash)
+    if loc is None:
+        return None
+    header = node.store.get_header(loc[0])
+    if header is None:
+        return None
+    block_number = header.number
+    batch = _find_batch_for_block(seq, block_number)
+    if batch is None:
+        return None
+    blocks = [node.store.get_canonical_block(n)
+              for n in range(batch.first_block, batch.last_block + 1)]
+    if any(b is None for b in blocks):
+        return None
+    receipts = [node.store.get_receipts(b.hash) for b in blocks]
+    if any(r is None for r in receipts):
+        # a message set built without the success filter would diverge
+        # from the committed root and serve an unclaimable proof
+        raise RpcError(-32000, "missing receipts for a batched block")
+    messages = collect_messages(blocks, receipts)
+    for idx, msg in enumerate(messages):
+        if msg.tx_hash == tx_hash:
+            return {
+                "batchNumber": hx(batch.number),
+                "messageId": hx(idx),
+                "messageHash": hb(msg.leaf()),
+                "merkleProof": [hb(p)
+                                for p in message_proof(messages, idx)],
+                "messageRoot": hb(message_root(messages)),
+                "verified": batch.verified,
+            }
+    return None
 
 
 def _health(node):

@@ -248,3 +248,62 @@ def test_actor_backoff_and_fatal_cancellation():
     finally:
         seq.stop()
         node.stop()
+
+
+def test_l1_message_proof_rpc():
+    """ethrex_getL1MessageProof serves the withdrawal claim data (batch,
+    index, leaf, Merkle path) and the L1 accepts the claim built from it
+    (reference: l2/networking/rpc/l2/messages.rs)."""
+    import json as _json
+    import urllib.request as _rq
+
+    from ethrex_tpu.l2.messages import BRIDGE_ADDRESS
+    from ethrex_tpu.rpc.server import RpcServer
+
+    node, l1, seq = _setup([protocol.PROVER_EXEC])
+    node.sequencer = seq
+    server = RpcServer(node, port=0).start()
+    try:
+        # a withdrawal: value burned to the bridge address
+        wd = Transaction(
+            tx_type=TYPE_DYNAMIC_FEE, chain_id=65536999, nonce=0,
+            max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+            gas_limit=21000, to=BRIDGE_ADDRESS, value=777).sign(SECRET)
+        node.submit_transaction(wd)
+        seq.produce_block()
+        batch = seq.commit_next_batch()
+        assert batch is not None
+
+        def call(method, *params):
+            payload = _json.dumps({"jsonrpc": "2.0", "id": 1,
+                                   "method": method,
+                                   "params": list(params)}).encode()
+            req = _rq.Request(f"http://127.0.0.1:{server.port}",
+                              data=payload,
+                              headers={"Content-Type": "application/json"})
+            with _rq.urlopen(req, timeout=10) as resp:
+                return _json.loads(resp.read())
+
+        proof = call("ethrex_getL1MessageProof",
+                     "0x" + wd.hash.hex())["result"]
+        assert proof is not None
+        assert int(proof["batchNumber"], 16) == batch.number
+        assert proof["verified"] is False
+        assert call("ethrex_batchNumberByBlock",
+                    hex(batch.first_block))["result"] == \
+            proof["batchNumber"]
+        # prove + verify the batch, then the claim goes through on L1
+        client = ProverClient(protocol.PROVER_EXEC,
+                              [("127.0.0.1", seq.coordinator.port)])
+        assert client.poll_once() == 1
+        assert seq.send_proofs() == (1, 1)
+        leaf = bytes.fromhex(proof["messageHash"][2:])
+        path = [bytes.fromhex(p[2:]) for p in proof["merkleProof"]]
+        l1.claim_withdrawal(batch.number, leaf,
+                            int(proof["messageId"], 16), path)
+        # unknown tx -> null
+        assert call("ethrex_getL1MessageProof",
+                    "0x" + "ab" * 32)["result"] is None
+    finally:
+        server.stop()
+        seq.stop()
