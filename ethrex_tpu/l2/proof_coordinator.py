@@ -117,6 +117,8 @@ class ProofCoordinator:
             allow_reuse_address = True
             daemon_threads = True
 
+        if self._server is not None:
+            return self    # idempotent: Sequencer.start() re-enters here
         self._server = Server((self.host, self.port), Handler)
         self.port = self._server.server_address[1]
         threading.Thread(target=self._server.serve_forever,

@@ -66,6 +66,12 @@ class ActorHealth:
 class Sequencer:
     """Wires all L2 actors (reference: start_l2)."""
 
+    # the timer-driven actor set; start() loops over these names and the
+    # admin pause/resume surface validates against them (keeping the RPC
+    # and the loop keyed to one registry instead of magic strings)
+    ACTOR_NAMES = ("produce_block", "commit_next_batch", "send_proofs",
+                   "watch_l1", "update_state")
+
     def __init__(self, node: Node, l1: L1Client,
                  config: SequencerConfig | None = None,
                  rollup: RollupStore | None = None):
@@ -103,6 +109,11 @@ class Sequencer:
         self.health: dict[str, ActorHealth] = {}
         self.fatal: tuple[str, str] | None = None
         self.on_fatal = None  # callback(actor, error) for orchestrators
+        # admin controls (reference: admin_server.rs — committer
+        # start/stop with optional delay, sequencer stop-at-batch)
+        self.paused: set[str] = set()
+        self._resume_at: dict[str, float] = {}
+        self.stop_at_batch: int | None = None
 
     def _regenerate_chain(self):
         """Re-import committed-batch blocks the chain store lost (crash
@@ -175,6 +186,9 @@ class Sequencer:
     # L1Committer (reference: l1_committer.rs commit_next_batch_to_l1)
     # ------------------------------------------------------------------
     def commit_next_batch(self) -> Batch | None:
+        if self.stop_at_batch is not None and \
+                self.rollup.latest_batch_number() + 1 > self.stop_at_batch:
+            return None    # admin stop-at: the committer idles here
         head = self.node.store.latest_number()
         first = self.last_batched_block + 1
         if head < first:
@@ -338,6 +352,9 @@ class Sequencer:
                                  self.cfg.max_backoff_factor)
                     if self._stop.wait(interval * factor):
                         return
+                    if st.name in self.paused or \
+                            self._resume_at.get(st.name, 0) > time.time():
+                        continue
                     try:
                         fn()
                         st.runs += 1
@@ -372,12 +389,34 @@ class Sequencer:
             t.start()
             self._threads.append(t)
 
-        loop(self.cfg.block_time, self.produce_block)
-        loop(self.cfg.commit_interval, self.commit_next_batch)
-        loop(self.cfg.proof_send_interval, self.send_proofs)
-        loop(self.cfg.watcher_interval, self.watch_l1)
-        loop(self.cfg.watcher_interval, self.update_state)
+        intervals = {
+            "produce_block": self.cfg.block_time,
+            "commit_next_batch": self.cfg.commit_interval,
+            "send_proofs": self.cfg.proof_send_interval,
+            "watch_l1": self.cfg.watcher_interval,
+            "update_state": self.cfg.watcher_interval,
+        }
+        for name in self.ACTOR_NAMES:
+            loop(intervals[name], getattr(self, name))
         return self
+
+    # ------------------------------------------------------------------
+    # admin controls (reference: l2/sequencer/admin_server.rs)
+    # ------------------------------------------------------------------
+    def pause_actor(self, name: str) -> None:
+        if name not in self.ACTOR_NAMES:
+            raise ValueError(f"unknown actor {name!r}")
+        self.paused.add(name)
+        self._resume_at.pop(name, None)
+
+    def resume_actor(self, name: str, delay: float = 0.0) -> None:
+        if name not in self.ACTOR_NAMES:
+            raise ValueError(f"unknown actor {name!r}")
+        if delay > 0:
+            self._resume_at[name] = time.time() + delay
+        else:
+            self._resume_at.pop(name, None)
+        self.paused.discard(name)
 
     def stop(self):
         self._stop.set()

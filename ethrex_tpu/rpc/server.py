@@ -14,12 +14,14 @@ from .eth import (CLIENT_NAME, CLIENT_VERSION, EthApi,
 
 class RpcServer:
     def __init__(self, node, host: str = "127.0.0.1", port: int = 8545,
-                 jwt_secret: bytes | None = None, engine: bool = False):
+                 jwt_secret: bytes | None = None, engine: bool = False,
+                 admin: bool = False):
         self.node = node
         self.eth = EthApi(node)
         self.host = host
         self.port = port
         self.jwt_secret = jwt_secret
+        self.admin_enabled = admin
         self._httpd: ThreadingHTTPServer | None = None
         self.methods = self._build_methods()
         if engine:
@@ -118,6 +120,13 @@ class RpcServer:
                 lambda h: _l1_message_proof(node, h),
             "ethrex_batchNumberByBlock":
                 lambda n: _batch_by_block(node, n),
+            "ethrex_adminStopCommitter":
+                lambda: _admin_committer(self, node, False),
+            "ethrex_adminStartCommitter":
+                lambda *a: _admin_committer(self, node, True,
+                                            *(a[:1] or (0,))),
+            "ethrex_adminSetStopAtBatch":
+                lambda n=None: _admin_stop_at(self, node, n),
         }
 
     def handle(self, request: dict):
@@ -394,6 +403,46 @@ def _l1_message_proof(node, tx_hash_hex):
     return None
 
 
+def _require_admin(server):
+    """Admin control methods live behind an explicit opt-in: the public
+    unauthenticated RPC must not let any client halt batch commitment
+    (the reference keeps these on a dedicated admin listener,
+    admin_server.rs; here `RpcServer(admin=True)` / --l2.admin)."""
+    if not getattr(server, "admin_enabled", False):
+        raise RpcError(-32601, "admin methods are disabled "
+                               "(start with admin enabled)")
+
+
+def _admin_committer(server, node, start: bool, delay=0):
+    """ethrex_adminStart/StopCommitter: pause/resume the L1 committer
+    actor, optionally delayed (reference: admin_server.rs
+    /committer/start/{delay} and /committer/stop)."""
+    from .serializers import parse_quantity
+
+    _require_admin(server)
+    seq = _rollup(node)
+    name = "commit_next_batch"
+    if start:
+        seq.resume_actor(name, float(parse_quantity(delay)
+                                     if isinstance(delay, str) else delay))
+    else:
+        seq.pause_actor(name)
+    return {"committer": "running" if start else "paused"}
+
+
+def _admin_stop_at(server, node, n):
+    """ethrex_adminSetStopAtBatch: the committer stops producing batch
+    checkpoints past this number; null clears the cap
+    (admin_server.rs set_sequencer_stop_at)."""
+    from .serializers import hx, parse_quantity
+
+    _require_admin(server)
+    seq = _rollup(node)
+    seq.stop_at_batch = None if n is None else parse_quantity(n)
+    return {"stopAtBatch": None if seq.stop_at_batch is None
+            else hx(seq.stop_at_batch)}
+
+
 def _health(node):
     out = {
         "head": node.store.latest_number(),
@@ -408,6 +457,11 @@ def _health(node):
             "pendingPrivileged": len(seq.pending_privileged),
             "actors": {name: st.to_json()
                        for name, st in seq.health.items()},
+            # admin state: a deliberately paused actor must be
+            # distinguishable from a stuck one (review finding)
+            "paused": sorted(seq.paused),
+            "resumeAt": dict(seq._resume_at),
+            "stopAtBatch": seq.stop_at_batch,
             "fatal": list(seq.fatal) if seq.fatal else None,
         }
     return out

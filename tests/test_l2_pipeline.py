@@ -307,3 +307,91 @@ def test_l1_message_proof_rpc():
     finally:
         server.stop()
         seq.stop()
+
+
+def test_admin_committer_controls():
+    """Admin surface (reference admin_server.rs): stop/start the
+    committer actor over RPC — against the LIVE actor loop — plus the
+    stop-at-batch cap, admin gating on the public server, and health
+    visibility of the paused state."""
+    import json as _json
+    import time as _time
+    import urllib.request as _rq
+
+    from ethrex_tpu.rpc.server import RpcServer
+
+    node, l1, seq = _setup([protocol.PROVER_EXEC])
+    node.sequencer = seq
+    seq.cfg.block_time = 0.05
+    seq.cfg.commit_interval = 0.05
+    seq.cfg.proof_send_interval = 0.05
+    seq.cfg.watcher_interval = 0.05
+    server = RpcServer(node, port=0, admin=True)
+    public = RpcServer(node, port=0)            # admin NOT enabled
+
+    def call(srv, method, *params):
+        payload = _json.dumps({"jsonrpc": "2.0", "id": 1,
+                               "method": method,
+                               "params": list(params)}).encode()
+        req = _rq.Request(f"http://127.0.0.1:{srv.port}",
+                          data=payload,
+                          headers={"Content-Type": "application/json"})
+        with _rq.urlopen(req, timeout=10) as resp:
+            return _json.loads(resp.read())
+
+    server.start()
+    public.start()
+    try:
+        # the public unauthenticated server refuses admin controls
+        r = call(public, "ethrex_adminStopCommitter")
+        assert r["error"]["code"] == -32601
+
+        # pause the committer BEFORE starting the loops
+        r = call(server, "ethrex_adminStopCommitter")["result"]
+        assert r == {"committer": "paused"}
+        health = call(server, "ethrex_health")["result"]
+        assert health["l2"]["paused"] == ["commit_next_batch"]
+
+        seq.start()
+        node.submit_transaction(_transfer(0))
+        deadline = _time.time() + 5
+        while _time.time() < deadline and \
+                node.store.latest_number() == 0:
+            _time.sleep(0.05)
+        assert node.store.latest_number() >= 1
+        _time.sleep(0.5)   # several commit ticks elapse while paused
+        assert seq.rollup.latest_batch_number() == 0
+
+        # resume: the LIVE loop commits the batch
+        assert call(server, "ethrex_adminStartCommitter")["result"] == \
+            {"committer": "running"}
+        deadline = _time.time() + 10
+        while _time.time() < deadline and \
+                seq.rollup.latest_batch_number() == 0:
+            _time.sleep(0.05)
+        assert seq.rollup.latest_batch_number() >= 1
+
+        # stop-at-batch caps the live committer; null clears it
+        cap = seq.rollup.latest_batch_number()
+        assert call(server, "ethrex_adminSetStopAtBatch",
+                    hex(cap))["result"] == {"stopAtBatch": hex(cap)}
+        node.submit_transaction(_transfer(1))
+        _time.sleep(0.6)
+        assert seq.rollup.latest_batch_number() == cap
+        assert call(server, "ethrex_adminSetStopAtBatch",
+                    None)["result"] == {"stopAtBatch": None}
+        deadline = _time.time() + 10
+        while _time.time() < deadline and \
+                seq.rollup.latest_batch_number() == cap:
+            _time.sleep(0.05)
+        assert seq.rollup.latest_batch_number() > cap
+
+        # unknown actor names are rejected, not silently accepted
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            seq.pause_actor("no_such_actor")
+    finally:
+        server.stop()
+        public.stop()
+        seq.stop()
