@@ -15,9 +15,9 @@ absorbed into the proof's public digest and re-derived natively by
 `check_steps` (no EVM execution: array lookups and dict replay only).
 
 Supported executed-opcode subset (v1):
-    STOP ADD SUB LT GT EQ ISZERO CALLER CALLVALUE CALLDATALOAD
-    CALLDATASIZE POP MLOAD MSTORE SLOAD SSTORE JUMP JUMPI JUMPDEST
-    PUSH0..PUSH32 DUP1..DUP14 SWAP1..SWAP13 RETURN
+    STOP ADD SUB LT GT EQ ISZERO NOT ADDRESS CALLER CALLVALUE
+    CALLDATALOAD CALLDATASIZE POP MLOAD MSTORE SLOAD SSTORE JUMP JUMPI
+    JUMPDEST PC PUSH0..PUSH32 DUP1..DUP14 SWAP1..SWAP13 RETURN
 Machine envelope: stack depth <= 14, memory = four 32-byte words at
 offsets 0/32/64/96 (word-aligned access), <= MAX_STEPS steps, top-level
 call only, value == 0, successful execution (a trace reaching REVERT or
@@ -42,6 +42,8 @@ OP_LT = 0x10
 OP_GT = 0x11
 OP_EQ = 0x14
 OP_ISZERO = 0x15
+OP_NOT = 0x19
+OP_ADDRESS = 0x30
 OP_CALLER = 0x33
 OP_CALLVALUE = 0x34
 OP_CDLOAD = 0x35
@@ -54,6 +56,7 @@ OP_SSTORE = 0x55
 OP_JUMP = 0x56
 OP_JUMPI = 0x57
 OP_JUMPDEST = 0x5B
+OP_PC = 0x58
 OP_PUSH0 = 0x5F
 OP_RETURN = 0xF3
 OP_REVERT = 0xFD
@@ -67,9 +70,10 @@ MAX_SWAP = 13        # SWAP1..SWAP13 (window exchange 0 <-> n)
 U256 = (1 << 256) - 1
 
 _SIMPLE_OPS = {OP_STOP, OP_ADD, OP_SUB, OP_LT, OP_GT, OP_EQ, OP_ISZERO,
-               OP_CALLER, OP_CALLVALUE, OP_CDLOAD, OP_CDSIZE, OP_POP,
-               OP_MLOAD, OP_MSTORE, OP_SLOAD, OP_SSTORE, OP_JUMP,
-               OP_JUMPI, OP_JUMPDEST, OP_RETURN}
+               OP_NOT, OP_ADDRESS, OP_CALLER, OP_CALLVALUE, OP_CDLOAD,
+               OP_CDSIZE, OP_POP, OP_MLOAD, OP_MSTORE, OP_SLOAD,
+               OP_SSTORE, OP_JUMP, OP_JUMPI, OP_JUMPDEST, OP_PC,
+               OP_RETURN}
 
 
 class UnsupportedTrace(Exception):
@@ -142,7 +146,8 @@ def _push_imm(code: bytes, pc: int, k: int) -> int:
 
 
 def run_trace(code: bytes, calldata: bytes, caller: bytes, callvalue: int,
-              sload, max_steps: int = MAX_STEPS):
+              sload, max_steps: int = MAX_STEPS,
+              address: bytes = b"\x00" * 20):
     """Execute, producing (steps, snapshots, writes).
 
     `sload(slot) -> int` reads CURRENT storage (the caller layers batch
@@ -223,6 +228,24 @@ def run_trace(code: bytes, calldata: bytes, caller: bytes, callvalue: int,
                 need(1)
                 steps.append(StepRec(pc, op))
                 stack[0] = 1 if stack[0] == 0 else 0
+                pc += 1
+            elif op == OP_NOT:
+                need(1)
+                steps.append(StepRec(pc, op))
+                stack[0] = U256 ^ stack[0]
+                pc += 1
+            elif op == OP_PC:
+                if len(stack) >= MAX_DEPTH:
+                    raise UnsupportedTrace("stack deeper than the window")
+                steps.append(StepRec(pc, op))
+                stack.insert(0, pc)
+                pc += 1
+            elif op == OP_ADDRESS:
+                if len(stack) >= MAX_DEPTH:
+                    raise UnsupportedTrace("stack deeper than the window")
+                v = int.from_bytes(address, "big")
+                steps.append(StepRec(pc, op, b=v))
+                stack.insert(0, v)
                 pc += 1
             elif op == OP_CALLER:
                 if len(stack) >= MAX_DEPTH:
@@ -317,7 +340,8 @@ def run_trace(code: bytes, calldata: bytes, caller: bytes, callvalue: int,
 
 def check_steps(code: bytes, calldata: bytes, caller: bytes,
                 callvalue: int, steps: list[StepRec],
-                slot_rows: list[tuple[int, int, int]]) -> None:
+                slot_rows: list[tuple[int, int, int]],
+                address: bytes = b"\x00" * 20) -> None:
     """Validate a claimed step list by pure data indexing — no EVM
     execution.  The circuit proves the machine SEMANTICS over these
     steps; this function pins everything the circuit takes as absorbed
@@ -325,8 +349,8 @@ def check_steps(code: bytes, calldata: bytes, caller: bytes,
 
       * op == code[pc] at a legal instruction start; PUSH immediates ==
         the code's bytes; jump landings are JUMPDESTs;
-      * CALLER/CALLVALUE/CALLDATASIZE/CALLDATALOAD values == the claimed
-        tx envelope / calldata bytes;
+      * ADDRESS/CALLER/CALLVALUE/CALLDATASIZE/CALLDATALOAD values ==
+        the claimed tx envelope / calldata bytes;
       * SLOAD/SSTORE records replay consistently against `slot_rows`
         (the tx's (slot, old, new) write-log rows in first-touch order,
         the SAME rows the state circuit applies);
@@ -383,6 +407,8 @@ def check_steps(code: bytes, calldata: bytes, caller: bytes,
         # record fields: pin to their native sources
         if op == OP_CALLER:
             want_b = int.from_bytes(caller, "big")
+        elif op == OP_ADDRESS:
+            want_b = int.from_bytes(address, "big")
         elif op == OP_CALLVALUE:
             want_b = callvalue
         elif op == OP_CDSIZE:

@@ -394,7 +394,8 @@ def build_vm_batch(blocks, coarse_log: list,
                 try:
                     gsteps, gsnaps, gwrites = bv.run_trace(
                         code, tx.data, sender, 0,
-                        lambda slot, _to=tx.to: gen_sget(_to, slot))
+                        lambda slot, _to=tx.to: gen_sget(_to, slot),
+                        address=tx.to)
                 except bv.UnsupportedTrace as e:
                     raise NotTransferBatch(f"generic trace: {e}")
                 # per-tx slot rows in first-touch order; reads emit no-op

@@ -62,10 +62,11 @@ SEG_PERIODS = 8
 SEG_LEN = PERIOD * SEG_PERIODS
 NUM_CHUNKS = 7
 
-NUM_FLAGS = 23
+NUM_FLAGS = 26
 (F_STOP, F_ADD, F_SUB, F_LT, F_GT, F_EQ, F_ISZERO, F_CALLER, F_CALLVALUE,
  F_CDLOAD, F_CDSIZE, F_POP, F_MLOAD, F_MSTORE, F_SLOAD, F_SSTORE, F_JUMP,
- F_JUMPI, F_JDEST, F_PUSH, F_DUP, F_SWAP, F_RETURN) = range(NUM_FLAGS)
+ F_JUMPI, F_JDEST, F_PUSH, F_DUP, F_SWAP, F_RETURN, F_NOT, F_PC,
+ F_ADDRESS) = range(NUM_FLAGS)
 
 _FLAG_OPCODE = {
     F_STOP: bv.OP_STOP, F_ADD: bv.OP_ADD, F_SUB: bv.OP_SUB, F_LT: bv.OP_LT,
@@ -74,7 +75,8 @@ _FLAG_OPCODE = {
     F_CDLOAD: bv.OP_CDLOAD, F_CDSIZE: bv.OP_CDSIZE, F_POP: bv.OP_POP,
     F_MLOAD: bv.OP_MLOAD, F_MSTORE: bv.OP_MSTORE, F_SLOAD: bv.OP_SLOAD,
     F_SSTORE: bv.OP_SSTORE, F_JUMP: bv.OP_JUMP, F_JUMPI: bv.OP_JUMPI,
-    F_JDEST: bv.OP_JUMPDEST, F_RETURN: bv.OP_RETURN,
+    F_JDEST: bv.OP_JUMPDEST, F_RETURN: bv.OP_RETURN, F_NOT: bv.OP_NOT,
+    F_PC: bv.OP_PC, F_ADDRESS: bv.OP_ADDRESS,
 }
 
 SLOTS = bv.MAX_DEPTH          # 14 stack window slots
@@ -260,8 +262,9 @@ class BytecodeAir(Air):
             if i:
                 idxsum = ops.add(idxsum, ops.mul(ops.const(i), dsel[i]))
 
-        pushg = fsum([F_PUSH, F_CALLER, F_CALLVALUE, F_CDSIZE, F_DUP])
-        replg = fsum([F_ISZERO, F_CDLOAD, F_MLOAD, F_SLOAD])
+        pushg = fsum([F_PUSH, F_CALLER, F_CALLVALUE, F_CDSIZE, F_DUP,
+                      F_PC, F_ADDRESS])
+        replg = fsum([F_ISZERO, F_CDLOAD, F_MLOAD, F_SLOAD, F_NOT])
         alug = fsum([F_ADD, F_SUB, F_LT, F_GT, F_EQ])
         pop1g = fsum([F_POP, F_JUMP])
         pop2g = fsum([F_MSTORE, F_SSTORE, F_JUMPI])
@@ -271,7 +274,7 @@ class BytecodeAir(Air):
         memg = fsum([F_MLOAD, F_MSTORE])
         rag = fsum([F_SLOAD, F_SSTORE, F_CDLOAD])
         rbg = fsum([F_SLOAD, F_SSTORE, F_CDLOAD, F_CALLER, F_CALLVALUE,
-                    F_CDSIZE, F_ADD, F_SUB, F_LT, F_GT])
+                    F_CDSIZE, F_ADD, F_SUB, F_LT, F_GT, F_ADDRESS])
 
         out = []
 
@@ -425,12 +428,15 @@ class BytecodeAir(Air):
                 acc = ops.add(acc, ops.mul(msel[i], mem[i][l]))
             return acc
 
-        envg = fsum([F_CALLER, F_CALLVALUE, F_CDSIZE])
+        envg = fsum([F_CALLER, F_CALLVALUE, F_CDSIZE, F_ADDRESS])
 
         def pv(l):
             acc = ops.add(ops.mul(f[F_PUSH], imm[l]),
                           ops.mul(envg, rb[l]))
-            return ops.add(acc, ops.mul(f[F_DUP], dupv(l)))
+            acc = ops.add(acc, ops.mul(f[F_DUP], dupv(l)))
+            if l == 10:
+                acc = ops.add(acc, ops.mul(f[F_PC], local[PC]))
+            return acc
 
         ldg = ops.add(f[F_CDLOAD], f[F_SLOAD])
 
@@ -438,6 +444,9 @@ class BytecodeAir(Air):
             acc = ops.add(ops.mul(ldg, rb[l]), ops.mul(f[F_MLOAD], mlv(l)))
             if l == 10:
                 acc = ops.add(acc, ops.mul(f[F_ISZERO], z))
+            maxlimb = ops.const(((1 << 16) if l == 0 else (1 << 24)) - 1)
+            acc = ops.add(acc, ops.mul(f[F_NOT],
+                                       ops.sub(maxlimb, stk[0][l])))
             return acc
 
         def av(l):

@@ -280,7 +280,7 @@ def _vm_stream_from_claims(vm_meta: dict, blocks_log: list):
                     slot_rows.append((slot, old_v, new_v))
                 try:
                     bv.check_steps(code, data, sender, 0, steps,
-                                   slot_rows)
+                                   slot_rows, address=to)
                 except bv.StepCheckError as e:
                     raise ValueError(f"vm generic steps: {e}")
                 bc_pubs.append(bca.bc_digest_stream(steps))

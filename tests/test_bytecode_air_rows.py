@@ -59,10 +59,14 @@ def _check_trace(trace, steps, air=None):
     return None
 
 
+ADDR = bytes.fromhex("abcd" * 10)   # nonzero: ADDRESS pushes real limbs
+
+
 def _run(code, cd=b"", pre=None):
     pre = pre or {}
     steps, snaps, writes = bv.run_trace(code, cd, b"\xaa" * 20, 0,
-                                        lambda s: pre.get(s, 0))
+                                        lambda s: pre.get(s, 0),
+                                        address=ADDR)
     trace = bca.generate_bytecode_trace(steps, snaps)
     return steps, snaps, trace
 
@@ -100,6 +104,12 @@ PROGRAMS = {
     "registry-store": (REGISTRY,
                        (5).to_bytes(32, "big") + (42).to_bytes(32, "big"),
                        {5: 10}),
+    # NOT, PC, ADDRESS: sstore(0, NOT(cdload(0))); sstore(1, pc);
+    # sstore(2, ADDRESS) — a NONZERO address so the env path is real
+    "not-pc-addr": (bytes([0x60, 0x00, 0x35, 0x19, 0x5F, 0x55,
+                           0x58, 0x60, 0x01, 0x55,
+                           0x30, 0x60, 0x02, 0x55, 0x00]),
+                    (5).to_bytes(32, "big"), None),
     "registry-alt": (REGISTRY,
                      (5).to_bytes(32, "big") + (3).to_bytes(32, "big"),
                      {5: 10}),
@@ -155,3 +165,57 @@ def test_tampered_traces_violate_constraints():
                unhalt):
         assert _check_trace(_tamper(trace, steps, fn), steps) \
             is not None, fn.__name__
+
+
+def test_tampered_new_opcodes_caught():
+    """Soundness pins for the round-5 opcode additions: lying about a
+    NOT result, the PC push, or the ADDRESS value must violate the
+    constraints (or the native address pin)."""
+    code, cd, pre = PROGRAMS["not-pc-addr"]
+    steps, snaps, trace = _run(code, cd, pre)
+
+    def flip_not_result(t, st):
+        # the stack top AFTER the NOT step
+        k = next(i for i, s in enumerate(st) if s.op == bv.OP_NOT)
+        rows = slice((k + 1) * bca.SEG_LEN, (k + 2) * bca.SEG_LEN)
+        t[rows, bca.STK + 10] = (t[rows, bca.STK + 10].astype(np.int64)
+                                 + 1) % P
+
+    def flip_pc_push(t, st):
+        k = next(i for i, s in enumerate(st) if s.op == bv.OP_PC)
+        rows = slice((k + 1) * bca.SEG_LEN, (k + 2) * bca.SEG_LEN)
+        t[rows, bca.STK + 10] = (t[rows, bca.STK + 10].astype(np.int64)
+                                 + 1) % P
+
+    def flip_address_rb(t, st):
+        k = next(i for i, s in enumerate(st) if s.op == bv.OP_ADDRESS)
+        rows = slice(k * bca.SEG_LEN, (k + 1) * bca.SEG_LEN)
+        t[rows, bca.RB + 10] = (t[rows, bca.RB + 10].astype(np.int64)
+                                + 1) % P
+
+    for fn in (flip_not_result, flip_pc_push, flip_address_rb):
+        assert _check_trace(_tamper(trace, steps, fn), steps) \
+            is not None, fn.__name__
+    # and a claimed ADDRESS value that differs from the tx target is
+    # rejected natively (the env pin)
+    claimed = [bv.StepRec.from_json(s.to_json()) for s in steps]
+    for s in claimed:
+        if s.op == bv.OP_ADDRESS:
+            s.b ^= 1
+    rows = []
+    seen = {}
+    for s in steps:
+        if s.op in (bv.OP_SLOAD, bv.OP_SSTORE) and s.a not in seen:
+            seen[s.a] = True
+            rows.append((s.a, 0, s.b))
+    # rebuild correct rows from the final writes
+    final = {}
+    for s in steps:
+        if s.op == bv.OP_SSTORE:
+            final[s.a] = s.b
+    rows = [(slot, 0, final.get(slot, 0)) for slot, _, _ in rows]
+    import pytest as _pytest
+
+    with _pytest.raises(bv.StepCheckError):
+        bv.check_steps(code, cd, b"\xaa" * 20, 0, claimed, rows,
+                       address=ADDR)
