@@ -2,19 +2,37 @@
 (parity with the reference's ProofCoordinator actor,
 crates/l2/sequencer/proof_coordinator.rs — per-(batch, prover_type)
 assignment map with timeout reassignment, version gating, duplicate-proof
-no-op storage).
+no-op storage), extended with the resilience layer:
+
+  * leases instead of a fixed timeout — Heartbeat messages from a prover
+    mid-proof extend its assignment deadline, so a slow TPU proof is not
+    reassigned out from under a live prover;
+  * per-batch failure tracking — every lease expiry and every rejected
+    submit counts against the (batch, prover_type) pair;
+  * poison-batch quarantine — a batch that keeps failing on its primary
+    prover type is handed to the fallback backend (the reference's
+    multi-prover model as graceful degradation) and surfaced via metrics
+    and the health endpoint;
+  * submit-time proof validation — a corrupt proof frees the assignment
+    slot immediately instead of poisoning the stored-proof map until the
+    proof sender's full audit.
 """
 
 from __future__ import annotations
 
+import logging
 import socketserver
 import threading
 import time
 
 from ..prover import protocol
+from ..utils import faults
 from .rollup_store import RollupStore
 
-ASSIGNMENT_TIMEOUT = 600.0  # seconds, like the reference's 10 minutes
+log = logging.getLogger("ethrex_tpu.l2.proof_coordinator")
+
+ASSIGNMENT_TIMEOUT = 600.0  # default lease, like the reference's 10 minutes
+QUARANTINE_THRESHOLD = 3    # failed assignments before exec fallback
 
 
 class ProofCoordinator:
@@ -22,43 +40,194 @@ class ProofCoordinator:
                  needed_types: list[str] | None = None,
                  commit_hash: str = protocol.PROTOCOL_VERSION,
                  host: str = "127.0.0.1", port: int = 0,
-                 proof_format: str = protocol.FORMAT_STARK):
+                 proof_format: str = protocol.FORMAT_STARK,
+                 lease_timeout: float = ASSIGNMENT_TIMEOUT,
+                 quarantine_threshold: int = QUARANTINE_THRESHOLD,
+                 fallback_type: str = protocol.PROVER_EXEC,
+                 verify_submissions: bool = True):
         self.rollup = rollup_store
         self.needed_types = needed_types or [protocol.PROVER_TPU]
         self.commit_hash = commit_hash
         self.proof_format = proof_format
-        # (batch_number, prover_type) -> assignment deadline
+        self.lease_timeout = lease_timeout
+        self.quarantine_threshold = quarantine_threshold
+        self.fallback_type = fallback_type
+        self.verify_submissions = verify_submissions
+        # (batch_number, prover_type) -> lease deadline; an expired entry
+        # stays until reassignment so a late-but-finished proof still lands
         self.assignments: dict[tuple[int, str], float] = {}
         # (batch_number, prover_type) -> first-assignment time (metrics)
         self.assigned_at: dict[tuple[int, str], float] = {}
+        # (batch_number, prover_type) -> failed assignments (expiry/reject)
+        self.failures: dict[tuple[int, str], int] = {}
+        self.quarantined: set[int] = set()
+        self.reassignments_total = 0
+        self.heartbeats_total = 0
+        self.rejected_submits_total = 0
+        self.unsolicited_submits_total = 0
         self.lock = threading.RLock()
         self.host = host
         self.port = port
         self._server: socketserver.ThreadingTCPServer | None = None
 
+    @staticmethod
+    def _now() -> float:
+        """Lease clock; an instance attribute in tests to fake expiry."""
+        return time.monotonic()
+
+    # ------------------------------------------------------------------
+    # failure accounting + quarantine
+    # ------------------------------------------------------------------
+    def _record_failure(self, batch: int, prover_type: str, reason: str):
+        """Caller holds self.lock."""
+        from ..utils.metrics import record_quarantine, record_reassignment
+
+        key = (batch, prover_type)
+        self.failures[key] = self.failures.get(key, 0) + 1
+        self.reassignments_total += 1
+        record_reassignment(batch, prover_type)
+        log.warning("batch %d assignment to %s failed (%s), %d/%d before "
+                    "quarantine", batch, prover_type, reason,
+                    self.failures[key], self.quarantine_threshold)
+        if (prover_type != self.fallback_type
+                and self.failures[key] >= self.quarantine_threshold
+                and batch not in self.quarantined):
+            self.quarantined.add(batch)
+            record_quarantine(len(self.quarantined))
+            log.error("batch %d quarantined off %r after %d failed "
+                      "assignments; falling back to %r", batch,
+                      prover_type, self.failures[key], self.fallback_type)
+
+    def _allowed_types(self) -> set[str]:
+        """Prover types this coordinator currently serves: the configured
+        set, plus the fallback backend while any batch is quarantined."""
+        allowed = set(self.needed_types)
+        if self.quarantined:
+            allowed.add(self.fallback_type)
+        return allowed
+
+    def effective_needed_types(self, batch_number: int,
+                               base: list[str] | None = None) -> list[str]:
+        """The prover types that actually settle this batch: quarantined
+        batches substitute the fallback type for every primary type
+        (graceful degradation — the proof sender and L1 path consume
+        this, so settlement keeps moving on the fallback proof)."""
+        types = list(base if base is not None else self.needed_types)
+        if batch_number in self.quarantined:
+            types = [self.fallback_type for _ in types]
+        return list(dict.fromkeys(types))
+
     # ------------------------------------------------------------------
     def next_batch_to_assign(self, prover_type: str) -> int | None:
         """Lowest batch with a stored prover input, no proof of this type,
-        and no live assignment (reference: next_batch_to_assign:149-215)."""
-        if prover_type not in self.needed_types:
+        and no live lease (reference: next_batch_to_assign:149-215).
+        Expired leases are counted as failed assignments — enough of them
+        quarantines the batch onto the fallback backend."""
+        if prover_type not in self._allowed_types():
             return None
-        now = time.monotonic()
+        now = self._now()
         with self.lock:
             candidates = sorted({
                 num for (num, ver) in self.rollup.prover_inputs
                 if ver == self.commit_hash
             })
             for num in candidates:
+                if num in self.quarantined:
+                    # quarantined batches go only to the fallback backend
+                    if prover_type != self.fallback_type:
+                        continue
+                elif prover_type not in self.needed_types:
+                    continue  # fallback prover: nothing else for it here
                 if self.rollup.get_proof(num, prover_type) is not None:
                     continue
-                deadline = self.assignments.get((num, prover_type))
-                if deadline is not None and deadline > now:
-                    continue
+                key = (num, prover_type)
+                deadline = self.assignments.get(key)
+                if deadline is not None:
+                    if deadline > now:
+                        continue  # live lease elsewhere
+                    # lease expired: the holder crashed or stalled
+                    self.assignments.pop(key, None)
+                    self.assigned_at.pop(key, None)
+                    self._record_failure(num, prover_type, "lease expired")
+                    if num in self.quarantined and \
+                            prover_type != self.fallback_type:
+                        continue  # this expiry tipped it into quarantine
                 self.assignments[(num, prover_type)] = \
-                    now + ASSIGNMENT_TIMEOUT
+                    now + self.lease_timeout
                 self.assigned_at[(num, prover_type)] = now
                 return num
         return None
+
+    # ------------------------------------------------------------------
+    def _handle_heartbeat(self, msg: dict) -> dict:
+        from ..utils.metrics import record_heartbeat
+
+        batch = msg.get("batch_id")
+        prover_type = msg.get("prover_type")
+        ok = False
+        with self.lock:
+            key = (batch, prover_type)
+            deadline = self.assignments.get(key)
+            if deadline is not None and deadline > self._now():
+                # live lease: extend it a full period from now
+                self.assignments[key] = self._now() + self.lease_timeout
+                self.heartbeats_total += 1
+                ok = True
+        if ok:
+            record_heartbeat()
+        return {"type": protocol.HEARTBEAT_ACK, "batch_id": batch, "ok": ok}
+
+    def _handle_submit(self, msg: dict) -> dict:
+        batch = msg.get("batch_id")
+        prover_type = msg.get("prover_type")
+        proof = msg.get("proof")
+        with self.lock:
+            allowed = self._allowed_types()
+            if batch in self.quarantined:
+                allowed.add(self.fallback_type)
+        if not isinstance(batch, int) or prover_type not in allowed \
+                or not isinstance(proof, dict):
+            return {"type": protocol.ERROR, "message": "bad submit"}
+        with self.lock:
+            if self.rollup.get_proof(batch, prover_type) is not None:
+                # duplicate submit -> no-op ACK (reference parity: the
+                # store keeps the first proof; the prover moves on)
+                return {"type": protocol.SUBMIT_ACK, "batch_id": batch}
+            if (batch, prover_type) not in self.assignments:
+                # unsolicited: never assigned (or already settled and
+                # cleaned up) — do not let an arbitrary connection write
+                # into the proof store
+                self.unsolicited_submits_total += 1
+                return {"type": protocol.ERROR,
+                        "message": f"no assignment for batch {batch}"}
+        if self.verify_submissions:
+            from ..prover.backend import get_backend
+
+            try:
+                ok = get_backend(prover_type).verify_submission(proof)
+            except Exception:  # noqa: BLE001 — untrusted wire input
+                ok = False
+            if not ok:
+                with self.lock:
+                    self.assignments.pop((batch, prover_type), None)
+                    self.assigned_at.pop((batch, prover_type), None)
+                    self.rejected_submits_total += 1
+                    self._record_failure(batch, prover_type,
+                                         "invalid proof")
+                return {"type": protocol.ERROR,
+                        "message": f"invalid proof for batch {batch}"}
+        proof = faults.inject("coordinator.store_proof", proof)
+        self.rollup.store_proof(batch, prover_type, proof)
+        with self.lock:
+            self.assignments.pop((batch, prover_type), None)
+            started = self.assigned_at.pop((batch, prover_type), None)
+        if started is not None:
+            # proving-time metric (reference: set_batch_proving_time,
+            # proof_coordinator.rs:286-296)
+            from ..utils.metrics import record_batch
+
+            record_batch(batch, self._now() - started)
+        return {"type": protocol.SUBMIT_ACK, "batch_id": batch}
 
     def handle_request(self, msg: dict) -> dict:
         mtype = msg.get("type")
@@ -67,7 +236,7 @@ class ProofCoordinator:
                 return {"type": protocol.VERSION_MISMATCH,
                         "expected": self.commit_hash}
             prover_type = msg.get("prover_type")
-            if prover_type not in self.needed_types:
+            if prover_type not in self._allowed_types():
                 return {"type": protocol.TYPE_NOT_NEEDED}
             batch = self.next_batch_to_assign(prover_type)
             if batch is None:
@@ -76,26 +245,29 @@ class ProofCoordinator:
                 batch, self.commit_hash)
             return {"type": protocol.INPUT_RESPONSE, "batch_id": batch,
                     "input": program_input, "format": self.proof_format}
+        if mtype == protocol.HEARTBEAT:
+            return self._handle_heartbeat(msg)
         if mtype == protocol.PROOF_SUBMIT:
-            batch = msg.get("batch_id")
-            prover_type = msg.get("prover_type")
-            proof = msg.get("proof")
-            if not isinstance(batch, int) or \
-                    prover_type not in self.needed_types \
-                    or not isinstance(proof, dict):
-                return {"type": protocol.ERROR, "message": "bad submit"}
-            self.rollup.store_proof(batch, prover_type, proof)
-            with self.lock:
-                self.assignments.pop((batch, prover_type), None)
-                started = self.assigned_at.pop((batch, prover_type), None)
-            if started is not None:
-                # proving-time metric (reference: set_batch_proving_time,
-                # proof_coordinator.rs:286-296)
-                from ..utils.metrics import record_batch
-
-                record_batch(batch, time.monotonic() - started)
-            return {"type": protocol.SUBMIT_ACK, "batch_id": batch}
+            return self._handle_submit(msg)
         return {"type": protocol.ERROR, "message": f"unknown type {mtype}"}
+
+    # ------------------------------------------------------------------
+    def stats_json(self) -> dict:
+        """Health-endpoint view of the resilience state."""
+        with self.lock:
+            return {
+                "liveAssignments": sum(
+                    1 for d in self.assignments.values()
+                    if d > self._now()),
+                "reassignments": self.reassignments_total,
+                "heartbeats": self.heartbeats_total,
+                "rejectedSubmits": self.rejected_submits_total,
+                "unsolicitedSubmits": self.unsolicited_submits_total,
+                "quarantined": sorted(self.quarantined),
+                "failures": {f"{num}/{ptype}": count
+                             for (num, ptype), count
+                             in sorted(self.failures.items())},
+            }
 
     # ------------------------------------------------------------------
     def start(self):
@@ -110,8 +282,17 @@ class ProofCoordinator:
                         break
                     if msg is None:
                         break
-                    resp = coordinator.handle_request(msg)
-                    protocol.send_msg(self.connection, resp)
+                    try:
+                        resp = coordinator.handle_request(msg)
+                    except Exception as e:  # noqa: BLE001 — internal
+                        # failure (or an injected one): drop the
+                        # connection, keep the lease; expiry re-assigns
+                        log.warning("coordinator request failed: %s", e)
+                        break
+                    try:
+                        protocol.send_msg(self.connection, resp)
+                    except (ConnectionError, OSError):
+                        break
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True

@@ -37,6 +37,11 @@ class SequencerConfig:
     # pattern, cmd/ethrex/ethrex.rs, + per-actor health endpoints)
     max_actor_failures: int = 10
     max_backoff_factor: int = 32
+    # prover resilience (docs/PROVER_RESILIENCE.md): assignment lease
+    # length (heartbeats extend it) and how many failed assignments of a
+    # batch to its primary prover type trigger the exec fallback
+    prover_lease_timeout: float = 600.0
+    prover_quarantine_threshold: int = 3
 
 
 @dataclasses.dataclass
@@ -81,7 +86,9 @@ class Sequencer:
         self.rollup = rollup if rollup is not None else RollupStore()
         self.coordinator = ProofCoordinator(
             self.rollup, needed_types=list(self.cfg.needed_prover_types),
-            commit_hash=self.cfg.commit_hash)
+            commit_hash=self.cfg.commit_hash,
+            lease_timeout=self.cfg.prover_lease_timeout,
+            quarantine_threshold=self.cfg.prover_quarantine_threshold)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         # checkpoint resume (reference: l1_committer.rs:389 per-batch
@@ -275,19 +282,29 @@ class Sequencer:
         first = self.l1.last_verified_batch() + 1
         last = first - 1
         needed = list(self.cfg.needed_prover_types)
+
+        def slot_type(n: int, t: str) -> str:
+            """The prover type that actually fills type t's proof slot for
+            batch n: quarantined batches settle on the coordinator's
+            fallback backend (graceful degradation — see
+            docs/PROVER_RESILIENCE.md)."""
+            eff = self.coordinator.effective_needed_types(n, [t])
+            return eff[0] if eff else t
+
         while self.rollup.get_batch(last + 1) is not None \
                 and self.rollup.get_batch(last + 1).committed \
-                and self.rollup.batch_fully_proven(last + 1, needed):
+                and self.rollup.batch_fully_proven(
+                    last + 1, [slot_type(last + 1, t) for t in needed]):
             last += 1
         if last < first:
             return None
         proofs = {}
         for t in needed:
             from ..prover.backend import get_backend
-            backend = get_backend(t)
 
             def check(n: int) -> bool:
-                proof = self.rollup.get_proof(n, t)
+                backend = get_backend(slot_type(n, t))
+                proof = self.rollup.get_proof(n, slot_type(n, t))
                 # anti-downgrade: the committer recorded the VM-circuit
                 # coverage this batch admits; a claimed-log proof for a
                 # circuit-covered batch is rejected without the witness
@@ -314,12 +331,14 @@ class Sequencer:
                 # distributed_proving.md:70-72)
                 for n, ok in results.items():
                     if not ok:
-                        self.rollup.delete_proof(n, t)
+                        self.rollup.delete_proof(n, slot_type(n, t))
                 return None
             # per-batch proof bytes: the L1 checks each batch's committed
             # output (state root + messages root) against its records
-            proofs[t] = [backend.to_proof_bytes(self.rollup.get_proof(n, t))
-                         for n in range(first, last + 1)]
+            proofs[t] = [
+                get_backend(slot_type(n, t)).to_proof_bytes(
+                    self.rollup.get_proof(n, slot_type(n, t)))
+                for n in range(first, last + 1)]
         self.l1.verify_batches(first, last, proofs)
         for n in range(first, last + 1):
             self.rollup.set_verified(n)

@@ -27,6 +27,16 @@ class ProverBackend:
         modes accept everything."""
         return True
 
+    def verify_submission(self, proof: dict) -> bool:
+        """Coordinator-side gate at ProofSubmit time: reject a corrupt
+        proof immediately so the batch is re-assignable instead of
+        stalling until send_proofs' full audit.  Must be cheap — backends
+        whose verify() is expensive override with a structural check."""
+        try:
+            return self.verify(proof)
+        except Exception:  # noqa: BLE001 — any crash on a submit is a no
+            return False   # (the proof came off the wire untrusted)
+
     def to_proof_bytes(self, proof: dict) -> bytes:
         import json
 

@@ -1,44 +1,181 @@
 """Prover pull-client: poll coordinator endpoints, prove, submit (parity
 with the reference's Prover actor, crates/prover/src/prover.rs:66-242 —
-request -> prove -> submit, version-gated, self-rescheduling).
+request -> prove -> submit, version-gated, self-rescheduling), hardened
+for real fleets:
+
+  * per-endpoint exponential backoff with jitter — a flapping coordinator
+    is retried gently instead of hammered every poll;
+  * a circuit breaker per endpoint — after `breaker_threshold`
+    consecutive failures the endpoint is skipped entirely until a
+    half-open probe after `breaker_cooldown` seconds succeeds;
+  * a background heartbeat thread while `backend.prove` runs — a long
+    TPU proof extends its coordinator lease instead of being reassigned;
+  * submit over a fresh connection — the socket that carried the input
+    request can die during a multi-minute proof without losing the
+    finished proof.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import logging
+import random
 import socket
 import threading
 import time
 
 from ..guest.execution import ProgramInput
+from ..utils import faults
 from . import protocol
 from .backend import ProverBackend, get_backend
+
+log = logging.getLogger("ethrex_tpu.prover.client")
+
+
+@dataclasses.dataclass
+class EndpointState:
+    """Per-endpoint breaker/backoff state (exposed for health checks)."""
+
+    failures: int = 0           # consecutive
+    next_attempt: float = 0.0   # monotonic backoff gate
+    breaker: str = "closed"     # closed | open | half-open
+    open_until: float = 0.0
+    transitions: int = 0
+
+
+class _HeartbeatThread(threading.Thread):
+    """Best-effort lease keep-alive over short-lived connections while the
+    backend proves; failures are ignored — lease expiry is the backstop."""
+
+    def __init__(self, host: str, port: int, batch_id: int,
+                 prover_type: str, interval: float):
+        super().__init__(daemon=True)
+        self.host, self.port = host, port
+        self.batch_id = batch_id
+        self.prover_type = prover_type
+        self.interval = interval
+        self.acked = 0
+        self._stop = threading.Event()
+
+    def run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                with socket.create_connection(
+                        (self.host, self.port), timeout=5) as sock:
+                    protocol.send_msg(sock, {
+                        "type": protocol.HEARTBEAT,
+                        "batch_id": self.batch_id,
+                        "prover_type": self.prover_type,
+                    })
+                    ack = protocol.recv_msg(sock)
+                if ack.get("type") == protocol.HEARTBEAT_ACK \
+                        and ack.get("ok"):
+                    self.acked += 1
+            except (ConnectionError, OSError, ValueError):
+                pass
+
+    def stop(self):
+        self._stop.set()
 
 
 class ProverClient:
     def __init__(self, backend: ProverBackend | str,
                  endpoints: list[tuple[str, int]],
                  commit_hash: str = protocol.PROTOCOL_VERSION,
-                 poll_interval: float = 1.0):
+                 poll_interval: float = 1.0,
+                 heartbeat_interval: float = 30.0,
+                 backoff_base: float = 0.5,
+                 backoff_max: float = 30.0,
+                 breaker_threshold: int = 5,
+                 breaker_cooldown: float = 10.0,
+                 rng_seed: int | None = None):
         self.backend = (get_backend(backend) if isinstance(backend, str)
                         else backend)
         self.endpoints = endpoints
         self.commit_hash = commit_hash
         self.poll_interval = poll_interval
+        self.heartbeat_interval = heartbeat_interval
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown = breaker_cooldown
+        self._rng = random.Random(rng_seed)
         self._stop = threading.Event()
         self.proved: list[int] = []   # batch ids proven (observability)
+        self.endpoint_states: dict[tuple[str, int], EndpointState] = {
+            ep: EndpointState() for ep in endpoints}
+
+    # ------------------------------------------------------------------
+    # breaker / backoff
+    # ------------------------------------------------------------------
+    def _should_attempt(self, st: EndpointState, now: float) -> bool:
+        if st.breaker == "open":
+            if now < st.open_until:
+                return False
+            st.breaker = "half-open"   # one probe allowed
+            st.transitions += 1
+            return True
+        return now >= st.next_attempt
+
+    def _record_success(self, ep, st: EndpointState):
+        if st.breaker != "closed":
+            st.breaker = "closed"
+            st.transitions += 1
+            log.info("endpoint %s:%d recovered, breaker closed", *ep)
+            self._publish_breaker(transition=True)
+        st.failures = 0
+        st.next_attempt = 0.0
+
+    def _record_failure(self, ep, st: EndpointState, now: float,
+                        err: Exception):
+        from ..utils.metrics import record_poll_error
+
+        record_poll_error()
+        st.failures += 1
+        log.warning("endpoint %s:%d poll failed (%d consecutive): %s",
+                    ep[0], ep[1], st.failures,
+                    f"{type(err).__name__}: {err}")
+        if st.breaker == "half-open" or \
+                st.failures >= self.breaker_threshold:
+            st.breaker = "open"
+            st.open_until = now + self.breaker_cooldown
+            st.transitions += 1
+            log.warning("endpoint %s:%d breaker open for %.1fs",
+                        ep[0], ep[1], self.breaker_cooldown)
+            self._publish_breaker(transition=True)
+        else:
+            # exponential backoff with jitter in [0.5x, 1x)
+            delay = min(self.backoff_base * (2 ** (st.failures - 1)),
+                        self.backoff_max)
+            st.next_attempt = now + delay * (0.5 + self._rng.random() / 2)
+
+    def _publish_breaker(self, transition: bool = False):
+        from ..utils.metrics import record_breaker
+
+        record_breaker(sum(1 for s in self.endpoint_states.values()
+                           if s.breaker == "open"), transition=transition)
 
     # ------------------------------------------------------------------
     def poll_once(self) -> int:
-        """One pass over all endpoints; returns number of batches proven."""
+        """One pass over all endpoints; returns number of batches proven.
+        Endpoint failures are absorbed into breaker/backoff state — the
+        prover never dies because a coordinator does."""
         proven = 0
-        for host, port in self.endpoints:
-            try:
-                proven += self._poll_endpoint(host, port)
-            except (ConnectionError, OSError, ValueError):
+        for ep in self.endpoints:
+            st = self.endpoint_states.setdefault(ep, EndpointState())
+            now = time.monotonic()
+            if not self._should_attempt(st, now):
                 continue
+            try:
+                proven += self._poll_endpoint(*ep)
+            except Exception as e:  # noqa: BLE001 — keep polling others
+                self._record_failure(ep, st, time.monotonic(), e)
+            else:
+                self._record_success(ep, st)
         return proven
 
     def _poll_endpoint(self, host: str, port: int) -> int:
+        # connection 1: request work (closed before the proof starts)
         with socket.create_connection((host, port), timeout=30) as sock:
             protocol.send_msg(sock, {
                 "type": protocol.INPUT_REQUEST,
@@ -46,15 +183,32 @@ class ProverClient:
                 "prover_type": self.backend.prover_type,
             })
             resp = protocol.recv_msg(sock)
-            rtype = resp.get("type")
-            if rtype == protocol.VERSION_MISMATCH:
-                raise ValueError(
-                    f"prover version mismatch: need {resp.get('expected')}")
-            if rtype != protocol.INPUT_RESPONSE:
-                return 0
-            batch_id = resp["batch_id"]
-            program_input = ProgramInput.from_json(resp["input"])
+        rtype = resp.get("type")
+        if rtype == protocol.VERSION_MISMATCH:
+            raise ValueError(
+                f"prover version mismatch: need {resp.get('expected')}")
+        if rtype != protocol.INPUT_RESPONSE:
+            return 0
+        batch_id = resp["batch_id"]
+        program_input = ProgramInput.from_json(resp["input"])
+        # heartbeats keep the coordinator lease alive through a long proof
+        hb = None
+        if self.heartbeat_interval and self.heartbeat_interval > 0:
+            hb = _HeartbeatThread(host, port, batch_id,
+                                  self.backend.prover_type,
+                                  self.heartbeat_interval)
+            hb.start()
+        try:
+            faults.inject("backend.prove")
             proof = self.backend.prove(program_input, resp["format"])
+            proof = faults.inject("backend.prove", proof,
+                                  kinds=("corrupt",))
+        finally:
+            if hb is not None:
+                hb.stop()
+        # connection 2: submit over a fresh socket — the input-request
+        # connection may long since have died under the proof
+        with socket.create_connection((host, port), timeout=30) as sock:
             protocol.send_msg(sock, {
                 "type": protocol.PROOF_SUBMIT,
                 "batch_id": batch_id,
@@ -62,18 +216,23 @@ class ProverClient:
                 "proof": proof,
             })
             ack = protocol.recv_msg(sock)
-            if ack.get("type") == protocol.SUBMIT_ACK:
-                self.proved.append(batch_id)
-                return 1
-            return 0
+        if ack.get("type") == protocol.SUBMIT_ACK:
+            self.proved.append(batch_id)
+            return 1
+        raise ValueError(
+            f"submit rejected for batch {batch_id}: "
+            f"{ack.get('message', ack.get('type'))}")
 
     # ------------------------------------------------------------------
     def run_forever(self):
+        from ..utils.metrics import record_poll_error
+
         while not self._stop.wait(self.poll_interval):
             try:
                 self.poll_once()
-            except Exception as e:  # noqa: BLE001 — prover must keep polling
-                print(f"prover poll error: {e}")
+            except Exception:  # noqa: BLE001 — prover must keep polling
+                record_poll_error()
+                log.exception("prover poll pass failed")
 
     def start(self) -> "ProverClient":
         threading.Thread(target=self.run_forever, daemon=True).start()

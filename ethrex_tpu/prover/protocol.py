@@ -9,6 +9,8 @@ from __future__ import annotations
 import json
 import socket
 
+from ..utils import faults
+
 # message types (the reference's ProofData variants)
 INPUT_REQUEST = "InputRequest"          # {commit_hash, prover_type}
 INPUT_RESPONSE = "InputResponse"        # {batch_id, input, format}
@@ -17,6 +19,10 @@ TYPE_NOT_NEEDED = "ProverTypeNotNeeded"
 PROOF_SUBMIT = "ProofSubmit"            # {batch_id, prover_type, proof}
 SUBMIT_ACK = "ProofSubmitACK"           # {batch_id}
 ERROR = "Error"                         # {message}
+# lease keep-alive: a prover mid-way through a long TPU proof extends its
+# assignment instead of relying on one fixed coordinator-side timeout
+HEARTBEAT = "Heartbeat"                 # {batch_id, prover_type}
+HEARTBEAT_ACK = "HeartbeatAck"          # {batch_id, ok}
 
 # proof formats (reference: ProofFormat — Compressed STARK vs Groth16 wrap)
 FORMAT_STARK = "stark"            # the two batch STARKs as-is
@@ -32,8 +38,25 @@ PROVER_TPU = "tpu"
 PROTOCOL_VERSION = "ethrex-tpu/prover/v1"
 
 
+class ProtocolError(ConnectionError):
+    """A frame that cannot be trusted: oversized, truncated, or not JSON.
+    Subclasses ConnectionError so every existing handler that drops a bad
+    connection drops a bad frame the same way."""
+
+
+def _decode_frame(buf: bytes) -> dict:
+    try:
+        msg = json.loads(buf.decode())
+    except (UnicodeDecodeError, ValueError) as e:
+        raise ProtocolError(f"malformed frame: {e}") from e
+    if not isinstance(msg, dict):
+        raise ProtocolError("malformed frame: not a JSON object")
+    return msg
+
+
 def send_msg(sock: socket.socket, msg: dict):
     data = json.dumps(msg, separators=(",", ":")).encode() + b"\n"
+    data = faults.inject("proto.send", data)
     sock.sendall(data)
 
 
@@ -49,12 +72,23 @@ def recv_msg(sock: socket.socket, max_size: int = 256 * 1024 * 1024) -> dict:
         if buf.endswith(b"\n"):
             break
         if len(buf) > max_size:
-            raise ConnectionError("message too large")
-    return json.loads(buf.decode())
+            raise ProtocolError("message too large")
+    data = faults.inject("proto.recv", bytes(buf))
+    if not data.endswith(b"\n"):
+        raise ProtocolError("truncated frame")
+    return _decode_frame(data)
 
 
 def recv_msg_file(rfile, max_size: int = 256 * 1024 * 1024) -> dict | None:
     line = rfile.readline(max_size)
     if not line:
         return None
-    return json.loads(line.decode())
+    line = faults.inject("proto.recv", line)
+    if not line.endswith(b"\n"):
+        # readline(max_size) silently returns a partial line when the
+        # frame exceeds the cap; a partial line at EOF is a peer that died
+        # mid-frame — neither may reach json.loads as if it were complete
+        if len(line) >= max_size:
+            raise ProtocolError("message too large")
+        raise ProtocolError("truncated frame")
+    return _decode_frame(line)

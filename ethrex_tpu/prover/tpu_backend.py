@@ -661,6 +661,20 @@ class TpuBackend(ProverBackend):
                 stark_verifier.VerificationError):
             return False
 
+    def verify_submission(self, proof: dict) -> bool:
+        """Structural gate only: the full STARK audit is expensive and
+        stays in send_proofs (verify_with_input); at submit time the
+        coordinator just needs enough shape to reject wire corruption and
+        free the assignment slot for honest provers."""
+        try:
+            bytes.fromhex(proof["output"][2:])
+            return (proof.get("backend") == self.prover_type
+                    and isinstance(proof.get("proof"), dict)
+                    and isinstance(proof.get("state_proof"), dict)
+                    and isinstance(proof.get("write_log"), list))
+        except (KeyError, TypeError, ValueError):
+            return False
+
     def check_coverage(self, proof: dict, expected_mode: str) -> bool:
         """Reject mode downgrades WITHOUT the witness: the committer
         derived `expected_mode` by running the same deterministic

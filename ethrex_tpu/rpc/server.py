@@ -463,5 +463,8 @@ def _health(node):
             "resumeAt": dict(seq._resume_at),
             "stopAtBatch": seq.stop_at_batch,
             "fatal": list(seq.fatal) if seq.fatal else None,
+            # prover pipeline resilience: lease/reassignment counters and
+            # the poison-batch quarantine (docs/PROVER_RESILIENCE.md)
+            "prover": seq.coordinator.stats_json(),
         }
     return out

@@ -1,0 +1,188 @@
+"""Deterministic fault-injection harness for the prover pipeline.
+
+A process-wide `FaultPlan` (seeded) carries rules bound to named
+injection points; production code calls `inject(site, payload)` at those
+points, which is a no-op until a plan is installed.  Same seed + same
+call sequence -> same fault schedule, so every failure mode in
+`tests/test_prover_chaos.py` replays deterministically.
+
+Injection points wired into the pipeline (see docs/PROVER_RESILIENCE.md):
+
+    proto.send              protocol.send_msg, after framing
+    proto.recv              protocol.recv_msg / recv_msg_file, after read
+    backend.prove           ProverClient around backend.prove
+    coordinator.store_proof ProofCoordinator before rollup.store_proof
+
+Fault kinds:
+
+    drop     raise InjectedFault (a ConnectionError): dropped connection
+    delay    time.sleep(seconds): a slow peer / slow TPU proof
+    corrupt  mutate the payload in place of the real one
+    error    raise an arbitrary exception: internal crash
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+import time
+
+SITES = frozenset({
+    "proto.send",
+    "proto.recv",
+    "backend.prove",
+    "coordinator.store_proof",
+})
+
+KINDS = frozenset({"drop", "delay", "corrupt", "error"})
+
+
+class InjectedFault(ConnectionError):
+    """Raised by drop rules; a ConnectionError so every handler that
+    survives real network failures survives injected ones the same way."""
+
+
+class FaultRule:
+    __slots__ = ("site", "kind", "p", "times", "seconds", "exc", "mutate",
+                 "fired")
+
+    def __init__(self, site: str, kind: str, p: float = 1.0,
+                 times: int | None = None, seconds: float = 0.0,
+                 exc: BaseException | None = None, mutate=None):
+        if site not in SITES:
+            raise ValueError(f"unknown injection site {site!r}")
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}")
+        self.site = site
+        self.kind = kind
+        self.p = p
+        self.times = times      # fire budget; None = unlimited
+        self.seconds = seconds  # delay kind
+        self.exc = exc          # error kind
+        self.mutate = mutate    # corrupt kind: payload -> payload
+        self.fired = 0
+
+
+def _default_corrupt(payload):
+    """Deterministic default mutation: flip wire bytes / clobber a proof's
+    backend tag — guaranteed to fail frame decoding or submit validation."""
+    if isinstance(payload, (bytes, bytearray)):
+        buf = bytearray(payload)
+        if buf:
+            buf[len(buf) // 2] ^= 0xFF
+        return bytes(buf)
+    if isinstance(payload, dict):
+        out = dict(payload)
+        if "backend" in out:
+            out["backend"] = "__corrupt__"
+        out["__corrupt__"] = True
+        return out
+    return payload
+
+
+class FaultPlan:
+    """A seeded schedule of fault rules.  Chainable builders:
+
+        FaultPlan(seed=7).error("backend.prove", times=1)
+        FaultPlan(3).drop("proto.send", times=3).delay("proto.recv", 0.2)
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.rules: list[FaultRule] = []
+        self.lock = threading.Lock()
+        self.log: list[tuple[str, str]] = []  # (site, kind) fire history
+
+    # -- builders ----------------------------------------------------------
+    def add(self, rule: FaultRule) -> "FaultPlan":
+        self.rules.append(rule)
+        return self
+
+    def drop(self, site: str, p: float = 1.0,
+             times: int | None = None) -> "FaultPlan":
+        return self.add(FaultRule(site, "drop", p=p, times=times))
+
+    def delay(self, site: str, seconds: float, p: float = 1.0,
+              times: int | None = None) -> "FaultPlan":
+        return self.add(FaultRule(site, "delay", p=p, times=times,
+                                  seconds=seconds))
+
+    def corrupt(self, site: str, p: float = 1.0, times: int | None = None,
+                mutate=None) -> "FaultPlan":
+        return self.add(FaultRule(site, "corrupt", p=p, times=times,
+                                  mutate=mutate))
+
+    def error(self, site: str, exc: BaseException | None = None,
+              p: float = 1.0, times: int | None = None) -> "FaultPlan":
+        return self.add(FaultRule(site, "error", p=p, times=times, exc=exc))
+
+    # -- firing ------------------------------------------------------------
+    def fire(self, site: str, payload=None, kinds=None):
+        matched: list[FaultRule] = []
+        with self.lock:
+            for rule in self.rules:
+                if rule.site != site:
+                    continue
+                if kinds is not None and rule.kind not in kinds:
+                    continue
+                if rule.kind == "corrupt" and payload is None:
+                    continue  # nothing to corrupt at this call point
+                if rule.times is not None and rule.fired >= rule.times:
+                    continue  # budget exhausted
+                if rule.p < 1.0 and self.rng.random() >= rule.p:
+                    continue
+                rule.fired += 1
+                self.log.append((site, rule.kind))
+                matched.append(rule)
+        # act outside the lock: a delay rule must not serialize the
+        # coordinator's handler threads behind a sleeping prover
+        for rule in matched:
+            if rule.kind == "delay":
+                time.sleep(rule.seconds)
+            elif rule.kind == "corrupt":
+                payload = (rule.mutate or _default_corrupt)(payload)
+            elif rule.kind == "error":
+                raise rule.exc if rule.exc is not None else InjectedFault(
+                    f"injected error at {site}")
+            else:  # drop
+                raise InjectedFault(f"injected connection drop at {site}")
+        return payload
+
+
+# -- process-wide plumbing (no-op default) ---------------------------------
+_ACTIVE: FaultPlan | None = None
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    global _ACTIVE
+    _ACTIVE = plan
+    return plan
+
+
+def clear() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active() -> FaultPlan | None:
+    return _ACTIVE
+
+
+def inject(site: str, payload=None, kinds=None):
+    """The production hook: returns the (possibly mutated) payload; may
+    sleep or raise per the active plan.  Free when no plan is installed."""
+    plan = _ACTIVE
+    if plan is None:
+        return payload
+    return plan.fire(site, payload, kinds=kinds)
+
+
+@contextlib.contextmanager
+def injected(plan: FaultPlan):
+    install(plan)
+    try:
+        yield plan
+    finally:
+        clear()

@@ -67,6 +67,38 @@ def record_block(block, elapsed: float):
                     "Execution throughput of the last imported block")
 
 
+def record_reassignment(batch_number: int, prover_type: str):
+    METRICS.inc("proof_reassignments_total", 1,
+                "Prover assignments re-issued after lease expiry or a "
+                "rejected proof")
+
+
+def record_quarantine(count: int):
+    METRICS.set("quarantined_batches", count,
+                "Batches quarantined off their primary prover type onto "
+                "the fallback backend")
+
+
+def record_poll_error():
+    METRICS.inc("prover_poll_errors_total", 1,
+                "Prover client poll passes that failed on an endpoint")
+
+
+def record_breaker(open_count: int, transition: bool = False):
+    METRICS.set("prover_breaker_open", open_count,
+                "Coordinator endpoints currently skipped by an open "
+                "circuit breaker")
+    if transition:
+        METRICS.inc("prover_breaker_transitions_total", 1,
+                    "Circuit breaker state transitions "
+                    "(closed/open/half-open)")
+
+
+def record_heartbeat():
+    METRICS.inc("prover_heartbeats_total", 1,
+                "Lease-extending heartbeats accepted by the coordinator")
+
+
 def record_batch(batch_number: int, proving_time: float | None = None):
     METRICS.set("ethrex_l2_latest_batch", batch_number,
                 "Latest committed L2 batch")

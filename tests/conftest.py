@@ -28,3 +28,24 @@ jax.config.update("jax_platforms", "cpu")
 from ethrex_tpu.utils.jax_cache import enable_persistent_cache  # noqa: E402
 
 enable_persistent_cache()
+
+
+# ---------------------------------------------------------------------------
+# fault-injection hygiene: a test that installs a FaultPlan must clear it
+# before returning — a leaked plan would fire nondeterministically inside
+# whatever test runs next (tests/test_prover_chaos.py is the battery).
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fault_plan_guard():
+    yield
+    from ethrex_tpu.utils import faults
+
+    plan = faults.active()
+    faults.clear()
+    if plan is not None and plan.rules:
+        pytest.fail(
+            "test leaked a non-empty active FaultPlan "
+            f"({len(plan.rules)} rule(s)); call faults.clear() "
+            "or use the faults.injected() context manager")

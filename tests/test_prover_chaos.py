@@ -1,0 +1,452 @@
+"""Fault-injection battery for the prover pipeline (docs/
+PROVER_RESILIENCE.md): every failure mode is driven by a seeded,
+deterministic FaultPlan — prover crash mid-prove, slow proofs kept alive
+by heartbeats, corrupt proofs, flapping endpoints, poison batches — plus
+the lease/assignment unit coverage (timeout reassignment, no
+double-assign under races, duplicate/unsolicited submits, oversized and
+malformed frames).
+
+Select alone with `-m chaos`; the whole battery is in the fast tier.
+"""
+
+import io
+import socket
+import threading
+import time
+
+import pytest
+
+from ethrex_tpu.crypto import secp256k1
+from ethrex_tpu.l2.l1_client import InMemoryL1
+from ethrex_tpu.l2.proof_coordinator import ProofCoordinator
+from ethrex_tpu.l2.rollup_store import RollupStore
+from ethrex_tpu.l2.sequencer import Sequencer, SequencerConfig
+from ethrex_tpu.node import Node
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.primitives.transaction import TYPE_DYNAMIC_FEE, Transaction
+from ethrex_tpu.prover import protocol
+from ethrex_tpu.prover.backend import ProverBackend
+from ethrex_tpu.prover.client import ProverClient
+from ethrex_tpu.utils import faults
+from ethrex_tpu.utils.faults import FaultPlan
+from ethrex_tpu.utils.metrics import METRICS
+
+pytestmark = pytest.mark.chaos
+
+SECRET = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
+SENDER = secp256k1.pubkey_to_address(secp256k1.pubkey_from_secret(SECRET))
+OTHER = bytes.fromhex("aa" * 20)
+
+GENESIS = {
+    "config": {"chainId": 65536999, "terminalTotalDifficulty": 0,
+               "shanghaiTime": 0, "cancunTime": 0},
+    "alloc": {"0x" + SENDER.hex(): {"balance": hex(10**21)}},
+    "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7", "timestamp": "0x0",
+}
+
+
+def _transfer(nonce, value=100):
+    return Transaction(
+        tx_type=TYPE_DYNAMIC_FEE, chain_id=65536999, nonce=nonce,
+        max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+        gas_limit=21000, to=OTHER, value=value,
+    ).sign(SECRET)
+
+
+def _mini_l2(prover_types, **cfg_kw):
+    """A real Node + sequencer + live TCP coordinator, one committed
+    batch, ready for provers to pull."""
+    node = Node(Genesis.from_json(GENESIS))
+    l1 = InMemoryL1(list(prover_types))
+    seq = Sequencer(node, l1, SequencerConfig(
+        needed_prover_types=tuple(prover_types), **cfg_kw))
+    seq.coordinator.start()
+    node.submit_transaction(_transfer(0))
+    seq.produce_block()
+    assert seq.commit_next_batch() is not None
+    return node, l1, seq
+
+
+def _endpoints(seq):
+    return [("127.0.0.1", seq.coordinator.port)]
+
+
+def _poll_until_proven(client, seq, prover_type, deadline_s=8.0):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        client.poll_once()
+        if seq.rollup.get_proof(1, prover_type) is not None:
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"batch 1 never proven as {prover_type}")
+
+
+# ===========================================================================
+# chaos scenarios (tentpole acceptance battery)
+# ===========================================================================
+
+def test_prover_crash_mid_prove_reassigned_and_recovered():
+    """A prover that dies inside backend.prove loses its lease; after
+    expiry the batch is reassigned and eventually proven — and the fault
+    schedule is exactly the seeded plan, nothing more."""
+    node, l1, seq = _mini_l2((protocol.PROVER_EXEC,),
+                             prover_lease_timeout=0.25)
+    co = seq.coordinator
+    try:
+        plan = faults.install(
+            FaultPlan(seed=7).error("backend.prove", times=1))
+        client = ProverClient(protocol.PROVER_EXEC, _endpoints(seq),
+                              heartbeat_interval=0, backoff_base=0.01,
+                              rng_seed=1)
+        assert client.poll_once() == 0          # injected crash mid-prove
+        assert seq.rollup.get_proof(1, protocol.PROVER_EXEC) is None
+        # the lease is still live: the batch is NOT immediately re-served
+        assert co.next_batch_to_assign(protocol.PROVER_EXEC) is None
+        time.sleep(0.3)                         # lease expires
+        _poll_until_proven(client, seq, protocol.PROVER_EXEC)
+        assert co.reassignments_total == 1
+        assert co.failures[(1, protocol.PROVER_EXEC)] == 1
+        assert plan.log == [("backend.prove", "error")]
+        assert seq.send_proofs() == (1, 1)
+        assert l1.last_verified_batch() == 1
+    finally:
+        faults.clear()
+        seq.stop()
+
+
+def test_heartbeat_keeps_slow_proof_alive():
+    """A proof 3x longer than the lease survives because the client's
+    heartbeat thread keeps extending the assignment — no reassignment,
+    proof accepted (the old fixed-600s-timeout failure mode)."""
+    node, l1, seq = _mini_l2((protocol.PROVER_EXEC,),
+                             prover_lease_timeout=0.4)
+    co = seq.coordinator
+    key = (1, protocol.PROVER_EXEC)
+    try:
+        faults.install(
+            FaultPlan(seed=3).delay("backend.prove", 1.2, times=1))
+        client = ProverClient(protocol.PROVER_EXEC, _endpoints(seq),
+                              heartbeat_interval=0.1, rng_seed=0)
+        results = []
+        t = threading.Thread(
+            target=lambda: results.append(client.poll_once()))
+        t.start()
+        deadline = time.time() + 3
+        while key not in co.assignments and time.time() < deadline:
+            time.sleep(0.01)
+        assert key in co.assignments
+        d0 = co.assignments[key]
+        time.sleep(0.6)                          # well past the raw lease
+        with co.lock:
+            still_held = key in co.assignments
+            extended = still_held and co.assignments[key] > d0
+        assert still_held and extended, "heartbeats did not extend lease"
+        # nobody else can steal the batch while the lease is being fed
+        assert co.next_batch_to_assign(protocol.PROVER_EXEC) is None
+        t.join(timeout=8)
+        assert results == [1]
+        assert seq.rollup.get_proof(1, protocol.PROVER_EXEC) is not None
+        assert co.heartbeats_total >= 2
+        assert co.reassignments_total == 0
+    finally:
+        faults.clear()
+        seq.stop()
+
+
+def test_corrupt_proof_rejected_then_retried():
+    """A corrupted proof is rejected at submit time (not at settlement),
+    the assignment slot is freed immediately, and the retry stores a
+    clean proof."""
+    node, l1, seq = _mini_l2((protocol.PROVER_EXEC,))
+    co = seq.coordinator
+    try:
+        faults.install(
+            FaultPlan(seed=11).corrupt("backend.prove", times=1))
+        client = ProverClient(protocol.PROVER_EXEC, _endpoints(seq),
+                              heartbeat_interval=0, backoff_base=0.01,
+                              rng_seed=2)
+        assert client.poll_once() == 0           # submit rejected
+        assert seq.rollup.get_proof(1, protocol.PROVER_EXEC) is None
+        assert co.rejected_submits_total == 1
+        assert co.failures[(1, protocol.PROVER_EXEC)] == 1
+        # rejection freed the slot: no lease expiry needed for the retry
+        time.sleep(0.05)                         # clear the backoff gate
+        _poll_until_proven(client, seq, protocol.PROVER_EXEC)
+        proof = seq.rollup.get_proof(1, protocol.PROVER_EXEC)
+        assert proof["backend"] == protocol.PROVER_EXEC
+        assert "__corrupt__" not in proof
+        assert seq.send_proofs() == (1, 1)
+    finally:
+        faults.clear()
+        seq.stop()
+
+
+def test_flapping_endpoint_breaker_opens_and_recovers():
+    """K consecutive connection drops open the endpoint's circuit
+    breaker; while open the endpoint is skipped entirely; after the
+    cooldown a half-open probe succeeds and the breaker closes."""
+    node, l1, seq = _mini_l2((protocol.PROVER_EXEC,))
+    ep = _endpoints(seq)[0]
+    try:
+        faults.install(FaultPlan(seed=5).drop("proto.send", times=3))
+        client = ProverClient(protocol.PROVER_EXEC, [ep],
+                              heartbeat_interval=0, backoff_base=0.01,
+                              breaker_threshold=3, breaker_cooldown=0.3,
+                              rng_seed=4)
+        st = client.endpoint_states[ep]
+        for _ in range(3):
+            time.sleep(0.03)                     # clear the backoff gate
+            assert client.poll_once() == 0
+        assert st.breaker == "open" and st.failures == 3
+        # open breaker: the endpoint is not even attempted
+        assert client.poll_once() == 0
+        assert st.failures == 3
+        time.sleep(0.35)                         # cooldown elapses
+        assert client.poll_once() == 1           # half-open probe succeeds
+        assert st.breaker == "closed" and st.failures == 0
+        assert seq.rollup.get_proof(1, protocol.PROVER_EXEC) is not None
+        rendered = METRICS.render()
+        assert "prover_breaker_transitions_total" in rendered
+        assert "prover_poll_errors_total" in rendered
+    finally:
+        faults.clear()
+        seq.stop()
+
+
+def test_poison_batch_quarantined_to_exec_fallback():
+    """A batch that keeps killing its tpu prover is quarantined after N
+    failed assignments and settled by the exec fallback backend — and the
+    whole path (metrics, health endpoint, L1 settlement) sees it."""
+    class CrashingTpu(ProverBackend):
+        prover_type = protocol.PROVER_TPU
+
+        def prove(self, program_input, proof_format):
+            raise RuntimeError("tpu backend wedged")
+
+    node, l1, seq = _mini_l2((protocol.PROVER_TPU,),
+                             prover_lease_timeout=0.25,
+                             prover_quarantine_threshold=2)
+    co = seq.coordinator
+    try:
+        bad = ProverClient(CrashingTpu(), _endpoints(seq),
+                           heartbeat_interval=0, backoff_base=0.01,
+                           breaker_threshold=100, rng_seed=0)
+        assert bad.poll_once() == 0              # assignment 1 crashes
+        time.sleep(0.3)
+        assert bad.poll_once() == 0              # expiry 1 -> reassigned
+        time.sleep(0.3)
+        assert bad.poll_once() == 0              # expiry 2 -> quarantine
+        assert co.quarantined == {1}
+        # tpu provers are no longer offered the poisoned batch
+        assert co.next_batch_to_assign(protocol.PROVER_TPU) is None
+        # graceful degradation: the exec fallback takes it over
+        good = ProverClient(protocol.PROVER_EXEC, _endpoints(seq),
+                            heartbeat_interval=0, rng_seed=0)
+        assert good.poll_once() == 1
+        assert seq.rollup.get_proof(1, protocol.PROVER_EXEC) is not None
+        # settlement consumes the fallback proof in the tpu slot
+        assert seq.send_proofs() == (1, 1)
+        assert l1.last_verified_batch() == 1
+        # metrics + health endpoint surface the quarantine
+        rendered = METRICS.render()
+        assert "proof_reassignments_total" in rendered
+        assert "quarantined_batches" in rendered
+        from ethrex_tpu.rpc.server import RpcServer
+
+        node.sequencer = seq
+        h = RpcServer(node).handle({
+            "jsonrpc": "2.0", "id": 1,
+            "method": "ethrex_health", "params": []})
+        prover_stats = h["result"]["l2"]["prover"]
+        assert prover_stats["quarantined"] == [1]
+        assert prover_stats["reassignments"] >= 2
+    finally:
+        seq.stop()
+
+
+def test_fault_plan_determinism():
+    """Same seed -> same fault schedule, independent of wall clock."""
+    def run(seed):
+        plan = FaultPlan(seed).error("backend.prove", p=0.5)
+        outcomes = []
+        for _ in range(32):
+            try:
+                plan.fire("backend.prove")
+                outcomes.append(0)
+            except ConnectionError:
+                outcomes.append(1)
+        return outcomes
+
+    a, b = run(5), run(5)
+    assert a == b and len(a) == 32
+    assert 0 < sum(a) < 32          # p=0.5 actually mixes over 32 draws
+    assert run(6) != a or run(7) != a
+
+
+# ===========================================================================
+# coordinator lease/assignment units (satellites)
+# ===========================================================================
+
+def _bare_coordinator(batches=1, **kw):
+    store = RollupStore()
+    for n in range(1, batches + 1):
+        store.store_prover_input(n, protocol.PROTOCOL_VERSION, {"stub": n})
+    kw.setdefault("needed_types", [protocol.PROVER_EXEC])
+    return store, ProofCoordinator(store, **kw)
+
+
+def test_lease_timeout_reassignment(monkeypatch):
+    """Assigned batch, lease expires (faked clock), the SAME batch goes
+    to a second prover of the same type; the expiry is counted."""
+    store, co = _bare_coordinator()
+    t = [100.0]
+    monkeypatch.setattr(co, "_now", lambda: t[0])
+    assert co.next_batch_to_assign(protocol.PROVER_EXEC) == 1
+    assert co.next_batch_to_assign(protocol.PROVER_EXEC) is None
+    t[0] += co.lease_timeout + 1
+    assert co.next_batch_to_assign(protocol.PROVER_EXEC) == 1
+    assert co.reassignments_total == 1
+    assert co.failures[(1, protocol.PROVER_EXEC)] == 1
+
+
+def test_heartbeat_extends_lease_and_rejects_unknown(monkeypatch):
+    store, co = _bare_coordinator()
+    t = [0.0]
+    monkeypatch.setattr(co, "_now", lambda: t[0])
+    assert co.next_batch_to_assign(protocol.PROVER_EXEC) == 1
+    d0 = co.assignments[(1, protocol.PROVER_EXEC)]
+
+    def beat(batch):
+        return co.handle_request({"type": protocol.HEARTBEAT,
+                                  "batch_id": batch,
+                                  "prover_type": protocol.PROVER_EXEC})
+
+    t[0] = co.lease_timeout - 1
+    ack = beat(1)
+    assert ack["type"] == protocol.HEARTBEAT_ACK and ack["ok"] is True
+    assert co.assignments[(1, protocol.PROVER_EXEC)] == \
+        t[0] + co.lease_timeout > d0
+    # an expired lease is not revived by a late heartbeat
+    t[0] += co.lease_timeout + 1
+    assert beat(1)["ok"] is False
+    # and a heartbeat for a batch never assigned is refused
+    assert beat(99)["ok"] is False
+
+
+def test_next_batch_never_double_assigns_under_race():
+    """N concurrent polls over K batches: each batch handed out exactly
+    once (the assignment map is the mutual exclusion)."""
+    store, co = _bare_coordinator(batches=3)
+    results = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(8)
+
+    def worker():
+        barrier.wait()
+        got = co.next_batch_to_assign(protocol.PROVER_EXEC)
+        with lock:
+            results.append(got)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assigned = [r for r in results if r is not None]
+    assert sorted(assigned) == [1, 2, 3]
+    assert results.count(None) == 5
+
+
+def test_duplicate_and_unsolicited_submits():
+    """Unsolicited ProofSubmit (no assignment) must not write the proof
+    store; a duplicate submit is a no-op ACK keeping the first proof."""
+    store, co = _bare_coordinator(verify_submissions=False)
+    msg = {"type": protocol.PROOF_SUBMIT, "batch_id": 1,
+           "prover_type": protocol.PROVER_EXEC,
+           "proof": {"backend": protocol.PROVER_EXEC, "v": 1}}
+    r = co.handle_request(msg)
+    assert r["type"] == protocol.ERROR
+    assert store.get_proof(1, protocol.PROVER_EXEC) is None
+    assert co.unsolicited_submits_total == 1
+    # with a live assignment the same submit lands
+    assert co.next_batch_to_assign(protocol.PROVER_EXEC) == 1
+    assert co.handle_request(msg)["type"] == protocol.SUBMIT_ACK
+    # duplicate (different payload!) -> no-op ACK, first proof kept
+    dup = dict(msg, proof={"backend": protocol.PROVER_EXEC, "v": 2})
+    assert co.handle_request(dup)["type"] == protocol.SUBMIT_ACK
+    assert store.get_proof(1, protocol.PROVER_EXEC)["v"] == 1
+
+
+def test_invalid_submit_rejected_and_slot_freed():
+    """verify_submissions: a proof the backend refuses is not stored and
+    the batch is immediately assignable again."""
+    store, co = _bare_coordinator()        # verify_submissions=True
+    assert co.next_batch_to_assign(protocol.PROVER_EXEC) == 1
+    r = co.handle_request({"type": protocol.PROOF_SUBMIT, "batch_id": 1,
+                           "prover_type": protocol.PROVER_EXEC,
+                           "proof": {"backend": "__corrupt__"}})
+    assert r["type"] == protocol.ERROR and "invalid proof" in r["message"]
+    assert store.get_proof(1, protocol.PROVER_EXEC) is None
+    assert co.rejected_submits_total == 1
+    assert co.next_batch_to_assign(protocol.PROVER_EXEC) == 1
+
+
+# ===========================================================================
+# wire-protocol hardening (satellites)
+# ===========================================================================
+
+def test_recv_msg_file_oversized_truncated_malformed():
+    # a line longer than max_size comes back from readline() with no
+    # trailing newline — previously fed straight into json.loads
+    with pytest.raises(ConnectionError, match="message too large"):
+        protocol.recv_msg_file(io.BytesIO(b"x" * 200), max_size=64)
+    # EOF mid-frame (peer died): also not a parseable message
+    with pytest.raises(ConnectionError, match="truncated frame"):
+        protocol.recv_msg_file(io.BytesIO(b'{"a": 1'), max_size=64)
+    with pytest.raises(ConnectionError, match="malformed frame"):
+        protocol.recv_msg_file(io.BytesIO(b"not json\n"))
+    with pytest.raises(ConnectionError, match="not a JSON object"):
+        protocol.recv_msg_file(io.BytesIO(b"[1,2]\n"))
+    assert protocol.recv_msg_file(io.BytesIO(b"")) is None
+    assert protocol.recv_msg_file(io.BytesIO(b'{"a":1}\n')) == {"a": 1}
+
+
+def test_recv_msg_oversized_and_malformed():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"garbage\n")
+        with pytest.raises(ConnectionError, match="malformed frame"):
+            protocol.recv_msg(b)
+        a.sendall(b"y" * 200)
+        with pytest.raises(ConnectionError, match="message too large"):
+            protocol.recv_msg(b, max_size=64)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_poll_error_goes_to_logger_and_metrics(caplog):
+    """A dead endpoint increments prover_poll_errors_total and logs via
+    the module logger (the old bare print is gone)."""
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()                       # nothing listens here any more
+    before = METRICS.counters.get("prover_poll_errors_total", 0)
+    client = ProverClient(protocol.PROVER_EXEC, [("127.0.0.1", port)],
+                          heartbeat_interval=0, rng_seed=0)
+    import logging
+
+    with caplog.at_level(logging.WARNING, "ethrex_tpu.prover.client"):
+        assert client.poll_once() == 0
+    assert METRICS.counters["prover_poll_errors_total"] == before + 1
+    assert client.endpoint_states[("127.0.0.1", port)].failures == 1
+    assert any("poll failed" in r.getMessage() for r in caplog.records)
+
+
+def test_fault_guard_requires_cleanup():
+    """The injected() context manager clears the plan (what the conftest
+    guard enforces for every test)."""
+    with faults.injected(FaultPlan(seed=1).drop("proto.send", times=1)):
+        assert faults.active() is not None
+    assert faults.active() is None
