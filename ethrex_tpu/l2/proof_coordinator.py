@@ -15,12 +15,23 @@ no-op storage), extended with the resilience layer:
     and the health endpoint;
   * submit-time proof validation — a corrupt proof frees the assignment
     slot immediately instead of poisoning the stored-proof map until the
-    proof sender's full audit.
+    proof sender's full audit;
+  * lease tokens — every assignment carries an unguessable token that
+    Heartbeat and ProofSubmit must echo; the wire protocol carries no
+    prover identity, so the token is what ties lease mutations (extension,
+    invalid-proof eviction, failure accounting) to the prover that was
+    actually granted the lease instead of to any connection that names the
+    right (batch, prover_type) pair;
+  * a bounded lease lifetime — heartbeats extend a lease only up to
+    `max_lease_lifetime` past first assignment, so a prover whose prove
+    call hangs (rather than crashes) is still eventually reassigned and
+    counted as a failure instead of pinning the batch forever.
 """
 
 from __future__ import annotations
 
 import logging
+import secrets
 import socketserver
 import threading
 import time
@@ -33,6 +44,7 @@ log = logging.getLogger("ethrex_tpu.l2.proof_coordinator")
 
 ASSIGNMENT_TIMEOUT = 600.0  # default lease, like the reference's 10 minutes
 QUARANTINE_THRESHOLD = 3    # failed assignments before exec fallback
+LEASE_LIFETIME_FACTOR = 6   # max heartbeat-extended lifetime, in leases
 
 
 class ProofCoordinator:
@@ -44,7 +56,8 @@ class ProofCoordinator:
                  lease_timeout: float = ASSIGNMENT_TIMEOUT,
                  quarantine_threshold: int = QUARANTINE_THRESHOLD,
                  fallback_type: str = protocol.PROVER_EXEC,
-                 verify_submissions: bool = True):
+                 verify_submissions: bool = True,
+                 max_lease_lifetime: float | None = None):
         self.rollup = rollup_store
         self.needed_types = needed_types or [protocol.PROVER_TPU]
         self.commit_hash = commit_hash
@@ -53,11 +66,21 @@ class ProofCoordinator:
         self.quarantine_threshold = quarantine_threshold
         self.fallback_type = fallback_type
         self.verify_submissions = verify_submissions
+        # total lifetime a lease may be heartbeat-extended to, measured
+        # from first assignment; a hung (not crashed) prover is reassigned
+        # once this is spent
+        self.max_lease_lifetime = (
+            max_lease_lifetime if max_lease_lifetime is not None
+            else LEASE_LIFETIME_FACTOR * lease_timeout)
         # (batch_number, prover_type) -> lease deadline; an expired entry
         # stays until reassignment so a late-but-finished proof still lands
         self.assignments: dict[tuple[int, str], float] = {}
-        # (batch_number, prover_type) -> first-assignment time (metrics)
+        # (batch_number, prover_type) -> first-assignment time (metrics +
+        # the max_lease_lifetime anchor)
         self.assigned_at: dict[tuple[int, str], float] = {}
+        # (batch_number, prover_type) -> token of the current lease holder;
+        # Heartbeat/ProofSubmit must echo it to mutate lease state
+        self.lease_tokens: dict[tuple[int, str], str] = {}
         # (batch_number, prover_type) -> failed assignments (expiry/reject)
         self.failures: dict[tuple[int, str], int] = {}
         self.quarantined: set[int] = set()
@@ -65,6 +88,7 @@ class ProofCoordinator:
         self.heartbeats_total = 0
         self.rejected_submits_total = 0
         self.unsolicited_submits_total = 0
+        self.stale_submits_total = 0
         self.lock = threading.RLock()
         self.host = host
         self.port = port
@@ -146,8 +170,7 @@ class ProofCoordinator:
                     if deadline > now:
                         continue  # live lease elsewhere
                     # lease expired: the holder crashed or stalled
-                    self.assignments.pop(key, None)
-                    self.assigned_at.pop(key, None)
+                    self._clear_lease(key)
                     self._record_failure(num, prover_type, "lease expired")
                     if num in self.quarantined and \
                             prover_type != self.fallback_type:
@@ -155,8 +178,22 @@ class ProofCoordinator:
                 self.assignments[(num, prover_type)] = \
                     now + self.lease_timeout
                 self.assigned_at[(num, prover_type)] = now
+                self.lease_tokens[(num, prover_type)] = \
+                    secrets.token_hex(16)
                 return num
         return None
+
+    def _clear_lease(self, key: tuple[int, str]) -> float | None:
+        """Drop a lease and its token; returns the first-assignment time
+        (None if it was never live). Caller holds self.lock."""
+        self.assignments.pop(key, None)
+        self.lease_tokens.pop(key, None)
+        return self.assigned_at.pop(key, None)
+
+    def lease_token(self, batch: int, prover_type: str) -> str | None:
+        """Token of the current lease holder for (batch, prover_type)."""
+        with self.lock:
+            return self.lease_tokens.get((batch, prover_type))
 
     # ------------------------------------------------------------------
     def _handle_heartbeat(self, msg: dict) -> dict:
@@ -164,15 +201,27 @@ class ProofCoordinator:
 
         batch = msg.get("batch_id")
         prover_type = msg.get("prover_type")
+        token = msg.get("lease_token")
         ok = False
         with self.lock:
             key = (batch, prover_type)
             deadline = self.assignments.get(key)
-            if deadline is not None and deadline > self._now():
-                # live lease: extend it a full period from now
-                self.assignments[key] = self._now() + self.lease_timeout
-                self.heartbeats_total += 1
-                ok = True
+            now = self._now()
+            if (deadline is not None and deadline > now
+                    and token is not None
+                    and token == self.lease_tokens.get(key)):
+                # only the granted holder may extend, and only up to
+                # max_lease_lifetime past first assignment — a hung prover
+                # cannot keep a batch pinned forever
+                hard = self.assigned_at.get(key, now) \
+                    + self.max_lease_lifetime
+                if now < hard:
+                    self.assignments[key] = \
+                        min(now + self.lease_timeout, hard)
+                    self.heartbeats_total += 1
+                    ok = True
+                # else: lifetime spent; the lease lapses at its current
+                # deadline, expiry reassigns and counts the failure
         if ok:
             record_heartbeat()
         return {"type": protocol.HEARTBEAT_ACK, "batch_id": batch, "ok": ok}
@@ -181,6 +230,7 @@ class ProofCoordinator:
         batch = msg.get("batch_id")
         prover_type = msg.get("prover_type")
         proof = msg.get("proof")
+        token = msg.get("lease_token")
         with self.lock:
             allowed = self._allowed_types()
             if batch in self.quarantined:
@@ -188,18 +238,24 @@ class ProofCoordinator:
         if not isinstance(batch, int) or prover_type not in allowed \
                 or not isinstance(proof, dict):
             return {"type": protocol.ERROR, "message": "bad submit"}
+        key = (batch, prover_type)
         with self.lock:
             if self.rollup.get_proof(batch, prover_type) is not None:
                 # duplicate submit -> no-op ACK (reference parity: the
                 # store keeps the first proof; the prover moves on)
                 return {"type": protocol.SUBMIT_ACK, "batch_id": batch}
-            if (batch, prover_type) not in self.assignments:
+            if key not in self.assignments:
                 # unsolicited: never assigned (or already settled and
                 # cleaned up) — do not let an arbitrary connection write
                 # into the proof store
                 self.unsolicited_submits_total += 1
                 return {"type": protocol.ERROR,
                         "message": f"no assignment for batch {batch}"}
+            # the wire protocol carries no prover identity — the lease
+            # token is what distinguishes the granted holder from a stale
+            # evicted prover or an arbitrary third party
+            holds_lease = (token is not None
+                           and token == self.lease_tokens.get(key))
         if self.verify_submissions:
             from ..prover.backend import get_backend
 
@@ -209,21 +265,49 @@ class ProofCoordinator:
                 ok = False
             if not ok:
                 with self.lock:
-                    self.assignments.pop((batch, prover_type), None)
-                    self.assigned_at.pop((batch, prover_type), None)
-                    self.rejected_submits_total += 1
-                    self._record_failure(batch, prover_type,
-                                         "invalid proof")
+                    # re-check under the lock: verification ran outside
+                    # it, and the lease may have expired and been
+                    # re-granted to a new holder in the meantime
+                    holds_lease = (token is not None and
+                                   token == self.lease_tokens.get(key))
+                    if holds_lease:
+                        self._clear_lease(key)
+                        self.rejected_submits_total += 1
+                        self._record_failure(batch, prover_type,
+                                             "invalid proof")
+                    else:
+                        # an invalid proof from a non-holder must not
+                        # evict the live holder's lease or burn the
+                        # batch's quarantine budget (unauthenticated
+                        # downgrade vector)
+                        self.stale_submits_total += 1
+                if holds_lease:
+                    return {"type": protocol.ERROR,
+                            "message": f"invalid proof for batch {batch}"}
+                from ..utils.metrics import record_stale_submit
+
+                record_stale_submit()
                 return {"type": protocol.ERROR,
-                        "message": f"invalid proof for batch {batch}"}
+                        "message": f"stale lease token for batch "
+                                   f"{batch}; proof rejected"}
+        elif not holds_lease:
+            # without submit-time verification the token is the only gate
+            # keeping arbitrary connections out of the proof store
+            with self.lock:
+                self.stale_submits_total += 1
+            from ..utils.metrics import record_stale_submit
+
+            record_stale_submit()
+            return {"type": protocol.ERROR,
+                    "message": f"stale lease token for batch {batch}"}
         proof = faults.inject("coordinator.store_proof", proof)
         self.rollup.store_proof(batch, prover_type, proof)
         with self.lock:
-            self.assignments.pop((batch, prover_type), None)
-            started = self.assigned_at.pop((batch, prover_type), None)
-        if started is not None:
+            started = self._clear_lease(key)
+        if started is not None and holds_lease:
             # proving-time metric (reference: set_batch_proving_time,
-            # proof_coordinator.rs:286-296)
+            # proof_coordinator.rs:286-296) — only meaningful when the
+            # submitter is the prover the clock was started for
             from ..utils.metrics import record_batch
 
             record_batch(batch, self._now() - started)
@@ -244,7 +328,8 @@ class ProofCoordinator:
             program_input = self.rollup.get_prover_input(
                 batch, self.commit_hash)
             return {"type": protocol.INPUT_RESPONSE, "batch_id": batch,
-                    "input": program_input, "format": self.proof_format}
+                    "input": program_input, "format": self.proof_format,
+                    "lease_token": self.lease_token(batch, prover_type)}
         if mtype == protocol.HEARTBEAT:
             return self._handle_heartbeat(msg)
         if mtype == protocol.PROOF_SUBMIT:
@@ -263,6 +348,7 @@ class ProofCoordinator:
                 "heartbeats": self.heartbeats_total,
                 "rejectedSubmits": self.rejected_submits_total,
                 "unsolicitedSubmits": self.unsolicited_submits_total,
+                "staleSubmits": self.stale_submits_total,
                 "quarantined": sorted(self.quarantined),
                 "failures": {f"{num}/{ptype}": count
                              for (num, ptype), count

@@ -38,9 +38,12 @@ class SequencerConfig:
     max_actor_failures: int = 10
     max_backoff_factor: int = 32
     # prover resilience (docs/PROVER_RESILIENCE.md): assignment lease
-    # length (heartbeats extend it) and how many failed assignments of a
-    # batch to its primary prover type trigger the exec fallback
+    # length (heartbeats extend it), the hard cap on how long heartbeats
+    # can keep one assignment alive (None -> coordinator default of
+    # 6 leases; bounds hung provers), and how many failed assignments of
+    # a batch to its primary prover type trigger the exec fallback
     prover_lease_timeout: float = 600.0
+    prover_max_lease_lifetime: float | None = None
     prover_quarantine_threshold: int = 3
 
 
@@ -88,7 +91,8 @@ class Sequencer:
             self.rollup, needed_types=list(self.cfg.needed_prover_types),
             commit_hash=self.cfg.commit_hash,
             lease_timeout=self.cfg.prover_lease_timeout,
-            quarantine_threshold=self.cfg.prover_quarantine_threshold)
+            quarantine_threshold=self.cfg.prover_quarantine_threshold,
+            max_lease_lifetime=self.cfg.prover_max_lease_lifetime)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         # checkpoint resume (reference: l1_committer.rs:389 per-batch

@@ -48,12 +48,14 @@ class _HeartbeatThread(threading.Thread):
     backend proves; failures are ignored — lease expiry is the backstop."""
 
     def __init__(self, host: str, port: int, batch_id: int,
-                 prover_type: str, interval: float):
+                 prover_type: str, interval: float,
+                 lease_token: str | None = None):
         super().__init__(daemon=True)
         self.host, self.port = host, port
         self.batch_id = batch_id
         self.prover_type = prover_type
         self.interval = interval
+        self.lease_token = lease_token
         self.acked = 0
         self._stop = threading.Event()
 
@@ -66,6 +68,7 @@ class _HeartbeatThread(threading.Thread):
                         "type": protocol.HEARTBEAT,
                         "batch_id": self.batch_id,
                         "prover_type": self.prover_type,
+                        "lease_token": self.lease_token,
                     })
                     ack = protocol.recv_msg(sock)
                 if ack.get("type") == protocol.HEARTBEAT_ACK \
@@ -102,6 +105,8 @@ class ProverClient:
         self._rng = random.Random(rng_seed)
         self._stop = threading.Event()
         self.proved: list[int] = []   # batch ids proven (observability)
+        self.submit_rejections = 0    # application-level rejects (not
+        #                               transport; never trips the breaker)
         self.endpoint_states: dict[tuple[str, int], EndpointState] = {
             ep: EndpointState() for ep in endpoints}
 
@@ -190,13 +195,15 @@ class ProverClient:
         if rtype != protocol.INPUT_RESPONSE:
             return 0
         batch_id = resp["batch_id"]
+        lease_token = resp.get("lease_token")
         program_input = ProgramInput.from_json(resp["input"])
         # heartbeats keep the coordinator lease alive through a long proof
         hb = None
         if self.heartbeat_interval and self.heartbeat_interval > 0:
             hb = _HeartbeatThread(host, port, batch_id,
                                   self.backend.prover_type,
-                                  self.heartbeat_interval)
+                                  self.heartbeat_interval,
+                                  lease_token=lease_token)
             hb.start()
         try:
             faults.inject("backend.prove")
@@ -214,14 +221,25 @@ class ProverClient:
                 "batch_id": batch_id,
                 "prover_type": self.backend.prover_type,
                 "proof": proof,
+                "lease_token": lease_token,
             })
             ack = protocol.recv_msg(sock)
         if ack.get("type") == protocol.SUBMIT_ACK:
             self.proved.append(batch_id)
             return 1
-        raise ValueError(
-            f"submit rejected for batch {batch_id}: "
-            f"{ack.get('message', ack.get('type'))}")
+        # application-level rejection (invalid proof, stale token): the
+        # coordinator answered fine, so the endpoint is healthy — do NOT
+        # feed this into the breaker/backoff failure count; a prover with
+        # a corrupt backend must not open its own breaker against a
+        # perfectly good coordinator
+        from ..utils.metrics import record_submit_rejected
+
+        record_submit_rejected()
+        self.submit_rejections += 1
+        log.warning("submit rejected for batch %d by %s:%d: %s",
+                    batch_id, host, port,
+                    ack.get("message", ack.get("type")))
+        return 0
 
     # ------------------------------------------------------------------
     def run_forever(self):

@@ -11,17 +11,23 @@ import socket
 
 from ..utils import faults
 
-# message types (the reference's ProofData variants)
+# message types (the reference's ProofData variants). The wire carries no
+# prover identity, so InputResponse issues a per-assignment lease_token;
+# Heartbeat and ProofSubmit must echo it — lease mutations only ever act
+# on behalf of the prover the lease was granted to.
 INPUT_REQUEST = "InputRequest"          # {commit_hash, prover_type}
-INPUT_RESPONSE = "InputResponse"        # {batch_id, input, format}
+INPUT_RESPONSE = "InputResponse"        # {batch_id, input, format,
+#                                          lease_token}
 VERSION_MISMATCH = "VersionMismatch"    # {expected}
 TYPE_NOT_NEEDED = "ProverTypeNotNeeded"
-PROOF_SUBMIT = "ProofSubmit"            # {batch_id, prover_type, proof}
+PROOF_SUBMIT = "ProofSubmit"            # {batch_id, prover_type, proof,
+#                                          lease_token}
 SUBMIT_ACK = "ProofSubmitACK"           # {batch_id}
 ERROR = "Error"                         # {message}
 # lease keep-alive: a prover mid-way through a long TPU proof extends its
 # assignment instead of relying on one fixed coordinator-side timeout
-HEARTBEAT = "Heartbeat"                 # {batch_id, prover_type}
+HEARTBEAT = "Heartbeat"                 # {batch_id, prover_type,
+#                                          lease_token}
 HEARTBEAT_ACK = "HeartbeatAck"          # {batch_id, ok}
 
 # proof formats (reference: ProofFormat — Compressed STARK vs Groth16 wrap)

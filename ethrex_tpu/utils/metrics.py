@@ -99,6 +99,19 @@ def record_heartbeat():
                 "Lease-extending heartbeats accepted by the coordinator")
 
 
+def record_stale_submit():
+    METRICS.inc("proof_stale_submits_total", 1,
+                "Proof submits refused for missing or non-current lease "
+                "tokens (left lease and failure state untouched)")
+
+
+def record_submit_rejected():
+    METRICS.inc("prover_submit_rejections_total", 1,
+                "Proof submits the coordinator rejected at the "
+                "application level (endpoint healthy; not a breaker "
+                "failure)")
+
+
 def record_batch(batch_number: int, proving_time: float | None = None):
     METRICS.set("ethrex_l2_latest_batch", batch_number,
                 "Latest committed L2 batch")

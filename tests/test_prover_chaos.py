@@ -170,12 +170,44 @@ def test_corrupt_proof_rejected_then_retried():
         assert co.rejected_submits_total == 1
         assert co.failures[(1, protocol.PROVER_EXEC)] == 1
         # rejection freed the slot: no lease expiry needed for the retry
-        time.sleep(0.05)                         # clear the backoff gate
+        # (and no client-side backoff either — the endpoint was healthy)
         _poll_until_proven(client, seq, protocol.PROVER_EXEC)
         proof = seq.rollup.get_proof(1, protocol.PROVER_EXEC)
         assert proof["backend"] == protocol.PROVER_EXEC
         assert "__corrupt__" not in proof
         assert seq.send_proofs() == (1, 1)
+    finally:
+        faults.clear()
+        seq.stop()
+
+
+def test_rejected_submit_does_not_trip_breaker():
+    """A prover producing invalid proofs (its own bug, or injected
+    corruption) accumulates submit rejections, NOT endpoint failures: the
+    coordinator answered the poll fine, so the breaker stays closed and
+    the next attempt runs with no backoff or cooldown to wait out."""
+    node, l1, seq = _mini_l2((protocol.PROVER_EXEC,))
+    ep = _endpoints(seq)[0]
+    try:
+        faults.install(
+            FaultPlan(seed=9).corrupt("backend.prove", times=2))
+        client = ProverClient(protocol.PROVER_EXEC, [ep],
+                              heartbeat_interval=0, backoff_base=0.01,
+                              breaker_threshold=2, rng_seed=3)
+        st = client.endpoint_states[ep]
+        before = METRICS.counters.get("prover_submit_rejections_total", 0)
+        assert client.poll_once() == 0
+        assert client.poll_once() == 0
+        # two rejections >= breaker_threshold, yet nothing was counted
+        # against the (healthy) endpoint
+        assert st.breaker == "closed" and st.failures == 0
+        assert client.submit_rejections == 2
+        assert METRICS.counters["prover_submit_rejections_total"] == \
+            before + 2
+        assert seq.coordinator.rejected_submits_total == 2
+        # third poll proves cleanly, immediately
+        assert client.poll_once() == 1
+        assert seq.rollup.get_proof(1, protocol.PROVER_EXEC) is not None
     finally:
         faults.clear()
         seq.stop()
@@ -315,22 +347,65 @@ def test_heartbeat_extends_lease_and_rejects_unknown(monkeypatch):
     monkeypatch.setattr(co, "_now", lambda: t[0])
     assert co.next_batch_to_assign(protocol.PROVER_EXEC) == 1
     d0 = co.assignments[(1, protocol.PROVER_EXEC)]
+    token = co.lease_token(1, protocol.PROVER_EXEC)
+    assert token
 
-    def beat(batch):
+    def beat(batch, tok=token):
         return co.handle_request({"type": protocol.HEARTBEAT,
                                   "batch_id": batch,
-                                  "prover_type": protocol.PROVER_EXEC})
+                                  "prover_type": protocol.PROVER_EXEC,
+                                  "lease_token": tok})
 
     t[0] = co.lease_timeout - 1
     ack = beat(1)
     assert ack["type"] == protocol.HEARTBEAT_ACK and ack["ok"] is True
     assert co.assignments[(1, protocol.PROVER_EXEC)] == \
         t[0] + co.lease_timeout > d0
+    # a heartbeat without the holder's token never extends the lease
+    assert beat(1, tok=None)["ok"] is False
+    assert beat(1, tok="forged")["ok"] is False
+    assert co.assignments[(1, protocol.PROVER_EXEC)] == \
+        t[0] + co.lease_timeout
     # an expired lease is not revived by a late heartbeat
     t[0] += co.lease_timeout + 1
     assert beat(1)["ok"] is False
     # and a heartbeat for a batch never assigned is refused
     assert beat(99)["ok"] is False
+
+
+def test_heartbeat_cannot_extend_past_max_lease_lifetime(monkeypatch):
+    """A hung prover's heartbeats keep arriving but the lease still dies:
+    extensions are capped at max_lease_lifetime past first assignment, so
+    the batch is eventually reassigned and the hang counted as a failure
+    (the liveness property the old fixed 600 s timeout guaranteed)."""
+    store, co = _bare_coordinator(lease_timeout=10.0,
+                                  max_lease_lifetime=25.0)
+    t = [0.0]
+    monkeypatch.setattr(co, "_now", lambda: t[0])
+    assert co.next_batch_to_assign(protocol.PROVER_EXEC) == 1
+    token = co.lease_token(1, protocol.PROVER_EXEC)
+    key = (1, protocol.PROVER_EXEC)
+
+    def beat():
+        return co.handle_request({"type": protocol.HEARTBEAT,
+                                  "batch_id": 1,
+                                  "prover_type": protocol.PROVER_EXEC,
+                                  "lease_token": token})["ok"]
+
+    t[0] = 8.0
+    assert beat() is True
+    assert co.assignments[key] == 18.0       # full extension
+    t[0] = 16.0
+    assert beat() is True
+    assert co.assignments[key] == 25.0       # clamped to the hard cap
+    t[0] = 24.0
+    assert beat() is True                    # still inside the lifetime
+    assert co.assignments[key] == 25.0       # but no further extension
+    t[0] = 26.0                              # lifetime spent, lease dead
+    assert beat() is False
+    assert co.next_batch_to_assign(protocol.PROVER_EXEC) == 1
+    assert co.failures[key] == 1
+    assert co.reassignments_total == 1
 
 
 def test_next_batch_never_double_assigns_under_race():
@@ -368,8 +443,15 @@ def test_duplicate_and_unsolicited_submits():
     assert r["type"] == protocol.ERROR
     assert store.get_proof(1, protocol.PROVER_EXEC) is None
     assert co.unsolicited_submits_total == 1
-    # with a live assignment the same submit lands
+    # with a live assignment but no lease token, the submit is still
+    # refused — verification is off, so the token is the only write gate
     assert co.next_batch_to_assign(protocol.PROVER_EXEC) == 1
+    r = co.handle_request(msg)
+    assert r["type"] == protocol.ERROR and "stale lease" in r["message"]
+    assert store.get_proof(1, protocol.PROVER_EXEC) is None
+    assert co.stale_submits_total == 1
+    # with the holder's token the same submit lands
+    msg["lease_token"] = co.lease_token(1, protocol.PROVER_EXEC)
     assert co.handle_request(msg)["type"] == protocol.SUBMIT_ACK
     # duplicate (different payload!) -> no-op ACK, first proof kept
     dup = dict(msg, proof={"backend": protocol.PROVER_EXEC, "v": 2})
@@ -382,13 +464,52 @@ def test_invalid_submit_rejected_and_slot_freed():
     the batch is immediately assignable again."""
     store, co = _bare_coordinator()        # verify_submissions=True
     assert co.next_batch_to_assign(protocol.PROVER_EXEC) == 1
+    token = co.lease_token(1, protocol.PROVER_EXEC)
     r = co.handle_request({"type": protocol.PROOF_SUBMIT, "batch_id": 1,
                            "prover_type": protocol.PROVER_EXEC,
-                           "proof": {"backend": "__corrupt__"}})
+                           "proof": {"backend": "__corrupt__"},
+                           "lease_token": token})
     assert r["type"] == protocol.ERROR and "invalid proof" in r["message"]
     assert store.get_proof(1, protocol.PROVER_EXEC) is None
     assert co.rejected_submits_total == 1
     assert co.next_batch_to_assign(protocol.PROVER_EXEC) == 1
+
+
+def test_stale_submit_cannot_evict_live_lease():
+    """An invalid proof from a connection that does NOT hold the lease
+    token (stale evicted prover, or any third party) is refused without
+    touching the live holder's lease or the batch's quarantine budget —
+    and the holder's own valid submit still lands afterwards."""
+    store, co = _bare_coordinator(quarantine_threshold=2)
+    assert co.next_batch_to_assign(protocol.PROVER_EXEC) == 1
+    token = co.lease_token(1, protocol.PROVER_EXEC)
+    key = (1, protocol.PROVER_EXEC)
+    d0 = co.assignments[key]
+
+    def attack(tok):
+        return co.handle_request({
+            "type": protocol.PROOF_SUBMIT, "batch_id": 1,
+            "prover_type": protocol.PROVER_EXEC,
+            "proof": {"backend": "__corrupt__"}, "lease_token": tok})
+
+    # enough corrupt submits to quarantine the batch, were they counted
+    for tok in (None, "forged", "forged", "forged"):
+        r = attack(tok)
+        assert r["type"] == protocol.ERROR
+        assert "stale lease" in r["message"]
+    assert co.assignments[key] == d0          # lease untouched
+    assert co.failures == {}                  # no failure burned
+    assert co.rejected_submits_total == 0
+    assert co.stale_submits_total == 4
+    assert co.quarantined == set()            # no forced downgrade
+    # the real holder's valid proof is accepted, not "no assignment"
+    valid = {"backend": protocol.PROVER_EXEC,
+             "output": "0x" + "00" * 176}     # decodable ProgramOutput
+    r = co.handle_request({"type": protocol.PROOF_SUBMIT, "batch_id": 1,
+                           "prover_type": protocol.PROVER_EXEC,
+                           "proof": valid, "lease_token": token})
+    assert r["type"] == protocol.SUBMIT_ACK
+    assert store.get_proof(1, protocol.PROVER_EXEC) is not None
 
 
 # ===========================================================================
