@@ -51,10 +51,12 @@ PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
 NUM_TXS = int(os.environ.get("BENCH_TXS", "10"))
 
 
-def probe_backend() -> bool:
+def probe_backend_error() -> str | None:
     """Cheap child-process jax.devices() probe so a dead tunnel costs
     PROBE_TIMEOUT, not a full measurement timeout (the tunnel can hang
-    indefinitely rather than erroring)."""
+    indefinitely rather than erroring).  Returns None when the backend is
+    usable, else a short diagnostic ("ExcType: message") so a degraded
+    record says WHY the probe failed."""
     want_cpu = os.environ.get("BENCH_ALLOW_CPU") == "1"
     check = ("import jax; assert jax.default_backend() != 'cpu'"
              if not want_cpu else "import jax; jax.devices()")
@@ -62,9 +64,19 @@ def probe_backend() -> bool:
         proc = subprocess.run(
             [sys.executable, "-c", check],
             capture_output=True, timeout=PROBE_TIMEOUT)
-        return proc.returncode == 0
     except subprocess.TimeoutExpired:
-        return False
+        return f"TimeoutExpired: backend probe exceeded {PROBE_TIMEOUT}s"
+    if proc.returncode == 0:
+        return None
+    # last non-empty stderr line is the exception line of the traceback
+    stderr = proc.stderr.decode(errors="replace") if proc.stderr else ""
+    lines = [ln.strip() for ln in stderr.splitlines() if ln.strip()]
+    detail = lines[-1] if lines else f"exit code {proc.returncode}"
+    return detail[:400]
+
+
+def probe_backend() -> bool:
+    return probe_backend_error() is None
 
 
 def _guard_backend() -> None:
@@ -489,8 +501,10 @@ def _extra_configs() -> dict:
     if os.environ.get("BENCH_FULL") == "1":
         flags.append(("3", "--measure-3"))
     for name, flag in flags:
-        if not probe_backend():
-            out[name] = {"error": "backend probe failed"}
+        probe_err = probe_backend_error()
+        if probe_err is not None:
+            out[name] = {"error": "backend probe failed",
+                         "detail": probe_err}
             continue
         res = _attempt(flag, EXTRA_TIMEOUT)
         out[name] = res if res is not None else {"error": "no output"}
@@ -506,8 +520,10 @@ def _mgas_config() -> dict:
 def main() -> None:
     last_err = ""
     for attempt in range(ATTEMPTS):
-        if not probe_backend():
-            last_err = f"attempt {attempt + 1}: backend probe failed"
+        probe_err = probe_backend_error()
+        if probe_err is not None:
+            last_err = (f"attempt {attempt + 1}: backend probe failed "
+                        f"({probe_err})")
             time.sleep(10)
             continue
         result = _attempt("--measure", ATTEMPT_TIMEOUT)

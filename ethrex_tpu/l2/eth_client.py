@@ -34,6 +34,18 @@ class TransportError(Exception):
     """Network/transport failure (retriable)."""
 
 
+# Error classification for the sequencer's actor loops: a transient error
+# (network flake, injected connection drop, timeout) is expected during an
+# L1 outage and gets a far larger failure budget than a deterministic one
+# (L1Error, logic bugs), which fails fast.  ConnectionError covers
+# faults.InjectedFault; OSError covers raw socket errors.
+TRANSIENT_ERRORS = (TransportError, ConnectionError, TimeoutError, OSError)
+
+
+def is_transient(exc: BaseException) -> bool:
+    return isinstance(exc, TRANSIENT_ERRORS)
+
+
 class EthClient:
     def __init__(self, url: str, timeout: float = 10.0, retries: int = 3,
                  retry_backoff: float = 0.5):

@@ -29,6 +29,7 @@ class Deposit:
     data: bytes = b""
     gas_limit: int = 200_000
     index: int = 0
+    l1_block: int = 0   # L1 block of inclusion (0 = unknown/legacy)
 
 
 # the aliased L1-bridge sender for privileged txs: deposits must NOT spend
@@ -81,9 +82,29 @@ class L1Client:
     def get_committed_state_root(self, number: int) -> bytes | None:
         return None
 
+    def get_committed_commitment(self, number: int) -> bytes | None:
+        """The on-chain commitment word for a settled batch (None when
+        unknown) — the idempotent committer and startup reconciliation
+        compare it against the locally recomputed commitment."""
+        return None
+
+    def get_block_number(self) -> int:
+        """Current L1 head block number (confirmation-depth anchor)."""
+        raise NotImplementedError
+
 
 class InMemoryL1(L1Client):
-    """OnChainProposer/CommonBridge semantics without an actual chain."""
+    """OnChainProposer/CommonBridge semantics without an actual chain.
+
+    Carries a minimal L1 block model: every state-changing transaction
+    (commit / verify / deposit / claim) is sealed into its own L1 block,
+    and a per-block snapshot history backs `reorg(depth)` — the chaos
+    battery's handle for dropping the newest commitments/deposits the way
+    a real L1 reorg does.  `advance_blocks` mines empty blocks so tests
+    can mature a deposit past the watcher's confirmation depth."""
+
+    # per-block snapshots retained for reorg(); older history is trimmed
+    MAX_HISTORY = 512
 
     def __init__(self, needed_prover_types: list[str],
                  l2_chain_id: int | None = None):
@@ -97,6 +118,77 @@ class InMemoryL1(L1Client):
         self.deposits: list[Deposit] = []
         self.consumed_deposits = 0
         self.lock = threading.RLock()
+        self.block_number = 0
+        self.reorgs_total = 0
+        self._history: list[tuple[int, dict]] = [(0, self._snapshot())]
+
+    # ---- L1 block model ----
+    def _snapshot(self) -> dict:
+        return {
+            "commitments": dict(self.commitments),
+            "message_roots": dict(self.message_roots),
+            "blob_sidecars": dict(self.blob_sidecars),
+            "claimed": set(self.claimed),
+            "verified_up_to": self.verified_up_to,
+            "deposits": list(self.deposits),
+            "consumed_deposits": self.consumed_deposits,
+        }
+
+    def _restore(self, snap: dict) -> None:
+        self.commitments = dict(snap["commitments"])
+        self.message_roots = dict(snap["message_roots"])
+        self.blob_sidecars = dict(snap["blob_sidecars"])
+        self.claimed = set(snap["claimed"])
+        self.verified_up_to = snap["verified_up_to"]
+        self.deposits = list(snap["deposits"])
+        self.consumed_deposits = snap["consumed_deposits"]
+
+    def _mine(self) -> int:
+        """Seal the current mutation into a new L1 block (lock held)."""
+        self.block_number += 1
+        self._history.append((self.block_number, self._snapshot()))
+        if len(self._history) > self.MAX_HISTORY:
+            self._history.pop(0)
+        return self.block_number
+
+    def advance_blocks(self, n: int = 1) -> int:
+        """Mine n empty L1 blocks (confirmations pass without activity)."""
+        with self.lock:
+            for _ in range(n):
+                self._mine()
+            return self.block_number
+
+    def get_block_number(self) -> int:
+        with self.lock:
+            return self.block_number
+
+    def reorg(self, depth: int) -> int:
+        """Drop the newest `depth` L1 blocks and everything they carried
+        (commitments, verifications, deposits, claims); returns the new
+        head.  Test surface for the sequencer's reorg handling."""
+        with self.lock:
+            if depth <= 0:
+                raise ValueError("reorg depth must be positive")
+            if depth > self.block_number:
+                raise L1Error(
+                    f"reorg depth {depth} exceeds chain height "
+                    f"{self.block_number}")
+            new_head = self.block_number - depth
+            snap = None
+            for blk, s in reversed(self._history):
+                if blk <= new_head:
+                    snap = s
+                    break
+            if snap is None:
+                raise L1Error(
+                    f"reorg to block {new_head} is beyond the retained "
+                    f"snapshot history")
+            self._restore(snap)
+            self._history = [(b, s) for b, s in self._history
+                             if b <= new_head]
+            self.block_number = new_head
+            self.reorgs_total += 1
+            return new_head
 
     # ---- OnChainProposer ----
     def commit_batch(self, number, new_state_root, commitment,
@@ -125,12 +217,18 @@ class InMemoryL1(L1Client):
             self.consumed_deposits = cursor
             self.commitments[number] = (new_state_root, commitment)
             self.message_roots[number] = bytes(messages_root)
+            self._mine()
             return keccak256(b"commit" + number.to_bytes(8, "big")
                              + commitment)
 
     def publish_blobs(self, number: int, bundle) -> None:
+        # the sidecar rides the commit tx (no block of its own); amend the
+        # commit block's snapshot so a reorg keeps blob and commitment
+        # consistent
         with self.lock:
             self.blob_sidecars[number] = bundle
+            if self._history:
+                self._history[-1][1]["blob_sidecars"][number] = bundle
 
     def get_blob_sidecar(self, number: int):
         with self.lock:
@@ -140,6 +238,11 @@ class InMemoryL1(L1Client):
         with self.lock:
             rec = self.commitments.get(number)
             return rec[0] if rec else None
+
+    def get_committed_commitment(self, number: int) -> bytes | None:
+        with self.lock:
+            rec = self.commitments.get(number)
+            return rec[1] if rec else None
 
     def verify_batches(self, first, last, proofs) -> bytes:
         """proofs: {prover_type: [proof_bytes for each batch first..last]}.
@@ -176,6 +279,7 @@ class InMemoryL1(L1Client):
                             f"proof messages root mismatch for batch "
                             f"{number}")
             self.verified_up_to = last
+            self._mine()
             return keccak256(b"verify" + first.to_bytes(8, "big")
                              + last.to_bytes(8, "big"))
 
@@ -203,6 +307,7 @@ class InMemoryL1(L1Client):
             if not verify_message_proof(root, leaf, index, proof):
                 raise L1Error("invalid message proof")
             self.claimed.add(leaf)
+            self._mine()
             return keccak256(b"claim" + leaf)
 
     # ---- CommonBridge: deposits ----
@@ -215,8 +320,10 @@ class InMemoryL1(L1Client):
                 l1_tx_hash=keccak256(b"deposit" + idx.to_bytes(8, "big")
                                      + recipient),
                 recipient=recipient, amount=amount, data=data,
-                gas_limit=gas_limit, index=idx)
+                gas_limit=gas_limit, index=idx,
+                l1_block=self.block_number + 1)
             self.deposits.append(d)
+            self._mine()
             return d
 
     def get_deposits(self, since_index: int) -> list[Deposit]:
@@ -250,11 +357,13 @@ class PersistentInMemoryL1(InMemoryL1):
                 self.claimed = {bytes.fromhex(h) for h in o["claimed"]}
                 self.verified_up_to = o["verified_up_to"]
                 self.consumed_deposits = o["consumed_deposits"]
+                self.block_number = o.get("block_number", 0)
                 self.deposits = [
                     Deposit(l1_tx_hash=bytes.fromhex(d["h"]),
                             recipient=bytes.fromhex(d["r"]),
                             amount=d["a"], data=bytes.fromhex(d["d"]),
-                            gas_limit=d["g"], index=d["i"])
+                            gas_limit=d["g"], index=d["i"],
+                            l1_block=d.get("b", 0))
                     for d in o["deposits"]]
                 from .blobs import BlobsBundle
 
@@ -267,6 +376,10 @@ class PersistentInMemoryL1(InMemoryL1):
                     for k, v in o["blobs"].items()}
         finally:
             self._loading = False
+        # reorg history does not persist across restarts: re-baseline the
+        # snapshot history at the reloaded state (a reorg can only rewind
+        # to blocks observed by this process)
+        self._history = [(self.block_number, self._snapshot())]
 
     def _save(self):
         if getattr(self, "_loading", False):
@@ -281,9 +394,10 @@ class PersistentInMemoryL1(InMemoryL1):
             "claimed": [h.hex() for h in self.claimed],
             "verified_up_to": self.verified_up_to,
             "consumed_deposits": self.consumed_deposits,
+            "block_number": self.block_number,
             "deposits": [{"h": d.l1_tx_hash.hex(), "r": d.recipient.hex(),
                           "a": d.amount, "d": d.data.hex(),
-                          "g": d.gas_limit, "i": d.index}
+                          "g": d.gas_limit, "i": d.index, "b": d.l1_block}
                          for d in self.deposits],
             "blobs": {str(k): {"blobs": [x.hex() for x in b.blobs],
                                "commitments": [x.hex()
@@ -323,6 +437,18 @@ class PersistentInMemoryL1(InMemoryL1):
 
     def deposit(self, *a, **kw):
         out = super().deposit(*a, **kw)
+        with self.lock:
+            self._save()
+        return out
+
+    def advance_blocks(self, n: int = 1) -> int:
+        out = super().advance_blocks(n)
+        with self.lock:
+            self._save()
+        return out
+
+    def reorg(self, depth: int) -> int:
+        out = super().reorg(depth)
         with self.lock:
             self._save()
         return out

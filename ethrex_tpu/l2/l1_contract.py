@@ -308,6 +308,21 @@ class RpcL1Client(L1Client):
     def last_verified_batch(self) -> int:
         return int.from_bytes(self._view(b"\x06"), "big")
 
+    def get_committed_commitment(self, number: int) -> bytes | None:
+        if self.last_committed_batch() < number:
+            return None
+        return self._view(b"\x08" + _word(number))[-32:]
+
+    def get_committed_state_root(self, number: int) -> bytes | None:
+        with self.lock:
+            rec = self.records.get(number)
+            return rec[0] if rec else None
+
+    def get_block_number(self) -> int:
+        # raw transport errors propagate: the sequencer's actor loop
+        # classifies them as transient (unlike deterministic L1Error)
+        return self.client.block_number()
+
     # ---- CommonBridge ----
     def deposit(self, recipient: bytes, amount: int) -> None:
         self._tx(b"\x03" + recipient, value=amount)

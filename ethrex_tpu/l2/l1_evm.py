@@ -160,3 +160,8 @@ class EvmL1(InMemoryL1):
 
     def last_verified_batch(self) -> int:
         return self._slot(1)
+
+    def reorg(self, depth: int) -> int:
+        # InMemoryL1's snapshot rewind cannot roll back EVM storage;
+        # refusing beats silently forking the two views of settlement
+        raise L1Error("reorg is not supported on EvmL1")

@@ -38,6 +38,8 @@ class RollupStore:
         #   (batch_number, prover_type) -> proof
         self.blobs: dict[int, object] = {}
         #   batch_number -> BlobsBundle (the L1 data-availability sidecar)
+        self._meta: dict = {}
+        #   sequencer checkpoints (deposit cursor, ...)
         self.lock = threading.RLock()
 
     # ---------------- batches ----------------
@@ -61,6 +63,32 @@ class RollupStore:
     def set_verified(self, number: int):
         with self.lock:
             self.batches[number].verified = True
+
+    def set_settlement(self, number: int, committed: bool | None = None,
+                       verified: bool | None = None):
+        """Flag-only settlement update (no commitment payload): the state
+        updater adopts/rolls back L1 settlement status through this so the
+        persistent store's write-through always sees it — mutating
+        `batch.committed` in place silently loses the flag on restart."""
+        with self.lock:
+            b = self.batches[number]
+            if committed is not None:
+                b.committed = committed
+            if verified is not None:
+                b.verified = verified
+
+    def delete_batch(self, number: int):
+        """Drop a batch and all its artifacts (proofs, prover inputs,
+        blobs) — the reorg path's last resort when a dropped commitment
+        cannot be re-submitted verbatim and the blocks must be re-batched
+        from scratch."""
+        with self.lock:
+            self.batches.pop(number, None)
+            for key in [k for k in self.prover_inputs if k[0] == number]:
+                self.prover_inputs.pop(key, None)
+            for key in [k for k in self.proofs if k[0] == number]:
+                self.proofs.pop(key, None)
+            self.blobs.pop(number, None)
 
     # ---------------- prover inputs ----------------
     def store_blobs_bundle(self, batch_number: int, bundle) -> None:
@@ -102,12 +130,10 @@ class RollupStore:
 
     # ---------------- sequencer checkpoints ----------------
     def get_meta(self, key: str, default=None):
-        return getattr(self, "_meta", {}).get(key, default)
+        return self._meta.get(key, default)
 
     def set_meta(self, key: str, value):
         with self.lock:
-            if not hasattr(self, "_meta"):
-                self._meta = {}
             self._meta[key] = value
 
 
@@ -124,7 +150,6 @@ class PersistentRollupStore(RollupStore):
         from ..storage.persistent import PersistentBackend
 
         self.backend = PersistentBackend(path)
-        self._meta = {}
         self._t_batches = self.backend.table("rollup_batches")
         self._t_inputs = self.backend.table("rollup_inputs")
         self._t_proofs = self.backend.table("rollup_proofs")
@@ -202,6 +227,25 @@ class PersistentRollupStore(RollupStore):
     def set_verified(self, number: int):
         super().set_verified(number)
         self._put_batch(self.batches[number])
+
+    def set_settlement(self, number: int, committed: bool | None = None,
+                       verified: bool | None = None):
+        super().set_settlement(number, committed=committed,
+                               verified=verified)
+        self._put_batch(self.batches[number])
+
+    def delete_batch(self, number: int):
+        with self.lock:
+            input_keys = [k for k in self.prover_inputs if k[0] == number]
+            proof_keys = [k for k in self.proofs if k[0] == number]
+            super().delete_batch(number)
+            self._t_batches.pop(str(number).encode(), None)
+            for n, ver in input_keys:
+                self._t_inputs.pop(f"{n}/{ver}".encode(), None)
+            for n, ptype in proof_keys:
+                self._t_proofs.pop(f"{n}/{ptype}".encode(), None)
+            self._t_blobs.pop(str(number).encode(), None)
+            self.backend.flush()
 
     def store_prover_input(self, batch_number: int, version: str,
                            program_input_json: dict):

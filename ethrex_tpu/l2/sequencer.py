@@ -9,8 +9,10 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import random
 import threading
 import time
+import types
 
 log = logging.getLogger("ethrex_tpu.l2.sequencer")
 
@@ -20,9 +22,19 @@ from ..guest.witness import generate_witness
 from ..node import Node
 from ..primitives.transaction import TYPE_PRIVILEGED, Transaction
 from ..prover import protocol
+from ..utils import faults
+from .eth_client import is_transient
 from .l1_client import L1Client
 from .proof_coordinator import ProofCoordinator
 from .rollup_store import Batch, RollupStore
+
+
+class SettlementDivergence(RuntimeError):
+    """The local settlement records and the L1 disagree about an
+    already-settled batch (same number, different commitment), or a batch
+    the L1 holds cannot be reproduced from the canonical chain.
+    Deliberately NOT a transient error: continuing would settle the L2 on
+    a fork, so the sequencer fails fast with a diagnostic instead."""
 
 
 @dataclasses.dataclass
@@ -34,9 +46,19 @@ class SequencerConfig:
     needed_prover_types: tuple = (protocol.PROVER_TPU,)
     commit_hash: str = protocol.PROTOCOL_VERSION
     # failure handling (reference: the fatal-subsystem cancellation token
-    # pattern, cmd/ethrex/ethrex.rs, + per-actor health endpoints)
+    # pattern, cmd/ethrex/ethrex.rs, + per-actor health endpoints).
+    # Deterministic errors (L1Error, logic bugs) burn max_actor_failures;
+    # transient ones (TransportError/ConnectionError/timeouts — an L1
+    # outage) get the much larger max_transient_failures budget plus
+    # jittered backoff, so a flaky L1 degrades instead of killing the
+    # sequencer (docs/L1_SETTLEMENT_RESILIENCE.md)
     max_actor_failures: int = 10
+    max_transient_failures: int = 200
     max_backoff_factor: int = 32
+    backoff_jitter: float = 0.25
+    # deposits shallower than this many L1 confirmations are not ingested
+    # (1 = included in any block; raise for reorg safety)
+    l1_confirmation_depth: int = 1
     # prover resilience (docs/PROVER_RESILIENCE.md): assignment lease
     # length (heartbeats extend it), the hard cap on how long heartbeats
     # can keep one assignment alive (None -> coordinator default of
@@ -53,20 +75,25 @@ class ActorHealth:
 
     name: str
     runs: int = 0
-    consecutive_failures: int = 0
+    consecutive_failures: int = 0        # deterministic errors
+    consecutive_transient: int = 0       # transport/connection errors
     last_error: str | None = None
+    last_error_class: str | None = None  # "transient" | "deterministic"
     last_success: float | None = None
 
     @property
     def healthy(self) -> bool:
-        return self.consecutive_failures == 0
+        return self.consecutive_failures == 0 \
+            and self.consecutive_transient == 0
 
     def to_json(self) -> dict:
         return {
             "healthy": self.healthy,
             "runs": self.runs,
             "consecutiveFailures": self.consecutive_failures,
+            "transientFailures": self.consecutive_transient,
             "lastError": self.last_error,
+            "lastErrorClass": self.last_error_class,
             "lastSuccess": self.last_success,
         }
 
@@ -125,6 +152,20 @@ class Sequencer:
         self.paused: set[str] = set()
         self._resume_at: dict[str, float] = {}
         self.stop_at_batch: int | None = None
+        # L1 settlement resilience (docs/L1_SETTLEMENT_RESILIENCE.md):
+        # batches whose commitment an L1 reorg dropped, queued for
+        # re-submission, plus the counters ethrex_health exposes
+        self._settlement_lock = threading.RLock()
+        self._recommit_queue: set[int] = set()
+        self.reorgs_total = 0
+        self.recommits_total = 0
+        self.commits_adopted_total = 0
+        self.rebuilt_batches_total = 0
+        self._backoff_rng = random.Random(0)
+        # startup reconciliation: close the crash window where the L1
+        # accepted settlement the local store never recorded, and refuse
+        # to run at all on a local/L1 divergence
+        self._reconcile_with_l1()
 
     def _regenerate_chain(self):
         """Re-import committed-batch blocks the chain store lost (crash
@@ -154,6 +195,143 @@ class Sequencer:
                 apply_fork_choice(self.node.store, tip, tip, tip)
         log.info("regenerated chain state up to block %d from rollup "
                  "checkpoints", self.node.store.latest_number())
+
+    # ------------------------------------------------------------------
+    # startup reconciliation (reference: state_updater.rs settlement
+    # reconciliation + l1_committer.rs ensure_checkpoint_for_committed_batch)
+    # ------------------------------------------------------------------
+    def _reconcile_with_l1(self) -> None:
+        """Compare local settlement records against the L1 at boot.
+
+        Three outcomes per batch: (a) L1 is ahead of the local store —
+        the commit-crash window; the missing batch record is rebuilt from
+        the canonical chain and adopted, instead of re-committing into a
+        permanent "out of order" fatal loop.  (b) Local flags lag the L1
+        (crash between commit/verify and the flag write) — adopted
+        through the store setters.  (c) The two records DIVERGE for the
+        same batch number — SettlementDivergence, fail fast."""
+        try:
+            l1_committed = self.l1.last_committed_batch()
+            l1_verified = self.l1.last_verified_batch()
+        except NotImplementedError:
+            return
+        except Exception as e:  # noqa: BLE001 — classify before giving up
+            if is_transient(e):
+                # L1 unreachable at boot: run anyway; the update_state
+                # actor reconciles as soon as it answers again
+                log.warning("L1 unreachable during startup "
+                            "reconciliation (%s); continuing", e)
+                return
+            raise
+        local = self.rollup.latest_batch_number()
+        for n in range(1, min(local, l1_committed) + 1):
+            batch = self.rollup.get_batch(n)
+            if batch is None or not batch.commitment:
+                continue
+            onchain = self.l1.get_committed_commitment(n)
+            if onchain is not None and onchain != batch.commitment:
+                raise SettlementDivergence(
+                    f"batch {n}: local commitment "
+                    f"{batch.commitment.hex()[:16]} != L1 commitment "
+                    f"{onchain.hex()[:16]} — the rollup store and the "
+                    f"settlement contract describe different chains; "
+                    f"refusing to settle on a fork")
+        for n in range(local + 1, l1_committed + 1):
+            self._rebuild_batch_from_l1(n)
+        for n in range(1, l1_committed + 1):
+            self._repair_partial_batch(n)
+        for n in sorted(self.rollup.batches):
+            b = self.rollup.get_batch(n)
+            if n <= l1_committed and not b.committed:
+                self.rollup.set_settlement(n, committed=True)
+            if n <= l1_verified and not b.verified:
+                self.rollup.set_settlement(n, verified=True)
+
+    def _repair_partial_batch(self, number: int) -> None:
+        """A narrower crash window: the batch record survived but the
+        crash lost its prover input and/or DA bundle (the writes after
+        store_batch).  Both are deterministic functions of the canonical
+        blocks, so they are recomputed — guarded by the commitment, which
+        must reproduce exactly."""
+        batch = self.rollup.get_batch(number)
+        if batch is None:
+            return
+        missing_input = self.rollup.get_prover_input(
+            number, self.cfg.commit_hash) is None
+        missing_blobs = self.rollup.get_blobs_bundle(number) is None
+        if not missing_input and not missing_blobs:
+            return
+        art = self._build_batch_artifacts(number, batch.first_block,
+                                          batch.last_block)
+        if art is None or (batch.commitment
+                           and art.commitment != batch.commitment):
+            raise SettlementDivergence(
+                f"batch {number} record is missing its "
+                f"{'prover input' if missing_input else 'DA bundle'} and "
+                f"the canonical chain no longer reproduces its commitment")
+        if missing_blobs:
+            self.rollup.store_blobs_bundle(number, art.bundle)
+        if missing_input:
+            self.rollup.store_prover_input(number, self.cfg.commit_hash,
+                                           art.program_input.to_json())
+        self.rebuilt_batches_total += 1
+        log.warning("repaired partial record of batch %d (rebuilt %s)",
+                    number,
+                    "input+blobs" if missing_input and missing_blobs
+                    else "input" if missing_input else "blobs")
+
+    def _rebuild_batch_from_l1(self, number: int) -> None:
+        """The verified crash window in commit_next_batch: the L1
+        accepted batch `number`, the process died before the rollup store
+        heard about it.  The blocks are still canonical, so the whole
+        batch record (witness, prover input, DA bundle, commitment) is
+        recomputed and checked against what the L1 actually settled."""
+        first = self.last_batched_block + 1
+        head = self.node.store.latest_number()
+        onchain_root = self.l1.get_committed_state_root(number)
+        onchain_commitment = self.l1.get_committed_commitment(number)
+        if onchain_root is None and onchain_commitment is None:
+            raise SettlementDivergence(
+                f"L1 has batch {number} committed but exposes neither its "
+                f"state root nor its commitment; cannot rebuild the lost "
+                f"batch record")
+        if onchain_root is not None:
+            candidates = [
+                b for b in range(first, head + 1)
+                if (blk := self.node.store.get_canonical_block(b))
+                is not None and blk.header.state_root == onchain_root]
+        else:
+            candidates = list(range(first, head + 1))
+        art = None
+        for last in candidates:
+            cand = self._build_batch_artifacts(number, first, last)
+            if cand is None:
+                continue
+            if onchain_commitment is not None \
+                    and cand.commitment != onchain_commitment:
+                continue
+            art = cand
+            break
+        if art is None:
+            raise SettlementDivergence(
+                f"L1 has batch {number} committed but no canonical block "
+                f"range [{first}..{head}] reproduces it — the chain store "
+                f"and the L1 describe different chains (or the chain tail "
+                f"was lost beyond recovery)")
+        last_block = art.blocks[-1].header.number
+        batch = Batch(number=number, first_block=first,
+                      last_block=last_block, state_root=art.state_root,
+                      commitment=art.commitment, vm_mode=art.vm_mode)
+        self.rollup.store_batch(batch)
+        self.rollup.store_blobs_bundle(number, art.bundle)
+        self.rollup.store_prover_input(number, self.cfg.commit_hash,
+                                       art.program_input.to_json())
+        self.rollup.set_committed(number, art.commitment)
+        self.last_batched_block = last_block
+        self.rebuilt_batches_total += 1
+        log.warning("rebuilt batch %d (blocks %d..%d) from the canonical "
+                    "chain after a commit-crash window", number, first,
+                    last_block)
 
     # ------------------------------------------------------------------
     # BlockProducer (reference: block_producer.rs produce_block)
@@ -187,8 +365,22 @@ class Sequencer:
         from .l1_client import make_deposit_tx
 
         with self._lock:
+            faults.inject("l1.get_deposits")
             deposits = self.l1.get_deposits(self._deposit_cursor)
+            depth = self.cfg.l1_confirmation_depth
+            head = None
+            if depth > 1:
+                try:
+                    head = self.l1.get_block_number()
+                except NotImplementedError:
+                    head = None  # L1 without a block surface: ingest all
             for dep in deposits:
+                if head is not None and dep.l1_block:
+                    if head - dep.l1_block + 1 < depth:
+                        # too shallow — a reorg could still drop it; later
+                        # deposits are younger still, so stop here to keep
+                        # the cursor contiguous
+                        break
                 tx = make_deposit_tx(self.node.config.chain_id, dep)
                 self.pending_privileged.append(tx)
                 self._deposit_cursor += 1
@@ -196,19 +388,18 @@ class Sequencer:
     # ------------------------------------------------------------------
     # L1Committer (reference: l1_committer.rs commit_next_batch_to_l1)
     # ------------------------------------------------------------------
-    def commit_next_batch(self) -> Batch | None:
-        if self.stop_at_batch is not None and \
-                self.rollup.latest_batch_number() + 1 > self.stop_at_batch:
-            return None    # admin stop-at: the committer idles here
-        head = self.node.store.latest_number()
-        first = self.last_batched_block + 1
-        if head < first:
-            return None
+    def _build_batch_artifacts(self, number: int, first: int,
+                               last: int) -> types.SimpleNamespace | None:
+        """Deterministically recompute everything batch `number` over
+        blocks [first, last] carries: witness, prover input, DA bundle,
+        commitment, vm mode.  Shared by the committer and startup
+        reconciliation — the same block range always reproduces the same
+        commitment, which is what makes commits idempotent and lost batch
+        records rebuildable."""
         blocks = [self.node.store.get_canonical_block(n)
-                  for n in range(first, head + 1)]
-        if any(b is None for b in blocks):
+                  for n in range(first, last + 1)]
+        if not blocks or any(b is None for b in blocks):
             return None
-        number = self.rollup.latest_batch_number() + 1
         coarse_log: list = []
         batch_receipts: list = []
         witness = generate_witness(self.node.chain, blocks,
@@ -241,7 +432,7 @@ class Sequencer:
         # for wire verifiers) — classified from the artifacts captured
         # during witness generation (no second execution), and derived
         # BEFORE the L1 call so a classifier error cannot break the
-        # L1-first commit ordering below
+        # L1-first commit ordering
         vm_mode = ""
         from ..prover import protocol as proto
 
@@ -253,30 +444,154 @@ class Sequencer:
             vm_mode = vm_mode_from_artifacts(
                 blocks, coarse_log, batch_receipts, witness,
                 parent.state_root)
-        # L1 first: only persist the batch once the commitment is accepted,
-        # otherwise a transient L1 failure would desync the batch counter
-        self.l1.commit_batch(number, state_root, commitment,
-                             privileged_hashes, msgs_root)
+        return types.SimpleNamespace(
+            blocks=blocks, program_input=program_input,
+            state_root=state_root, privileged_hashes=privileged_hashes,
+            msgs_root=msgs_root, bundle=bundle, commitment=commitment,
+            vm_mode=vm_mode)
+
+    def _settle_commit(self, number: int, commitment: bytes,
+                       state_root: bytes, privileged_hashes: list,
+                       msgs_root: bytes, bundle) -> None:
+        """Idempotent L1 commit: if the L1 already holds batch `number`
+        with OUR commitment (a retry after the commit tx landed but the
+        acknowledgment was lost), adopt it as success; a different
+        commitment is a divergence and fails fast.  The l1.commit fault
+        site fires on both legs — before the call (request lost) and
+        after it returns (response lost)."""
+        faults.inject("l1.commit")
+        if self.l1.last_committed_batch() >= number:
+            onchain = self.l1.get_committed_commitment(number)
+            if onchain != commitment:
+                raise SettlementDivergence(
+                    f"batch {number} already settled on L1 with a "
+                    f"different commitment "
+                    f"(l1={onchain.hex()[:16] if onchain else None} "
+                    f"local={commitment.hex()[:16]}); refusing to settle "
+                    f"on a fork")
+            with self._settlement_lock:
+                self.commits_adopted_total += 1
+            from ..utils.metrics import record_commit_adopted
+
+            record_commit_adopted()
+            log.warning("batch %d already committed on L1 with a matching "
+                        "commitment; adopting it as success", number)
+        else:
+            self.l1.commit_batch(number, state_root, commitment,
+                                 privileged_hashes, msgs_root)
+            faults.inject("l1.commit")
         try:
             # publish the DA sidecar alongside the commitment (the commit
             # tx is the blob carrier; based followers re-derive the chain
-            # from it — l2/based.py)
-            self.l1.publish_blobs(number, bundle)
+            # from it — l2/based.py); on the adopt path re-publish only
+            # if the first attempt died before the sidecar went out
+            if self.l1.get_blob_sidecar(number) is None:
+                self.l1.publish_blobs(number, bundle)
         except NotImplementedError:
             pass
+
+    def commit_next_batch(self) -> Batch | None:
+        with self._settlement_lock:
+            if self._recommit_queue:
+                # reorged-out commitments take priority over new batches
+                return self._recommit_batch(min(self._recommit_queue))
+        number = self.rollup.latest_batch_number() + 1
+        if self.stop_at_batch is not None and number > self.stop_at_batch:
+            return None    # admin stop-at: the committer idles here
+        if self.l1.last_committed_batch() >= number:
+            # the L1 already holds the batch we are about to build: a
+            # commit succeeded but its acknowledgment was lost before any
+            # local persistence.  Building a fresh batch now would span a
+            # WIDER block range (production kept going) and diverge —
+            # re-derive the settled record from the L1 instead, exactly
+            # like startup reconciliation
+            self._rebuild_batch_from_l1(number)
+            with self._settlement_lock:
+                self.commits_adopted_total += 1
+            from ..utils.metrics import record_batch, record_commit_adopted
+
+            record_commit_adopted()
+            record_batch(number)
+            return self.rollup.get_batch(number)
+        head = self.node.store.latest_number()
+        first = self.last_batched_block + 1
+        if head < first:
+            return None
+        art = self._build_batch_artifacts(number, first, head)
+        if art is None:
+            return None
+        # L1 first: only persist the batch once the commitment is accepted,
+        # otherwise a transient L1 failure would desync the batch counter
+        self._settle_commit(number, art.commitment, art.state_root,
+                            art.privileged_hashes, art.msgs_root,
+                            art.bundle)
         batch = Batch(number=number, first_block=first,
-                      last_block=head, state_root=state_root,
-                      commitment=commitment, vm_mode=vm_mode)
+                      last_block=head, state_root=art.state_root,
+                      commitment=art.commitment, vm_mode=art.vm_mode)
         self.rollup.store_batch(batch)
-        self.rollup.store_blobs_bundle(number, bundle)
+        self.rollup.store_blobs_bundle(number, art.bundle)
         self.rollup.store_prover_input(number, self.cfg.commit_hash,
-                                       program_input.to_json())
-        self.rollup.set_committed(number, commitment)
+                                       art.program_input.to_json())
+        self.rollup.set_committed(number, art.commitment)
         self.last_batched_block = head
         from ..utils.metrics import record_batch
 
         record_batch(number)
         return batch
+
+    def _recommit_batch(self, number: int) -> Batch | None:
+        """Re-submit a batch whose L1 commitment a reorg dropped.  The
+        stored record is re-committed VERBATIM (same commitment), so the
+        stored proofs stay valid and send_proofs can re-verify without
+        re-proving."""
+        batch = self.rollup.get_batch(number)
+        if batch is None:
+            self._recommit_queue.discard(number)
+            return None
+        bundle = self.rollup.get_blobs_bundle(number)
+        blocks = [self.node.store.get_canonical_block(n)
+                  for n in range(batch.first_block, batch.last_block + 1)]
+        if bundle is None or any(b is None for b in blocks):
+            # unusable record (partial persistence + reorg): drop it and
+            # every batch above, rewind, and re-batch from scratch
+            self._drop_batches_from(number)
+            return None
+        privileged_hashes = [
+            tx.hash for b in blocks for tx in b.body.transactions
+            if tx.tx_type == TYPE_PRIVILEGED]
+        from .messages import collect_messages, message_root
+
+        receipts = [self.node.store.get_receipts(b.hash) for b in blocks]
+        if any(r is None for r in receipts):
+            self._drop_batches_from(number)
+            return None
+        msgs_root = message_root(collect_messages(blocks, receipts))
+        self._settle_commit(number, batch.commitment, batch.state_root,
+                            privileged_hashes, msgs_root, bundle)
+        self.rollup.set_settlement(number, committed=True)
+        with self._settlement_lock:
+            self._recommit_queue.discard(number)
+            self.recommits_total += 1
+        from ..utils.metrics import record_recommit
+
+        record_recommit()
+        log.info("re-committed batch %d after an L1 reorg", number)
+        return batch
+
+    def _drop_batches_from(self, number: int) -> None:
+        """Reorg last resort: delete batch records from `number` up and
+        rewind last_batched_block so the normal committer re-batches the
+        (still canonical) blocks from scratch."""
+        with self._settlement_lock:
+            latest = self.rollup.latest_batch_number()
+            for n in range(number, latest + 1):
+                self.rollup.delete_batch(n)
+                self._recommit_queue.discard(n)
+            prev = self.rollup.get_batch(number - 1)
+            self.last_batched_block = prev.last_block if prev else 0
+            log.warning("dropped unusable batch records %d..%d after an "
+                        "L1 reorg; rewound last_batched_block to %d",
+                        number, latest, self.last_batched_block)
 
     # ------------------------------------------------------------------
     # L1ProofSender (reference: l1_proof_sender.rs — consecutive proven
@@ -343,7 +658,9 @@ class Sequencer:
                 get_backend(slot_type(n, t)).to_proof_bytes(
                     self.rollup.get_proof(n, slot_type(n, t)))
                 for n in range(first, last + 1)]
+        faults.inject("l1.verify")
         self.l1.verify_batches(first, last, proofs)
+        faults.inject("l1.verify")
         for n in range(first, last + 1):
             self.rollup.set_verified(n)
         return (first, last)
@@ -352,13 +669,52 @@ class Sequencer:
     # StateUpdater (reference: state_updater.rs)
     # ------------------------------------------------------------------
     def update_state(self):
+        """Reconcile local settlement flags with the L1 — in BOTH
+        directions.  Forward: adopt flags the L1 advanced past us (e.g.
+        another tooling path verified batches).  Backward: an L1 reorg
+        that regressed last_committed/verified drops the affected flags
+        through the write-through setters and queues the batches for
+        re-commit, so the committer re-settles them verbatim."""
         committed = self.l1.last_committed_batch()
         verified = self.l1.last_verified_batch()
-        for n, batch in list(self.rollup.batches.items()):
-            if n <= committed and not batch.committed:
-                batch.committed = True
-            if n <= verified and not batch.verified:
-                batch.verified = True
+        with self._settlement_lock:
+            reorged = False
+            for n in sorted(self.rollup.batches, reverse=True):
+                batch = self.rollup.get_batch(n)
+                if n > committed and batch.committed:
+                    # settlement regression: the commit tx reorged out
+                    self.rollup.set_settlement(n, committed=False,
+                                               verified=False)
+                    self._recommit_queue.add(n)
+                    reorged = True
+                    log.warning("L1 reorg dropped the commitment of batch "
+                                "%d; queued for re-commit", n)
+            for n in sorted(self.rollup.batches):
+                batch = self.rollup.get_batch(n)
+                if n <= committed and not batch.committed:
+                    onchain = self.l1.get_committed_commitment(n)
+                    if onchain is not None and batch.commitment \
+                            and onchain != batch.commitment:
+                        raise SettlementDivergence(
+                            f"batch {n} settled on L1 with a different "
+                            f"commitment (l1={onchain.hex()[:16]} "
+                            f"local={batch.commitment.hex()[:16]})")
+                    self.rollup.set_settlement(n, committed=True)
+                if n <= verified and not batch.verified:
+                    self.rollup.set_settlement(n, verified=True)
+                if n > verified and batch.verified:
+                    # the verify tx reorged out (commit may have
+                    # survived); send_proofs re-verifies from stored
+                    # proofs
+                    self.rollup.set_settlement(n, verified=False)
+                    reorged = True
+                    log.warning("L1 reorg dropped the verification of "
+                                "batch %d; will re-verify", n)
+            if reorged:
+                self.reorgs_total += 1
+                from ..utils.metrics import record_l1_reorg
+
+                record_l1_reorg()
 
     # ------------------------------------------------------------------
     def start(self):
@@ -370,10 +726,17 @@ class Sequencer:
 
             def run():
                 while True:
-                    # exponential backoff while an actor keeps failing
-                    factor = min(1 << st.consecutive_failures,
-                                 self.cfg.max_backoff_factor)
-                    if self._stop.wait(interval * factor):
+                    # exponential backoff while an actor keeps failing —
+                    # jittered so a fleet of actors hammered by the same
+                    # L1 outage doesn't retry in lockstep
+                    steps = min(st.consecutive_failures
+                                + st.consecutive_transient, 16)
+                    factor = min(1 << steps, self.cfg.max_backoff_factor)
+                    delay = interval * factor
+                    if factor > 1:
+                        delay *= 1 + self._backoff_rng.random() \
+                            * self.cfg.backoff_jitter
+                    if self._stop.wait(delay):
                         return
                     if st.name in self.paused or \
                             self._resume_at.get(st.name, 0) > time.time():
@@ -382,16 +745,35 @@ class Sequencer:
                         fn()
                         st.runs += 1
                         st.consecutive_failures = 0
+                        st.consecutive_transient = 0
                         st.last_success = time.time()
                     except Exception as e:  # noqa: BLE001 — actors survive
-                        st.consecutive_failures += 1
+                        # error classification: transient faults (network
+                        # flakes, injected drops — an L1 outage) get a far
+                        # larger failure budget than deterministic errors,
+                        # so an outage degrades instead of killing the
+                        # sequencer
+                        transient = is_transient(e)
+                        if transient:
+                            st.consecutive_transient += 1
+                            st.last_error_class = "transient"
+                            count = st.consecutive_transient
+                            budget = self.cfg.max_transient_failures
+                            from ..utils.metrics import \
+                                record_transient_error
+
+                            record_transient_error()
+                        else:
+                            st.consecutive_failures += 1
+                            st.last_error_class = "deterministic"
+                            count = st.consecutive_failures
+                            budget = self.cfg.max_actor_failures
                         st.last_error = f"{type(e).__name__}: {e}"
-                        log.warning("sequencer actor %s failed (%d/%d): %s",
-                                    st.name, st.consecutive_failures,
-                                    self.cfg.max_actor_failures,
-                                    st.last_error)
-                        if st.consecutive_failures >= \
-                                self.cfg.max_actor_failures:
+                        log.warning("sequencer actor %s failed "
+                                    "[%s %d/%d]: %s",
+                                    st.name, st.last_error_class,
+                                    count, budget, st.last_error)
+                        if count >= budget:
                             # fatal subsystem: cancel the whole sequencer
                             # (reference: cancellation token -> non-zero
                             # exit, ethrex.rs:208)

@@ -466,5 +466,15 @@ def _health(node):
             # prover pipeline resilience: lease/reassignment counters and
             # the poison-batch quarantine (docs/PROVER_RESILIENCE.md)
             "prover": seq.coordinator.stats_json(),
+            # L1 settlement resilience: reorg/recommit/adoption counters
+            # and the recommit backlog (docs/L1_SETTLEMENT_RESILIENCE.md)
+            "l1": {
+                "reorgs": seq.reorgs_total,
+                "recommitted": seq.recommits_total,
+                "adoptedCommits": seq.commits_adopted_total,
+                "rebuiltBatches": seq.rebuilt_batches_total,
+                "recommitQueue": sorted(seq._recommit_queue),
+                "confirmationDepth": seq.cfg.l1_confirmation_depth,
+            },
         }
     return out

@@ -6,12 +6,20 @@ points, which is a no-op until a plan is installed.  Same seed + same
 call sequence -> same fault schedule, so every failure mode in
 `tests/test_prover_chaos.py` replays deterministically.
 
-Injection points wired into the pipeline (see docs/PROVER_RESILIENCE.md):
+Injection points wired into the pipeline (see docs/PROVER_RESILIENCE.md
+and docs/L1_SETTLEMENT_RESILIENCE.md):
 
     proto.send              protocol.send_msg, after framing
     proto.recv              protocol.recv_msg / recv_msg_file, after read
     backend.prove           ProverClient around backend.prove
     coordinator.store_proof ProofCoordinator before rollup.store_proof
+    l1.commit               sequencer around L1Client.commit_batch; fires
+                            on BOTH legs — before the call (request lost)
+                            and after it returns (tx mined, receipt lost;
+                            pair with after=1 to target this leg)
+    l1.verify               sequencer around L1Client.verify_batches,
+                            same two-leg convention
+    l1.get_deposits         sequencer before L1Client.get_deposits
 
 Fault kinds:
 
@@ -33,6 +41,9 @@ SITES = frozenset({
     "proto.recv",
     "backend.prove",
     "coordinator.store_proof",
+    "l1.commit",
+    "l1.verify",
+    "l1.get_deposits",
 })
 
 KINDS = frozenset({"drop", "delay", "corrupt", "error"})
@@ -45,11 +56,12 @@ class InjectedFault(ConnectionError):
 
 class FaultRule:
     __slots__ = ("site", "kind", "p", "times", "seconds", "exc", "mutate",
-                 "fired")
+                 "after", "fired", "seen")
 
     def __init__(self, site: str, kind: str, p: float = 1.0,
                  times: int | None = None, seconds: float = 0.0,
-                 exc: BaseException | None = None, mutate=None):
+                 exc: BaseException | None = None, mutate=None,
+                 after: int = 0):
         if site not in SITES:
             raise ValueError(f"unknown injection site {site!r}")
         if kind not in KINDS:
@@ -61,7 +73,9 @@ class FaultRule:
         self.seconds = seconds  # delay kind
         self.exc = exc          # error kind
         self.mutate = mutate    # corrupt kind: payload -> payload
+        self.after = after      # skip the first N matching occasions
         self.fired = 0
+        self.seen = 0
 
 
 def _default_corrupt(payload):
@@ -101,22 +115,25 @@ class FaultPlan:
         return self
 
     def drop(self, site: str, p: float = 1.0,
-             times: int | None = None) -> "FaultPlan":
-        return self.add(FaultRule(site, "drop", p=p, times=times))
+             times: int | None = None, after: int = 0) -> "FaultPlan":
+        return self.add(FaultRule(site, "drop", p=p, times=times,
+                                  after=after))
 
     def delay(self, site: str, seconds: float, p: float = 1.0,
-              times: int | None = None) -> "FaultPlan":
+              times: int | None = None, after: int = 0) -> "FaultPlan":
         return self.add(FaultRule(site, "delay", p=p, times=times,
-                                  seconds=seconds))
+                                  seconds=seconds, after=after))
 
     def corrupt(self, site: str, p: float = 1.0, times: int | None = None,
-                mutate=None) -> "FaultPlan":
+                mutate=None, after: int = 0) -> "FaultPlan":
         return self.add(FaultRule(site, "corrupt", p=p, times=times,
-                                  mutate=mutate))
+                                  mutate=mutate, after=after))
 
     def error(self, site: str, exc: BaseException | None = None,
-              p: float = 1.0, times: int | None = None) -> "FaultPlan":
-        return self.add(FaultRule(site, "error", p=p, times=times, exc=exc))
+              p: float = 1.0, times: int | None = None,
+              after: int = 0) -> "FaultPlan":
+        return self.add(FaultRule(site, "error", p=p, times=times, exc=exc,
+                                  after=after))
 
     # -- firing ------------------------------------------------------------
     def fire(self, site: str, payload=None, kinds=None):
@@ -131,6 +148,9 @@ class FaultPlan:
                     continue  # nothing to corrupt at this call point
                 if rule.times is not None and rule.fired >= rule.times:
                     continue  # budget exhausted
+                rule.seen += 1
+                if rule.seen <= rule.after:
+                    continue  # occasion deliberately skipped (after=N)
                 if rule.p < 1.0 and self.rng.random() >= rule.p:
                     continue
                 rule.fired += 1

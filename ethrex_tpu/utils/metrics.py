@@ -112,6 +112,31 @@ def record_submit_rejected():
                 "failure)")
 
 
+def record_l1_reorg():
+    METRICS.inc("l1_reorgs_total", 1,
+                "L1 reorgs detected through a settlement regression "
+                "(last_committed/verified moved backwards)")
+
+
+def record_recommit():
+    METRICS.inc("batches_recommitted_total", 1,
+                "Batches re-committed verbatim after an L1 reorg dropped "
+                "their commitment")
+
+
+def record_commit_adopted():
+    METRICS.inc("l1_commits_adopted_total", 1,
+                "Commit attempts adopted as success because the L1 "
+                "already held a matching commitment (retry after a lost "
+                "acknowledgment)")
+
+
+def record_transient_error():
+    METRICS.inc("sequencer_transient_errors_total", 1,
+                "Sequencer actor iterations that failed with a transient "
+                "(network-class) error and were retried with backoff")
+
+
 def record_batch(batch_number: int, proving_time: float | None = None):
     METRICS.set("ethrex_l2_latest_batch", batch_number,
                 "Latest committed L2 batch")

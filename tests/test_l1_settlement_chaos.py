@@ -1,0 +1,486 @@
+"""L1 settlement chaos battery (docs/L1_SETTLEMENT_RESILIENCE.md):
+crash-window replays around the L1-first commit ordering, idempotent
+commit adoption when the acknowledgment is lost, L1 reorg drills
+(commit and verify transactions dropped, shallow deposits), and a
+flaky-L1 soak where the pipeline must fully settle without ever going
+fatal.  Every fault is driven by the seeded FaultPlan sites `l1.commit`,
+`l1.verify`, `l1.get_deposits`.
+
+Select alone with `-m chaos`; the whole battery is in the fast tier.
+"""
+
+import time
+
+import pytest
+
+from ethrex_tpu.guest.execution import ProgramInput
+from ethrex_tpu.l2.l1_client import InMemoryL1, PersistentInMemoryL1
+from ethrex_tpu.l2.rollup_store import PersistentRollupStore, RollupStore
+from ethrex_tpu.l2.sequencer import (Sequencer, SequencerConfig,
+                                     SettlementDivergence)
+from ethrex_tpu.node import Node
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.prover import protocol
+from ethrex_tpu.prover.backend import get_backend
+from ethrex_tpu.prover.client import ProverClient
+from ethrex_tpu.storage.persistent import PersistentBackend
+from ethrex_tpu.storage.store import Store
+from ethrex_tpu.utils import faults
+from ethrex_tpu.utils.faults import FaultPlan
+from tests.test_l2_pipeline import GENESIS, _transfer
+
+pytestmark = pytest.mark.chaos
+
+CFG = SequencerConfig(needed_prover_types=(protocol.PROVER_EXEC,))
+
+
+def _open_node(tmp_path):
+    store = Store(PersistentBackend(str(tmp_path / "chain.db")))
+    return Node(Genesis.from_json(GENESIS), store=store)
+
+
+def _prove(seq, number):
+    """Prove one committed batch directly with the exec backend (no
+    coordinator round-trip; the chaos here targets the L1 legs)."""
+    backend = get_backend(protocol.PROVER_EXEC)
+    stored = seq.rollup.get_prover_input(number, seq.cfg.commit_hash)
+    assert stored is not None, f"batch {number} has no prover input"
+    proof = backend.prove(ProgramInput.from_json(stored),
+                          protocol.FORMAT_STARK)
+    seq.rollup.store_proof(number, protocol.PROVER_EXEC, proof)
+
+
+def _settle(seq, l1):
+    """Prove every committed batch and drive send_proofs until the L1
+    has verified up to the local latest batch."""
+    latest = seq.rollup.latest_batch_number()
+    for n in range(l1.last_verified_batch() + 1, latest + 1):
+        if seq.rollup.get_proof(n, protocol.PROVER_EXEC) is None:
+            _prove(seq, n)
+    seq.send_proofs()
+    assert l1.last_verified_batch() == latest
+
+
+# ===========================================================================
+# crash windows: L1 accepted the commit, the process died before (some of)
+# the local persistence ran
+# ===========================================================================
+
+@pytest.mark.parametrize("died_at", ["store_batch", "store_blobs_bundle",
+                                     "store_prover_input", "set_committed"])
+def test_commit_crash_window_reconciled_on_restart(tmp_path, died_at):
+    """Kill the sequencer after l1.commit_batch but before `died_at`
+    persisted; restart on the same stores.  Startup reconciliation must
+    rebuild/repair the batch record from the canonical chain, adopt the
+    settled flags, never re-commit, and the batch must still settle to
+    fully verified."""
+    path = str(tmp_path / "rollup.db")
+    l1path = str(tmp_path / "l1.json")
+    node = _open_node(tmp_path)
+    l1 = PersistentInMemoryL1(l1path, [protocol.PROVER_EXEC])
+    rollup = PersistentRollupStore(path)
+    seq = Sequencer(node, l1, CFG, rollup=rollup)
+    node.submit_transaction(_transfer(0))
+    seq.produce_block()
+    node.store.flush()
+
+    class Killed(RuntimeError):
+        pass
+
+    def dying(*a, **kw):
+        raise Killed(f"process died at rollup.{died_at}")
+
+    setattr(rollup, died_at, dying)
+    with pytest.raises(Killed):
+        seq.commit_next_batch()
+    # the commit tx mined before the crash
+    assert l1.last_committed_batch() == 1
+    rollup.close()
+    node.store.backend.close()
+
+    node2 = _open_node(tmp_path)
+    l1b = PersistentInMemoryL1(l1path, [protocol.PROVER_EXEC])
+    rollup2 = PersistentRollupStore(path)
+    seq2 = Sequencer(node2, l1b, CFG, rollup=rollup2)
+    # the lost/partial record was rebuilt and adopted as committed
+    assert rollup2.latest_batch_number() == 1
+    b = rollup2.get_batch(1)
+    assert b.committed
+    assert l1b.get_committed_commitment(1) == b.commitment
+    assert rollup2.get_prover_input(1, CFG.commit_hash) is not None
+    assert rollup2.get_blobs_bundle(1) is not None
+    assert seq2.rebuilt_batches_total >= (0 if died_at == "set_committed"
+                                          else 1)
+    # no duplicate commit: the L1 still holds exactly one batch
+    assert seq2.commit_next_batch() is None
+    assert l1b.last_committed_batch() == 1
+    assert seq2.last_batched_block == node2.store.latest_number()
+    # and it settles end-to-end
+    _settle(seq2, l1b)
+    assert l1b.last_verified_batch() == rollup2.latest_batch_number() == 1
+    rollup2.close()
+    node2.store.backend.close()
+
+
+def test_crash_window_survives_second_restart(tmp_path):
+    """The reconciled state is durable: a second clean restart sees a
+    complete record and reconciliation is a no-op."""
+    path = str(tmp_path / "rollup.db")
+    l1path = str(tmp_path / "l1.json")
+    node = _open_node(tmp_path)
+    l1 = PersistentInMemoryL1(l1path, [protocol.PROVER_EXEC])
+    rollup = PersistentRollupStore(path)
+    seq = Sequencer(node, l1, CFG, rollup=rollup)
+    node.submit_transaction(_transfer(0))
+    seq.produce_block()
+    node.store.flush()
+    rollup.store_batch = lambda *a, **kw: (_ for _ in ()).throw(
+        RuntimeError("killed"))
+    with pytest.raises(RuntimeError):
+        seq.commit_next_batch()
+    rollup.close()
+    node.store.backend.close()
+
+    node2 = _open_node(tmp_path)
+    rollup2 = PersistentRollupStore(path)
+    seq2 = Sequencer(node2, PersistentInMemoryL1(
+        l1path, [protocol.PROVER_EXEC]), CFG, rollup=rollup2)
+    assert seq2.rebuilt_batches_total == 1
+    rollup2.close()
+    node2.store.backend.close()
+
+    node3 = _open_node(tmp_path)
+    rollup3 = PersistentRollupStore(path)
+    seq3 = Sequencer(node3, PersistentInMemoryL1(
+        l1path, [protocol.PROVER_EXEC]), CFG, rollup=rollup3)
+    assert seq3.rebuilt_batches_total == 0
+    assert rollup3.get_batch(1).committed
+    rollup3.close()
+    node3.store.backend.close()
+
+
+def test_divergent_local_commitment_fails_fast(tmp_path):
+    """Same batch number, different commitment on the two sides: the
+    sequencer must refuse to start rather than settle on a fork."""
+    path = str(tmp_path / "rollup.db")
+    node = _open_node(tmp_path)
+    l1 = InMemoryL1([protocol.PROVER_EXEC])
+    rollup = PersistentRollupStore(path)
+    seq = Sequencer(node, l1, CFG, rollup=rollup)
+    node.submit_transaction(_transfer(0))
+    seq.produce_block()
+    batch = seq.commit_next_batch()
+    assert batch is not None
+    # corrupt the on-chain record (a fork's different batch 1)
+    root, _ = l1.commitments[1]
+    l1.commitments[1] = (root, b"\xde\xad" * 16)
+    node.store.flush()
+    rollup.close()
+    node.store.backend.close()
+
+    node2 = _open_node(tmp_path)
+    rollup2 = PersistentRollupStore(path)
+    with pytest.raises(SettlementDivergence):
+        Sequencer(node2, l1, CFG, rollup=rollup2)
+    rollup2.close()
+    node2.store.backend.close()
+
+
+# ===========================================================================
+# idempotent commit: the two legs of the l1.commit fault site
+# ===========================================================================
+
+def _mini_l2():
+    node = Node(Genesis.from_json(GENESIS))
+    l1 = InMemoryL1([protocol.PROVER_EXEC])
+    seq = Sequencer(node, l1, CFG)
+    node.submit_transaction(_transfer(0))
+    seq.produce_block()
+    return node, l1, seq
+
+
+def test_commit_request_lost_is_retried_not_adopted():
+    """First leg: the commit request never reached the L1.  The retry
+    must be a real commit, not an adoption."""
+    node, l1, seq = _mini_l2()
+    try:
+        faults.install(FaultPlan(seed=3).drop("l1.commit", times=1))
+        with pytest.raises(faults.InjectedFault):
+            seq.commit_next_batch()
+        assert l1.last_committed_batch() == 0
+        assert seq.rollup.latest_batch_number() == 0
+        faults.clear()
+        batch = seq.commit_next_batch()
+        assert batch is not None and batch.number == 1
+        assert l1.last_committed_batch() == 1
+        assert seq.commits_adopted_total == 0
+    finally:
+        faults.clear()
+
+
+def test_commit_response_lost_is_adopted_not_duplicated():
+    """Second leg (after=1 skips the request leg): the commit tx mined,
+    the acknowledgment was lost before any local persistence.  The retry
+    must detect the matching on-chain commitment and adopt it."""
+    node, l1, seq = _mini_l2()
+    try:
+        faults.install(FaultPlan(seed=3).drop("l1.commit", times=1,
+                                              after=1))
+        with pytest.raises(faults.InjectedFault):
+            seq.commit_next_batch()
+        assert l1.last_committed_batch() == 1       # tx mined
+        assert seq.rollup.latest_batch_number() == 0  # nothing persisted
+        faults.clear()
+        batch = seq.commit_next_batch()
+        assert batch is not None and batch.number == 1
+        assert seq.commits_adopted_total == 1
+        assert l1.last_committed_batch() == 1       # no duplicate
+        b = seq.rollup.get_batch(1)
+        assert b.committed
+        assert l1.get_committed_commitment(1) == b.commitment
+        _settle(seq, l1)
+    finally:
+        faults.clear()
+
+
+# ===========================================================================
+# reorg drills
+# ===========================================================================
+
+def test_reorg_drops_commit_and_verify_then_recommitted():
+    """A depth-2 reorg unwinds both the verify and the commit block.
+    update_state detects the regression, rolls the flags back through the
+    store, queues the batch; the committer re-submits it VERBATIM and the
+    stored proof re-verifies without re-proving."""
+    node, l1, seq = _mini_l2()
+    assert seq.commit_next_batch().number == 1
+    _settle(seq, l1)
+    assert l1.last_verified_batch() == 1
+    commitment = seq.rollup.get_batch(1).commitment
+
+    l1.reorg(2)
+    assert l1.last_committed_batch() == 0
+    assert l1.last_verified_batch() == 0
+
+    seq.update_state()
+    assert seq.reorgs_total == 1
+    b = seq.rollup.get_batch(1)
+    assert not b.committed and not b.verified
+    assert 1 in seq._recommit_queue
+
+    batch = seq.commit_next_batch()     # drains the recommit queue first
+    assert batch is not None and batch.number == 1
+    assert batch.commitment == commitment   # verbatim re-submission
+    assert seq.recommits_total == 1
+    assert not seq._recommit_queue
+    assert l1.last_committed_batch() == 1
+    assert seq.rollup.get_batch(1).committed
+
+    assert seq.send_proofs() == (1, 1)  # stored proof still valid
+    assert l1.last_verified_batch() == 1
+    seq.update_state()
+    assert seq.rollup.get_batch(1).verified
+
+
+def test_reorg_drops_verify_only_reverified():
+    """A depth-1 reorg unwinds just the verify tx: the commitment
+    survives, only the verified flag rolls back and send_proofs
+    re-verifies."""
+    node, l1, seq = _mini_l2()
+    assert seq.commit_next_batch().number == 1
+    _settle(seq, l1)
+
+    l1.reorg(1)
+    assert l1.last_committed_batch() == 1
+    assert l1.last_verified_batch() == 0
+
+    seq.update_state()
+    assert seq.reorgs_total == 1
+    b = seq.rollup.get_batch(1)
+    assert b.committed and not b.verified
+    assert not seq._recommit_queue      # commit still settled
+
+    assert seq.send_proofs() == (1, 1)
+    assert l1.last_verified_batch() == 1
+
+
+def test_reorg_then_new_batches_settle_in_order():
+    """After a recommit the pipeline keeps going: new blocks batch and
+    settle on top of the re-settled batch."""
+    node, l1, seq = _mini_l2()
+    assert seq.commit_next_batch().number == 1
+    _settle(seq, l1)
+    l1.reorg(2)
+    seq.update_state()
+    assert seq.commit_next_batch().number == 1      # recommit
+    node.submit_transaction(_transfer(1))
+    seq.produce_block()
+    batch2 = seq.commit_next_batch()
+    assert batch2 is not None and batch2.number == 2
+    assert l1.last_committed_batch() == 2
+    _settle(seq, l1)
+    assert l1.last_verified_batch() == 2
+
+
+def test_shallow_deposit_not_ingested_before_confirmation():
+    """With l1_confirmation_depth=3, a fresh deposit (1 confirmation) is
+    not ingested; a reorg that drops it mints nothing; once re-deposited
+    and matured past the depth it is ingested exactly once."""
+    node = Node(Genesis.from_json(GENESIS))
+    l1 = InMemoryL1([protocol.PROVER_EXEC])
+    seq = Sequencer(node, l1, SequencerConfig(
+        needed_prover_types=(protocol.PROVER_EXEC,),
+        l1_confirmation_depth=3))
+    l1.deposit(b"\x61" * 20, 1000)
+    seq.watch_l1()
+    assert not seq.pending_privileged   # 1 confirmation < 3
+
+    l1.reorg(1)                         # the deposit block reorgs out
+    assert not l1.deposits
+    seq.watch_l1()
+    assert not seq.pending_privileged   # nothing minted from the orphan
+
+    l1.deposit(b"\x61" * 20, 1000)
+    seq.watch_l1()
+    assert not seq.pending_privileged   # still shallow
+    l1.advance_blocks(2)                # now 3 confirmations deep
+    seq.watch_l1()
+    assert len(seq.pending_privileged) == 1
+    seq.watch_l1()
+    assert len(seq.pending_privileged) == 1  # cursor advanced, no dup
+
+
+# ===========================================================================
+# flaky-L1 soak: sustained transient faults must degrade, not kill
+# ===========================================================================
+
+def test_flaky_l1_soak_settles_without_going_fatal():
+    """Live actor loops against an L1 dropping ~30% of commit/verify/
+    deposit calls (bounded fault budgets).  The sequencer must never set
+    `fatal`, classify the failures as transient, and fully settle once
+    the plan drains — with the l1/prover sections in ethrex_health."""
+    node = Node(Genesis.from_json(GENESIS))
+    l1 = InMemoryL1([protocol.PROVER_EXEC])
+    seq = Sequencer(node, l1, SequencerConfig(
+        needed_prover_types=(protocol.PROVER_EXEC,),
+        block_time=0.03, commit_interval=0.03, proof_send_interval=0.03,
+        watcher_interval=0.03, max_backoff_factor=2))
+    seq.stop_at_batch = 2       # bound the settlement target
+    for n in range(2):
+        node.submit_transaction(_transfer(n))
+    plan = faults.install(
+        FaultPlan(seed=11)
+        .drop("l1.commit", p=0.3, times=4)
+        .drop("l1.verify", p=0.3, times=4)
+        .drop("l1.get_deposits", p=0.3, times=4))
+    client = None
+    try:
+        seq.start()
+        client = ProverClient(protocol.PROVER_EXEC,
+                              [("127.0.0.1", seq.coordinator.port)],
+                              heartbeat_interval=0, backoff_base=0.01,
+                              rng_seed=2)
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            client.poll_once()
+            if seq.fatal is not None:
+                break
+            if l1.last_verified_batch() >= 2:
+                break
+            time.sleep(0.02)
+        assert seq.fatal is None, f"sequencer went fatal: {seq.fatal}"
+        assert l1.last_verified_batch() >= 2
+        assert l1.last_verified_batch() == l1.last_committed_batch()
+        # transient classification: no actor ever burned a deterministic
+        # failure from the injected drops
+        for st in seq.health.values():
+            assert st.consecutive_failures == 0
+        if plan.log:
+            assert any(st.last_error_class == "transient"
+                       for st in seq.health.values())
+        # health surface carries the settlement-resilience counters
+        from ethrex_tpu.rpc.server import _health
+
+        node.sequencer = seq
+        h = _health(node)
+        assert "l1" in h["l2"] and "prover" in h["l2"]
+        assert h["l2"]["l1"]["confirmationDepth"] == 1
+        assert h["l2"]["l1"]["recommitQueue"] == []
+    finally:
+        faults.clear()
+        seq.stop()
+
+
+def test_transient_budget_larger_than_deterministic():
+    """Unit check on the classification: a ConnectionError-class failure
+    burns the transient budget, an L1Error burns the deterministic one,
+    and only the latter reaches `fatal` quickly."""
+    node = Node(Genesis.from_json(GENESIS))
+    l1 = InMemoryL1([protocol.PROVER_EXEC])
+    seq = Sequencer(node, l1, SequencerConfig(
+        needed_prover_types=(protocol.PROVER_EXEC,),
+        watcher_interval=0.01, max_actor_failures=3,
+        max_transient_failures=50, max_backoff_factor=1))
+    try:
+        faults.install(FaultPlan(seed=5).drop("l1.get_deposits", times=10))
+        seq.start()
+        deadline = time.time() + 5.0
+        peak = 0
+        while time.time() < deadline:
+            st = seq.health.get("watch_l1")
+            if st is not None:
+                peak = max(peak, st.consecutive_transient)
+            if peak >= 4:
+                break
+            time.sleep(0.005)
+        st = seq.health["watch_l1"]
+        # more transient failures than the deterministic budget allows,
+        # yet the sequencer is still alive
+        assert peak >= 4 > 3
+        assert st.consecutive_failures == 0
+        assert st.last_error_class == "transient"
+        assert seq.fatal is None
+    finally:
+        faults.clear()
+        seq.stop()
+
+
+# ===========================================================================
+# satellite regressions
+# ===========================================================================
+
+def test_update_state_flags_persist_across_restart(tmp_path):
+    """update_state must adopt settlement flags through the write-through
+    setter: after a restart the adopted flags are still set (the old
+    in-place mutation silently skipped persistence)."""
+    path = str(tmp_path / "rollup.db")
+    node = _open_node(tmp_path)
+    l1 = InMemoryL1([protocol.PROVER_EXEC])
+    rollup = PersistentRollupStore(path)
+    seq = Sequencer(node, l1, CFG, rollup=rollup)
+    node.submit_transaction(_transfer(0))
+    seq.produce_block()
+    assert seq.commit_next_batch().number == 1
+    _settle(seq, l1)
+    # wind the LOCAL flags back (as if a crash lost the flag writes),
+    # then let update_state re-adopt them from the L1
+    rollup.set_settlement(1, committed=False, verified=False)
+    seq.update_state()
+    b = rollup.get_batch(1)
+    assert b.committed and b.verified
+    node.store.flush()
+    rollup.close()
+    node.store.backend.close()
+
+    rollup2 = PersistentRollupStore(path)
+    b2 = rollup2.get_batch(1)
+    assert b2.committed and b2.verified
+    rollup2.close()
+
+
+def test_rollup_store_meta_initialized_in_constructor():
+    rs = RollupStore()
+    assert rs._meta == {}
+    assert rs.get_meta("missing", 42) == 42
+    rs.set_meta("k", 7)
+    assert rs.get_meta("k") == 7

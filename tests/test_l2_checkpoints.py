@@ -106,6 +106,48 @@ def test_chain_regenerated_from_rollup_checkpoints(tmp_path):
     rollup2.close()
 
 
+def test_regenerated_chain_resumes_production(tmp_path):
+    """Regeneration is not just a restore: the sequencer must keep
+    producing and committing on top of the regenerated tail, and the
+    whole chain (regenerated batch included) must settle end-to-end."""
+    path = str(tmp_path / "rollup.db")
+    node = Node(Genesis.from_json(GENESIS))  # chain in memory: "lost"
+    l1 = InMemoryL1(needed_prover_types=[protocol.PROVER_EXEC])
+    rollup = PersistentRollupStore(path)
+    seq = Sequencer(node, l1, CFG, rollup=rollup)
+    node.submit_transaction(_transfer(0))
+    seq.produce_block()
+    assert seq.commit_next_batch().number == 1
+    head = node.store.latest_number()
+    rollup.close()
+
+    node2 = Node(Genesis.from_json(GENESIS))
+    rollup2 = PersistentRollupStore(path)
+    seq2 = Sequencer(node2, l1, CFG, rollup=rollup2)
+    assert node2.store.latest_number() == head
+    # production resumes on the regenerated tail
+    node2.submit_transaction(_transfer(1))
+    block = seq2.produce_block()
+    assert block.header.number == head + 1
+    batch2 = seq2.commit_next_batch()
+    assert batch2 is not None and batch2.number == 2
+    assert batch2.first_block == head + 1
+    assert l1.last_committed_batch() == 2
+    # and both batches (regenerated + fresh) settle to verified
+    from ethrex_tpu.guest.execution import ProgramInput
+    from ethrex_tpu.prover.backend import get_backend
+
+    backend = get_backend(protocol.PROVER_EXEC)
+    for n in (1, 2):
+        stored = rollup2.get_prover_input(n, CFG.commit_hash)
+        proof = backend.prove(ProgramInput.from_json(stored),
+                              protocol.FORMAT_STARK)
+        rollup2.store_proof(n, protocol.PROVER_EXEC, proof)
+    assert seq2.send_proofs() == (1, 2)
+    assert l1.last_verified_batch() == 2
+    rollup2.close()
+
+
 def test_deposit_cursor_checkpoint(tmp_path):
     path = str(tmp_path / "rollup.db")
     node = _open_node(tmp_path)

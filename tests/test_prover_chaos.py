@@ -571,3 +571,47 @@ def test_fault_guard_requires_cleanup():
     with faults.injected(FaultPlan(seed=1).drop("proto.send", times=1)):
         assert faults.active() is not None
     assert faults.active() is None
+
+
+def test_recv_drop_poll_fails_then_recovers():
+    """An inbound frame dropped mid-read (proto.recv) fails that poll
+    gracefully; once the fault budget drains the same client proves the
+    batch."""
+    node, l1, seq = _mini_l2((protocol.PROVER_EXEC,))
+    try:
+        faults.install(FaultPlan(seed=13).drop("proto.recv", times=1))
+        client = ProverClient(protocol.PROVER_EXEC, _endpoints(seq),
+                              heartbeat_interval=0, backoff_base=0.01,
+                              rng_seed=6)
+        assert client.poll_once() == 0      # the read died mid-frame
+        assert seq.rollup.get_proof(1, protocol.PROVER_EXEC) is None
+        time.sleep(0.03)                    # clear the backoff gate
+        _poll_until_proven(client, seq, protocol.PROVER_EXEC)
+        assert seq.send_proofs() == (1, 1)
+        assert l1.last_verified_batch() == 1
+    finally:
+        faults.clear()
+        seq.stop()
+
+
+def test_store_proof_crash_reassigned_after_lease_expiry():
+    """The coordinator crashing at rollup.store_proof
+    (coordinator.store_proof) loses the proof but not the lease
+    accounting: after expiry the batch is reassigned and settles."""
+    node, l1, seq = _mini_l2((protocol.PROVER_EXEC,),
+                             prover_lease_timeout=0.25)
+    try:
+        faults.install(
+            FaultPlan(seed=17).error("coordinator.store_proof", times=1))
+        client = ProverClient(protocol.PROVER_EXEC, _endpoints(seq),
+                              heartbeat_interval=0, backoff_base=0.01,
+                              rng_seed=7)
+        client.poll_once()                  # proof computed, store crashed
+        assert seq.rollup.get_proof(1, protocol.PROVER_EXEC) is None
+        time.sleep(0.3)                     # lease expires -> reassigned
+        _poll_until_proven(client, seq, protocol.PROVER_EXEC)
+        assert seq.send_proofs() == (1, 1)
+        assert l1.last_verified_batch() == 1
+    finally:
+        faults.clear()
+        seq.stop()

@@ -83,3 +83,72 @@ def test_prewarm_is_side_effect_free_and_counts():
         node.submit_transaction(t)
     blk = node.produce_block()
     assert len(blk.body.transactions) == 5
+
+
+def test_every_fault_site_has_chaos_coverage():
+    """Every registered fault-injection site must be exercised by at
+    least one chaos test, so a new site cannot land without battery
+    coverage."""
+    import glob
+    import os
+
+    from ethrex_tpu.utils import faults
+
+    here = os.path.dirname(__file__)
+    corpus = ""
+    for path in glob.glob(os.path.join(here, "test_*chaos*.py")):
+        with open(path) as f:
+            corpus += f.read()
+    missing = [s for s in sorted(faults.SITES) if f'"{s}"' not in corpus]
+    assert not missing, f"fault sites without chaos coverage: {missing}"
+
+
+def test_bench_probe_reports_failure_detail(monkeypatch):
+    """A degraded bench record must say WHY the backend probe failed —
+    the last exception line of the child's stderr, or the timeout."""
+    import subprocess
+
+    import bench
+
+    class Failed:
+        returncode = 1
+        stderr = (b"Traceback (most recent call last):\n"
+                  b'  File "<string>", line 1, in <module>\n'
+                  b"RuntimeError: no TPU devices found\n")
+
+    monkeypatch.setattr(bench.subprocess, "run",
+                        lambda *a, **kw: Failed())
+    assert bench.probe_backend_error() == "RuntimeError: no TPU devices found"
+    assert bench.probe_backend() is False
+
+    class Ok:
+        returncode = 0
+        stderr = b""
+
+    monkeypatch.setattr(bench.subprocess, "run", lambda *a, **kw: Ok())
+    assert bench.probe_backend_error() is None
+    assert bench.probe_backend() is True
+
+    def hang(*a, **kw):
+        raise subprocess.TimeoutExpired("probe", bench.PROBE_TIMEOUT)
+
+    monkeypatch.setattr(bench.subprocess, "run", hang)
+    err = bench.probe_backend_error()
+    assert err is not None and "TimeoutExpired" in err
+
+
+def test_fault_rule_after_skips_leading_occasions():
+    """after=N arms a rule only from the N+1th matching occasion — the
+    handle the chaos battery uses to hit the response leg of a two-leg
+    site like l1.commit."""
+    from ethrex_tpu.utils.faults import FaultPlan, InjectedFault
+
+    plan = FaultPlan(seed=0).drop("l1.commit", times=1, after=1)
+    assert plan.fire("l1.commit") is None          # leg 1: skipped
+    try:
+        plan.fire("l1.commit")                     # leg 2: fires
+        raise AssertionError("expected InjectedFault")
+    except InjectedFault:
+        pass
+    assert plan.fire("l1.commit") is None          # budget exhausted
+    assert plan.log == [("l1.commit", "drop")]
