@@ -140,12 +140,21 @@ def measure() -> None:
     warm = backend.prove(pi, "stark")
     assert warm.get("vm", {}).get("mode") == "transfer"
 
+    from ethrex_tpu.utils import tracing
+
     t0 = time.perf_counter()
-    proof = backend.prove(pi, "stark")
+    with tracing.span("bench.prove") as bench_span:
+        proof = backend.prove(pi, "stark")
     wall = time.perf_counter() - t0
     if not backend.verify(proof):
         print("self-verification failed", file=sys.stderr)
         sys.exit(4)
+
+    # per-stage breakdown from the profiling spans of the timed prove
+    stages = {}
+    if bench_span is not None:
+        stages = {k: round(v, 4) for k, v in sorted(
+            tracing.TRACER.stage_breakdown(bench_span.trace_id).items())}
 
     gas_per_sec = gas / wall
     print(json.dumps({
@@ -157,6 +166,7 @@ def measure() -> None:
         "num_txs": NUM_TXS,
         "gas_per_sec": round(gas_per_sec, 1),
         "proofs_per_hour_chip": round(3600.0 / wall, 2),
+        "stages": stages,
         "config": "BASELINE-1 (10-transfer block, vm mode, 3 STARKs)",
     }))
 
@@ -267,16 +277,24 @@ def measure_config4() -> None:
     backend = TpuBackend()
     warm = backend.prove(pi, "groth16")
     assert "groth16" in warm
+    from ethrex_tpu.utils import tracing
+
     t0 = time.perf_counter()
-    proof = backend.prove(pi, "groth16")
+    with tracing.span("bench.prove") as bench_span:
+        proof = backend.prove(pi, "groth16")
     wall = time.perf_counter() - t0
     if not backend.verify(proof):
         print("self-verification failed", file=sys.stderr)
         sys.exit(4)
+    stages = {}
+    if bench_span is not None:
+        stages = {k: round(v, 4) for k, v in sorted(
+            tracing.TRACER.stage_breakdown(bench_span.trace_id).items())}
     print(json.dumps({
         "metric": "groth16_wrap_prove_wall_s", "value": round(wall, 3),
         "unit": "s", "vs_baseline": 0.0,
         "batch_gas": block.header.gas_used,
+        "stages": stages,
         "config": "BASELINE-4 (config-1 batch, compressed + Groth16 wrap)",
     }))
 

@@ -328,6 +328,12 @@ class Blockchain:
         during execution, and REJECTS the block if the claim does not
         match — a tampered list cannot ride a valid block (reference:
         blockchain.rs:552 BAL validation)."""
+        import time as _time
+
+        from ..utils.metrics import (observe_block_execution,
+                                     observe_block_import)
+
+        t_import = _time.perf_counter()
         header = block.header
         parent = self.store.get_header(header.parent_hash)
         if parent is None:
@@ -351,8 +357,10 @@ class Blockchain:
                 recorder = BalRecorder()
                 state_db = self.store.state_db(parent.state_root)
                 self.warm_from_bal(state_db, bal)
+            t_exec = _time.perf_counter()
             outcome = self.execute_block(block, parent, state_db,
                                          bal_recorder=recorder)
+            observe_block_execution(_time.perf_counter() - t_exec)
             self._validate_block_outcome(header, outcome)
             if recorder is not None and \
                     recorder.build().hash() != bal.hash():
@@ -369,6 +377,7 @@ class Blockchain:
             self.store.discard_node_layer(header.number, header.hash)
             raise
         self.store.add_block(block, outcome.receipts)
+        observe_block_import(_time.perf_counter() - t_import)
 
     def generate_bal(self, block: Block, parent: BlockHeader):
         """Derive the block's EIP-7928 Block Access List (builder side:

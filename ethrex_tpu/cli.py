@@ -81,6 +81,15 @@ def _add_node_flags(parser: argparse.ArgumentParser):
     parser.add_argument("--metrics.port", dest="metrics_port", type=int,
                         default=_env_int("METRICS_PORT", 0),
                         help="Prometheus /metrics port (0 = off)")
+    parser.add_argument("--log-level", dest="log_level",
+                        choices=("debug", "info", "warning", "error"),
+                        default=_env("LOG_LEVEL", "info"),
+                        help="structured logger threshold")
+    parser.add_argument("--log-json", dest="log_json",
+                        action="store_true",
+                        default=_env("LOG_JSON") == "1",
+                        help="emit logs as one JSON object per line "
+                             "(with trace/span IDs when in context)")
     parser.add_argument("--authrpc.addr", dest="authrpc_addr",
                         default=_env("AUTHRPC_ADDR", "127.0.0.1"))
     parser.add_argument("--authrpc.port", dest="authrpc_port", type=int,
@@ -521,6 +530,12 @@ def main(argv=None):
     p_mon.add_argument("--interval", type=float, default=2.0)
 
     args = parser.parse_args(argv)
+
+    # repl/monitor subcommands don't take the shared node flags
+    from .utils.tracing import setup_logging
+
+    setup_logging(getattr(args, "log_level", "info") or "info",
+                  json_mode=bool(getattr(args, "log_json", False)))
 
     def cmd_repl(a):
         from .utils.repl import run as repl_run

@@ -111,11 +111,12 @@ def _load():
                 build()
                 _lib = bind()
             except Exception as e:
-                import sys
+                import logging
 
                 err = getattr(e, "stderr", b"")
-                print("native EVM build failed, using pure Python: "
-                      f"{e} {err[-300:] if err else ''}", file=sys.stderr)
+                logging.getLogger("ethrex_tpu.native_vm").warning(
+                    "native EVM build failed, using pure Python: %s %s",
+                    e, err[-300:] if err else "")
                 _lib = False
     return _lib
 

@@ -37,7 +37,7 @@ import threading
 import time
 
 from ..prover import protocol
-from ..utils import faults
+from ..utils import faults, tracing
 from .rollup_store import RollupStore
 
 log = logging.getLogger("ethrex_tpu.l2.proof_coordinator")
@@ -83,6 +83,9 @@ class ProofCoordinator:
         self.lease_tokens: dict[tuple[int, str], str] = {}
         # (batch_number, prover_type) -> failed assignments (expiry/reject)
         self.failures: dict[tuple[int, str], int] = {}
+        # batch_number -> trace ID; one trace follows the batch through
+        # assign -> prove -> submit -> verify -> settle (docs/OBSERVABILITY.md)
+        self.batch_traces: dict[int, str] = {}
         self.quarantined: set[int] = set()
         self.reassignments_total = 0
         self.heartbeats_total = 0
@@ -189,6 +192,20 @@ class ProofCoordinator:
         self.assignments.pop(key, None)
         self.lease_tokens.pop(key, None)
         return self.assigned_at.pop(key, None)
+
+    def trace_for_batch(self, batch: int) -> str:
+        """The trace ID following this batch's proving lifecycle (created
+        on first assignment, reused on reassignment so retries land in
+        the same trace)."""
+        with self.lock:
+            tid = self.batch_traces.get(batch)
+            if tid is None:
+                tid = tracing.new_trace_id()
+                self.batch_traces[batch] = tid
+                if len(self.batch_traces) > 4096:
+                    for old in sorted(self.batch_traces)[:1024]:
+                        del self.batch_traces[old]
+            return tid
 
     def lease_token(self, batch: int, prover_type: str) -> str | None:
         """Token of the current lease holder for (batch, prover_type)."""
@@ -300,8 +317,13 @@ class ProofCoordinator:
             record_stale_submit()
             return {"type": protocol.ERROR,
                     "message": f"stale lease token for batch {batch}"}
-        proof = faults.inject("coordinator.store_proof", proof)
-        self.rollup.store_proof(batch, prover_type, proof)
+        with tracing.trace_context(msg.get("trace_id")
+                                   or self.batch_traces.get(batch),
+                                   msg.get("span_id")):
+            with tracing.span("prover.store_proof", batch=batch,
+                              prover_type=prover_type):
+                proof = faults.inject("coordinator.store_proof", proof)
+                self.rollup.store_proof(batch, prover_type, proof)
         with self.lock:
             started = self._clear_lease(key)
         if started is not None and holds_lease:
@@ -325,11 +347,18 @@ class ProofCoordinator:
             batch = self.next_batch_to_assign(prover_type)
             if batch is None:
                 return {"type": protocol.TYPE_NOT_NEEDED}
-            program_input = self.rollup.get_prover_input(
-                batch, self.commit_hash)
+            trace_id = self.trace_for_batch(batch)
+            assign_span = None
+            with tracing.trace_context(trace_id):
+                with tracing.span("prover.assign", batch=batch,
+                                  prover_type=prover_type) as sp:
+                    program_input = self.rollup.get_prover_input(
+                        batch, self.commit_hash)
+                    assign_span = sp.span_id if sp else None
             return {"type": protocol.INPUT_RESPONSE, "batch_id": batch,
                     "input": program_input, "format": self.proof_format,
-                    "lease_token": self.lease_token(batch, prover_type)}
+                    "lease_token": self.lease_token(batch, prover_type),
+                    "trace_id": trace_id, "span_id": assign_span}
         if mtype == protocol.HEARTBEAT:
             return self._handle_heartbeat(msg)
         if mtype == protocol.PROOF_SUBMIT:

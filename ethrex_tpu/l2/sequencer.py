@@ -22,7 +22,8 @@ from ..guest.witness import generate_witness
 from ..node import Node
 from ..primitives.transaction import TYPE_PRIVILEGED, Transaction
 from ..prover import protocol
-from ..utils import faults
+from ..utils import faults, tracing
+from ..utils.metrics import observe_actor_iteration
 from .eth_client import is_transient
 from .l1_client import L1Client
 from .proof_coordinator import ProofCoordinator
@@ -80,11 +81,24 @@ class ActorHealth:
     last_error: str | None = None
     last_error_class: str | None = None  # "transient" | "deterministic"
     last_success: float | None = None
+    # loop-iteration latency (failed iterations count too — a slow
+    # failure is still a stall)
+    timed_runs: int = 0
+    last_seconds: float | None = None
+    total_seconds: float = 0.0
+    max_seconds: float = 0.0
 
     @property
     def healthy(self) -> bool:
         return self.consecutive_failures == 0 \
             and self.consecutive_transient == 0
+
+    def note_duration(self, seconds: float):
+        self.timed_runs += 1
+        self.last_seconds = seconds
+        self.total_seconds += seconds
+        if seconds > self.max_seconds:
+            self.max_seconds = seconds
 
     def to_json(self) -> dict:
         return {
@@ -95,6 +109,13 @@ class ActorHealth:
             "lastError": self.last_error,
             "lastErrorClass": self.last_error_class,
             "lastSuccess": self.last_success,
+            "loop": {
+                "lastSeconds": self.last_seconds,
+                "avgSeconds": (self.total_seconds / self.timed_runs
+                               if self.timed_runs else None),
+                "maxSeconds": self.max_seconds if self.timed_runs
+                else None,
+            },
         }
 
 
@@ -644,7 +665,15 @@ class Sequencer:
                             proof, ProgramInput.from_json(stored))
                 return backend.verify(proof)
 
-            results = {n: check(n) for n in range(first, last + 1)}
+            results = {}
+            for n in range(first, last + 1):
+                # join the batch's proving trace (opened at assignment)
+                # so verification shows up in the same lifecycle trace
+                with tracing.trace_context(
+                        self.coordinator.batch_traces.get(n)):
+                    with tracing.span("proof.verify", batch=n,
+                                      prover_type=slot_type(n, t)):
+                        results[n] = check(n)
             if not all(results.values()):
                 # invalid proof: delete so the fleet re-proves (reference:
                 # distributed_proving.md:70-72)
@@ -662,7 +691,10 @@ class Sequencer:
         self.l1.verify_batches(first, last, proofs)
         faults.inject("l1.verify")
         for n in range(first, last + 1):
-            self.rollup.set_verified(n)
+            with tracing.trace_context(
+                    self.coordinator.batch_traces.get(n)):
+                with tracing.span("proof.settle", batch=n):
+                    self.rollup.set_verified(n)
         return (first, last)
 
     # ------------------------------------------------------------------
@@ -741,6 +773,7 @@ class Sequencer:
                     if st.name in self.paused or \
                             self._resume_at.get(st.name, 0) > time.time():
                         continue
+                    t0 = time.perf_counter()
                     try:
                         fn()
                         st.runs += 1
@@ -790,6 +823,10 @@ class Sequencer:
                             except Exception:  # noqa: BLE001 — not started
                                 pass
                             return
+                    finally:
+                        dt = time.perf_counter() - t0
+                        st.note_duration(dt)
+                        observe_actor_iteration(st.name, dt)
             t = threading.Thread(target=run, daemon=True)
             t.start()
             self._threads.append(t)

@@ -4,6 +4,7 @@ minus p2p which arrives with the sync rounds)."""
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 
@@ -14,6 +15,8 @@ from .blockchain.payload import build_payload, create_payload_header
 from .evm.executor import InvalidTransaction
 from .primitives.genesis import Genesis
 from .storage.store import Store
+
+log = logging.getLogger("ethrex_tpu.node")
 
 
 class Node:
@@ -168,7 +171,7 @@ class Node:
                                 deadline=time.monotonic()
                                 + block_time / 2)
                 except Exception as e:  # noqa: BLE001 — keep producing
-                    print(f"block production failed: {e}")
+                    log.warning("block production failed: %s", e)
 
         self._producer_thread = threading.Thread(target=loop, daemon=True)
         self._producer_thread.start()
@@ -181,7 +184,7 @@ class Node:
         if thread is not None:
             thread.join(timeout=30)
             if thread.is_alive():
-                print("warning: block producer did not stop within 30s")
+                log.warning("block producer did not stop within 30s")
                 return False
             self._producer_thread = None
         return True

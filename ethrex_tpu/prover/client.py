@@ -25,7 +25,7 @@ import threading
 import time
 
 from ..guest.execution import ProgramInput
-from ..utils import faults
+from ..utils import faults, tracing
 from . import protocol
 from .backend import ProverBackend, get_backend
 
@@ -196,6 +196,10 @@ class ProverClient:
             return 0
         batch_id = resp["batch_id"]
         lease_token = resp.get("lease_token")
+        # continue the trace the coordinator opened at assignment, so the
+        # whole batch lifecycle shares one trace ID across the TCP seam
+        trace_id = resp.get("trace_id")
+        parent_span = resp.get("span_id")
         program_input = ProgramInput.from_json(resp["input"])
         # heartbeats keep the coordinator lease alive through a long proof
         hb = None
@@ -205,25 +209,33 @@ class ProverClient:
                                   self.heartbeat_interval,
                                   lease_token=lease_token)
             hb.start()
-        try:
-            faults.inject("backend.prove")
-            proof = self.backend.prove(program_input, resp["format"])
-            proof = faults.inject("backend.prove", proof,
-                                  kinds=("corrupt",))
-        finally:
-            if hb is not None:
-                hb.stop()
-        # connection 2: submit over a fresh socket — the input-request
-        # connection may long since have died under the proof
-        with socket.create_connection((host, port), timeout=30) as sock:
-            protocol.send_msg(sock, {
-                "type": protocol.PROOF_SUBMIT,
-                "batch_id": batch_id,
-                "prover_type": self.backend.prover_type,
-                "proof": proof,
-                "lease_token": lease_token,
-            })
-            ack = protocol.recv_msg(sock)
+        with tracing.trace_context(trace_id, parent_span):
+            try:
+                with tracing.span("prover.prove", batch=batch_id,
+                                  backend=self.backend.prover_type):
+                    faults.inject("backend.prove")
+                    proof = self.backend.prove(program_input,
+                                               resp["format"])
+                    proof = faults.inject("backend.prove", proof,
+                                          kinds=("corrupt",))
+            finally:
+                if hb is not None:
+                    hb.stop()
+            # connection 2: submit over a fresh socket — the input-request
+            # connection may long since have died under the proof
+            with tracing.span("prover.submit", batch=batch_id) as sub:
+                with socket.create_connection((host, port),
+                                              timeout=30) as sock:
+                    protocol.send_msg(sock, {
+                        "type": protocol.PROOF_SUBMIT,
+                        "batch_id": batch_id,
+                        "prover_type": self.backend.prover_type,
+                        "proof": proof,
+                        "lease_token": lease_token,
+                        "trace_id": trace_id,
+                        "span_id": sub.span_id if sub else None,
+                    })
+                    ack = protocol.recv_msg(sock)
         if ack.get("type") == protocol.SUBMIT_ACK:
             self.proved.append(batch_id)
             return 1

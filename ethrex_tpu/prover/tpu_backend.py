@@ -87,6 +87,7 @@ from ..ops import babybear as bb
 from ..stark import prover as stark_prover
 from ..stark import verifier as stark_verifier
 from ..stark.prover import StarkParams
+from ..utils import tracing
 from . import protocol
 from .backend import ProverBackend
 
@@ -401,6 +402,13 @@ class TpuBackend(ProverBackend):
         self.mesh = mesh
 
     def prove(self, program_input: ProgramInput, proof_format: str) -> dict:
+        # one root span per prove so per-stage child spans form a single
+        # subtree even when no caller opened a trace (e.g. bench)
+        with tracing.span("backend.prove", format=proof_format):
+            return self._prove_impl(program_input, proof_format)
+
+    def _prove_impl(self, program_input: ProgramInput,
+                    proof_format: str) -> dict:
         from ..guest import transfer_log as tl_mod
         from ..guest.witness_oracles import WitnessOracles
         from ..models import token_air as tka
@@ -408,30 +416,35 @@ class TpuBackend(ProverBackend):
 
         blocks_log: list = []
         receipts: list = []
-        output = execution_program(program_input, write_log=blocks_log,
-                                   receipts_out=receipts)
-        encoded = output.encode()
+        with tracing.span("prove.execute", stage="execute"):
+            output = execution_program(program_input,
+                                       write_log=blocks_log,
+                                       receipts_out=receipts)
+            encoded = output.encode()
 
-        vm_batch = None
-        try:
-            oracles = WitnessOracles(program_input.witness,
-                                     output.initial_state_root)
-            vm_batch = tl_mod.build_vm_batch(program_input.blocks,
-                                             blocks_log, receipts,
-                                             oracles=oracles)
-            blocks_log = vm_batch.blocks_log
-        except tl_mod.NotTransferBatch:
-            pass
+            vm_batch = None
+            try:
+                oracles = WitnessOracles(program_input.witness,
+                                         output.initial_state_root)
+                vm_batch = tl_mod.build_vm_batch(program_input.blocks,
+                                                 blocks_log, receipts,
+                                                 oracles=oracles)
+                blocks_log = vm_batch.blocks_log
+            except tl_mod.NotTransferBatch:
+                pass
 
-        entries = access_log.flatten_entries(blocks_log)
-        records, r_pre, r_post, depth = \
-            access_log.build_access_records(entries)
-        S = _schedule_for(depth)
-        air = sua.StateUpdateAir(depth, seg_periods=S)
-        trace = sua.generate_state_update_trace(records, r_pre, depth, S)
-        pub = sua.state_update_public_inputs(records, r_pre, r_post, S)
-        state_proof = stark_prover.prove(air, trace, pub, PARAMS,
-                                 mesh=self.mesh)
+        with tracing.span("prove.state_proof", stage="state_proof"):
+            entries = access_log.flatten_entries(blocks_log)
+            records, r_pre, r_post, depth = \
+                access_log.build_access_records(entries)
+            S = _schedule_for(depth)
+            air = sua.StateUpdateAir(depth, seg_periods=S)
+            trace = sua.generate_state_update_trace(records, r_pre,
+                                                    depth, S)
+            pub = sua.state_update_public_inputs(records, r_pre,
+                                                 r_post, S)
+            state_proof = stark_prover.prove(air, trace, pub, PARAMS,
+                                             mesh=self.mesh)
         digest = pub[16:24]
 
         vm_pub = None
@@ -444,38 +457,43 @@ class TpuBackend(ProverBackend):
         bc_proofs: list = []
         bc_airs: list = []
         if vm_batch is not None:
-            vm_air = ta.TransferAir()
-            vm_trace = ta.generate_transfer_trace(vm_batch.segs)
-            vm_pub = ta.transfer_public_inputs(vm_batch.segs)
-            vm_proof = stark_prover.prove(vm_air, vm_trace, vm_pub,
-                              PARAMS, mesh=self.mesh)
-            if vm_batch.tok_segs:
-                tok_air = tka.TokenAir()
-                tok_trace = tka.generate_token_trace(vm_batch.tok_segs)
-                tok_pub = tka.token_public_inputs(vm_batch.tok_segs)
-                tok_proof = stark_prover.prove(tok_air, tok_trace,
-                                               tok_pub, PARAMS,
-                                               mesh=self.mesh)
-            if vm_batch.bc_calls:
-                from ..models import bytecode_air as bca
+            with tracing.span("prove.vm_proofs", stage="vm_circuits"):
+                vm_air = ta.TransferAir()
+                vm_trace = ta.generate_transfer_trace(vm_batch.segs)
+                vm_pub = ta.transfer_public_inputs(vm_batch.segs)
+                vm_proof = stark_prover.prove(vm_air, vm_trace, vm_pub,
+                                              PARAMS, mesh=self.mesh)
+                if vm_batch.tok_segs:
+                    tok_air = tka.TokenAir()
+                    tok_trace = tka.generate_token_trace(
+                        vm_batch.tok_segs)
+                    tok_pub = tka.token_public_inputs(vm_batch.tok_segs)
+                    tok_proof = stark_prover.prove(tok_air, tok_trace,
+                                                   tok_pub, PARAMS,
+                                                   mesh=self.mesh)
+                if vm_batch.bc_calls:
+                    from ..models import bytecode_air as bca
 
-                for call in vm_batch.bc_calls:
-                    air_bc = bca.BytecodeAir()
-                    bc_trace = bca.generate_bytecode_trace(call.steps,
-                                                           call.snaps)
-                    pub_bc = bca.bytecode_public_inputs(call.steps)
-                    bc_airs.append(air_bc)
-                    bc_pubs.append(pub_bc)
-                    bc_proofs.append(stark_prover.prove(
-                        air_bc, bc_trace, pub_bc, PARAMS, mesh=self.mesh))
+                    for call in vm_batch.bc_calls:
+                        air_bc = bca.BytecodeAir()
+                        bc_trace = bca.generate_bytecode_trace(
+                            call.steps, call.snaps)
+                        pub_bc = bca.bytecode_public_inputs(call.steps)
+                        bc_airs.append(air_bc)
+                        bc_pubs.append(pub_bc)
+                        bc_proofs.append(stark_prover.prove(
+                            air_bc, bc_trace, pub_bc, PARAMS,
+                            mesh=self.mesh))
 
-        limbs = binding_limbs(encoded, r_pre, r_post, digest, vm_pub,
-                              tok_pub, bc_pubs)
-        bind_air = pair.Poseidon2SpongeAir(num_chunks=len(limbs) // 8)
-        bind_trace = pair.generate_sponge_trace(limbs)
-        bind_pub = pair.sponge_public_inputs(limbs)
-        bind_proof = stark_prover.prove(bind_air, bind_trace, bind_pub,
-                                        PARAMS, mesh=self.mesh)
+        with tracing.span("prove.binding", stage="binding"):
+            limbs = binding_limbs(encoded, r_pre, r_post, digest, vm_pub,
+                                  tok_pub, bc_pubs)
+            bind_air = pair.Poseidon2SpongeAir(num_chunks=len(limbs) // 8)
+            bind_trace = pair.generate_sponge_trace(limbs)
+            bind_pub = pair.sponge_public_inputs(limbs)
+            bind_proof = stark_prover.prove(bind_air, bind_trace,
+                                            bind_pub, PARAMS,
+                                            mesh=self.mesh)
         proof = {
             "backend": self.prover_type,
             "format": proof_format,
@@ -509,7 +527,8 @@ class TpuBackend(ProverBackend):
                 proofs.append(tok_proof)
             airs.extend(bc_airs)
             proofs.extend(bc_proofs)
-            agg = agg_mod.aggregate(airs, proofs, PARAMS)
+            with tracing.span("prove.aggregate", stage="aggregate"):
+                agg = agg_mod.aggregate(airs, proofs, PARAMS)
             proof["state_proof"], proof["proof"] = agg.inners[:2]
             cursor = 2
             if vm_batch is not None:
@@ -528,10 +547,14 @@ class TpuBackend(ProverBackend):
             if proof_format == protocol.FORMAT_GROTH16:
                 from . import groth16_wrap
 
-                wrapped = groth16_wrap.wrap_prove(
-                    [int(v) for v in agg.outer["pub_inputs"]],
-                    rnd=encoded[:32])
-                proof["groth16"] = groth16_wrap.proof_to_json(wrapped)
+                # proof_to_json stays inside the span: it is what forces
+                # any still-in-flight device work to the host
+                with tracing.span("prove.groth16_wrap",
+                                  stage="groth16_wrap"):
+                    wrapped = groth16_wrap.wrap_prove(
+                        [int(v) for v in agg.outer["pub_inputs"]],
+                        rnd=encoded[:32])
+                    proof["groth16"] = groth16_wrap.proof_to_json(wrapped)
         return proof
 
     # -- verification -------------------------------------------------------

@@ -6,10 +6,22 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..utils.metrics import observe_rpc_request
+from ..utils.tracing import TRACER
 
 from .eth import (CLIENT_NAME, CLIENT_VERSION, EthApi,
                   RpcError)  # noqa: F401 (RpcError used below)
+
+
+class _Httpd(ThreadingHTTPServer):
+    # The socketserver default backlog of 5 lets the kernel RST
+    # connections when a burst of clients connects faster than the
+    # accept loop drains (the reset shows up client-side as
+    # ConnectionResetError 104, not a clean HTTP error).
+    request_queue_size = 128
 
 
 class RpcServer:
@@ -127,6 +139,11 @@ class RpcServer:
                                             *(a[:1] or (0,))),
             "ethrex_adminSetStopAtBatch":
                 lambda n=None: _admin_stop_at(self, node, n),
+            # tracing namespace: serve the in-process trace ring buffer
+            "ethrex_trace_recentTraces":
+                lambda limit=None: TRACER.recent(_trace_limit(limit)),
+            "ethrex_trace_slowest":
+                lambda limit=None: TRACER.slowest(_trace_limit(limit)),
         }
 
     def handle(self, request: dict):
@@ -138,6 +155,7 @@ class RpcServer:
         fn = self.methods.get(method)
         if fn is None:
             return _err(rid, -32601, f"method {method} not found")
+        t0 = time.perf_counter()
         try:
             result = fn(*params)
             return {"jsonrpc": "2.0", "id": rid, "result": result}
@@ -147,6 +165,9 @@ class RpcServer:
             return _err(rid, -32602, f"invalid params: {ex}")
         except Exception as ex:  # noqa: BLE001 — RPC boundary
             return _err(rid, -32603, f"internal error: {ex}")
+        finally:
+            # known methods only, so label cardinality stays bounded
+            observe_rpc_request(method, time.perf_counter() - t0)
 
     # ------------------------------------------------------------------
     def start(self):
@@ -185,7 +206,7 @@ class RpcServer:
             def log_message(self, *args):
                 pass
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._httpd = _Httpd((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]
         thread = threading.Thread(target=self._httpd.serve_forever,
                                   daemon=True)
@@ -443,11 +464,24 @@ def _admin_stop_at(server, node, n):
             else hx(seq.stop_at_batch)}
 
 
+def _trace_limit(limit) -> int:
+    """ethrex_trace_* limit param: JSON int or 0x-quantity, default 20."""
+    if limit is None:
+        return 20
+    if isinstance(limit, str):
+        from .serializers import parse_quantity
+
+        return parse_quantity(limit)
+    return int(limit)
+
+
 def _health(node):
     out = {
         "head": node.store.latest_number(),
         "mempool": len(node.mempool),
         "peers": _peer_count(node),
+        "tracing": {"bufferedTraces": len(TRACER),
+                    "droppedTraces": TRACER.dropped},
     }
     seq = getattr(node, "sequencer", None)
     if seq is not None:

@@ -33,6 +33,7 @@ from ..ops import fri
 from ..ops import merkle
 from ..ops import ntt
 from ..ops.challenger import Challenger
+from ..utils import tracing
 from .air import Air, DeviceOps
 
 
@@ -296,66 +297,86 @@ def _prove(air: Air, trace: np.ndarray, pub_inputs: list[int],
     ch.absorb_elems([n, w, B])
     ch.absorb_elems([v % bb.P for v in pub_inputs])
 
+    # Stage spans are block_until_ready()-bounded so JAX async dispatch
+    # cannot attribute device time to the wrong stage.  The LDE and the
+    # Merkle tree are fused into one XLA program (p_commit), so the
+    # merkle_commit span measures the residual wait after the LDE
+    # outputs are ready — near zero when the fusion wins.
     # ---- 1. trace commitment --------------------------------------------
-    cols = bb.to_mont(jnp.asarray(trace.T.astype(np.uint32)))       # (w, n)
-    lde_cols, lde_rows, levels_t = p_commit(cols)
-    trace_root = levels_t[-1][0]
-    ch.absorb_digest(trace_root)
+    with tracing.span("prove.trace_lde", stage="trace_lde",
+                      width=w, n=n):
+        cols = bb.to_mont(jnp.asarray(trace.T.astype(np.uint32)))   # (w, n)
+        lde_cols, lde_rows, levels_t = p_commit(cols)
+        jax.block_until_ready((lde_cols, lde_rows))
+    with tracing.span("prove.merkle_commit", stage="merkle_commit"):
+        jax.block_until_ready(levels_t)
+        trace_root = levels_t[-1][0]
+        ch.absorb_digest(trace_root)
     alpha = ch.sample_ext()
 
     # ---- 2. constraint quotient -----------------------------------------
-    bounds = air.boundaries(pub_inputs, n)
-    bound_vals = bb.to_mont(jnp.asarray(
-        np.array([v % bb.P for (_, _, v) in bounds], dtype=np.uint32)))
-    chunks, q_lde, q_rows, levels_q = p_quotient(
-        lde_cols, ext.to_device(alpha), bound_vals)
-    q_root = levels_q[-1][0]
-    ch.absorb_digest(q_root)
+    with tracing.span("prove.quotient", stage="quotient"):
+        bounds = air.boundaries(pub_inputs, n)
+        bound_vals = bb.to_mont(jnp.asarray(
+            np.array([v % bb.P for (_, _, v) in bounds],
+                     dtype=np.uint32)))
+        chunks, q_lde, q_rows, levels_q = p_quotient(
+            lde_cols, ext.to_device(alpha), bound_vals)
+        jax.block_until_ready(levels_q)
+        q_root = levels_q[-1][0]
+        ch.absorb_digest(q_root)
     zeta = ch.sample_ext()
 
     # ---- 3. out-of-domain openings --------------------------------------
-    zeta_g = ext.h_mul(zeta, ext.h_from_base(g_n))
-    t_z_dev, t_zg_dev, q_z_dev = p_open(
-        cols, chunks, ext.to_device(zeta), ext.to_device(zeta_g))
-    t_at_z = [tuple(int(x) for x in row) for row in _canon(t_z_dev)]
-    t_at_zg = [tuple(int(x) for x in row) for row in _canon(t_zg_dev)]
-    q_at_z = [tuple(int(x) for x in row) for row in _canon(q_z_dev)]
-    for tup in t_at_z + t_at_zg + q_at_z:
-        ch.absorb_ext(tup)
+    with tracing.span("prove.openings", stage="openings"):
+        zeta_g = ext.h_mul(zeta, ext.h_from_base(g_n))
+        t_z_dev, t_zg_dev, q_z_dev = p_open(
+            cols, chunks, ext.to_device(zeta), ext.to_device(zeta_g))
+        t_at_z = [tuple(int(x) for x in row) for row in _canon(t_z_dev)]
+        t_at_zg = [tuple(int(x) for x in row)
+                   for row in _canon(t_zg_dev)]
+        q_at_z = [tuple(int(x) for x in row) for row in _canon(q_z_dev)]
+        for tup in t_at_z + t_at_zg + q_at_z:
+            ch.absorb_ext(tup)
     gamma = ch.sample_ext()
 
     # ---- 4. DEEP composition + 5. FRI ------------------------------------
-    F = p_deep(lde_rows, q_lde, t_z_dev, t_zg_dev, q_z_dev,
-               ext.to_device(zeta), ext.to_device(zeta_g),
-               ext.to_device(gamma))
-    fparams = fri.FriParams(
-        log_blowup=lb, num_queries=params.num_queries,
-        log_final_size=params.log_final_size, shift=shift,
-        grinding_bits=params.grinding_bits,
-    )
-    fprover = fri.FriProver(fparams, mesh=mesh)
-    fri_proof, indices = fprover.prove(F, ch)
+    with tracing.span("prove.fri_fold", stage="fri_fold"):
+        F = p_deep(lde_rows, q_lde, t_z_dev, t_zg_dev, q_z_dev,
+                   ext.to_device(zeta), ext.to_device(zeta_g),
+                   ext.to_device(gamma))
+        fparams = fri.FriParams(
+            log_blowup=lb, num_queries=params.num_queries,
+            log_final_size=params.log_final_size, shift=shift,
+            grinding_bits=params.grinding_bits,
+        )
+        fprover = fri.FriProver(fparams, mesh=mesh)
+        # FriProver.prove returns host-side data, so the span is
+        # implicitly device-bounded
+        fri_proof, indices = fprover.prove(F, ch)
 
     # ---- openings of trace/quotient at the query indices -----------------
-    rows_np, q_rows_np, lt_np, lq_np = jax.device_get(
-        (lde_rows, q_rows, tuple(levels_t), tuple(levels_q)))
-    lde_rows_c = bb.from_mont_host(rows_np)
-    q_rows_c = bb.from_mont_host(q_rows_np)
-    levels_t_c = [bb.from_mont_host(l) for l in lt_np]
-    levels_q_c = [bb.from_mont_host(l) for l in lq_np]
-    half = N // 2
-    openings = []
-    for q in indices:
-        entry = {}
-        for name, rows_c, levels_c in (
-            ("trace", lde_rows_c, levels_t_c),
-            ("quotient", q_rows_c, levels_q_c),
-        ):
-            for tag, idx in (("lo", q), ("hi", q + half)):
-                entry[f"{name}_{tag}"] = [int(v) for v in rows_c[idx]]
-                entry[f"{name}_{tag}_path"] = merkle.open_path_canonical(
-                    levels_c, idx)
-        openings.append(entry)
+    with tracing.span("prove.query", stage="query",
+                      num_queries=params.num_queries):
+        rows_np, q_rows_np, lt_np, lq_np = jax.device_get(
+            (lde_rows, q_rows, tuple(levels_t), tuple(levels_q)))
+        lde_rows_c = bb.from_mont_host(rows_np)
+        q_rows_c = bb.from_mont_host(q_rows_np)
+        levels_t_c = [bb.from_mont_host(l) for l in lt_np]
+        levels_q_c = [bb.from_mont_host(l) for l in lq_np]
+        half = N // 2
+        openings = []
+        for q in indices:
+            entry = {}
+            for name, rows_c, levels_c in (
+                ("trace", lde_rows_c, levels_t_c),
+                ("quotient", q_rows_c, levels_q_c),
+            ):
+                for tag, idx in (("lo", q), ("hi", q + half)):
+                    entry[f"{name}_{tag}"] = [int(v) for v in rows_c[idx]]
+                    entry[f"{name}_{tag}_path"] = \
+                        merkle.open_path_canonical(levels_c, idx)
+            openings.append(entry)
 
     return {
         "n": n, "width": w, "log_blowup": lb,

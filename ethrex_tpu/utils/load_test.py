@@ -115,7 +115,9 @@ def main(argv=None):
                         default="transfer")
     args = parser.parse_args(argv)
     result = run_load(args.url, int(args.key, 16), args.txs, args.mode)
-    print(json.dumps(result, indent=2))
+    import sys
+
+    sys.stdout.write(json.dumps(result, indent=2) + "\n")
 
 
 if __name__ == "__main__":

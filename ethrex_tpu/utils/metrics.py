@@ -7,14 +7,51 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+# Fixed exponential buckets: 1ms * 2^i, spanning ~1ms .. ~524s.  One
+# shared ladder keeps every latency histogram comparable and the
+# exposition size bounded.
+DEFAULT_BUCKETS = tuple(0.001 * 2 ** i for i in range(20))
+
+
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_labels(labels: tuple) -> str:
+    return ",".join(f'{k}="{_escape_label(v)}"' for k, v in labels)
+
+
+class _Histogram:
+    """One named histogram family: per-labelset bucket counts + sum."""
+
+    __slots__ = ("buckets", "series")
+
+    def __init__(self, buckets):
+        self.buckets = tuple(sorted(buckets))
+        # labels tuple -> [bucket counts..., +Inf count, sum]
+        self.series: dict[tuple, list] = {}
+
+    def observe(self, value: float, labels: tuple):
+        row = self.series.get(labels)
+        if row is None:
+            row = [0] * (len(self.buckets) + 1) + [0.0]
+            self.series[labels] = row
+        for i, le in enumerate(self.buckets):
+            if value <= le:
+                row[i] += 1
+        row[len(self.buckets)] += 1      # +Inf == total count
+        row[-1] += value                 # running sum
+
 
 class Metrics:
-    """Process-wide metric registry (counters + gauges + histograms-lite)."""
+    """Process-wide metric registry (counters + gauges + histograms)."""
 
     def __init__(self):
         self.lock = threading.Lock()
         self.counters: dict[str, float] = {}
         self.gauges: dict[str, float] = {}
+        self.histograms: dict[str, _Histogram] = {}
         self.help: dict[str, str] = {}
         self.started = time.time()
 
@@ -30,6 +67,38 @@ class Metrics:
             if help_text:
                 self.help[name] = help_text
 
+    def observe(self, name: str, value: float,
+                labels: dict | None = None, help_text: str = "",
+                buckets=DEFAULT_BUCKETS):
+        """Record one observation into a labelled histogram."""
+        key = tuple(sorted((labels or {}).items()))
+        with self.lock:
+            hist = self.histograms.get(name)
+            if hist is None:
+                hist = self.histograms[name] = _Histogram(buckets)
+            hist.observe(float(value), key)
+            if help_text:
+                self.help[name] = help_text
+
+    def _render_histograms(self, lines: list):
+        for name, hist in sorted(self.histograms.items()):
+            if name in self.help:
+                lines.append(f"# HELP {name} {self.help[name]}")
+            lines.append(f"# TYPE {name} histogram")
+            nb = len(hist.buckets)
+            for labels, row in sorted(hist.series.items()):
+                base = _fmt_labels(labels)
+                sep = "," if base else ""
+                for i, le in enumerate(hist.buckets):
+                    lines.append(
+                        f'{name}_bucket{{{base}{sep}le="{repr(le)}"}} '
+                        f"{row[i]}")
+                lines.append(
+                    f'{name}_bucket{{{base}{sep}le="+Inf"}} {row[nb]}')
+                brace = f"{{{base}}}" if base else ""
+                lines.append(f"{name}_sum{brace} {row[-1]}")
+                lines.append(f"{name}_count{brace} {row[nb]}")
+
     def render(self) -> str:
         with self.lock:
             lines = []
@@ -43,6 +112,7 @@ class Metrics:
                     lines.append(f"# HELP {name} {self.help[name]}")
                 lines.append(f"# TYPE {name} gauge")
                 lines.append(f"{name} {value}")
+            self._render_histograms(lines)
             lines.append("# TYPE process_uptime_seconds gauge")
             lines.append(
                 f"process_uptime_seconds {time.time() - self.started}")
@@ -143,6 +213,39 @@ def record_batch(batch_number: int, proving_time: float | None = None):
     if proving_time is not None:
         METRICS.set("ethrex_l2_batch_proving_seconds", proving_time,
                     "Wall-clock of the last batch proof")
+
+
+def _observe_safe(name, value, labels, help_text):
+    # Telemetry sits inside hot/traced paths; it must never raise there.
+    try:
+        METRICS.observe(name, value, labels, help_text)
+    except Exception:
+        pass
+
+
+def observe_rpc_request(method: str, seconds: float):
+    _observe_safe("rpc_request_seconds", seconds, {"method": method},
+                  "JSON-RPC request latency by method")
+
+
+def observe_prover_stage(stage: str, seconds: float):
+    _observe_safe("prover_stage_seconds", seconds, {"stage": stage},
+                  "Per-stage prover latency (block_until_ready-bounded)")
+
+
+def observe_block_execution(seconds: float):
+    _observe_safe("block_execution_seconds", seconds, None,
+                  "EVM execution time per block (execute_block)")
+
+
+def observe_block_import(seconds: float):
+    _observe_safe("block_import_seconds", seconds, None,
+                  "End-to-end block import time (add_block)")
+
+
+def observe_actor_iteration(actor: str, seconds: float):
+    _observe_safe("sequencer_actor_seconds", seconds, {"actor": actor},
+                  "Sequencer actor loop iteration latency")
 
 
 class MetricsServer:

@@ -55,10 +55,52 @@ def snapshot(rpc: RpcSession, blocks: int = 8) -> dict:
     except Exception:
         out["health"] = None
     try:
+        # older nodes don't serve the trace namespace; skip the panel
+        out["traces"] = rpc.call("ethrex_trace_slowest", [5])
+    except Exception:
+        out["traces"] = None
+    try:
         out["peers"] = len(rpc.call("admin_peers", []))
     except Exception:
         out["peers"] = None
     return out
+
+
+def _ms(v) -> str:
+    return f"{v * 1000:.1f}ms" if isinstance(v, (int, float)) else "—"
+
+
+def _latency_lines(snap: dict, width: int) -> list[str]:
+    """Latency panel: per-actor loop stats + slowest traces.  Every field
+    access is defensive — an L1-only or older node simply has no panel."""
+    lines: list[str] = []
+    health = snap.get("health")
+    actors = {}
+    if isinstance(health, dict) and isinstance(health.get("l2"), dict):
+        actors = health["l2"].get("actors") or {}
+    rows = []
+    for name, st in actors.items():
+        loop = st.get("loop") if isinstance(st, dict) else None
+        if isinstance(loop, dict) and loop.get("lastSeconds") is not None:
+            rows.append(f"   {name:<20} last {_ms(loop['lastSeconds']):>9}"
+                        f"  avg {_ms(loop.get('avgSeconds')):>9}"
+                        f"  max {_ms(loop.get('maxSeconds')):>9}")
+    if rows:
+        lines.append("─" * width)
+        lines.append(" actor loop latency")
+        lines.extend(rows)
+    traces = snap.get("traces")
+    if isinstance(traces, list) and traces:
+        lines.append("─" * width)
+        lines.append(" slowest traces")
+        for t in traces[:5]:
+            if not isinstance(t, dict):
+                continue
+            lines.append(f"   {str(t.get('name', '?')):<24}"
+                         f" {_ms(t.get('seconds')):>9}"
+                         f"  spans {t.get('spanCount', '?'):<4}"
+                         f" {str(t.get('traceId', ''))[:16]}")
+    return lines
 
 
 def render_lines(snap: dict, width: int = 100) -> list[str]:
@@ -95,6 +137,7 @@ def render_lines(snap: dict, width: int = 100) -> list[str]:
         items = hl.items() if isinstance(hl, dict) else enumerate(hl)
         for k, v in items:
             lines.append(f"   {k}: {v}")
+    lines.extend(_latency_lines(snap, width))
     lines.append("─" * width)
     lines.append(" q quits · refreshes every interval")
     return lines

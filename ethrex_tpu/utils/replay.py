@@ -160,9 +160,9 @@ if __name__ == "__main__":
     import sys
 
     if len(sys.argv) < 2 or "--genesis" not in sys.argv:
-        print("usage: python -m ethrex_tpu.utils.replay <cache.json> "
-              "--genesis <genesis.json>", file=sys.stderr)
+        sys.stderr.write("usage: python -m ethrex_tpu.utils.replay "
+                         "<cache.json> --genesis <genesis.json>\n")
         sys.exit(2)
     cache = sys.argv[1]
     genesis = sys.argv[sys.argv.index("--genesis") + 1]
-    print(json.dumps(replay(cache, genesis), indent=2))
+    sys.stdout.write(json.dumps(replay(cache, genesis), indent=2) + "\n")

@@ -1,0 +1,347 @@
+"""Hierarchical in-process tracing and structured logging (stdlib only).
+
+Mirrors the shape of the reference stack's tracing setup (ethrex wires
+`tracing_subscriber` + OTLP spans around the sequencer and prover): a
+span is a named, timed region with attributes; spans nest via a
+thread-local context stack; completed spans are folded into a bounded
+ring buffer of traces keyed by trace ID.
+
+Cross-process propagation is cooperative: the proof coordinator stamps
+``trace_id``/``span_id`` into ``InputResponse``, the prover client
+re-enters that context with :class:`trace_context`, and ``ProofSubmit``
+echoes the IDs back, so one batch's life (assign -> prove -> submit ->
+verify -> settle) is a single trace even across the TCP seam.
+
+Everything here is best-effort by contract: tracing must NEVER raise
+into the traced path.  Span entry/exit and recording are wrapped so a
+tracing bug degrades to missing telemetry, not a failed prove.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import secrets
+import sys
+import threading
+import time
+
+# Completed traces kept in memory (oldest evicted first).
+TRACE_CAPACITY = 256
+# Spans kept per trace (runaway-loop protection).
+SPANS_PER_TRACE = 512
+
+
+def new_trace_id() -> str:
+    return secrets.token_hex(8)
+
+
+def new_span_id() -> str:
+    return secrets.token_hex(4)
+
+
+class Span:
+    """A single timed region.  Fields are finalized on context exit."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "start",
+                 "seconds", "attrs", "status", "error", "_t0")
+
+    def __init__(self, trace_id, span_id, parent_id, name, attrs):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.attrs = attrs
+        self.start = time.time()
+        self._t0 = time.perf_counter()
+        self.seconds = 0.0
+        self.status = "ok"
+        self.error = None
+
+    def set_attr(self, key, value):
+        try:
+            self.attrs[key] = value
+        except Exception:
+            pass
+
+    def to_json(self) -> dict:
+        out = {
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "parentId": self.parent_id,
+            "name": self.name,
+            "start": self.start,
+            "seconds": self.seconds,
+            "status": self.status,
+        }
+        if self.attrs:
+            out["attrs"] = {k: _jsonable(v) for k, v in self.attrs.items()}
+        if self.error:
+            out["error"] = self.error
+        return out
+
+
+def _jsonable(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+_ctx = threading.local()
+
+
+def _stack() -> list:
+    st = getattr(_ctx, "stack", None)
+    if st is None:
+        st = []
+        _ctx.stack = st
+    return st
+
+
+def current() -> "tuple[str, str | None] | None":
+    """(trace_id, span_id) for the innermost active context, or None."""
+    try:
+        st = _stack()
+        return st[-1] if st else None
+    except Exception:
+        return None
+
+
+def current_trace_id() -> "str | None":
+    cur = current()
+    return cur[0] if cur else None
+
+
+class Tracer:
+    """Bounded ring buffer of completed traces, keyed by trace ID."""
+
+    def __init__(self, capacity: int = TRACE_CAPACITY):
+        self.lock = threading.Lock()
+        self.capacity = capacity
+        self._traces: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
+        self.dropped = 0
+
+    def record(self, span: Span) -> None:
+        with self.lock:
+            rec = self._traces.get(span.trace_id)
+            if rec is None:
+                rec = {"traceId": span.trace_id, "spans": []}
+                self._traces[span.trace_id] = rec
+                while len(self._traces) > self.capacity:
+                    self._traces.popitem(last=False)
+                    self.dropped += 1
+            else:
+                # A late span keeps its trace warm in the ring.
+                self._traces.move_to_end(span.trace_id)
+            rec["spans"].append(span.to_json())
+            if len(rec["spans"]) > SPANS_PER_TRACE:
+                del rec["spans"][:len(rec["spans"]) - SPANS_PER_TRACE]
+
+    def __len__(self) -> int:
+        with self.lock:
+            return len(self._traces)
+
+    def get_trace(self, trace_id: str) -> "dict | None":
+        with self.lock:
+            rec = self._traces.get(trace_id)
+            if rec is None:
+                return None
+            return {"traceId": rec["traceId"], "spans": list(rec["spans"])}
+
+    def _summaries(self) -> list:
+        with self.lock:
+            recs = [(tid, list(rec["spans"]))
+                    for tid, rec in self._traces.items()]
+        out = []
+        for tid, spans in recs:
+            if not spans:
+                continue
+            start = min(s["start"] for s in spans)
+            end = max(s["start"] + s["seconds"] for s in spans)
+            root = next((s for s in spans if not s["parentId"]), spans[0])
+            out.append({
+                "traceId": tid,
+                "name": root["name"],
+                "start": start,
+                "seconds": end - start,
+                "spanCount": len(spans),
+                "spans": spans,
+            })
+        return out
+
+    def recent(self, limit: int = 20) -> list:
+        """Most recently touched traces, newest first."""
+        return list(reversed(self._summaries()))[:max(0, limit)]
+
+    def slowest(self, limit: int = 20) -> list:
+        """Traces ordered by wall-clock extent, slowest first."""
+        out = self._summaries()
+        out.sort(key=lambda t: t["seconds"], reverse=True)
+        return out[:max(0, limit)]
+
+    def stage_breakdown(self, trace_id: str) -> "dict[str, float]":
+        """Sum span durations by their ``stage`` attribute for one trace."""
+        rec = self.get_trace(trace_id)
+        stages: "dict[str, float]" = {}
+        if rec is None:
+            return stages
+        for s in rec["spans"]:
+            stage = (s.get("attrs") or {}).get("stage")
+            if stage:
+                stages[stage] = stages.get(stage, 0.0) + s["seconds"]
+        return stages
+
+    def clear(self) -> None:
+        with self.lock:
+            self._traces.clear()
+            self.dropped = 0
+
+
+TRACER = Tracer()
+
+
+class span:
+    """Context manager opening a span under the current thread context.
+
+    With no enclosing context a new trace is started.  ``stage=`` also
+    feeds the ``prover_stage_seconds`` histogram on exit.  Never raises:
+    on internal failure ``__enter__`` yields None and the body still runs.
+    """
+
+    __slots__ = ("_name", "_stage", "_attrs", "_span", "_pushed")
+
+    def __init__(self, name: str, stage: "str | None" = None, **attrs):
+        self._name = name
+        self._stage = stage
+        self._attrs = attrs
+        self._span = None
+        self._pushed = False
+
+    def __enter__(self):
+        try:
+            attrs = dict(self._attrs)
+            if self._stage:
+                attrs["stage"] = self._stage
+            st = _stack()
+            if st:
+                trace_id, parent_id = st[-1]
+            else:
+                trace_id, parent_id = new_trace_id(), None
+            sp = Span(trace_id, new_span_id(), parent_id, self._name, attrs)
+            st.append((trace_id, sp.span_id))
+            self._pushed = True
+            self._span = sp
+        except Exception:
+            self._span = None
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb):
+        try:
+            if self._pushed:
+                st = _stack()
+                if st:
+                    st.pop()
+            sp = self._span
+            if sp is not None:
+                sp.seconds = time.perf_counter() - sp._t0
+                if exc is not None:
+                    sp.status = "error"
+                    sp.error = f"{exc_type.__name__}: {exc}"
+                TRACER.record(sp)
+                if self._stage:
+                    from . import metrics
+                    metrics.observe_prover_stage(self._stage, sp.seconds)
+        except Exception:
+            pass
+        return False
+
+
+class trace_context:
+    """Re-enter a trace received over the wire on this thread.
+
+    Spans opened inside become children of ``parent_span_id`` (or roots
+    of the trace when no parent is known).  A falsy ``trace_id`` starts
+    a fresh trace so callers need not special-case old peers that do
+    not send one.  Never raises.
+    """
+
+    __slots__ = ("_trace_id", "_parent_id", "_pushed")
+
+    def __init__(self, trace_id: "str | None",
+                 parent_span_id: "str | None" = None):
+        self._trace_id = trace_id
+        self._parent_id = parent_span_id
+        self._pushed = False
+
+    def __enter__(self):
+        try:
+            tid = self._trace_id
+            if not isinstance(tid, str) or not tid:
+                tid = new_trace_id()
+            pid = self._parent_id if isinstance(self._parent_id, str) else None
+            _stack().append((tid, pid))
+            self._pushed = True
+            self._trace_id = tid
+        except Exception:
+            pass
+        return self._trace_id
+
+    def __exit__(self, exc_type, exc, tb):
+        try:
+            if self._pushed:
+                st = _stack()
+                if st:
+                    st.pop()
+        except Exception:
+            pass
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Structured logging
+
+
+class JsonLogFormatter(logging.Formatter):
+    """One JSON object per line; carries trace context when present."""
+
+    def format(self, record):
+        out = {
+            "ts": round(record.created, 3),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        cur = current()
+        if cur:
+            out["traceId"] = cur[0]
+            if cur[1]:
+                out["spanId"] = cur[1]
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+
+def setup_logging(level: str = "info", json_mode: bool = False,
+                  stream=None) -> logging.Logger:
+    """Configure the ``ethrex_tpu`` logger namespace.
+
+    Idempotent: replaces any handler installed by a prior call.  Library
+    modules log via ``logging.getLogger("ethrex_tpu.<mod>")`` and route
+    through here; nothing is written until this is called (or the root
+    logger is otherwise configured), which keeps library imports silent.
+    """
+    root = logging.getLogger("ethrex_tpu")
+    handler = logging.StreamHandler(stream if stream is not None
+                                    else sys.stderr)
+    if json_mode:
+        handler.setFormatter(JsonLogFormatter())
+    else:
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)-7s %(name)s: %(message)s"))
+    root.handlers[:] = [handler]
+    root.setLevel(getattr(logging, str(level).upper(), logging.INFO))
+    # propagation stays on: the root logger has no handlers in normal
+    # CLI runs (no duplicate output), and pytest's caplog attaches there
+    return root

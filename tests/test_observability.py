@@ -1,0 +1,370 @@
+"""Observability layer: hierarchical tracing, labelled Prometheus
+histograms, trace propagation over the prover protocol, and the monitor's
+graceful degradation (docs/OBSERVABILITY.md)."""
+
+import json
+import threading
+
+from ethrex_tpu.crypto import secp256k1
+from ethrex_tpu.l2.l1_client import InMemoryL1
+from ethrex_tpu.l2.sequencer import ActorHealth, Sequencer, SequencerConfig
+from ethrex_tpu.node import Node
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.primitives.transaction import TYPE_DYNAMIC_FEE, Transaction
+from ethrex_tpu.prover import protocol
+from ethrex_tpu.prover.client import ProverClient
+from ethrex_tpu.rpc.server import RpcServer
+from ethrex_tpu.utils import tracing
+from ethrex_tpu.utils.metrics import METRICS, Metrics
+from ethrex_tpu.utils.tracing import TRACER, Tracer, span, trace_context
+
+SECRET = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
+SENDER = secp256k1.pubkey_to_address(secp256k1.pubkey_from_secret(SECRET))
+
+GENESIS = {
+    "config": {"chainId": 65536999, "terminalTotalDifficulty": 0,
+               "shanghaiTime": 0, "cancunTime": 0},
+    "alloc": {"0x" + SENDER.hex(): {"balance": hex(10**21)}},
+    "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7", "timestamp": "0x0",
+}
+
+
+def _transfer(nonce, value=100):
+    return Transaction(
+        tx_type=TYPE_DYNAMIC_FEE, chain_id=65536999, nonce=nonce,
+        max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+        gas_limit=21000, to=bytes.fromhex("aa" * 20), value=value,
+    ).sign(SECRET)
+
+
+# ---------------------------------------------------------------------------
+# tracing core
+
+
+def test_span_nesting_and_trace_record():
+    with span("outer", kind="test") as outer:
+        assert outer is not None
+        with span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    trace = TRACER.get_trace(outer.trace_id)
+    by_name = {s["name"]: s for s in trace["spans"]}
+    assert by_name["outer"]["parentId"] is None
+    assert by_name["inner"]["parentId"] == outer.span_id
+    assert by_name["outer"]["attrs"]["kind"] == "test"
+    assert by_name["outer"]["seconds"] >= by_name["inner"]["seconds"]
+
+
+def test_span_records_error_and_reraises():
+    try:
+        with span("boom") as sp:
+            raise ValueError("exploded")
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("span must not swallow exceptions")
+    rec = TRACER.get_trace(sp.trace_id)["spans"][0]
+    assert rec["status"] == "error"
+    assert "ValueError: exploded" in rec["error"]
+
+
+def test_tracer_ring_buffer_is_bounded():
+    t = Tracer(capacity=4)
+    for i in range(10):
+        sp = tracing.Span(tracing.new_trace_id(), tracing.new_span_id(),
+                          None, f"t{i}", {})
+        t.record(sp)
+    assert len(t) == 4
+    assert t.dropped == 6
+    # the survivors are the newest four
+    assert {s["name"] for tr in t.recent(10) for s in tr["spans"]} == \
+        {"t6", "t7", "t8", "t9"}
+
+
+def test_tracing_never_raises_into_traced_path(monkeypatch):
+    def explode(_span):
+        raise RuntimeError("tracer is broken")
+
+    monkeypatch.setattr(TRACER, "record", explode)
+    with span("guarded") as sp:
+        ran = True
+    assert ran and sp is not None
+    with trace_context(object()):  # junk trace id: coerced, not raised
+        with span("still-fine"):
+            pass
+
+
+def test_trace_context_continues_remote_trace():
+    with trace_context("feedbeef00000000", "cafe0001"):
+        with span("continued") as sp:
+            assert sp.trace_id == "feedbeef00000000"
+            assert sp.parent_id == "cafe0001"
+    spans = TRACER.get_trace("feedbeef00000000")["spans"]
+    assert any(s["name"] == "continued" for s in spans)
+
+
+def test_thread_local_isolation():
+    ids = {}
+
+    def worker(key):
+        with span(f"w-{key}") as sp:
+            ids[key] = sp.trace_id
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    with span("main") as sp:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        ids["main"] = sp.trace_id
+    assert len(set(ids.values())) == 3
+
+
+def test_slowest_and_stage_breakdown():
+    t = Tracer(capacity=16)
+    for name, secs, stage in (("fast", 0.01, "a"), ("slow", 5.0, "b")):
+        sp = tracing.Span(tracing.new_trace_id(), tracing.new_span_id(),
+                          None, name, {"stage": stage})
+        sp.seconds = secs
+        t.record(sp)
+        slow_tid = sp.trace_id
+    slowest = t.slowest(1)
+    assert slowest[0]["name"] == "slow"
+    assert t.stage_breakdown(slow_tid) == {"b": 5.0}
+
+
+# ---------------------------------------------------------------------------
+# Prometheus histogram exposition
+
+
+def test_histogram_exposition_format():
+    m = Metrics()
+    # a label value that needs escaping, plus enough observations to
+    # spread across buckets
+    label = {"method": 'eth_"call"\nx\\y'}
+    for v in (0.0005, 0.003, 0.003, 0.7, 100.0, 10**6):
+        m.observe("rpc_request_seconds", v, label, "help text")
+    text = m.render()
+    lines = text.splitlines()
+    assert "# TYPE rpc_request_seconds histogram" in lines
+    assert "# HELP rpc_request_seconds help text" in lines
+    # label escaping: backslash, quote, newline
+    assert 'method="eth_\\"call\\"\\nx\\\\y"' in text
+    bucket_lines = [ln for ln in lines
+                    if ln.startswith("rpc_request_seconds_bucket")]
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in bucket_lines]
+    # cumulative buckets must be monotone non-decreasing
+    assert counts == sorted(counts)
+    # le="+Inf" equals _count equals total observations
+    inf = [ln for ln in bucket_lines if 'le="+Inf"' in ln]
+    assert len(inf) == 1 and int(inf[0].rsplit(" ", 1)[1]) == 6
+    count_ln = [ln for ln in lines
+                if ln.startswith("rpc_request_seconds_count")]
+    assert int(count_ln[0].rsplit(" ", 1)[1]) == 6
+    sum_ln = [ln for ln in lines
+              if ln.startswith("rpc_request_seconds_sum")]
+    assert abs(float(sum_ln[0].rsplit(" ", 1)[1]) - 1000100.7065) < 1e-3
+    # the le ladder parses as increasing floats
+    les = [ln.split('le="')[1].split('"')[0] for ln in bucket_lines[:-1]]
+    as_floats = [float(v) for v in les]
+    assert as_floats == sorted(as_floats) and len(set(as_floats)) == len(les)
+
+
+def test_histograms_do_not_break_counters_and_gauges():
+    m = Metrics()
+    m.inc("things_total", 2, "things")
+    m.set("level", 7)
+    m.observe("latency_seconds", 0.1)
+    text = m.render()
+    assert "things_total 2" in text
+    assert "level 7" in text
+    # unlabelled histogram series renders without a dangling comma
+    assert 'latency_seconds_bucket{le="0.001"} 0' in text
+    assert "latency_seconds_count 1" in text
+
+
+def test_rpc_and_prover_stage_histograms_exposed():
+    node = Node(Genesis.from_json(GENESIS))
+    server = RpcServer(node)
+    server.handle({"jsonrpc": "2.0", "id": 1,
+                   "method": "eth_blockNumber", "params": []})
+    from ethrex_tpu.utils.metrics import observe_prover_stage
+
+    observe_prover_stage("trace_lde", 0.25)
+    text = METRICS.render()
+    assert 'rpc_request_seconds_bucket{method="eth_blockNumber",le="+Inf"}' \
+        in text
+    assert 'prover_stage_seconds_bucket{stage="trace_lde",le="+Inf"}' in text
+    assert 'rpc_request_seconds_count{method="eth_blockNumber"}' in text
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: one proved batch == one trace
+
+
+def test_single_trace_covers_batch_lifecycle():
+    node = Node(Genesis.from_json(GENESIS))
+    l1 = InMemoryL1(needed_prover_types=[protocol.PROVER_EXEC])
+    seq = Sequencer(node, l1, SequencerConfig(
+        needed_prover_types=(protocol.PROVER_EXEC,)))
+    seq.coordinator.start()
+    try:
+        node.submit_transaction(_transfer(0))
+        seq.produce_block()
+        assert seq.commit_next_batch() is not None
+        client = ProverClient(protocol.PROVER_EXEC,
+                              [("127.0.0.1", seq.coordinator.port)])
+        assert client.poll_once() == 1
+        assert seq.send_proofs() == (1, 1)
+
+        tid = seq.coordinator.batch_traces[1]
+        trace = TRACER.get_trace(tid)
+        spans = trace["spans"]
+        names = {s["name"] for s in spans}
+        assert {"prover.assign", "prover.prove", "prover.submit",
+                "prover.store_proof", "proof.verify",
+                "proof.settle"} <= names
+        # every span shares the one trace ID (coordinator thread, prover
+        # client thread, and sequencer all joined the same trace)
+        assert {s["traceId"] for s in spans} == {tid}
+        # the cross-process span tree is linked: prove hangs off assign,
+        # store_proof hangs off submit
+        by_name = {s["name"]: s for s in spans}
+        assert by_name["prover.prove"]["parentId"] == \
+            by_name["prover.assign"]["spanId"]
+        assert by_name["prover.store_proof"]["parentId"] == \
+            by_name["prover.submit"]["spanId"]
+
+        # retrievable through the RPC trace namespace
+        node.sequencer = seq
+        server = RpcServer(node)
+        r = server.handle({"jsonrpc": "2.0", "id": 1,
+                           "method": "ethrex_trace_recentTraces",
+                           "params": [300]})
+        match = [t for t in r["result"] if t["traceId"] == tid]
+        assert match and match[0]["spanCount"] == len(spans)
+        json.dumps(r)  # JSON-serializable all the way down
+        r = server.handle({"jsonrpc": "2.0", "id": 2,
+                           "method": "ethrex_trace_slowest",
+                           "params": ["0x5"]})
+        assert len(r["result"]) <= 5
+    finally:
+        seq.stop()
+
+
+def test_health_reports_actor_loop_latency():
+    st = ActorHealth("produce_block")
+    st.note_duration(0.5)
+    st.note_duration(0.1)
+    loop = st.to_json()["loop"]
+    assert loop["lastSeconds"] == 0.1
+    assert abs(loop["avgSeconds"] - 0.3) < 1e-9
+    assert loop["maxSeconds"] == 0.5
+    # untimed actor: nulls, not division errors
+    assert ActorHealth("x").to_json()["loop"]["avgSeconds"] is None
+
+
+def test_health_includes_tracing_and_loop_stats():
+    node = Node(Genesis.from_json(GENESIS))
+    l1 = InMemoryL1(needed_prover_types=[protocol.PROVER_EXEC])
+    seq = Sequencer(node, l1, SequencerConfig(
+        needed_prover_types=(protocol.PROVER_EXEC,)))
+    node.sequencer = seq
+    seq.health["produce_block"] = ActorHealth("produce_block")
+    seq.health["produce_block"].note_duration(0.02)
+    server = RpcServer(node)
+    r = server.handle({"jsonrpc": "2.0", "id": 1,
+                       "method": "ethrex_health", "params": []})
+    health = r["result"]
+    assert "bufferedTraces" in health["tracing"]
+    actor = health["l2"]["actors"]["produce_block"]
+    assert actor["loop"]["lastSeconds"] == 0.02
+
+
+# ---------------------------------------------------------------------------
+# monitor degradation
+
+
+def test_monitor_degrades_against_l1_only_node():
+    from ethrex_tpu.utils.monitor import render_lines, snapshot
+    from ethrex_tpu.utils.repl import RpcSession
+
+    node = Node(Genesis.from_json(GENESIS))
+    server = RpcServer(node).start()
+    # simulate an older / L1-only node: no L2 namespace, no trace RPCs
+    for method in ("ethrex_health", "ethrex_latestBatch",
+                   "ethrex_trace_slowest", "ethrex_trace_recentTraces"):
+        server.methods.pop(method)
+    try:
+        node.produce_block()
+        snap = snapshot(RpcSession(f"http://127.0.0.1:{server.port}"))
+        assert snap["head"]["number"] == 1
+        assert snap["batch"] is None
+        assert snap["health"] is None
+        assert snap["traces"] is None
+        lines = render_lines(snap, width=80)
+        assert any("head #1" in ln for ln in lines)
+        assert not any("slowest traces" in ln for ln in lines)
+        assert not any("actor loop latency" in ln for ln in lines)
+    finally:
+        server._httpd.shutdown()
+
+
+def test_monitor_renders_latency_panels():
+    from ethrex_tpu.utils.monitor import render_lines
+
+    snap = {
+        "head": {"number": 1, "hash": "0x" + "00" * 32, "gas_used": 0,
+                 "gas_limit": 30_000_000, "txs": 0, "base_fee": 7,
+                 "timestamp": 0},
+        "recent": [],
+        "health": {"l2": {"actors": {"produce_block": {
+            "loop": {"lastSeconds": 0.004, "avgSeconds": 0.002,
+                     "maxSeconds": 0.01}}}}},
+        "traces": [{"name": "prover.assign", "seconds": 1.25,
+                    "spanCount": 7, "traceId": "ab" * 8}],
+    }
+    lines = render_lines(snap, width=100)
+    assert any("actor loop latency" in ln for ln in lines)
+    assert any("produce_block" in ln and "4.0ms" in ln for ln in lines)
+    assert any("slowest traces" in ln for ln in lines)
+    assert any("prover.assign" in ln for ln in lines)
+    # malformed/partial payloads must not crash the panel
+    snap["traces"] = ["garbage", {"name": "x"}]
+    snap["health"] = {"l2": {"actors": {"a": "not-a-dict"}}}
+    render_lines(snap, width=100)
+
+
+# ---------------------------------------------------------------------------
+# structured logging
+
+
+def test_json_log_formatter_carries_trace_context():
+    import io
+    import logging
+
+    buf = io.StringIO()
+    tracing.setup_logging("info", json_mode=True, stream=buf)
+    try:
+        with trace_context("ab" * 8, "cd" * 4):
+            logging.getLogger("ethrex_tpu.test").info("hello %s", "world")
+        rec = json.loads(buf.getvalue())
+        assert rec["msg"] == "hello world"
+        assert rec["traceId"] == "ab" * 8
+        assert rec["spanId"] == "cd" * 4
+        assert rec["level"] == "info"
+    finally:
+        logging.getLogger("ethrex_tpu").handlers[:] = []
+
+
+def test_cli_accepts_log_flags():
+    import argparse
+
+    from ethrex_tpu.cli import _add_node_flags
+
+    p = argparse.ArgumentParser()
+    _add_node_flags(p)
+    args = p.parse_args(["--log-level", "debug", "--log-json"])
+    assert args.log_level == "debug" and args.log_json is True
+    args = p.parse_args([])
+    assert args.log_level == "info" and args.log_json is False

@@ -103,6 +103,29 @@ def test_every_fault_site_has_chaos_coverage():
     assert not missing, f"fault sites without chaos coverage: {missing}"
 
 
+def test_no_bare_print_in_library_modules():
+    """Library diagnostics go through the structured logger
+    (utils/tracing.py setup_logging), never bare print().  Terminal
+    front-ends (cli, repl, monitor) own stdout and are allowlisted."""
+    import pathlib
+    import re
+
+    import ethrex_tpu
+
+    root = pathlib.Path(ethrex_tpu.__file__).parent
+    allow = {"cli.py", "repl.py", "monitor.py"}
+    pat = re.compile(r"(?<![A-Za-z0-9_.])print\(")
+    offenders = []
+    for path in sorted(root.rglob("*.py")):
+        if path.name in allow:
+            continue
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            if pat.search(line):
+                offenders.append(f"{path.relative_to(root)}:{lineno}")
+    assert not offenders, \
+        f"bare print() in library modules (use logging): {offenders}"
+
+
 def test_bench_probe_reports_failure_detail(monkeypatch):
     """A degraded bench record must say WHY the backend probe failed —
     the last exception line of the child's stderr, or the timeout."""
