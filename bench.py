@@ -79,6 +79,25 @@ def probe_backend() -> bool:
     return probe_backend_error() is None
 
 
+def detect_backend() -> str | None:
+    """Child-process `jax.default_backend()` — distinguishes a CPU-only
+    host (jax imports fine, no chip plugged in) from a broken/hung
+    backend (None).  Drives the CPU fallback in main(): a host with no
+    chip should publish an honest backend=cpu record, not degrade after
+    three probe retries that can never pass."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            capture_output=True, timeout=PROBE_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode != 0:
+        return None
+    out = proc.stdout.decode(errors="replace").strip()
+    return out or None
+
+
 def _guard_backend() -> None:
     if os.environ.get("BENCH_ALLOW_CPU") == "1":
         # the axon TPU plugin ignores JAX_PLATFORMS; force CPU through
@@ -536,6 +555,16 @@ def _mgas_config() -> dict:
 
 
 def main() -> None:
+    cpu_fallback = False
+    if (os.environ.get("BENCH_ALLOW_CPU") != "1"
+            and detect_backend() == "cpu"):
+        # CPU-only host: the tunnel is ABSENT, not flaky — the chip probe
+        # can never pass, and retrying it three times only produces a
+        # degraded record with no number at all.  Run the same headline
+        # pipeline on CPU instead, tagged backend=cpu so the record is
+        # never mistaken for (or cached as) a chip measurement.
+        os.environ["BENCH_ALLOW_CPU"] = "1"
+        cpu_fallback = True
     last_err = ""
     for attempt in range(ATTEMPTS):
         probe_err = probe_backend_error()
@@ -546,14 +575,22 @@ def main() -> None:
             continue
         result = _attempt("--measure", ATTEMPT_TIMEOUT)
         if result is not None and "_err" not in result:
-            if os.environ.get("BENCH_SKIP_EXTRAS") != "1":
+            if cpu_fallback:
+                result["backend"] = "cpu"
+                if os.environ.get("BENCH_SKIP_EXTRAS") != "1":
+                    # chip-bound extras (2/4/5) are pointless on CPU;
+                    # the L1-side mgas number is chip-independent
+                    result["configs"] = {"mgas": _mgas_config()}
+            elif os.environ.get("BENCH_SKIP_EXTRAS") != "1":
                 result["configs"] = _extra_configs()
                 result["configs"]["mgas"] = _mgas_config()
-            try:
-                with open(LAST_PATH, "w") as f:
-                    json.dump(result, f)
-            except OSError:
-                pass
+            if not cpu_fallback:
+                # only chip records feed the degraded-replay cache
+                try:
+                    with open(LAST_PATH, "w") as f:
+                        json.dump(result, f)
+                except OSError:
+                    pass
             print(json.dumps(result))
             return
         last_err = f"attempt {attempt + 1}: {result.get('_err', '?')}"

@@ -36,18 +36,23 @@ def apply_fork_choice(store: Store, head_hash: bytes,
         if parent is None:
             raise ForkChoiceError("detached branch")
         cursor = parent
-    # drop any stale canonical entries above the new head
-    old_head = store.head_header()
-    for number in range(head.number + 1, old_head.number + 1):
-        store.canonical.pop(number, None)
-    for header in branch:
-        store.set_canonical(header.number, header.hash)
-    store.set_head(head_hash)
-    if safe_hash:
-        store.meta["safe"] = safe_hash
-    if finalized_hash:
-        store.meta["finalized"] = finalized_hash
-        # flatten every layer at or below the finalized height to the
-        # durable backend (see Store.finalize_node_layers)
-        store.finalize_node_layers(fin.number)
+    # the canonical rewrite + head/safe/finalized markers commit as one
+    # journaled unit on persistent stores: a crash mid-fork-choice must
+    # not leave the canonical index pointing at a mix of old and new
+    # branches
+    with store.write_group():
+        # drop any stale canonical entries above the new head
+        old_head = store.head_header()
+        for number in range(head.number + 1, old_head.number + 1):
+            store.canonical.pop(number, None)
+        for header in branch:
+            store.set_canonical(header.number, header.hash)
+        store.set_head(head_hash)
+        if safe_hash:
+            store.meta["safe"] = safe_hash
+        if finalized_hash:
+            store.meta["finalized"] = finalized_hash
+            # flatten every layer at or below the finalized height to the
+            # durable backend (see Store.finalize_node_layers)
+            store.finalize_node_layers(fin.number)
     return head

@@ -13,6 +13,7 @@ import json
 import os
 import signal
 import sys
+import threading
 import time
 
 from .node import Node
@@ -122,6 +123,12 @@ def _add_node_flags(parser: argparse.ArgumentParser):
                         default=_env("NODE_CONFIG"),
                         help="JSON file persisting known peers across "
                         "restarts (reference: node_config.json)")
+    parser.add_argument("--shutdown-deadline", dest="shutdown_deadline",
+                        type=float,
+                        default=_env_float("SHUTDOWN_DEADLINE", 30.0),
+                        help="bounded SIGTERM/SIGINT drain deadline (s): "
+                        "RPC stops, writers join, in-flight proof submits "
+                        "land, every backend flushes and closes")
 
 
 def _load_genesis(args) -> Genesis | None:
@@ -341,9 +348,18 @@ def run_node(args) -> int:
         node.start_dev_producer(args.block_time)
         print(f"dev producer running (block time {args.block_time}s)")
 
+    # coordinated drain (utils/shutdown.py): rpc -> producer -> flush+close
+    from .utils.shutdown import build_node_shutdown
+
+    manager = build_node_shutdown(
+        node=node, servers=[server, authrpc, ws, metrics],
+        stores=[node.store],
+        deadline=args.shutdown_deadline)
+    stop_event = _install_signal_handlers(stop_event=threading.Event())
     try:
-        signal.pause()
-    except (KeyboardInterrupt, AttributeError):
+        while not stop_event.wait(0.5):
+            pass
+    except KeyboardInterrupt:
         pass
     finally:
         # persist known peers (reference: node_config.json on shutdown)
@@ -359,18 +375,26 @@ def run_node(args) -> int:
                     continue
             with open(args.node_config, "w") as f:
                 json.dump({"known_peers": known}, f)
-        # order matters: stop writers (join producer), THEN fsync, THEN
-        # close the backend; servers last-but-harmless
-        writers_stopped = node.stop()
-        node.store.flush()
-        try:
-            server.stop()
-        except OSError:
-            pass
-        if store is not None and writers_stopped:
-            # never close the native handle under a live writer
-            store.backend.close()
+        report = manager.run()
+        print(f"shutdown complete in {report['durationSeconds']:.2f}s "
+              f"({len(report['steps'])} steps)")
     return 0
+
+
+def _install_signal_handlers(stop_event: threading.Event):
+    """SIGTERM/SIGINT set the stop event; the main loop then runs the
+    coordinated drain.  Falls back silently off the main thread (tests
+    drive the manager directly)."""
+    def _on_signal(signum, frame):
+        print(f"received {signal.Signals(signum).name}; draining...")
+        stop_event.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+    except ValueError:
+        pass
+    return stop_event
 
 
 def run_l2(args) -> int:
@@ -454,26 +478,30 @@ def run_l2(args) -> int:
             clients.append(client)
             print(f"in-process {ptype} prover polling the coordinator")
 
+    # coordinated drain: rpc -> prover clients -> sequencer (in-flight
+    # proof submits land) -> producer -> flush+close both stores
+    from .utils.shutdown import build_node_shutdown
+
+    manager = build_node_shutdown(
+        node=node, servers=[server], sequencer=seq,
+        prover_clients=clients, stores=[node.store, rollup],
+        deadline=args.shutdown_deadline)
+    stop_event = _install_signal_handlers(stop_event=threading.Event())
+
     code = 0
     try:
-        while seq.fatal is None:
-            time.sleep(0.5)
-        actor, err = seq.fatal
-        print(f"fatal sequencer actor {actor}: {err}", file=sys.stderr)
-        code = 1
+        while seq.fatal is None and not stop_event.wait(0.5):
+            pass
+        if seq.fatal is not None:
+            actor, err = seq.fatal
+            print(f"fatal sequencer actor {actor}: {err}", file=sys.stderr)
+            code = 1
     except KeyboardInterrupt:
         pass
     finally:
-        for client in clients:
-            client.stop()
-        seq.stop()
-        server.stop()
-        writers_stopped = node.stop()
-        node.store.flush()
-        if hasattr(rollup, "close"):
-            rollup.close()
-        if store is not None and writers_stopped:
-            store.backend.close()
+        report = manager.run()
+        print(f"shutdown complete in {report['durationSeconds']:.2f}s "
+              f"({len(report['steps'])} steps)")
     return code
 
 

@@ -96,6 +96,12 @@ class ProofCoordinator:
         self.host = host
         self.port = port
         self._server: socketserver.ThreadingTCPServer | None = None
+        # requests currently inside handle_request; stop() waits for
+        # them so an in-flight proof submit lands before the drain
+        # proceeds (a submit that misses the window leases back on
+        # restart via normal lease expiry)
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
 
     @staticmethod
     def _now() -> float:
@@ -336,6 +342,16 @@ class ProofCoordinator:
         return {"type": protocol.SUBMIT_ACK, "batch_id": batch}
 
     def handle_request(self, msg: dict) -> dict:
+        with self._inflight_cv:
+            self._inflight += 1
+        try:
+            return self._handle_request(msg)
+        finally:
+            with self._inflight_cv:
+                self._inflight -= 1
+                self._inflight_cv.notify_all()
+
+    def _handle_request(self, msg: dict) -> dict:
         mtype = msg.get("type")
         if mtype == protocol.INPUT_REQUEST:
             if msg.get("commit_hash") != self.commit_hash:
@@ -421,7 +437,23 @@ class ProofCoordinator:
                          daemon=True).start()
         return self
 
-    def stop(self):
+    def stop(self, timeout: float = 5.0) -> bool:
+        """Stop accepting connections, then wait (bounded) for in-flight
+        requests to finish so a proof submit already past the wire lands
+        in the rollup store instead of being dropped mid-handler.
+        Returns True when the drain completed inside the deadline."""
         if self._server:
             self._server.shutdown()
             self._server.server_close()
+        deadline = time.monotonic() + timeout
+        with self._inflight_cv:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    log.warning("%d coordinator request(s) still in flight "
+                                "after %.1fs drain deadline; their leases "
+                                "will expire and reassign", self._inflight,
+                                timeout)
+                    return False
+                self._inflight_cv.wait(remaining)
+        return True

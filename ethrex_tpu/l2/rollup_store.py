@@ -8,6 +8,7 @@ ensure_checkpoint_for_committed_batch / state regeneration)."""
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import threading
@@ -136,6 +137,15 @@ class RollupStore:
         with self.lock:
             self._meta[key] = value
 
+    # ---------------- lifecycle ----------------
+    def write_group(self):
+        """Atomic multi-record write group (batch + blobs + input +
+        settlement flags as one unit); no journal needed in memory."""
+        return contextlib.nullcontext(self)
+
+    def close(self):
+        """Release backing resources; no-op in memory, idempotent."""
+
 
 class PersistentRollupStore(RollupStore):
     """RollupStore with write-through persistence (native KV backend).
@@ -239,12 +249,15 @@ class PersistentRollupStore(RollupStore):
             input_keys = [k for k in self.prover_inputs if k[0] == number]
             proof_keys = [k for k in self.proofs if k[0] == number]
             super().delete_batch(number)
-            self._t_batches.pop(str(number).encode(), None)
-            for n, ver in input_keys:
-                self._t_inputs.pop(f"{n}/{ver}".encode(), None)
-            for n, ptype in proof_keys:
-                self._t_proofs.pop(f"{n}/{ptype}".encode(), None)
-            self._t_blobs.pop(str(number).encode(), None)
+            # all artifacts drop as one journaled unit: a crash mid-delete
+            # must not leave a proof whose batch record is gone
+            with self.write_group():
+                self._t_batches.pop(str(number).encode(), None)
+                for n, ver in input_keys:
+                    self._t_inputs.pop(f"{n}/{ver}".encode(), None)
+                for n, ptype in proof_keys:
+                    self._t_proofs.pop(f"{n}/{ptype}".encode(), None)
+                self._t_blobs.pop(str(number).encode(), None)
             self.backend.flush()
 
     def store_prover_input(self, batch_number: int, version: str,
@@ -279,6 +292,14 @@ class PersistentRollupStore(RollupStore):
         super().set_meta(key, value)
         self._t_meta[key.encode()] = json.dumps(value).encode()
         self.backend.flush()
+
+    def write_group(self):
+        """Journaled multi-record commit: the committer's batch-record
+        group (store_batch + blobs + prover input + set_committed) lands
+        atomically — a crash between the writes reopens to either the
+        full record or none of it (startup reconciliation rebuilds the
+        latter from L1; see docs/L1_SETTLEMENT_RESILIENCE.md)."""
+        return self.backend.batch()
 
     def close(self):
         self.backend.close()

@@ -182,6 +182,13 @@ class Sequencer:
         self.recommits_total = 0
         self.commits_adopted_total = 0
         self.rebuilt_batches_total = 0
+        # the committer's last in-flight commit attempt (number, first
+        # block, artifacts): when the L1 accepts a commit but the
+        # acknowledgment is lost in-process, the exact artifacts that
+        # were settled are still in hand — the rebuild adopts them after
+        # checking them against the on-chain record instead of paying a
+        # full candidate search while block production races ahead
+        self._last_commit_attempt = None
         self._backoff_rng = random.Random(0)
         # startup reconciliation: close the crash window where the L1
         # accepted settlement the local store never recorded, and refuse
@@ -316,14 +323,29 @@ class Sequencer:
                 f"L1 has batch {number} committed but exposes neither its "
                 f"state root nor its commitment; cannot rebuild the lost "
                 f"batch record")
-        if onchain_root is not None:
+        art = None
+        # fast path: the lost acknowledgment happened in THIS process, so
+        # the artifacts the L1 just accepted are the committer's last
+        # attempt — adopt them if the on-chain record confirms the match
+        # (a full candidate search below stays for genuine restarts,
+        # where production is not racing the rebuild)
+        cached = self._last_commit_attempt
+        if (cached is not None and cached[0] == number
+                and cached[1] == first
+                and (onchain_commitment is None
+                     or cached[2].commitment == onchain_commitment)
+                and (onchain_root is None
+                     or cached[2].state_root == onchain_root)):
+            art = cached[2]
+        if art is None and onchain_root is not None:
             candidates = [
                 b for b in range(first, head + 1)
                 if (blk := self.node.store.get_canonical_block(b))
                 is not None and blk.header.state_root == onchain_root]
-        else:
+        elif art is None:
             candidates = list(range(first, head + 1))
-        art = None
+        else:
+            candidates = []
         for last in candidates:
             cand = self._build_batch_artifacts(number, first, last)
             if cand is None:
@@ -343,11 +365,12 @@ class Sequencer:
         batch = Batch(number=number, first_block=first,
                       last_block=last_block, state_root=art.state_root,
                       commitment=art.commitment, vm_mode=art.vm_mode)
-        self.rollup.store_batch(batch)
-        self.rollup.store_blobs_bundle(number, art.bundle)
-        self.rollup.store_prover_input(number, self.cfg.commit_hash,
-                                       art.program_input.to_json())
-        self.rollup.set_committed(number, art.commitment)
+        with self.rollup.write_group():
+            self.rollup.store_batch(batch)
+            self.rollup.store_blobs_bundle(number, art.bundle)
+            self.rollup.store_prover_input(number, self.cfg.commit_hash,
+                                           art.program_input.to_json())
+            self.rollup.set_committed(number, art.commitment)
         self.last_batched_block = last_block
         self.rebuilt_batches_total += 1
         log.warning("rebuilt batch %d (blocks %d..%d) from the canonical "
@@ -542,18 +565,27 @@ class Sequencer:
         if art is None:
             return None
         # L1 first: only persist the batch once the commitment is accepted,
-        # otherwise a transient L1 failure would desync the batch counter
+        # otherwise a transient L1 failure would desync the batch counter.
+        # Remember the attempt first: if the L1 accepts it but the
+        # acknowledgment is lost, the rebuild adopts these artifacts
+        # instead of re-deriving the settled range from scratch
+        self._last_commit_attempt = (number, first, art)
         self._settle_commit(number, art.commitment, art.state_root,
                             art.privileged_hashes, art.msgs_root,
                             art.bundle)
         batch = Batch(number=number, first_block=first,
                       last_block=head, state_root=art.state_root,
                       commitment=art.commitment, vm_mode=art.vm_mode)
-        self.rollup.store_batch(batch)
-        self.rollup.store_blobs_bundle(number, art.bundle)
-        self.rollup.store_prover_input(number, self.cfg.commit_hash,
-                                       art.program_input.to_json())
-        self.rollup.set_committed(number, art.commitment)
+        # the local batch record is one journaled unit: a crash between
+        # these writes reopens to either the full record or none (and the
+        # none case is exactly the commit-crash window reconciliation
+        # already rebuilds from L1)
+        with self.rollup.write_group():
+            self.rollup.store_batch(batch)
+            self.rollup.store_blobs_bundle(number, art.bundle)
+            self.rollup.store_prover_input(number, self.cfg.commit_hash,
+                                           art.program_input.to_json())
+            self.rollup.set_committed(number, art.commitment)
         self.last_batched_block = head
         from ..utils.metrics import record_batch
 
@@ -860,6 +892,20 @@ class Sequencer:
             self._resume_at.pop(name, None)
         self.paused.discard(name)
 
-    def stop(self):
+    def stop(self, timeout: float = 10.0) -> bool:
+        """Drain: signal every actor loop, join the actor threads (each
+        finishes its in-flight iteration — a mid-commit batch lands or
+        rolls back through its write group), then stop the coordinator,
+        which waits for in-flight proof submits to land.  Returns True
+        when every actor stopped within the deadline."""
         self._stop.set()
-        self.coordinator.stop()
+        deadline = time.monotonic() + timeout
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        stragglers = [t for t in self._threads if t.is_alive()]
+        if stragglers:
+            log.warning("%d sequencer actor(s) still running after %.1fs "
+                        "drain deadline", len(stragglers), timeout)
+        self.coordinator.stop(
+            timeout=max(0.5, deadline - time.monotonic()))
+        return not stragglers

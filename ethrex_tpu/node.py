@@ -94,8 +94,11 @@ class Node:
             t0 = time.monotonic()
             result = build_payload(self.chain, parent, header, txs, [],
                                    mempool=self.mempool)
-            self.chain.add_block(result.block)
-            apply_fork_choice(self.store, result.block.hash)
+            # block records + fork choice commit as one journaled unit on
+            # persistent stores (write groups nest; see write_group)
+            with self.store.write_group():
+                self.chain.add_block(result.block)
+                apply_fork_choice(self.store, result.block.hash)
             for tx in result.block.body.transactions:
                 self.mempool.remove_transaction(tx.hash)
             from .utils.metrics import record_block
@@ -129,8 +132,9 @@ class Node:
         with self.lock:
             if self.store.get_header(block.hash) is not None:
                 return False
-            self.chain.add_block(block)  # raises InvalidBlock on bad blocks
-            apply_fork_choice(self.store, block.hash)
+            with self.store.write_group():
+                self.chain.add_block(block)  # raises InvalidBlock
+                apply_fork_choice(self.store, block.hash)
         self._gossip(block)  # transitive relay (terminates: peers that
         return True          # already have it import nothing and don't relay
 
@@ -176,15 +180,16 @@ class Node:
         self._producer_thread = threading.Thread(target=loop, daemon=True)
         self._producer_thread.start()
 
-    def stop(self) -> bool:
+    def stop(self, timeout: float = 30.0) -> bool:
         """Returns True when all writers are stopped (safe to close the
         backend); False if the producer is still alive after the timeout."""
         self._stop.set()
         thread = self._producer_thread
         if thread is not None:
-            thread.join(timeout=30)
+            thread.join(timeout=timeout)
             if thread.is_alive():
-                log.warning("block producer did not stop within 30s")
+                log.warning("block producer did not stop within %.1fs",
+                            timeout)
                 return False
             self._producer_thread = None
         return True

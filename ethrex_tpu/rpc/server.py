@@ -483,8 +483,16 @@ def _health(node):
         "tracing": {"bufferedTraces": len(TRACER),
                     "droppedTraces": TRACER.dropped},
     }
+    sd = getattr(node, "shutdown", None)
+    if sd is not None:
+        out["shutdown"] = {"phase": sd.phase,
+                           "durationSeconds": sd.duration}
     seq = getattr(node, "sequencer", None)
     if seq is not None:
+        from ..storage.persistent import storage_stats
+        from ..utils import shutdown as _shutdown
+
+        stats = storage_stats()
         out["l2"] = {
             "latestBatch": seq.rollup.latest_batch_number(),
             "lastBatchedBlock": seq.last_batched_block,
@@ -509,6 +517,15 @@ def _health(node):
                 "rebuiltBatches": seq.rebuilt_batches_total,
                 "recommitQueue": sorted(seq._recommit_queue),
                 "confirmationDepth": seq.cfg.l1_confirmation_depth,
+            },
+            # storage resilience: corruption/rebuild/journal counters and
+            # the last drain duration (docs/STORAGE_RESILIENCE.md)
+            "store": {
+                "corruptRecords": stats["corrupt_records"],
+                "rebuiltRecords": stats["rebuilt_records"],
+                "journalReplays": stats["journal_replays"],
+                "journalDiscards": stats["journal_discards"],
+                "lastShutdownSeconds": _shutdown.LAST_DURATION,
             },
         }
     return out

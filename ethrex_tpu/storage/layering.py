@@ -91,6 +91,13 @@ class LayeredTable:
             n += self.flatten_layer(tag)
         return n
 
+    def pending(self) -> tuple[int, int]:
+        """(open layers, staged node writes) not yet settled to the
+        base — the restart re-import tail a crash right now would pay.
+        Health/monitor surface this; Store.close() drains it to zero."""
+        snapshot = tuple(self.layers)
+        return len(snapshot), sum(len(w) for _, w in snapshot)
+
     # -- dict protocol -----------------------------------------------------
     def _lookup(self, key):
         # snapshot the layer list: settling (RPC fork-choice thread) may

@@ -6,19 +6,42 @@ Each table is a dict-like view: reads hit an in-memory cache of decoded
 objects (the "memtable/block-cache" role), writes go write-through to the
 native log.  Objects are serialized with the same RLP codecs the wire
 uses, so a reopened store reconstructs identical state.
+
+Crash-consistency layer (docs/STORAGE_RESILIENCE.md):
+
+- every record value carries a version byte + CRC32 envelope; a checksum
+  mismatch on read is quarantined (deleted) and surfaced as
+  `CorruptRecord` — a corrupt record is never silently served;
+- `PersistentBackend.batch()` groups writes from one logical unit (block
+  import, rollup batch record) into a write-ahead journal that is made
+  durable (fsync + atomic rename) before any op touches the KV log, then
+  replayed or discarded on reopen — a crash at any byte offset leaves a
+  consistent, reopenable store;
+- fault sites `store.open` / `store.put` / `store.flush` wire the
+  deterministic harness (utils/faults.py) into every durable write so
+  the chaos battery (tests/test_storage_chaos.py) can kill the process
+  at each write point.
 """
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
+import logging
 import os
+import struct
 import subprocess
 import threading
+import weakref
+import zlib
 
 from ..primitives import rlp
 from ..primitives.block import BlockBody, BlockHeader
 from ..primitives.receipt import Receipt
-from .store import StorageBackend
+from ..utils import faults, metrics
+from .store import CorruptRecord, StorageBackend
+
+log = logging.getLogger("ethrex_tpu.storage.persistent")
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libkvstore.so"))
@@ -81,6 +104,140 @@ def _load():
         lib.kv_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
+
+
+# ---------------------------------------------------------------------------
+# corruption / recovery statistics (process-wide, health-readable)
+# ---------------------------------------------------------------------------
+
+_STATS_LOCK = threading.Lock()
+_STATS = {
+    "corrupt_records": 0,
+    "rebuilt_records": 0,
+    "journal_replays": 0,
+    "journal_discards": 0,
+}
+
+
+def _bump(name: str):
+    with _STATS_LOCK:
+        _STATS[name] += 1
+
+
+def note_rebuild():
+    """A quarantined record was re-derived from surviving data."""
+    _bump("rebuilt_records")
+    metrics.record_store_rebuild()
+
+
+def storage_stats() -> dict:
+    with _STATS_LOCK:
+        return dict(_STATS)
+
+
+# every live backend, so test teardown can close leaked KV handles (and
+# their flocks) instead of letting them dangle across cases
+_OPEN_BACKENDS: "weakref.WeakSet[PersistentBackend]" = weakref.WeakSet()
+
+
+def close_leaked_backends() -> int:
+    n = 0
+    for backend in list(_OPEN_BACKENDS):
+        if backend.handle is not None:
+            backend.close()
+            n += 1
+    return n
+
+
+# ---------------------------------------------------------------------------
+# record envelope: version byte + CRC32 over the payload
+# ---------------------------------------------------------------------------
+
+_ENVELOPE_VERSION = b"\x01"
+
+
+def _wrap_value(payload: bytes) -> bytes:
+    return _ENVELOPE_VERSION + struct.pack("<I", zlib.crc32(payload)) \
+        + payload
+
+
+def _unwrap_value(raw: bytes) -> bytes | None:
+    """The payload, or None when the envelope fails verification."""
+    if len(raw) < 5 or raw[:1] != _ENVELOPE_VERSION:
+        return None
+    (crc,) = struct.unpack_from("<I", raw, 1)
+    payload = raw[5:]
+    if zlib.crc32(payload) != crc:
+        return None
+    return payload
+
+
+# ---------------------------------------------------------------------------
+# write-ahead journal: one batch of (table, key, value|tombstone) ops
+# ---------------------------------------------------------------------------
+
+_J_MAGIC = b"ETXWAL1\n"
+_TOMBSTONE = 0xFFFFFFFF
+
+
+def _encode_journal(ops) -> bytes:
+    body = bytearray(struct.pack("<I", len(ops)))
+    for tb, kb, vb in ops:
+        body += struct.pack("<B", len(tb)) + tb
+        body += struct.pack("<I", len(kb)) + kb
+        if vb is None:
+            body += struct.pack("<I", _TOMBSTONE)
+        else:
+            body += struct.pack("<I", len(vb)) + vb
+    body = bytes(body)
+    return _J_MAGIC + struct.pack("<II", len(body), zlib.crc32(body)) + body
+
+
+def _decode_journal(blob: bytes):
+    """The op list, or None when the journal is torn or corrupt."""
+    try:
+        if not blob.startswith(_J_MAGIC):
+            return None
+        off = len(_J_MAGIC)
+        blen, crc = struct.unpack_from("<II", blob, off)
+        body = blob[off + 8:off + 8 + blen]
+        if len(body) != blen or zlib.crc32(body) != crc:
+            return None
+        (count,) = struct.unpack_from("<I", body, 0)
+        pos = 4
+        ops = []
+        for _ in range(count):
+            (tl,) = struct.unpack_from("<B", body, pos)
+            pos += 1
+            tb = body[pos:pos + tl]
+            pos += tl
+            (kl,) = struct.unpack_from("<I", body, pos)
+            pos += 4
+            kb = body[pos:pos + kl]
+            pos += kl
+            (vl,) = struct.unpack_from("<I", body, pos)
+            pos += 4
+            if vl == _TOMBSTONE:
+                vb = None
+            else:
+                vb = body[pos:pos + vl]
+                pos += vl
+            ops.append((bytes(tb), bytes(kb), None if vb is None
+                        else bytes(vb)))
+        if pos != len(body):
+            return None
+        return ops
+    except (struct.error, IndexError):
+        return None
+
+
+class _BatchState:
+    __slots__ = ("depth", "ops", "undo")
+
+    def __init__(self):
+        self.depth = 0
+        self.ops = []   # (table_bytes, key_bytes, value_bytes | None)
+        self.undo = []  # (table, key, had_cache, prev_value, was_deleted)
 
 
 # ---------------------------------------------------------------------------
@@ -157,7 +314,15 @@ class PersistentTable:
     """dict-like view over one table: read-through decoded-object cache +
     write-through to the native log.  Point lookups hit kv_get on cache
     miss, so opening a store does NOT decode all history; iteration
-    materializes the table on first use (rare paths only)."""
+    materializes the table on first use (rare paths only).
+
+    Values are CRC-enveloped (unless the store predates checksums): a
+    mismatch quarantines the record and raises CorruptRecord on point
+    reads, or skips it during materialization — corrupt data is never
+    decoded and served.  Inside `backend.batch()` writes are staged into
+    the journal instead of hitting the KV log directly; the cache is
+    updated immediately so in-batch reads observe the writes, and rolled
+    back if the batch aborts."""
 
     def __init__(self, backend: "PersistentBackend", name: str):
         self.backend = backend
@@ -169,21 +334,36 @@ class PersistentTable:
         self._deleted: set = set()
         self._materialized = False
 
+    def _quarantine(self, key, kb: bytes):
+        log.error("corrupt record in table %s key %s (%s): quarantined",
+                  self.name, kb.hex(), self.backend.path)
+        _bump("corrupt_records")
+        metrics.record_store_corruption()
+        self.backend.quarantined.append((self.name, kb.hex()))
+        try:
+            self.backend.delete_raw(self.name_b, kb)
+        except OSError:
+            pass  # read-only / poisoned backend: still never served
+        self.cache.pop(key, None)
+        self._deleted.add(key)
+
     def _fetch(self, key):
-        """cache -> native store -> _MISSING."""
+        """cache -> native store -> _MISSING; CorruptRecord on a failed
+        checksum (after quarantining the record)."""
         if key in self.cache:
             return self.cache[key]
         if key in self._deleted or self._materialized:
             return _MISSING
-        lib = self.backend.lib
         kb = self.key_enc(key)
-        out = ctypes.POINTER(ctypes.c_uint8)()
-        out_len = ctypes.c_uint32()
-        if not lib.kv_get(self.backend.handle, self.name_b, kb, len(kb),
-                          ctypes.byref(out), ctypes.byref(out_len)):
+        raw = self.backend.get_raw(self.name_b, kb)
+        if raw is None:
             return _MISSING
-        raw = ctypes.string_at(out, out_len.value)
-        lib.kv_free(out)
+        if self.backend.checksums:
+            payload = _unwrap_value(raw)
+            if payload is None:
+                self._quarantine(key, kb)
+                raise CorruptRecord(self.name, kb, self.backend.path)
+            raw = payload
         value = self.val_dec(raw)
         self.cache[key] = value
         return value
@@ -191,22 +371,20 @@ class PersistentTable:
     def _materialize(self):
         if self._materialized:
             return
-        lib = self.backend.lib
-        it = lib.kv_scan_start(self.backend.handle, self.name_b)
-        k = ctypes.POINTER(ctypes.c_uint8)()
-        v = ctypes.POINTER(ctypes.c_uint8)()
-        kl = ctypes.c_uint32()
-        vl = ctypes.c_uint32()
-        while lib.kv_scan_next(it, ctypes.byref(k), ctypes.byref(kl),
-                               ctypes.byref(v), ctypes.byref(vl)):
-            key_b = ctypes.string_at(k, kl.value)
-            val_b = ctypes.string_at(v, vl.value)
-            lib.kv_free(k)
-            lib.kv_free(v)
+        corrupt = []
+        for key_b, val_b in self.backend.scan_all(self.name_b):
             key = self.key_dec(key_b)
-            if key not in self.cache and key not in self._deleted:
-                self.cache[key] = self.val_dec(val_b)
-        lib.kv_scan_end(it)
+            if key in self.cache or key in self._deleted:
+                continue
+            if self.backend.checksums:
+                payload = _unwrap_value(val_b)
+                if payload is None:
+                    corrupt.append((key, key_b))
+                    continue
+                val_b = payload
+            self.cache[key] = self.val_dec(val_b)
+        for key, key_b in corrupt:
+            self._quarantine(key, key_b)
         self._materialized = True
 
     # -- dict protocol (the subset Store/Trie use) -------------------------
@@ -223,13 +401,21 @@ class PersistentTable:
     def __contains__(self, key):
         return self._fetch(key) is not _MISSING
 
+    def _stage_undo(self, st: _BatchState, key):
+        st.undo.append((self, key, key in self.cache,
+                        self.cache.get(key), key in self._deleted))
+
     def __setitem__(self, key, value):
-        kb = self.key_enc(key)
         vb = self.val_enc(value)
-        if not self.backend.lib.kv_put(self.backend.handle, self.name_b,
-                                       kb, len(kb), vb, len(vb)):
-            raise OSError(f"kv_put failed for table {self.name} "
-                          "(disk full or I/O error)")
+        if self.backend.checksums:
+            vb = _wrap_value(vb)
+        kb = self.key_enc(key)
+        st = self.backend.current_batch()
+        if st is not None:
+            self._stage_undo(st, key)
+            st.ops.append((self.name_b, kb, vb))
+        else:
+            self.backend.kv_write(self.name_b, kb, vb)
         self.cache[key] = value
         self._deleted.discard(key)
 
@@ -238,9 +424,12 @@ class PersistentTable:
         if value is _MISSING:
             return default
         kb = self.key_enc(key)
-        if not self.backend.lib.kv_delete(self.backend.handle, self.name_b,
-                                          kb, len(kb)):
-            raise OSError(f"kv_delete failed for table {self.name}")
+        st = self.backend.current_batch()
+        if st is not None:
+            self._stage_undo(st, key)
+            st.ops.append((self.name_b, kb, None))
+        else:
+            self.backend.kv_write(self.name_b, kb, None)
         self.cache.pop(key, None)
         self._deleted.add(key)
         return value
@@ -278,11 +467,217 @@ class PersistentBackend(StorageBackend):
         self.lib = _load()
         os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
                     exist_ok=True)
-        self.handle = self.lib.kv_open(path.encode())
+        self.path = os.path.abspath(path)
+        self.journal_path = self.path + ".journal"
+        faults.inject("store.open")
+        fresh = not (os.path.exists(self.path)
+                     and os.path.getsize(self.path) > 0)
+        self.handle = self.lib.kv_open(self.path.encode())
         if not self.handle:
             raise OSError(f"cannot open kv store at {path}")
+        self._hlock = threading.Lock()
+        self._local = threading.local()
+        self._poisoned: str | None = None
         self._tables: dict[str, PersistentTable] = {}
+        self.quarantined: list[tuple[str, str]] = []
+        if fresh:
+            self.checksums = True
+            self.put_raw(b"__format__", b"version", b"1")
+        else:
+            # a store written before the checksum envelope carries raw
+            # values; flag it so reads skip verification instead of
+            # misreading every record as corrupt
+            self.checksums = self.get_raw(b"__format__", b"version") == b"1"
+            if not self.checksums:
+                log.warning("legacy store without record checksums at %s; "
+                            "corruption detection disabled", path)
+        self._replay_journal()
+        _OPEN_BACKENDS.add(self)
 
+    # -- raw KV access (handle-guarded, serialized) ------------------------
+    def _require_open(self):
+        if self.handle is None:
+            raise OSError(f"kv store at {self.path} is closed")
+
+    def _require_writable(self):
+        self._require_open()
+        if self._poisoned:
+            raise OSError(f"kv store at {self.path} needs reopen "
+                          f"({self._poisoned})")
+
+    def put_raw(self, table_b: bytes, kb: bytes, vb: bytes):
+        with self._hlock:
+            self._require_writable()
+            if not self.lib.kv_put(self.handle, table_b, kb, len(kb),
+                                   vb, len(vb)):
+                raise OSError(f"kv_put failed for table "
+                              f"{table_b.decode(errors='replace')} "
+                              "(disk full or I/O error)")
+
+    def delete_raw(self, table_b: bytes, kb: bytes):
+        with self._hlock:
+            self._require_writable()
+            if not self.lib.kv_delete(self.handle, table_b, kb, len(kb)):
+                raise OSError(f"kv_delete failed for table "
+                              f"{table_b.decode(errors='replace')}")
+
+    def get_raw(self, table_b: bytes, kb: bytes) -> bytes | None:
+        with self._hlock:
+            self._require_open()
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            out_len = ctypes.c_uint32()
+            if not self.lib.kv_get(self.handle, table_b, kb, len(kb),
+                                   ctypes.byref(out), ctypes.byref(out_len)):
+                return None
+            raw = ctypes.string_at(out, out_len.value)
+            self.lib.kv_free(out)
+            return raw
+
+    def scan_all(self, table_b: bytes) -> list:
+        entries = []
+        with self._hlock:
+            self._require_open()
+            it = self.lib.kv_scan_start(self.handle, table_b)
+            k = ctypes.POINTER(ctypes.c_uint8)()
+            v = ctypes.POINTER(ctypes.c_uint8)()
+            kl = ctypes.c_uint32()
+            vl = ctypes.c_uint32()
+            while self.lib.kv_scan_next(it, ctypes.byref(k), ctypes.byref(kl),
+                                        ctypes.byref(v), ctypes.byref(vl)):
+                entries.append((ctypes.string_at(k, kl.value),
+                                ctypes.string_at(v, vl.value)))
+                self.lib.kv_free(k)
+                self.lib.kv_free(v)
+            self.lib.kv_scan_end(it)
+        return entries
+
+    def kv_write(self, table_b: bytes, kb: bytes, vb: bytes | None):
+        """One durable write (vb=None deletes) through the store.put
+        fault site; corrupt/torn rules mangle the bytes that land on
+        disk, which the checksum envelope must catch on read."""
+        vb = faults.inject("store.put", vb)
+        if vb is None:
+            self.delete_raw(table_b, kb)
+        else:
+            self.put_raw(table_b, kb, vb)
+
+    # -- journaled multi-table batches --------------------------------------
+    def current_batch(self) -> _BatchState | None:
+        return getattr(self._local, "batch", None)
+
+    @contextlib.contextmanager
+    def batch(self):
+        """Group writes into one atomic journaled unit.  Reentrant per
+        thread: nested batches fold into the outermost one, which
+        commits (journal -> fsync -> apply -> unjournal) on exit or
+        rolls the staged cache state back if the group aborts."""
+        st = self.current_batch()
+        if st is None:
+            st = _BatchState()
+            self._local.batch = st
+        st.depth += 1
+        try:
+            yield self
+        except BaseException:
+            st.depth -= 1
+            if st.depth == 0:
+                self._local.batch = None
+                self._rollback(st)
+            raise
+        st.depth -= 1
+        if st.depth == 0:
+            self._local.batch = None
+            self._commit_batch(st)
+
+    def _rollback(self, st: _BatchState):
+        for table, key, had, prev, was_deleted in reversed(st.undo):
+            if had:
+                table.cache[key] = prev
+            else:
+                table.cache.pop(key, None)
+            if was_deleted:
+                table._deleted.add(key)
+            else:
+                table._deleted.discard(key)
+
+    def _commit_batch(self, st: _BatchState):
+        if not st.ops:
+            return
+        good = _encode_journal(st.ops)
+        # leg 1 of store.flush: the journal bytes themselves — a corrupt
+        # or torn rule mangles what reaches the disk, simulating a crash
+        # mid-journal-write
+        blob = faults.inject("store.flush", good,
+                             kinds=("corrupt", "torn", "delay"))
+        tmp = self.journal_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.journal_path)
+        if blob is not good:
+            self._poisoned = "torn journal write (injected)"
+            raise faults.InjectedFault(
+                "injected torn journal write at store.flush")
+        # leg 2: after the journal is durable, before any op applies —
+        # an error here must replay cleanly on reopen
+        faults.inject("store.flush", kinds=("error", "drop"))
+        try:
+            for tb, kb, vb in st.ops:
+                self.kv_write(tb, kb, vb)
+            with self._hlock:
+                self._require_open()
+                self.lib.kv_flush(self.handle)
+        except BaseException as exc:
+            # an interrupted apply leaves the KV log behind the journal;
+            # refuse further writes so this handle cannot interleave new
+            # ops with the pending replay — reopen recovers
+            self._poisoned = f"batch apply interrupted: {exc!r}"
+            raise
+        try:
+            os.unlink(self.journal_path)
+        except OSError:
+            pass
+
+    def _replay_journal(self):
+        tmp = self.journal_path + ".tmp"
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        if not os.path.exists(self.journal_path):
+            return
+        try:
+            with open(self.journal_path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            blob = b""
+        ops = _decode_journal(blob)
+        if ops is None:
+            log.warning("discarding torn write journal at %s (%d bytes); "
+                        "the interrupted batch never committed",
+                        self.journal_path, len(blob))
+            _bump("journal_discards")
+            metrics.record_journal_discard()
+        else:
+            for tb, kb, vb in ops:
+                if vb is None:
+                    self.delete_raw(tb, kb)
+                else:
+                    self.put_raw(tb, kb, vb)
+            with self._hlock:
+                self.lib.kv_flush(self.handle)
+            log.info("replayed write journal at %s (%d ops)",
+                     self.journal_path, len(ops))
+            _bump("journal_replays")
+            metrics.record_journal_replay()
+        try:
+            os.unlink(self.journal_path)
+        except OSError:
+            pass
+
+    # -- lifecycle -----------------------------------------------------------
     def table(self, name: str):
         t = self._tables.get(name)
         if t is None:
@@ -291,12 +686,23 @@ class PersistentBackend(StorageBackend):
         return t
 
     def flush(self):
-        self.lib.kv_flush(self.handle)
+        if self.handle is None:
+            return
+        faults.inject("store.flush")
+        with self._hlock:
+            if self.handle is not None:
+                self.lib.kv_flush(self.handle)
 
     def compact(self):
-        self.lib.kv_compact(self.handle)
+        with self._hlock:
+            self._require_open()
+            self.lib.kv_compact(self.handle)
 
     def close(self):
-        if self.handle:
+        """Idempotent flush-and-close; releases the file lock."""
+        with self._hlock:
+            if self.handle is None:
+                return
+            self.lib.kv_flush(self.handle)
             self.lib.kv_close(self.handle)
             self.handle = None

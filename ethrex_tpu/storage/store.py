@@ -9,7 +9,9 @@ trie and all storage tries, keyed by node hash), code by hash.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import logging
 import threading
 
 from ..crypto.keccak import keccak256
@@ -21,6 +23,27 @@ from ..evm.db import StateDB, TrieSource, VmDatabase
 from ..trie.trie import Trie
 
 
+log = logging.getLogger("ethrex_tpu.storage.store")
+
+
+class CorruptRecord(RuntimeError):
+    """A persistent record failed its checksum on read.  The record has
+    been quarantined (deleted from the KV log): derivable tables
+    (canonical index) are rebuilt from surviving chain data; anything
+    else needs a resync or a snapshot restore — the corrupt bytes are
+    never decoded or served."""
+
+    def __init__(self, table: str, key, path: str = ""):
+        self.table = table
+        self.key = key
+        key_repr = key.hex() if isinstance(key, (bytes, bytearray)) \
+            else repr(key)
+        where = f" ({path})" if path else ""
+        super().__init__(
+            f"checksum mismatch in table {table!r} key {key_repr}{where}"
+            " — record quarantined; re-derive it or restore from a snapshot")
+
+
 class StorageBackend:
     """KV-table backend interface (in-memory, or the native C++ log store)."""
 
@@ -29,6 +52,14 @@ class StorageBackend:
 
     def flush(self):
         """Durability barrier; no-op for volatile backends."""
+
+    def batch(self):
+        """Atomic multi-table write group; volatile backends need no
+        journal, so the base is a no-op context."""
+        return contextlib.nullcontext(self)
+
+    def close(self):
+        """Release the backing resources; no-op for volatile backends."""
 
 
 class InMemoryBackend(StorageBackend):
@@ -106,29 +137,37 @@ class Store:
             root = state.commit()
             header = genesis.header(root)
             block_hash = header.hash
-            self.headers[block_hash] = header
             from ..primitives.block import BlockBody
-            self.bodies[block_hash] = BlockBody(
-                withdrawals=[] if header.withdrawals_root is not None
-                else None)
-            self.receipts[block_hash] = []
-            self.canonical[0] = block_hash
-            self.meta["head"] = block_hash
-            self.meta["safe"] = block_hash
-            self.meta["finalized"] = block_hash
-            self.meta["genesis"] = block_hash
-            self.meta["config"] = config_fp
+            # the genesis chain records are one journaled unit (trie
+            # nodes above are content-addressed: a partial alloc write
+            # is invisible without these records and re-written on the
+            # next init)
+            with self.write_group():
+                self.headers[block_hash] = header
+                self.bodies[block_hash] = BlockBody(
+                    withdrawals=[] if header.withdrawals_root is not None
+                    else None)
+                self.receipts[block_hash] = []
+                self.canonical[0] = block_hash
+                self.meta["head"] = block_hash
+                self.meta["safe"] = block_hash
+                self.meta["finalized"] = block_hash
+                self.meta["genesis"] = block_hash
+                self.meta["config"] = config_fp
             return header
 
     # ---------------- chain data ----------------
     def add_block(self, block: Block, receipts: list):
         with self.lock:
             h = block.hash
-            self.headers[h] = block.header
-            self.bodies[h] = block.body
-            self.receipts[h] = receipts
-            for i, tx in enumerate(block.body.transactions):
-                self.tx_index[tx.hash] = (h, i)
+            # header+body+receipts+txloc land as one journaled unit —
+            # a crash between them cannot leave a half-imported block
+            with self.write_group():
+                self.headers[h] = block.header
+                self.bodies[h] = block.body
+                self.receipts[h] = receipts
+                for i, tx in enumerate(block.body.transactions):
+                    self.tx_index[tx.hash] = (h, i)
 
     def set_canonical(self, number: int, block_hash: bytes):
         with self.lock:
@@ -141,6 +180,24 @@ class Store:
     def flush(self):
         """Durability barrier (persistent backends); no-op in memory."""
         self.backend.flush()
+
+    def write_group(self):
+        """Atomic multi-table write group (reentrant per thread): on a
+        persistent backend the writes commit through one write-ahead
+        journal, so a crash at any byte offset applies all of them or
+        none (see docs/STORAGE_RESILIENCE.md)."""
+        return self.backend.batch()
+
+    def close(self):
+        """Flush-and-close for persistent backends; idempotent.  Settles
+        any pending node-diff layers first so a clean shutdown leaves no
+        restart re-import tail."""
+        with self.lock:
+            if self.layering_enabled():
+                with self.write_group():
+                    self.nodes.flatten_all()
+            self.flush()
+            self.backend.close()
 
     # -- node-table diff layering (storage/layering.py) --------------------
     def enable_layering(self) -> None:
@@ -194,12 +251,17 @@ class Store:
 
     def _settle_node_layers(self, cutoff_number: int) -> None:
         settled = False
-        for tag in list(self.nodes.layer_tags()):
-            number, _block_hash = tag
-            if number > cutoff_number:
-                continue
-            self.nodes.flatten_layer(tag)
-            settled = True
+        # the settle burst is one journaled unit: a crash mid-flatten
+        # must not leave half a layer's nodes durable with the layer
+        # gone on restart (the re-import tail regenerates from the last
+        # full settle)
+        with self.write_group():
+            for tag in list(self.nodes.layer_tags()):
+                number, _block_hash = tag
+                if number > cutoff_number:
+                    continue
+                self.nodes.flatten_layer(tag)
+                settled = True
         if settled:
             self.flush()
 
@@ -220,10 +282,32 @@ class Store:
         return Block(h, b)
 
     def canonical_hash(self, number: int) -> bytes | None:
-        return self.canonical.get(number)
+        try:
+            return self.canonical.get(number)
+        except CorruptRecord:
+            # the canonical index is derivable: walk parent hashes down
+            # from the head and rewrite the quarantined entry
+            return self._rebuild_canonical(number)
+
+    def _rebuild_canonical(self, number: int) -> bytes | None:
+        with self.lock:
+            cursor = self.head_header()
+            while cursor.number > number:
+                parent = self.headers.get(cursor.parent_hash)
+                if parent is None:
+                    return None
+                cursor = parent
+            if cursor.number != number:
+                return None
+            self.canonical[number] = cursor.hash
+            log.warning("rebuilt quarantined canonical entry %d -> 0x%s",
+                        number, cursor.hash.hex())
+            from .persistent import note_rebuild
+            note_rebuild()
+            return cursor.hash
 
     def get_canonical_block(self, number: int) -> Block | None:
-        h = self.canonical.get(number)
+        h = self.canonical_hash(number)
         return self.get_block(h) if h else None
 
     def get_receipts(self, block_hash: bytes):

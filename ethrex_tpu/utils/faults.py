@@ -20,12 +20,21 @@ and docs/L1_SETTLEMENT_RESILIENCE.md):
     l1.verify               sequencer around L1Client.verify_batches,
                             same two-leg convention
     l1.get_deposits         sequencer before L1Client.get_deposits
+    store.open              PersistentBackend.__init__ before kv_open
+    store.put               every durable KV write (direct put/delete and
+                            each op applied from a committed batch journal)
+    store.flush             two legs per batch commit: the journal bytes
+                            (corrupt/torn mangle them = crash mid-journal)
+                            and post-journal pre-apply (error/drop = crash
+                            after the journal is durable); also fired by
+                            backend.flush (see docs/STORAGE_RESILIENCE.md)
 
 Fault kinds:
 
     drop     raise InjectedFault (a ConnectionError): dropped connection
     delay    time.sleep(seconds): a slow peer / slow TPU proof
     corrupt  mutate the payload in place of the real one
+    torn     truncate a bytes payload mid-record: a torn disk write
     error    raise an arbitrary exception: internal crash
 """
 
@@ -44,9 +53,12 @@ SITES = frozenset({
     "l1.commit",
     "l1.verify",
     "l1.get_deposits",
+    "store.open",
+    "store.put",
+    "store.flush",
 })
 
-KINDS = frozenset({"drop", "delay", "corrupt", "error"})
+KINDS = frozenset({"drop", "delay", "corrupt", "torn", "error"})
 
 
 class InjectedFault(ConnectionError):
@@ -129,6 +141,11 @@ class FaultPlan:
         return self.add(FaultRule(site, "corrupt", p=p, times=times,
                                   mutate=mutate, after=after))
 
+    def torn(self, site: str, p: float = 1.0, times: int | None = None,
+             after: int = 0) -> "FaultPlan":
+        return self.add(FaultRule(site, "torn", p=p, times=times,
+                                  after=after))
+
     def error(self, site: str, exc: BaseException | None = None,
               p: float = 1.0, times: int | None = None,
               after: int = 0) -> "FaultPlan":
@@ -144,8 +161,8 @@ class FaultPlan:
                     continue
                 if kinds is not None and rule.kind not in kinds:
                     continue
-                if rule.kind == "corrupt" and payload is None:
-                    continue  # nothing to corrupt at this call point
+                if rule.kind in ("corrupt", "torn") and payload is None:
+                    continue  # nothing to mangle at this call point
                 if rule.times is not None and rule.fired >= rule.times:
                     continue  # budget exhausted
                 rule.seen += 1
@@ -163,6 +180,9 @@ class FaultPlan:
                 time.sleep(rule.seconds)
             elif rule.kind == "corrupt":
                 payload = (rule.mutate or _default_corrupt)(payload)
+            elif rule.kind == "torn":
+                if isinstance(payload, (bytes, bytearray)):
+                    payload = bytes(payload)[:max(1, len(payload) // 2)]
             elif rule.kind == "error":
                 raise rule.exc if rule.exc is not None else InjectedFault(
                     f"injected error at {site}")

@@ -207,6 +207,35 @@ def record_transient_error():
                 "(network-class) error and were retried with backoff")
 
 
+def record_store_corruption():
+    METRICS.inc("store_corruption_total", 1,
+                "Persistent-store records whose checksum failed on read "
+                "(detected, quarantined, never served)")
+
+
+def record_store_rebuild():
+    METRICS.inc("store_rebuilds_total", 1,
+                "Quarantined records re-derived from surviving chain data "
+                "(canonical index rebuilt by parent-hash walk)")
+
+
+def record_journal_replay():
+    METRICS.inc("store_journal_replays_total", 1,
+                "Write-ahead journals replayed into the KV log on reopen "
+                "(crash landed after the journal was durable)")
+
+
+def record_journal_discard():
+    METRICS.inc("store_journal_discards_total", 1,
+                "Torn or corrupt write-ahead journals discarded on reopen "
+                "(crash landed mid-journal; the batch never committed)")
+
+
+def record_shutdown_duration(seconds: float):
+    METRICS.set("shutdown_duration_seconds", seconds,
+                "Wall-clock of the last coordinated shutdown drain")
+
+
 def record_batch(batch_number: int, proving_time: float | None = None):
     METRICS.set("ethrex_l2_latest_batch", batch_number,
                 "Latest committed L2 batch")

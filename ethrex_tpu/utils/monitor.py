@@ -103,6 +103,29 @@ def _latency_lines(snap: dict, width: int) -> list[str]:
     return lines
 
 
+def _storage_lines(snap: dict, width: int) -> list[str]:
+    """Storage resilience panel: corruption/journal counters and the last
+    drain duration.  Defensive like the latency panel — an L1-only or
+    older node has no `l2.store` section and simply gets no panel."""
+    health = snap.get("health")
+    store = {}
+    if isinstance(health, dict) and isinstance(health.get("l2"), dict):
+        store = health["l2"].get("store") or {}
+    if not isinstance(store, dict) or not store:
+        return []
+    last = store.get("lastShutdownSeconds")
+    return [
+        "─" * width,
+        " storage resilience",
+        f"   corrupt {store.get('corruptRecords', '?'):<5}"
+        f" rebuilt {store.get('rebuiltRecords', '?'):<5}"
+        f" journal replays {store.get('journalReplays', '?'):<5}"
+        f" discards {store.get('journalDiscards', '?'):<5}"
+        f" last shutdown "
+        + (f"{last:.2f}s" if isinstance(last, (int, float)) else "—"),
+    ]
+
+
 def render_lines(snap: dict, width: int = 100) -> list[str]:
     """Snapshot -> dashboard lines (pure; the curses loop just blits)."""
     h = snap["head"]
@@ -138,6 +161,7 @@ def render_lines(snap: dict, width: int = 100) -> list[str]:
         for k, v in items:
             lines.append(f"   {k}: {v}")
     lines.extend(_latency_lines(snap, width))
+    lines.extend(_storage_lines(snap, width))
     lines.append("─" * width)
     lines.append(" q quits · refreshes every interval")
     return lines

@@ -1,0 +1,137 @@
+"""Coordinated node shutdown: drain every subsystem in dependency order
+under one bounded deadline (the seat of the reference's cancellation-token
+teardown in cmd/ethrex — RPC stops accepting, writers stop, in-flight work
+lands, backends flush and close).
+
+The CLI builds a `ShutdownManager` with `build_node_shutdown` and runs it
+from its SIGTERM/SIGINT handler; `ethrex_health` reports the live phase
+while the drain runs, and the total wall-clock lands in the
+`shutdown_duration_seconds` gauge.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from .metrics import record_shutdown_duration
+
+log = logging.getLogger("ethrex_tpu.utils.shutdown")
+
+# wall-clock of the last completed drain in this process (health-readable
+# even after the manager object is gone)
+LAST_DURATION: float | None = None
+
+
+class ShutdownManager:
+    """Ordered drain steps under one deadline.
+
+    Each step is `fn(remaining_seconds)`; exceptions are recorded, never
+    propagated — a failing step must not keep later steps (flush, close)
+    from running.  Steps registered with `critical=True` (durability:
+    flush + close) run even after the deadline is exhausted, with a small
+    grace budget; ordinary steps are skipped at that point."""
+
+    CRITICAL_GRACE = 2.0
+
+    def __init__(self, deadline: float = 30.0):
+        self.deadline = deadline
+        self.steps: list[tuple[str, object, bool]] = []
+        self.phase = "running"
+        self.report: list[dict] = []
+        self.duration: float | None = None
+        self._lock = threading.Lock()
+        self._ran = False
+
+    def register(self, phase: str, fn, critical: bool = False) -> None:
+        self.steps.append((phase, fn, critical))
+
+    def summary(self) -> dict:
+        return {"phase": self.phase, "durationSeconds": self.duration,
+                "deadlineSeconds": self.deadline, "steps": self.report}
+
+    def run(self) -> dict:
+        with self._lock:
+            if self._ran:
+                return self.summary()
+            self._ran = True
+        global LAST_DURATION
+        t0 = time.monotonic()
+        for phase, fn, critical in self.steps:
+            self.phase = phase
+            remaining = self.deadline - (time.monotonic() - t0)
+            entry = {"phase": phase, "ok": True}
+            if remaining <= 0:
+                if critical:
+                    remaining = self.CRITICAL_GRACE
+                else:
+                    entry.update(ok=False, error="deadline exhausted")
+                    self.report.append(entry)
+                    log.warning("shutdown step %s skipped: deadline "
+                                "exhausted", phase)
+                    continue
+            t1 = time.monotonic()
+            try:
+                result = fn(remaining)
+                if result is False:
+                    entry["ok"] = False
+                    entry["error"] = "did not finish within its budget"
+            except Exception as e:  # noqa: BLE001 — drain must continue
+                entry["ok"] = False
+                entry["error"] = f"{type(e).__name__}: {e}"
+                log.warning("shutdown step %s failed: %s", phase,
+                            entry["error"])
+            entry["seconds"] = round(time.monotonic() - t1, 4)
+            self.report.append(entry)
+        self.duration = time.monotonic() - t0
+        self.phase = "done"
+        LAST_DURATION = self.duration
+        record_shutdown_duration(self.duration)
+        failed = [s["phase"] for s in self.report if not s["ok"]]
+        log.info("shutdown drain complete in %.2fs (%d steps%s)",
+                 self.duration, len(self.report),
+                 f"; degraded: {failed}" if failed else "")
+        return self.summary()
+
+
+def build_node_shutdown(node=None, servers=(), sequencer=None,
+                        prover_clients=(), stores=(),
+                        deadline: float = 30.0) -> ShutdownManager:
+    """Wire the standard drain order for a node stack:
+
+    1. rpc — stop accepting requests (HTTP/WS/metrics servers);
+    2. prover-clients — no new proofs enter the pipe;
+    3. sequencer — actors finish their in-flight iteration, the
+       coordinator waits for in-flight submits to land (or their leases
+       expire and reassign on restart);
+    4. producer — the dev block producer joins;
+    5. flush+close — every store settles pending layers, flushes and
+       releases its KV handle (critical: runs even past the deadline).
+
+    Any component may be None/empty — an L1-only node registers only the
+    steps it has.  The manager is attached to `node.shutdown` so
+    `ethrex_health` can report the live phase."""
+    manager = ShutdownManager(deadline=deadline)
+    for server in servers:
+        if server is None:
+            continue
+        manager.register("rpc", lambda t, s=server: s.stop())
+    for client in prover_clients:
+        if client is None:
+            continue
+        manager.register("prover-clients", lambda t, c=client: c.stop())
+    if sequencer is not None:
+        manager.register(
+            "sequencer", lambda t, s=sequencer: s.stop(timeout=t))
+    if node is not None:
+        manager.register(
+            "producer", lambda t, n=node: n.stop(timeout=max(t, 1.0)))
+    for store in stores:
+        if store is None:
+            continue
+        manager.register("flush-close",
+                         lambda t, s=store: s.close(), critical=True)
+    if node is not None:
+        node.shutdown = manager
+    return manager

@@ -49,3 +49,17 @@ def _fault_plan_guard():
             "test leaked a non-empty active FaultPlan "
             f"({len(plan.rules)} rule(s)); call faults.clear() "
             "or use the faults.injected() context manager")
+
+
+@pytest.fixture(autouse=True)
+def _close_leaked_kv_backends():
+    """Close any persistent KV handle a test left open (and release its
+    flock) so one leaked backend cannot wedge every later test that
+    reopens the same tmp path.  Silent: leaking is untidy, not a
+    failure — the handle guards make post-close access raise cleanly."""
+    yield
+    import sys
+
+    persistent = sys.modules.get("ethrex_tpu.storage.persistent")
+    if persistent is not None:
+        persistent.close_leaked_backends()

@@ -103,6 +103,25 @@ def test_every_fault_site_has_chaos_coverage():
     assert not missing, f"fault sites without chaos coverage: {missing}"
 
 
+def test_store_fault_sites_covered_by_storage_battery():
+    """The store.* sites are the storage battery's contract: each must be
+    exercised in tests/test_storage_chaos.py specifically (not merely
+    mentioned somewhere in another battery)."""
+    import os
+
+    from ethrex_tpu.utils import faults
+
+    here = os.path.dirname(__file__)
+    with open(os.path.join(here, "test_storage_chaos.py")) as f:
+        corpus = f.read()
+    store_sites = [s for s in sorted(faults.SITES)
+                   if s.startswith("store.")]
+    assert store_sites, "store.* fault sites missing from faults.SITES"
+    missing = [s for s in store_sites if f'"{s}"' not in corpus]
+    assert not missing, \
+        f"store sites without storage-battery coverage: {missing}"
+
+
 def test_no_bare_print_in_library_modules():
     """Library diagnostics go through the structured logger
     (utils/tracing.py setup_logging), never bare print().  Terminal
