@@ -554,6 +554,46 @@ def _mgas_config() -> dict:
     return res if res is not None else {"error": "no output"}
 
 
+REGRESSION_THRESHOLD = float(
+    os.environ.get("BENCH_REGRESSION_THRESHOLD", "0.8"))
+
+
+def check_regression(current: dict | None = None,
+                     baseline: dict | None = None,
+                     threshold: float = REGRESSION_THRESHOLD) -> int:
+    """CI gate: compare a fresh mgas run against the cached
+    .bench_last.json record.  Exit code 2 when current/baseline drops
+    below `threshold` (default 0.8, i.e. a >20% regression); 0 when OK
+    or when there is no baseline yet; 1 when the current measurement
+    itself failed.  Prints one JSON line either way."""
+    if current is None:
+        current = _mgas_config()
+    if baseline is None:
+        try:
+            with open(LAST_PATH) as f:
+                baseline = json.load(f).get("configs", {}).get("mgas", {})
+        except (OSError, ValueError):
+            baseline = {}
+    cur = current.get("value") if isinstance(current, dict) else None
+    base = baseline.get("value") if isinstance(baseline, dict) else None
+    out = {"metric": "mgas_regression_check", "current": cur,
+           "baseline": base, "threshold": threshold}
+    if not isinstance(cur, (int, float)) or cur <= 0:
+        out["status"] = "error"
+        out["detail"] = current.get("error", "no current measurement") \
+            if isinstance(current, dict) else "no current measurement"
+        print(json.dumps(out))
+        return 1
+    if not isinstance(base, (int, float)) or base <= 0:
+        out["status"] = "no-baseline"
+        print(json.dumps(out))
+        return 0
+    out["ratio"] = cur / base
+    out["status"] = "regression" if out["ratio"] < threshold else "ok"
+    print(json.dumps(out))
+    return 2 if out["status"] == "regression" else 0
+
+
 def main() -> None:
     cpu_fallback = False
     if (os.environ.get("BENCH_ALLOW_CPU") != "1"
@@ -641,5 +681,7 @@ if __name__ == "__main__":
         measure_config5()
     elif "--measure" in sys.argv:
         measure()
+    elif "--check-regression" in sys.argv:
+        sys.exit(check_regression())
     else:
         main()

@@ -129,6 +129,12 @@ def _add_node_flags(parser: argparse.ArgumentParser):
                         help="bounded SIGTERM/SIGINT drain deadline (s): "
                         "RPC stops, writers join, in-flight proof submits "
                         "land, every backend flushes and closes")
+    parser.add_argument("--debug-snapshot-dir", dest="debug_snapshot_dir",
+                        default=_env("DEBUG_SNAPSHOT_DIR"),
+                        help="flight-recorder destination: debug snapshot "
+                        "bundles (metrics, windows, alerts, traces, TPU "
+                        "telemetry) written here on fatal actor errors, "
+                        "shutdown, and ethrex_debug_snapshot calls")
 
 
 def _load_genesis(args) -> Genesis | None:
@@ -348,6 +354,14 @@ def run_node(args) -> int:
         node.start_dev_producer(args.block_time)
         print(f"dev producer running (block time {args.block_time}s)")
 
+    # observability: sampler + SLO alerts + optional flight recorder
+    from .utils import snapshot
+    from .utils.alerts import build_default_engine
+
+    if args.debug_snapshot_dir:
+        snapshot.configure(args.debug_snapshot_dir)
+    node.start_telemetry(alerts=build_default_engine(node))
+
     # coordinated drain (utils/shutdown.py): rpc -> producer -> flush+close
     from .utils.shutdown import build_node_shutdown
 
@@ -477,6 +491,15 @@ def run_l2(args) -> int:
             client.start()
             clients.append(client)
             print(f"in-process {ptype} prover polling the coordinator")
+
+    # observability: sampler + SLO alerts + optional flight recorder
+    # (fatal actor errors auto-snapshot via Sequencer's on_fatal hook)
+    from .utils import snapshot
+    from .utils.alerts import build_default_engine
+
+    if args.debug_snapshot_dir:
+        snapshot.configure(args.debug_snapshot_dir)
+    node.start_telemetry(alerts=build_default_engine(node))
 
     # coordinated drain: rpc -> prover clients -> sequencer (in-flight
     # proof submits land) -> producer -> flush+close both stores

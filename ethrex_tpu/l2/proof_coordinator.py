@@ -30,6 +30,7 @@ no-op storage), extended with the resilience layer:
 
 from __future__ import annotations
 
+import collections
 import logging
 import secrets
 import socketserver
@@ -102,6 +103,19 @@ class ProofCoordinator:
         # restart via normal lease expiry)
         self._inflight = 0
         self._inflight_cv = threading.Condition()
+        # bounded ring of recent lease events (assign/expire/reject/
+        # quarantine/proof) for the flight recorder: the raw counters say
+        # HOW MANY leases churned, this says WHICH and WHEN
+        self.events: collections.deque = collections.deque(maxlen=64)
+
+    def _note_event(self, event: str, batch: int, prover_type: str,
+                    detail: str | None = None):
+        """Caller holds self.lock (or accepts best-effort ordering)."""
+        entry = {"ts": time.time(), "event": event, "batch": batch,
+                 "proverType": prover_type}
+        if detail:
+            entry["detail"] = detail
+        self.events.append(entry)
 
     @staticmethod
     def _now() -> float:
@@ -119,6 +133,7 @@ class ProofCoordinator:
         self.failures[key] = self.failures.get(key, 0) + 1
         self.reassignments_total += 1
         record_reassignment(batch, prover_type)
+        self._note_event("lease-failure", batch, prover_type, reason)
         log.warning("batch %d assignment to %s failed (%s), %d/%d before "
                     "quarantine", batch, prover_type, reason,
                     self.failures[key], self.quarantine_threshold)
@@ -127,6 +142,7 @@ class ProofCoordinator:
                 and batch not in self.quarantined):
             self.quarantined.add(batch)
             record_quarantine(len(self.quarantined))
+            self._note_event("quarantine", batch, prover_type)
             log.error("batch %d quarantined off %r after %d failed "
                       "assignments; falling back to %r", batch,
                       prover_type, self.failures[key], self.fallback_type)
@@ -332,6 +348,7 @@ class ProofCoordinator:
                 self.rollup.store_proof(batch, prover_type, proof)
         with self.lock:
             started = self._clear_lease(key)
+            self._note_event("proof-stored", batch, prover_type)
         if started is not None and holds_lease:
             # proving-time metric (reference: set_batch_proving_time,
             # proof_coordinator.rs:286-296) — only meaningful when the
@@ -371,6 +388,8 @@ class ProofCoordinator:
                     program_input = self.rollup.get_prover_input(
                         batch, self.commit_hash)
                     assign_span = sp.span_id if sp else None
+            with self.lock:
+                self._note_event("assign", batch, prover_type)
             return {"type": protocol.INPUT_RESPONSE, "batch_id": batch,
                     "input": program_input, "format": self.proof_format,
                     "lease_token": self.lease_token(batch, prover_type),
@@ -398,6 +417,7 @@ class ProofCoordinator:
                 "failures": {f"{num}/{ptype}": count
                              for (num, ptype), count
                              in sorted(self.failures.items())},
+                "recentEvents": list(self.events),
             }
 
     # ------------------------------------------------------------------

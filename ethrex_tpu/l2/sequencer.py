@@ -168,6 +168,7 @@ class Sequencer:
         self.health: dict[str, ActorHealth] = {}
         self.fatal: tuple[str, str] | None = None
         self.on_fatal = None  # callback(actor, error) for orchestrators
+        self.started_at: float | None = None  # stall-watchdog baseline
         # admin controls (reference: admin_server.rs — committer
         # start/stop with optional delay, sequencer stop-at-batch)
         self.paused: set[str] = set()
@@ -727,6 +728,9 @@ class Sequencer:
                     self.coordinator.batch_traces.get(n)):
                 with tracing.span("proof.settle", batch=n):
                     self.rollup.set_verified(n)
+        from ..utils.metrics import record_verified_batch
+
+        record_verified_batch(last)
         return (first, last)
 
     # ------------------------------------------------------------------
@@ -779,10 +783,14 @@ class Sequencer:
                 from ..utils.metrics import record_l1_reorg
 
                 record_l1_reorg()
+        from ..utils.metrics import record_verified_batch
+
+        record_verified_batch(verified)
 
     # ------------------------------------------------------------------
     def start(self):
         self.coordinator.start()
+        self.started_at = time.time()
 
         def loop(interval, fn):
             st = ActorHealth(fn.__name__)
@@ -850,6 +858,16 @@ class Sequencer:
                             cb = self.on_fatal
                             if cb is not None:
                                 cb(st.name, st.last_error)
+                            # flight recorder: capture the dying state
+                            # (no-op unless --debug-snapshot-dir is set;
+                            # must never raise in the actor loop)
+                            try:
+                                from ..utils import snapshot as _snapshot
+
+                                _snapshot.on_fatal(st.name, st.last_error,
+                                                   node=self.node)
+                            except Exception:
+                                pass
                             try:
                                 self.coordinator.stop()
                             except Exception:  # noqa: BLE001 — not started

@@ -35,6 +35,23 @@ class Node:
         # new-canonical-block observers (websocket subscriptions etc.);
         # `on_new_block` stays the single p2p gossip hook
         self.block_listeners: list = []
+        # observability surfaces attached by start_telemetry / the CLI
+        self.telemetry = None
+        self.alerts = None
+
+    def start_telemetry(self, interval: float = 1.0, alerts=None):
+        """Start the metrics sampler (the node owns its lifecycle; the
+        shutdown drain's `telemetry` step stops it with a final sample).
+        When an AlertEngine is supplied its evaluate() runs after every
+        sampler tick."""
+        from .utils import timeseries
+
+        engine = timeseries.ENGINE
+        if alerts is not None:
+            self.alerts = alerts
+            engine.add_evaluator(alerts.evaluate)
+        self.telemetry = engine.start(interval)
+        return engine
 
     # ------------------------------------------------------------------
     def head_state_root(self) -> bytes:

@@ -405,7 +405,13 @@ class TpuBackend(ProverBackend):
         # one root span per prove so per-stage child spans form a single
         # subtree even when no caller opened a trace (e.g. bench)
         with tracing.span("backend.prove", format=proof_format):
-            return self._prove_impl(program_input, proof_format)
+            out = self._prove_impl(program_input, proof_format)
+        # refresh device-memory / live-array gauges while the runtime
+        # still holds this proof's peak allocations (never raises)
+        from ..utils.jax_cache import update_metrics_gauges
+
+        update_metrics_gauges()
+        return out
 
     def _prove_impl(self, program_input: ProgramInput,
                     proof_format: str) -> dict:

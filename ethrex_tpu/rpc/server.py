@@ -144,6 +144,9 @@ class RpcServer:
                 lambda limit=None: TRACER.recent(_trace_limit(limit)),
             "ethrex_trace_slowest":
                 lambda limit=None: TRACER.slowest(_trace_limit(limit)),
+            # SLO/alert engine + flight recorder (docs/OBSERVABILITY.md)
+            "ethrex_alerts": lambda: _alerts(node),
+            "ethrex_debug_snapshot": lambda: _debug_snapshot(node),
         }
 
     def handle(self, request: dict):
@@ -475,6 +478,29 @@ def _trace_limit(limit) -> int:
     return int(limit)
 
 
+def _alerts(node):
+    """ethrex_alerts: alert-engine state, degrading to a disabled stub
+    on nodes that never attached an engine (L1-only / older nodes)."""
+    eng = getattr(node, "alerts", None)
+    if eng is None:
+        return {"enabled": False, "rules": [], "active": [], "recent": []}
+    out = {"enabled": True}
+    out.update(eng.to_json())
+    return out
+
+
+def _debug_snapshot(node):
+    """ethrex_debug_snapshot: return a flight-recorder bundle, and
+    persist it when --debug-snapshot-dir configured a destination."""
+    from ..utils import snapshot
+
+    bundle = snapshot.collect(node, reason="rpc")
+    path = snapshot.write(node, reason="rpc", bundle=bundle)
+    if path is not None:
+        bundle["path"] = path
+    return bundle
+
+
 def _health(node):
     out = {
         "head": node.store.latest_number(),
@@ -483,6 +509,21 @@ def _health(node):
         "tracing": {"bufferedTraces": len(TRACER),
                     "droppedTraces": TRACER.dropped},
     }
+    alerts = getattr(node, "alerts", None)
+    if alerts is not None:
+        active = alerts.active()
+        out["alerts"] = {
+            "firing": len(active),
+            "page": sum(1 for a in active if a["severity"] == "page"),
+            "warn": sum(1 for a in active if a["severity"] == "warn"),
+            "active": [a["name"] for a in active],
+            "transitions": alerts.transitions_total,
+        }
+    telemetry = getattr(node, "telemetry", None)
+    if telemetry is not None:
+        out["telemetry"] = {"samples": len(telemetry.samples),
+                            "samplerRunning": telemetry.running(),
+                            "samplerErrors": telemetry.sampler_errors}
     sd = getattr(node, "shutdown", None)
     if sd is not None:
         out["shutdown"] = {"phase": sd.phase,

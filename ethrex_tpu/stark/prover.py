@@ -22,6 +22,7 @@ docs/SOUNDNESS.md.
 from __future__ import annotations
 
 import dataclasses
+import time
 
 import numpy as np
 import jax
@@ -34,6 +35,7 @@ from ..ops import merkle
 from ..ops import ntt
 from ..ops.challenger import Challenger
 from ..utils import tracing
+from ..utils.metrics import record_kernel_build
 from .air import Air, DeviceOps
 
 
@@ -85,8 +87,13 @@ def _phases(air: Air, log_n: int, lb: int, shift: int, mesh=None):
     cached = _PHASE_CACHE.get(key)
     if cached is not None:
         return cached
+    t0 = time.perf_counter()
     built = _build_phases(air, log_n, lb, shift, mesh)
     _PHASE_CACHE[key] = built
+    # retrace telemetry: every miss here is a fresh set of phase programs
+    # (trace + jit staging; XLA compile time lands separately through
+    # jax.monitoring in utils/jax_cache.py)
+    record_kernel_build(type(air).__name__, time.perf_counter() - t0)
     return built
 
 

@@ -1,0 +1,285 @@
+"""Declarative SLO/alert engine over the time-series windows.
+
+Rules are plain data: a signal callable (engine, node) -> float | None,
+a threshold, and hysteresis counts.  The state machine per rule is
+
+    ok -> pending -> firing -> ok
+
+with two flap guards: a rule must breach `for_count` consecutive
+evaluations before it fires (a single bad sample never pages), and must
+clear `resolve_count` consecutive evaluations before it resolves (a
+boundary-hugging series cannot strobe).  A signal returning None (cold
+start, no samples, no data in window) is always treated as not-breached.
+
+Burn-rate severities follow the multi-window convention: each SLO
+yields a "page" rule (short window, high threshold — fast burn) and a
+"warn" rule (long window, lower threshold — slow burn).  Transitions
+are logged, counted (alert_transitions_total / alerts_firing), kept in
+a bounded history ring, and surfaced through the ethrex_alerts RPC, the
+ethrex_health alerts section, and the monitor panel.
+
+evaluate() never raises — a broken rule records its error on the rule
+state and evaluation moves on.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable
+
+from . import timeseries
+from .metrics import record_alert_transition, record_alerts_firing
+
+log = logging.getLogger("ethrex_tpu.alerts")
+
+HISTORY = 64
+
+
+@dataclasses.dataclass
+class AlertRule:
+    name: str
+    severity: str                      # "page" | "warn"
+    signal: Callable                   # (engine, node) -> float | None
+    threshold: float
+    window: float = 60.0               # informational: the signal's window
+    for_count: int = 2                 # consecutive breaches before firing
+    resolve_count: int = 2             # consecutive clears before resolving
+    description: str = ""
+    runbook: str = ""
+
+
+class _RuleState:
+    __slots__ = ("state", "breach_streak", "ok_streak", "since",
+                 "last_value", "last_error")
+
+    def __init__(self):
+        self.state = "ok"
+        self.breach_streak = 0
+        self.ok_streak = 0
+        self.since = None
+        self.last_value = None
+        self.last_error = None
+
+
+class AlertEngine:
+    """Evaluates a rule set against a TimeSeriesEngine; never raises."""
+
+    def __init__(self, engine=None, rules=(), node=None,
+                 history: int = HISTORY):
+        self.engine = engine if engine is not None else timeseries.ENGINE
+        self.node = node
+        self.rules = list(rules)
+        self.states = {r.name: _RuleState() for r in self.rules}
+        self.history: collections.deque = collections.deque(maxlen=history)
+        self.transitions_total = 0
+        self.eval_errors = 0
+        self.lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def evaluate(self, now: float | None = None):
+        try:
+            self._evaluate(time.time() if now is None else now)
+        except Exception:
+            self.eval_errors += 1
+
+    def _evaluate(self, now: float):
+        with self.lock:
+            for rule in self.rules:
+                st = self.states[rule.name]
+                try:
+                    value = rule.signal(self.engine, self.node)
+                    st.last_error = None
+                except Exception as exc:
+                    value = None
+                    st.last_error = f"{type(exc).__name__}: {exc}"
+                    self.eval_errors += 1
+                st.last_value = value
+                breached = value is not None and value >= rule.threshold
+                if breached:
+                    st.breach_streak += 1
+                    st.ok_streak = 0
+                    if st.state != "firing":
+                        if st.breach_streak >= rule.for_count:
+                            self._transition(rule, st, "firing", now, value)
+                        else:
+                            st.state = "pending"
+                else:
+                    st.ok_streak += 1
+                    st.breach_streak = 0
+                    if st.state == "firing":
+                        if st.ok_streak >= rule.resolve_count:
+                            self._transition(rule, st, "resolved", now, value)
+                    elif st.state == "pending":
+                        st.state = "ok"
+            firing = sum(1 for s in self.states.values()
+                         if s.state == "firing")
+        record_alerts_firing(firing)
+
+    def _transition(self, rule, st, event, now, value):
+        st.state = "firing" if event == "firing" else "ok"
+        st.since = now
+        self.transitions_total += 1
+        self.history.append({
+            "rule": rule.name, "severity": rule.severity, "event": event,
+            "ts": now, "value": value})
+        record_alert_transition(rule.name, event)
+        log.log(logging.WARNING if event == "firing" else logging.INFO,
+                "alert %s: %s [%s] value=%s threshold=%s",
+                event, rule.name, rule.severity, value, rule.threshold)
+
+    # ------------------------------------------------------------------
+    def _alert_json(self, rule, st):
+        return {"name": rule.name, "severity": rule.severity,
+                "state": st.state, "value": st.last_value,
+                "threshold": rule.threshold, "window": rule.window,
+                "since": st.since, "description": rule.description,
+                "runbook": rule.runbook, "error": st.last_error}
+
+    def active(self) -> list:
+        with self.lock:
+            return [self._alert_json(r, self.states[r.name])
+                    for r in self.rules
+                    if self.states[r.name].state == "firing"]
+
+    def to_json(self) -> dict:
+        with self.lock:
+            rules = [self._alert_json(r, self.states[r.name])
+                     for r in self.rules]
+            recent = list(self.history)
+        return {"rules": rules,
+                "active": [r for r in rules if r["state"] == "firing"],
+                "recent": recent,
+                "transitions": self.transitions_total,
+                "evalErrors": self.eval_errors}
+
+
+# ---------------------------------------------------------------------------
+# signal helpers (each returns (engine, node) -> float | None)
+
+def rate_signal(counter: str, window: float = 60.0):
+    return lambda eng, node: eng.rate(counter, window=window)
+
+
+def p95_signal(histogram: str, window: float = 300.0):
+    def sig(eng, node):
+        p = eng.percentiles(histogram, qs=(0.95,), window=window)
+        return None if p is None else p.get("p95")
+    return sig
+
+
+def settlement_lag_signal(eng, node):
+    """Batches committed but not yet verified on the L1."""
+    latest = eng.gauge("ethrex_l2_latest_batch")
+    if latest is None:
+        return None
+    verified = eng.gauge("ethrex_l2_last_verified_batch") or 0.0
+    return latest - verified
+
+
+def actor_stall_signal(eng, node):
+    """Seconds since the least-recently-successful sequencer actor made
+    progress (no-progress watchdog; every healthy actor iteration —
+    including an idle no-op — counts as a success)."""
+    seq = getattr(node, "sequencer", None)
+    if seq is None or not getattr(seq, "health", None):
+        return None
+    now = time.time()
+    started = getattr(seq, "started_at", None)
+    worst = None
+    for st in seq.health.values():
+        last = getattr(st, "last_success", None)
+        if last is None:
+            if (not getattr(st, "runs", 0)
+                    and not getattr(st, "consecutive_failures", 0)):
+                continue            # actor never scheduled yet
+            last = started
+        if last is None:
+            continue
+        stall = now - last
+        if worst is None or stall > worst:
+            worst = stall
+    return worst
+
+
+def default_rules(node=None) -> list:
+    """The stock SLO set (documented in docs/OBSERVABILITY.md)."""
+    mk = AlertRule
+    return [
+        # batch proving latency (tail) — fast/slow burn over p95
+        mk("batch_proving_p95:page", "page",
+           p95_signal("batch_proving_seconds", window=120.0), 480.0,
+           window=120.0, for_count=2, resolve_count=3,
+           description="Batch proof p95 over 2m exceeds 480s",
+           runbook="Check prover fleet health (ethrex_health l2.prover) "
+                   "and TPU compile churn (prover_kernel_retraces_total)."),
+        mk("batch_proving_p95:warn", "warn",
+           p95_signal("batch_proving_seconds", window=600.0), 120.0,
+           window=600.0, for_count=3, resolve_count=3,
+           description="Batch proof p95 over 10m exceeds 120s",
+           runbook="Inspect prover_stage_seconds for the regressing stage."),
+        # prover lease-loss / reassignment rate
+        mk("prover_reassignment_rate:page", "page",
+           rate_signal("proof_reassignments_total", window=60.0), 0.2,
+           window=60.0, for_count=2, resolve_count=3,
+           description="Lease losses/rejections above 0.2/s over 1m",
+           runbook="Provers are dying or submitting bad proofs; check "
+                   "quarantined_batches and the coordinator log."),
+        mk("prover_reassignment_rate:warn", "warn",
+           rate_signal("proof_reassignments_total", window=600.0), 0.02,
+           window=600.0, for_count=3, resolve_count=3,
+           description="Lease losses/rejections above 0.02/s over 10m",
+           runbook="A prover endpoint is flapping; check breaker metrics."),
+        # store corruption — any corruption warrants a look
+        mk("store_corruption_rate:page", "page",
+           rate_signal("store_corruption_total", window=60.0), 0.1,
+           window=60.0, for_count=2, resolve_count=3,
+           description="Checksum failures above 0.1/s over 1m",
+           runbook="Disk is actively corrupting records; stop writes and "
+                   "inspect backend.quarantined."),
+        mk("store_corruption_rate:warn", "warn",
+           rate_signal("store_corruption_total", window=600.0), 0.001,
+           window=600.0, for_count=2, resolve_count=3,
+           description="Any checksum failure in the last 10m",
+           runbook="See docs/STORAGE_RESILIENCE.md quarantine flow."),
+        # L1 settlement lag (gauge-derived; windows are evaluation-paced)
+        mk("l1_settlement_lag:page", "page",
+           settlement_lag_signal, 20.0,
+           window=60.0, for_count=3, resolve_count=3,
+           description="20+ committed batches await L1 verification",
+           runbook="Verifier is stalled or L1 is rejecting proofs; check "
+                   "l2.l1 in ethrex_health."),
+        mk("l1_settlement_lag:warn", "warn",
+           settlement_lag_signal, 5.0,
+           window=600.0, for_count=5, resolve_count=3,
+           description="5+ committed batches await L1 verification",
+           runbook="Settlement is falling behind proving; check "
+                   "send_proofs actor latency."),
+        # sequencer actor stall — no-progress watchdog
+        mk("sequencer_stall:page", "page",
+           actor_stall_signal, 120.0,
+           window=60.0, for_count=2, resolve_count=3,
+           description="A sequencer actor made no progress for 120s",
+           runbook="Check l2.actors in ethrex_health for the stalled "
+                   "actor and its lastError."),
+        mk("sequencer_stall:warn", "warn",
+           actor_stall_signal, 30.0,
+           window=60.0, for_count=3, resolve_count=3,
+           description="A sequencer actor made no progress for 30s",
+           runbook="Often an L1 outage burning the transient budget; see "
+                   "sequencer_transient_errors_total."),
+        # sequencer loop latency (tail) — slow-burn warn only
+        mk("sequencer_loop_p95:warn", "warn",
+           p95_signal("sequencer_actor_seconds", window=600.0), 5.0,
+           window=600.0, for_count=3, resolve_count=3,
+           description="Actor loop p95 over 10m exceeds 5s",
+           runbook="An actor body is slow; sequencer_actor_seconds is "
+                   "labelled per actor."),
+    ]
+
+
+def build_default_engine(node=None, engine=None) -> AlertEngine:
+    return AlertEngine(engine=engine, rules=default_rules(node), node=node)

@@ -1,15 +1,30 @@
-"""Persistent XLA compile cache keyed by a host-CPU fingerprint.
+"""Persistent XLA compile cache keyed by a host-CPU fingerprint, plus
+JAX runtime telemetry.
 
 XLA's AOT results embed machine features; loading a cache written on a
 different host SIGSEGVs/SIGILLs (observed as "Compile machine features ...
 doesn't match" warnings before a crash).  Both the test suite and bench.py
 route through this helper so they share one correctly-scoped cache.
+
+Telemetry: jax.monitoring listeners count backend compiles (with
+durations) and persistent-cache hits/misses; runtime_telemetry() adds
+per-device memory stats and live-array counts for the flight recorder,
+and update_metrics_gauges() mirrors them into the Metrics registry.
+Every telemetry path is exception-guarded — a missing jax.monitoring
+API or a backend without memory_stats() degrades to empty data, never
+an error in the prover path.
 """
 
 from __future__ import annotations
 
 import hashlib
 import platform
+import threading
+
+_LOCK = threading.Lock()
+_MONITORING_INSTALLED = False
+STATS = {"compiles": 0, "compile_seconds": 0.0,
+         "cache_hits": 0, "cache_misses": 0}
 
 
 def cache_dir(prefix: str = "/tmp/ethrex_tpu_jax_cache") -> str:
@@ -22,9 +37,116 @@ def cache_dir(prefix: str = "/tmp/ethrex_tpu_jax_cache") -> str:
     return f"{prefix}_{fp}"
 
 
+def _on_duration(event: str, duration: float, **kw) -> None:
+    try:
+        if "backend_compile" in event:
+            with _LOCK:
+                STATS["compiles"] += 1
+                STATS["compile_seconds"] += duration
+            from .metrics import record_jax_compile
+
+            record_jax_compile(duration)
+    except Exception:
+        pass
+
+
+def _on_event(event: str, **kw) -> None:
+    try:
+        if "cache_hit" in event:
+            with _LOCK:
+                STATS["cache_hits"] += 1
+            from .metrics import record_jax_cache_event
+
+            record_jax_cache_event(True)
+        elif "cache_miss" in event:
+            with _LOCK:
+                STATS["cache_misses"] += 1
+            from .metrics import record_jax_cache_event
+
+            record_jax_cache_event(False)
+    except Exception:
+        pass
+
+
+def install_monitoring() -> bool:
+    """Attach jax.monitoring listeners (idempotent, never raises).
+    Returns whether listeners are installed."""
+    global _MONITORING_INSTALLED
+    with _LOCK:
+        if _MONITORING_INSTALLED:
+            return True
+        try:
+            from jax import monitoring
+
+            monitoring.register_event_duration_secs_listener(_on_duration)
+            monitoring.register_event_listener(_on_event)
+            _MONITORING_INSTALLED = True
+        except Exception:
+            return False
+    return True
+
+
 def enable_persistent_cache(min_compile_secs: float = 1.0) -> None:
     import jax
 
     jax.config.update("jax_compilation_cache_dir", cache_dir())
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       min_compile_secs)
+    install_monitoring()
+
+
+def runtime_telemetry() -> dict:
+    """JAX runtime facts for the flight recorder.  Never raises."""
+    with _LOCK:
+        out = {"cache": dict(STATS), "cacheDir": cache_dir(),
+               "monitoring": _MONITORING_INSTALLED}
+    try:
+        import jax
+
+        out["backend"] = jax.default_backend()
+        devices = []
+        for d in jax.local_devices():
+            entry = {"id": d.id, "platform": d.platform,
+                     "kind": getattr(d, "device_kind", None)}
+            try:
+                ms = d.memory_stats()
+                entry["memory"] = (
+                    {k: ms[k] for k in ("bytes_in_use", "peak_bytes_in_use",
+                                        "bytes_limit") if k in ms}
+                    if ms else None)
+            except Exception:
+                entry["memory"] = None
+            devices.append(entry)
+        out["devices"] = devices
+        try:
+            out["liveArrays"] = len(jax.live_arrays())
+        except Exception:
+            out["liveArrays"] = None
+    except Exception as exc:
+        out["error"] = f"{type(exc).__name__}: {exc}"
+    return out
+
+
+def update_metrics_gauges() -> None:
+    """Mirror device memory / live-array stats into gauges.  Called
+    after each backend prove; never raises."""
+    try:
+        from .metrics import (record_jax_device_memory,
+                              record_jax_live_arrays)
+
+        tel = runtime_telemetry()
+        in_use = peak = 0.0
+        seen = False
+        for d in tel.get("devices", ()):
+            mem = d.get("memory")
+            if not mem:
+                continue
+            seen = True
+            in_use += mem.get("bytes_in_use", 0) or 0
+            peak += mem.get("peak_bytes_in_use", 0) or 0
+        if seen:
+            record_jax_device_memory(in_use, peak)
+        if tel.get("liveArrays") is not None:
+            record_jax_live_arrays(tel["liveArrays"])
+    except Exception:
+        pass

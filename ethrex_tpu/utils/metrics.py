@@ -22,6 +22,13 @@ def _fmt_labels(labels: tuple) -> str:
     return ",".join(f'{k}="{_escape_label(v)}"' for k, v in labels)
 
 
+def _fmt_le(le) -> str:
+    """Canonical shortest-float bucket boundary: coerce to float first so
+    numpy scalars / ints / Decimals all render identically ("0.004",
+    "5.0"), keeping le labels stable and joinable across scrapes."""
+    return repr(float(le))
+
+
 class _Histogram:
     """One named histogram family: per-labelset bucket counts + sum."""
 
@@ -80,6 +87,39 @@ class Metrics:
             if help_text:
                 self.help[name] = help_text
 
+    def snapshot(self) -> dict:
+        """Point-in-time plain-data copy of the registry (JSON-safe).
+
+        The time-series engine samples this periodically; histogram rows
+        keep the cumulative-per-bucket layout so window deltas can be
+        taken bucket-by-bucket."""
+        with self.lock:
+            hists = {}
+            for name, hist in self.histograms.items():
+                nb = len(hist.buckets)
+                hists[name] = {
+                    "buckets": [float(b) for b in hist.buckets],
+                    "series": [
+                        {"labels": dict(labels),
+                         "counts": [int(c) for c in row[:nb + 1]],
+                         "sum": float(row[-1])}
+                        for labels, row in hist.series.items()],
+                }
+            return {"ts": time.time(),
+                    "counters": dict(self.counters),
+                    "gauges": dict(self.gauges),
+                    "histograms": hists}
+
+    def reset(self):
+        """Drop every series and restart the uptime clock (test isolation
+        and simulated process restarts)."""
+        with self.lock:
+            self.counters.clear()
+            self.gauges.clear()
+            self.histograms.clear()
+            self.help.clear()
+            self.started = time.time()
+
     def _render_histograms(self, lines: list):
         for name, hist in sorted(self.histograms.items()):
             if name in self.help:
@@ -91,7 +131,7 @@ class Metrics:
                 sep = "," if base else ""
                 for i, le in enumerate(hist.buckets):
                     lines.append(
-                        f'{name}_bucket{{{base}{sep}le="{repr(le)}"}} '
+                        f'{name}_bucket{{{base}{sep}le="{_fmt_le(le)}"}} '
                         f"{row[i]}")
                 lines.append(
                     f'{name}_bucket{{{base}{sep}le="+Inf"}} {row[nb]}')
@@ -242,6 +282,75 @@ def record_batch(batch_number: int, proving_time: float | None = None):
     if proving_time is not None:
         METRICS.set("ethrex_l2_batch_proving_seconds", proving_time,
                     "Wall-clock of the last batch proof")
+        _observe_safe("batch_proving_seconds", proving_time, None,
+                      "Batch proof wall-clock distribution (drives the "
+                      "proving-latency p95 SLO)")
+
+
+def record_verified_batch(batch_number: int):
+    METRICS.set("ethrex_l2_last_verified_batch", batch_number,
+                "Highest L2 batch verified on the L1 (settlement-lag "
+                "alert reads latest_batch minus this)")
+
+
+def record_kernel_build(air: str, seconds: float):
+    METRICS.inc("prover_kernel_retraces_total", 1,
+                "STARK phase-program builds (jit retraces): cache misses "
+                "in the in-process phase cache")
+    _observe_safe("prover_kernel_build_seconds", seconds, {"air": air},
+                  "Wall-clock to build+stage the jitted STARK phase "
+                  "programs for one AIR shape")
+
+
+def record_jax_compile(seconds: float):
+    METRICS.inc("jax_backend_compiles_total", 1,
+                "XLA backend compilations observed via jax.monitoring")
+    _observe_safe("jax_backend_compile_seconds", seconds, None,
+                  "XLA backend compile wall-clock per compilation")
+
+
+def record_jax_cache_event(hit: bool):
+    if hit:
+        METRICS.inc("jax_compilation_cache_hits_total", 1,
+                    "Persistent XLA compilation-cache hits")
+    else:
+        METRICS.inc("jax_compilation_cache_misses_total", 1,
+                    "Persistent XLA compilation-cache misses")
+
+
+def record_jax_device_memory(bytes_in_use: float, peak_bytes: float):
+    METRICS.set("jax_device_bytes_in_use", bytes_in_use,
+                "Accelerator memory currently allocated, summed over "
+                "local devices")
+    METRICS.set("jax_device_peak_bytes_in_use", peak_bytes,
+                "Peak accelerator memory allocated, summed over local "
+                "devices")
+
+
+def record_jax_live_arrays(count: float):
+    METRICS.set("jax_live_arrays", count,
+                "Live JAX arrays currently tracked by the runtime")
+
+
+def record_telemetry_sample():
+    METRICS.inc("telemetry_samples_total", 1,
+                "Registry samples taken by the time-series engine")
+
+
+def record_alert_transition(rule: str, event: str):
+    METRICS.inc("alert_transitions_total", 1,
+                "Alert state transitions (firing or resolved) across all "
+                "rules")
+
+
+def record_alerts_firing(count: int):
+    METRICS.set("alerts_firing", count,
+                "Alert rules currently in the firing state")
+
+
+def record_snapshot_written():
+    METRICS.inc("debug_snapshots_total", 1,
+                "Flight-recorder debug snapshots written to disk")
 
 
 def _observe_safe(name, value, labels, help_text):
@@ -286,17 +395,27 @@ class MetricsServer:
     def start(self):
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
-                if self.path != "/metrics":
-                    self.send_response(404)
+                # A scraper may abort mid-response; a dead socket is the
+                # scraper's problem, never the server thread's.
+                try:
+                    if self.path != "/metrics":
+                        body = b"not found\n"
+                        self.send_response(404)
+                        self.send_header("Content-Type",
+                                         "text/plain; charset=utf-8")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    body = METRICS.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
-                    return
-                body = METRICS.render().encode()
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
 
             def log_message(self, *args):
                 pass

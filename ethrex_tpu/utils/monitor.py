@@ -60,6 +60,11 @@ def snapshot(rpc: RpcSession, blocks: int = 8) -> dict:
     except Exception:
         out["traces"] = None
     try:
+        # older nodes don't serve the alerts namespace; skip the panel
+        out["alerts"] = rpc.call("ethrex_alerts", [])
+    except Exception:
+        out["alerts"] = None
+    try:
         out["peers"] = len(rpc.call("admin_peers", []))
     except Exception:
         out["peers"] = None
@@ -126,6 +131,36 @@ def _storage_lines(snap: dict, width: int) -> list[str]:
     ]
 
 
+def _alerts_lines(snap: dict, width: int) -> list[str]:
+    """Alerts panel: firing SLO rules + most recent transitions.
+    Defensive — an L1-only node answers enabled=False (no panel) and an
+    older node without ethrex_alerts yields None (no panel)."""
+    alerts = snap.get("alerts")
+    if not isinstance(alerts, dict) or not alerts.get("enabled"):
+        return []
+    active = alerts.get("active")
+    active = active if isinstance(active, list) else []
+    lines = ["─" * width,
+             f" alerts  firing {len(active)}"]
+    for a in active[:5]:
+        if not isinstance(a, dict):
+            continue
+        value = a.get("value")
+        shown = f"{value:.4g}" if isinstance(value, (int, float)) else "—"
+        lines.append(f"   [{str(a.get('severity', '?')):<4}]"
+                     f" {str(a.get('name', '?')):<32}"
+                     f" value {shown:>10}"
+                     f" ≥ {a.get('threshold', '?')}")
+    recent = alerts.get("recent")
+    if isinstance(recent, list) and recent:
+        for ev in recent[-3:]:
+            if not isinstance(ev, dict):
+                continue
+            lines.append(f"   {str(ev.get('event', '?')):<9}"
+                         f" {str(ev.get('rule', '?')):<32}")
+    return lines
+
+
 def render_lines(snap: dict, width: int = 100) -> list[str]:
     """Snapshot -> dashboard lines (pure; the curses loop just blits)."""
     h = snap["head"]
@@ -160,6 +195,7 @@ def render_lines(snap: dict, width: int = 100) -> list[str]:
         items = hl.items() if isinstance(hl, dict) else enumerate(hl)
         for k, v in items:
             lines.append(f"   {k}: {v}")
+    lines.extend(_alerts_lines(snap, width))
     lines.extend(_latency_lines(snap, width))
     lines.extend(_storage_lines(snap, width))
     lines.append("─" * width)

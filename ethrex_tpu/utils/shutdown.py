@@ -111,8 +111,15 @@ def build_node_shutdown(node=None, servers=(), sequencer=None,
 
     Any component may be None/empty — an L1-only node registers only the
     steps it has.  The manager is attached to `node.shutdown` so
-    `ethrex_health` can report the live phase."""
+    `ethrex_health` can report the live phase.
+
+    Two telemetry steps bracket the drain: a flight-recorder snapshot
+    runs FIRST (capturing the live pre-drain state; a no-op unless
+    --debug-snapshot-dir configured a destination), and the time-series
+    sampler is stopped (with one final drain sample) after the
+    sequencer/producer land but before stores close."""
     manager = ShutdownManager(deadline=deadline)
+    manager.register("snapshot", lambda t: _write_shutdown_snapshot(node))
     for server in servers:
         if server is None:
             continue
@@ -127,6 +134,7 @@ def build_node_shutdown(node=None, servers=(), sequencer=None,
     if node is not None:
         manager.register(
             "producer", lambda t, n=node: n.stop(timeout=max(t, 1.0)))
+    manager.register("telemetry", lambda t: _stop_telemetry())
     for store in stores:
         if store is None:
             continue
@@ -135,3 +143,19 @@ def build_node_shutdown(node=None, servers=(), sequencer=None,
     if node is not None:
         node.shutdown = manager
     return manager
+
+
+def _write_shutdown_snapshot(node):
+    from . import snapshot
+
+    if snapshot.configured_dir() is None:
+        return True
+    snapshot.write(node, reason="shutdown")
+    return True
+
+
+def _stop_telemetry():
+    from . import timeseries
+
+    timeseries.ENGINE.stop()
+    return True

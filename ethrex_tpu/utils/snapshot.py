@@ -1,0 +1,136 @@
+"""Flight recorder: one-file JSON debug snapshots for post-mortems.
+
+A snapshot bundles everything an operator needs after an incident —
+metrics dump, time-series windows, active + recent alerts, slowest
+traces, actor health, store/journal stats, and TPU/JAX runtime
+telemetry — into a single JSON document.  Bundles are produced on
+demand (`ethrex_debug_snapshot` RPC), automatically on fatal actor
+errors (Sequencer wires `on_fatal` through here), and at the start of a
+coordinated shutdown drain, whenever `--debug-snapshot-dir` configured
+a destination.
+
+Snapshot writing sits behind the telemetry never-raise contract: every
+section is collected independently (a broken subsystem yields an
+{"error": ...} stub, not a missing bundle) and `write()` returns None
+on any filesystem failure instead of raising into the caller — which
+may be a dying actor.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+
+from . import jax_cache, timeseries
+from .metrics import METRICS, record_snapshot_written
+from .tracing import TRACER
+
+log = logging.getLogger("ethrex_tpu.snapshot")
+
+VERSION = 1
+_DIR: str | None = None
+_KEEP = 20
+
+
+def configure(directory: str | None, keep: int = _KEEP) -> None:
+    """Set (or clear, with None) the auto-snapshot destination."""
+    global _DIR, _KEEP
+    _DIR = directory
+    _KEEP = keep
+
+
+def configured_dir() -> str | None:
+    return _DIR
+
+
+def _section(fn):
+    try:
+        return fn()
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
+def _traces():
+    return {"slowest": TRACER.slowest(10), "recent": TRACER.recent(10),
+            "dropped": TRACER.dropped}
+
+
+def _health(node):
+    if node is None:
+        return None
+    from ..rpc.server import _health as rpc_health  # lazy: avoid a cycle
+
+    return rpc_health(node)
+
+
+def _store(node):
+    from ..storage.persistent import storage_stats
+
+    return storage_stats()
+
+
+def collect(node=None, reason: str = "manual") -> dict:
+    """Assemble a snapshot bundle.  Never raises; every section is
+    independently guarded."""
+    engine = getattr(node, "telemetry", None) or timeseries.ENGINE
+    alerts = getattr(node, "alerts", None)
+    return {
+        "version": VERSION,
+        "reason": reason,
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "metrics": _section(METRICS.snapshot),
+        "timeseries": _section(engine.windows_json),
+        "alerts": _section(alerts.to_json) if alerts is not None else None,
+        "traces": _section(_traces),
+        "health": _section(lambda: _health(node)),
+        "store": _section(lambda: _store(node)),
+        "tpu": _section(jax_cache.runtime_telemetry),
+    }
+
+
+def _prune(directory: str) -> None:
+    snaps = sorted(f for f in os.listdir(directory)
+                   if f.startswith("snapshot-") and f.endswith(".json"))
+    for stale in snaps[:-_KEEP] if _KEEP > 0 else snaps:
+        try:
+            os.unlink(os.path.join(directory, stale))
+        except OSError:
+            pass
+
+
+def write(node=None, reason: str = "manual",
+          directory: str | None = None, bundle: dict | None = None) -> str | None:
+    """Write a bundle to `directory` (default: the configured dir).
+    Returns the path, or None when unconfigured or on any failure."""
+    directory = directory or _DIR
+    if not directory:
+        return None
+    try:
+        if bundle is None:
+            bundle = collect(node, reason)
+        os.makedirs(directory, exist_ok=True)
+        name = f"snapshot-{time.time_ns()}-{reason}.json"
+        path = os.path.join(directory, name)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(bundle, f, indent=1, default=str)
+        os.replace(tmp, path)
+        _prune(directory)
+        record_snapshot_written()
+        log.info("debug snapshot written: %s (reason=%s)", path, reason)
+        return path
+    except Exception as exc:
+        log.warning("debug snapshot failed (reason=%s): %s", reason, exc)
+        return None
+
+
+def on_fatal(actor: str, error, node=None) -> str | None:
+    """Fatal-actor hook (called from the sequencer loop; must never
+    raise there)."""
+    try:
+        return write(node, reason=f"fatal-{actor}")
+    except Exception:
+        return None

@@ -1,0 +1,262 @@
+"""Rolling-window time-series engine over the Metrics registry.
+
+Raw counters and cumulative histogram buckets are not operator signals;
+rates and windowed percentiles are.  A lightweight sampler thread (owned
+by the node, drained on shutdown) snapshots the registry on a fixed
+interval into a bounded ring of samples.  Derived queries take deltas
+between the newest sample and the oldest sample inside the requested
+window:
+
+  * counter delta / elapsed  -> rate (counter resets clamp to the new
+    value, never a negative rate);
+  * histogram bucket deltas  -> windowed p50/p95/p99 by linear
+    interpolation inside the bucket ladder (Prometheus
+    histogram_quantile semantics, +Inf capped at the last finite
+    boundary).
+
+Everything here sits on the telemetry side of the never-raise contract:
+`tick()` (the sampler body) and the registered evaluators are
+exception-guarded, so a broken metric can never take the node down.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+from .metrics import METRICS, record_telemetry_sample
+
+DEFAULT_INTERVAL = 1.0
+DEFAULT_WINDOW = 60.0
+MAX_SAMPLES = 4096
+
+# Histogram families summarised by windows_json (bounded output; ad-hoc
+# families remain queryable through percentiles()).
+_SUMMARY_QS = (0.5, 0.95, 0.99)
+
+
+class TimeSeriesEngine:
+    """Ring of registry samples + windowed rate/percentile queries."""
+
+    def __init__(self, registry=None, max_samples: int = MAX_SAMPLES):
+        self.registry = registry if registry is not None else METRICS
+        self.samples: collections.deque = collections.deque(
+            maxlen=max_samples)
+        self.lock = threading.Lock()
+        self.interval = DEFAULT_INTERVAL
+        self.sampler_errors = 0
+        self._evaluators: list = []
+        self._thread = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------------
+    # sampling
+    def sample_now(self, now: float | None = None) -> dict:
+        """Take one registry sample (tests pass explicit timestamps)."""
+        snap = self.registry.snapshot()
+        if now is not None:
+            snap["ts"] = float(now)
+        with self.lock:
+            self.samples.append(snap)
+        record_telemetry_sample()
+        return snap
+
+    def clear(self):
+        with self.lock:
+            self.samples.clear()
+        self._evaluators = []
+        self.sampler_errors = 0
+
+    def add_evaluator(self, fn):
+        """Register a callable run after every sampler tick (the alert
+        engine registers its evaluate here)."""
+        if fn not in self._evaluators:
+            self._evaluators.append(fn)
+
+    def tick(self, now: float | None = None):
+        """One sampler beat: sample + run evaluators.  Never raises."""
+        try:
+            self.sample_now(now)
+        except Exception:
+            self.sampler_errors += 1
+        for fn in list(self._evaluators):
+            try:
+                fn()
+            except Exception:
+                self.sampler_errors += 1
+
+    # ------------------------------------------------------------------
+    # windowed queries
+    def _bounds(self, window: float, now: float | None):
+        """(oldest-in-window, newest) sample pair, or None if fewer than
+        two samples land inside the window."""
+        with self.lock:
+            if len(self.samples) < 2:
+                return None
+            newest = self.samples[-1]
+            cutoff = (now if now is not None else newest["ts"]) - window
+            oldest = None
+            for s in self.samples:
+                if s["ts"] >= cutoff:
+                    oldest = s
+                    break
+            if oldest is None or oldest is newest:
+                return None
+            return oldest, newest
+
+    def rate(self, name: str, window: float = DEFAULT_WINDOW,
+             now: float | None = None) -> float | None:
+        """Windowed per-second rate of a counter; None without data."""
+        bounds = self._bounds(window, now)
+        if bounds is None:
+            return None
+        old, new = bounds
+        a = old["counters"].get(name)
+        b = new["counters"].get(name)
+        if a is None and b is None:
+            return None
+        a, b = a or 0.0, b or 0.0
+        dt = new["ts"] - old["ts"]
+        if dt <= 0:
+            return None
+        # Counter reset (process restart / Metrics.reset): the new value
+        # IS the increase since the reset — never a negative rate.
+        inc = b - a if b >= a else b
+        return inc / dt
+
+    def gauge(self, name: str) -> float | None:
+        """Latest sampled gauge value."""
+        with self.lock:
+            if not self.samples:
+                return None
+            return self.samples[-1]["gauges"].get(name)
+
+    def counter(self, name: str) -> float | None:
+        """Latest sampled cumulative counter value."""
+        with self.lock:
+            if not self.samples:
+                return None
+            return self.samples[-1]["counters"].get(name)
+
+    @staticmethod
+    def _series_delta(old_hist, new_hist, labels):
+        """Summed per-bucket cumulative deltas across matching series."""
+        nb = len(new_hist["buckets"])
+        old_by_labels = {}
+        if old_hist and old_hist.get("buckets") == new_hist["buckets"]:
+            for s in old_hist["series"]:
+                old_by_labels[tuple(sorted(s["labels"].items()))] = s
+        deltas = [0] * (nb + 1)
+        seen = False
+        for s in new_hist["series"]:
+            if labels is not None and any(
+                    s["labels"].get(k) != v for k, v in labels.items()):
+                continue
+            seen = True
+            prev = old_by_labels.get(tuple(sorted(s["labels"].items())))
+            pc = prev["counts"] if prev else [0] * (nb + 1)
+            # Per-series reset clamp: counts moving backwards means the
+            # registry restarted; treat the new counts as the delta.
+            if s["counts"][nb] < pc[nb]:
+                pc = [0] * (nb + 1)
+            for i in range(nb + 1):
+                deltas[i] += s["counts"][i] - pc[i]
+        return (deltas, new_hist["buckets"]) if seen else (None, None)
+
+    def percentiles(self, name: str, qs=_SUMMARY_QS,
+                    window: float = DEFAULT_WINDOW,
+                    labels: dict | None = None,
+                    now: float | None = None) -> dict | None:
+        """Windowed percentile estimates from histogram-bucket deltas.
+
+        Returns {"p50": ..., ...} or None when no observation landed in
+        the window (cold start must read as no-data, not zero)."""
+        bounds = self._bounds(window, now)
+        if bounds is None:
+            return None
+        old, new = bounds
+        new_hist = new["histograms"].get(name)
+        if new_hist is None:
+            return None
+        deltas, buckets = self._series_delta(
+            old["histograms"].get(name), new_hist, labels)
+        if deltas is None:
+            return None
+        total = deltas[len(buckets)]
+        if total <= 0:
+            return None
+        out = {}
+        for q in qs:
+            rank = q * total
+            value = buckets[-1]          # +Inf cap: last finite boundary
+            lower, prev_count = 0.0, 0
+            for i, le in enumerate(buckets):
+                if deltas[i] >= rank:
+                    span = deltas[i] - prev_count
+                    frac = (rank - prev_count) / span if span else 1.0
+                    value = lower + frac * (le - lower)
+                    break
+                lower, prev_count = le, deltas[i]
+            out[f"p{int(q * 100)}"] = value
+        return out
+
+    def windows_json(self, window: float = DEFAULT_WINDOW,
+                     now: float | None = None) -> dict:
+        """Serializable summary of the current windows (snapshot/RPC)."""
+        with self.lock:
+            if not self.samples:
+                return {"window": window, "samples": 0}
+            newest = self.samples[-1]
+            n = len(self.samples)
+        if now is None:
+            now = newest["ts"]
+        rates = {}
+        for name in sorted(newest["counters"]):
+            r = self.rate(name, window, now)
+            if r is not None:
+                rates[name] = r
+        pcts = {}
+        for name in sorted(newest["histograms"]):
+            p = self.percentiles(name, window=window, now=now)
+            if p is not None:
+                pcts[name] = p
+        return {"window": window, "samples": n, "ts": newest["ts"],
+                "rates": rates, "percentiles": pcts,
+                "gauges": dict(newest["gauges"]),
+                "samplerErrors": self.sampler_errors}
+
+    # ------------------------------------------------------------------
+    # sampler thread
+    def start(self, interval: float = DEFAULT_INTERVAL):
+        """Start the background sampler (idempotent)."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self.interval = interval
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="telemetry-sampler", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            self.tick()
+
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def stop(self, timeout: float = 2.0):
+        """Stop the sampler and drain: one final sample so the last
+        window reflects the state at shutdown.  Never raises."""
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        try:
+            self.sample_now()
+        except Exception:
+            self.sampler_errors += 1
+        self._evaluators = []
+
+
+ENGINE = TimeSeriesEngine()  # process-global, like METRICS / TRACER

@@ -52,6 +52,34 @@ def _fault_plan_guard():
 
 
 @pytest.fixture(autouse=True)
+def _metrics_isolation():
+    """Snapshot/restore the process-global METRICS registry around every
+    test, so counters incremented by one test cannot leak into another's
+    assertions.  The global time-series ENGINE (which samples METRICS)
+    and the flight-recorder destination are reset alongside — a sampler
+    or snapshot dir left configured by one test must not fire in the
+    next."""
+    import copy
+
+    from ethrex_tpu.utils.metrics import METRICS
+
+    with METRICS.lock:
+        saved = (dict(METRICS.counters), dict(METRICS.gauges),
+                 copy.deepcopy(METRICS.histograms), dict(METRICS.help))
+    yield
+    from ethrex_tpu.utils import snapshot, timeseries
+
+    timeseries.ENGINE.stop(timeout=2.0)
+    timeseries.ENGINE.clear()
+    snapshot.configure(None)
+    with METRICS.lock:
+        METRICS.counters = dict(saved[0])
+        METRICS.gauges = dict(saved[1])
+        METRICS.histograms = saved[2]
+        METRICS.help = dict(saved[3])
+
+
+@pytest.fixture(autouse=True)
 def _close_leaked_kv_backends():
     """Close any persistent KV handle a test left open (and release its
     flock) so one leaked backend cannot wedge every later test that

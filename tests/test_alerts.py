@@ -1,0 +1,583 @@
+"""SLO engine battery (docs/OBSERVABILITY.md): rolling-window
+time-series queries, burn-rate alert hysteresis, the flight recorder,
+graceful RPC degradation, and a full-stack quiet -> firing -> resolved
+lifecycle driven by a real injected prover fault."""
+
+import json
+import os
+import time
+
+from ethrex_tpu.crypto import secp256k1
+from ethrex_tpu.l2.l1_client import InMemoryL1
+from ethrex_tpu.l2.sequencer import Sequencer, SequencerConfig
+from ethrex_tpu.node import Node
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.primitives.transaction import TYPE_DYNAMIC_FEE, Transaction
+from ethrex_tpu.prover import protocol
+from ethrex_tpu.prover.client import ProverClient
+from ethrex_tpu.rpc.server import RpcServer
+from ethrex_tpu.utils import faults, snapshot, timeseries
+from ethrex_tpu.utils.alerts import (AlertEngine, AlertRule, actor_stall_signal,
+                                     build_default_engine, default_rules,
+                                     rate_signal, settlement_lag_signal)
+from ethrex_tpu.utils.faults import FaultPlan
+from ethrex_tpu.utils.metrics import METRICS, Metrics
+from ethrex_tpu.utils.repl import RpcSession
+from ethrex_tpu.utils.timeseries import TimeSeriesEngine
+
+SECRET = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
+SENDER = secp256k1.pubkey_to_address(secp256k1.pubkey_from_secret(SECRET))
+
+GENESIS = {
+    "config": {"chainId": 65536999, "terminalTotalDifficulty": 0,
+               "shanghaiTime": 0, "cancunTime": 0},
+    "alloc": {"0x" + SENDER.hex(): {"balance": hex(10**21)}},
+    "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7", "timestamp": "0x0",
+}
+
+
+def _transfer(nonce, value=100):
+    return Transaction(
+        tx_type=TYPE_DYNAMIC_FEE, chain_id=65536999, nonce=nonce,
+        max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+        gas_limit=21000, to=bytes.fromhex("aa" * 20), value=value,
+    ).sign(SECRET)
+
+
+# ---------------------------------------------------------------------------
+# time-series engine: rates
+
+
+def test_rate_from_counter_deltas_and_reset_clamp():
+    m = Metrics()
+    eng = TimeSeriesEngine(m)
+    t0 = 1000.0
+    m.inc("work_total", 10)
+    eng.sample_now(now=t0)
+    m.inc("work_total", 30)
+    eng.sample_now(now=t0 + 10)
+    assert eng.rate("work_total", window=60.0) == 3.0
+    # counter reset (simulated restart): the new value IS the increase,
+    # never a negative rate
+    m.reset()
+    m.inc("work_total", 5)
+    eng.sample_now(now=t0 + 20)
+    r = eng.rate("work_total", window=15.0)
+    assert r is not None and r >= 0
+    assert r == 5 / 10  # oldest-in-window is the t0+10 sample
+    # unknown counter: no data, not zero
+    assert eng.rate("no_such_total", window=60.0) is None
+
+
+def test_rate_window_excludes_old_samples():
+    m = Metrics()
+    eng = TimeSeriesEngine(m)
+    m.inc("work_total", 100)
+    eng.sample_now(now=0.0)
+    m.inc("work_total", 2)
+    eng.sample_now(now=100.0)
+    m.inc("work_total", 2)
+    eng.sample_now(now=110.0)
+    # 60s window from the newest sample: only the 100 -> 110 delta
+    assert eng.rate("work_total", window=60.0) == 2 / 10
+    # a window wide enough to reach the first sample sees all 4 increments
+    assert eng.rate("work_total", window=200.0) == 4 / 110
+
+
+def test_rate_requires_two_samples_in_window():
+    m = Metrics()
+    eng = TimeSeriesEngine(m)
+    assert eng.rate("work_total") is None          # no samples at all
+    m.inc("work_total", 1)
+    eng.sample_now(now=0.0)
+    assert eng.rate("work_total") is None          # one sample
+    eng.sample_now(now=500.0)
+    # the older sample fell out of the 60s window: still no data
+    assert eng.rate("work_total", window=60.0) is None
+
+
+# ---------------------------------------------------------------------------
+# time-series engine: windowed percentiles
+
+
+def test_windowed_percentiles_from_bucket_deltas():
+    m = Metrics()
+    eng = TimeSeriesEngine(m)
+    eng.sample_now(now=-100.0)
+    # stale observations BEFORE the window must not pollute the estimate
+    for _ in range(100):
+        m.observe("lat_seconds", 400.0)
+    eng.sample_now(now=0.0)
+    for _ in range(99):
+        m.observe("lat_seconds", 0.010)
+    m.observe("lat_seconds", 0.100)
+    eng.sample_now(now=10.0)
+    p = eng.percentiles("lat_seconds", window=60.0)
+    assert p is not None
+    # 99/100 windowed observations sit in the (0.008, 0.016] bucket
+    assert 0.008 < p["p50"] <= 0.016
+    assert 0.008 < p["p95"] <= 0.016
+    assert p["p99"] <= 0.128
+    # had the window covered everything, the stale 400s would dominate
+    p_all = eng.percentiles("lat_seconds", window=1000.0)
+    assert p_all["p95"] > 100.0
+
+
+def test_percentiles_cold_start_and_quiet_window_are_none():
+    m = Metrics()
+    eng = TimeSeriesEngine(m)
+    assert eng.percentiles("lat_seconds") is None   # no samples
+    m.observe("lat_seconds", 1.0)
+    eng.sample_now(now=0.0)
+    eng.sample_now(now=10.0)
+    # histogram exists but nothing was observed inside the window:
+    # no-data, not zero
+    assert eng.percentiles("lat_seconds", window=60.0) is None
+    assert eng.percentiles("no_such_seconds", window=60.0) is None
+
+
+def test_percentiles_label_filter():
+    m = Metrics()
+    eng = TimeSeriesEngine(m)
+    eng.sample_now(now=0.0)
+    m.observe("stage_seconds", 0.010, {"stage": "fast"})
+    m.observe("stage_seconds", 60.0, {"stage": "slow"})
+    eng.sample_now(now=10.0)
+    fast = eng.percentiles("stage_seconds", labels={"stage": "fast"})
+    slow = eng.percentiles("stage_seconds", labels={"stage": "slow"})
+    both = eng.percentiles("stage_seconds")
+    assert fast["p95"] <= 0.016
+    assert slow["p95"] > 30.0
+    assert fast["p95"] < both["p95"] <= slow["p95"]
+    assert eng.percentiles("stage_seconds", labels={"stage": "nope"}) is None
+
+
+def test_windows_json_shape():
+    m = Metrics()
+    eng = TimeSeriesEngine(m)
+    assert eng.windows_json()["samples"] == 0
+    m.inc("work_total", 1)
+    m.set("level", 7.0)
+    eng.sample_now(now=0.0)
+    m.inc("work_total", 5)
+    m.observe("lat_seconds", 0.01)
+    eng.sample_now(now=10.0)
+    out = eng.windows_json(window=60.0)
+    assert out["samples"] == 2
+    assert out["rates"]["work_total"] == 0.5
+    assert "p95" in out["percentiles"]["lat_seconds"]
+    assert out["gauges"]["level"] == 7.0
+    assert out["samplerErrors"] == 0
+    json.dumps(out)  # JSON-safe all the way down
+
+
+# ---------------------------------------------------------------------------
+# time-series engine: never-raise + sampler thread
+
+
+def test_tick_never_raises_and_counts_errors():
+    class Broken:
+        def snapshot(self):
+            raise RuntimeError("registry is broken")
+
+    eng = TimeSeriesEngine(Broken())
+    eng.tick()
+    assert eng.sampler_errors == 1
+
+    m = Metrics()
+    eng = TimeSeriesEngine(m)
+
+    def bad_evaluator():
+        raise ValueError("rule exploded")
+
+    ran = []
+    eng.add_evaluator(bad_evaluator)
+    eng.add_evaluator(lambda: ran.append(1))
+    eng.tick()
+    # the broken evaluator is counted, the healthy one still ran, the
+    # sample still landed
+    assert eng.sampler_errors == 1
+    assert ran == [1]
+    assert len(eng.samples) == 1
+
+
+def test_sampler_thread_lifecycle_and_drain():
+    m = Metrics()
+    eng = TimeSeriesEngine(m)
+    eng.start(interval=0.01)
+    assert eng.running()
+    assert eng.start(interval=0.01) is eng      # idempotent
+    deadline = time.time() + 5.0
+    while len(eng.samples) < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    assert len(eng.samples) >= 3
+    before = len(eng.samples)
+    eng.stop()
+    assert not eng.running()
+    # stop() takes one final drain sample
+    assert len(eng.samples) >= before + 1
+    eng.stop()  # idempotent, never raises
+
+
+# ---------------------------------------------------------------------------
+# alert engine: hysteresis state machine
+
+
+def _scripted_engine(values, threshold=10.0, for_count=2, resolve_count=2):
+    """AlertEngine over a canned signal: pops one value per evaluate."""
+    feed = list(values)
+    rule = AlertRule("test_rule:page", "page",
+                     lambda eng, node: feed.pop(0), threshold,
+                     for_count=for_count, resolve_count=resolve_count)
+    return AlertEngine(engine=TimeSeriesEngine(Metrics()), rules=[rule])
+
+
+def test_cold_start_none_never_fires():
+    eng = _scripted_engine([None] * 50)
+    for _ in range(50):
+        eng.evaluate(now=0.0)
+    st = eng.states["test_rule:page"]
+    assert st.state == "ok"
+    assert eng.transitions_total == 0 and not eng.active()
+
+
+def test_fires_after_for_count_and_resolves_after_resolve_count():
+    eng = _scripted_engine([5, 50, 50, 50, 5, 5, 5], for_count=2,
+                           resolve_count=2)
+    st = eng.states["test_rule:page"]
+    eng.evaluate(now=1.0)
+    assert st.state == "ok"                 # below threshold
+    eng.evaluate(now=2.0)
+    assert st.state == "pending"            # first breach: pending, no page
+    assert not eng.active()
+    eng.evaluate(now=3.0)
+    assert st.state == "firing"             # second consecutive breach
+    assert [a["name"] for a in eng.active()] == ["test_rule:page"]
+    eng.evaluate(now=4.0)
+    assert st.state == "firing"             # still breaching
+    eng.evaluate(now=5.0)
+    assert st.state == "firing"             # one clear does NOT resolve
+    eng.evaluate(now=6.0)
+    assert st.state == "ok"                 # second consecutive clear
+    events = [(h["rule"], h["event"]) for h in eng.history]
+    assert events == [("test_rule:page", "firing"),
+                      ("test_rule:page", "resolved")]
+    assert eng.transitions_total == 2
+
+
+def test_flapping_suppressed_by_hysteresis():
+    # strobing around the threshold: breach streaks never reach
+    # for_count, so the rule never pages
+    eng = _scripted_engine([50, 5] * 20, for_count=2)
+    for i in range(40):
+        eng.evaluate(now=float(i))
+    assert eng.transitions_total == 0
+    assert eng.states["test_rule:page"].state in ("ok", "pending")
+
+
+def test_signal_exception_is_guarded_and_recorded():
+    def boom(eng, node):
+        raise RuntimeError("signal exploded")
+
+    rule = AlertRule("broken:warn", "warn", boom, 1.0)
+    eng = AlertEngine(engine=TimeSeriesEngine(Metrics()), rules=[rule])
+    for _ in range(3):
+        eng.evaluate(now=0.0)
+    st = eng.states["broken:warn"]
+    assert st.state == "ok"
+    assert "RuntimeError: signal exploded" in st.last_error
+    assert eng.eval_errors == 3
+    json.dumps(eng.to_json())
+
+
+def test_transitions_recorded_in_global_metrics():
+    eng = _scripted_engine([50] * 4 + [5, 5], for_count=2, resolve_count=2)
+    before = METRICS.counters.get("alert_transitions_total", 0)
+    for i in range(6):
+        eng.evaluate(now=float(i))
+    assert METRICS.counters["alert_transitions_total"] == before + 2
+    # the firing gauge tracked the lifecycle and ended at zero
+    assert METRICS.gauges["alerts_firing"] == 0
+
+
+# ---------------------------------------------------------------------------
+# signal helpers + the stock rule set
+
+
+def test_settlement_lag_signal():
+    m = Metrics()
+    eng = TimeSeriesEngine(m)
+    assert settlement_lag_signal(eng, None) is None     # cold start
+    m.set("ethrex_l2_latest_batch", 30)
+    eng.sample_now(now=0.0)
+    assert settlement_lag_signal(eng, None) == 30.0     # nothing verified
+    m.set("ethrex_l2_last_verified_batch", 28)
+    eng.sample_now(now=1.0)
+    assert settlement_lag_signal(eng, None) == 2.0
+
+
+def test_actor_stall_signal():
+    from types import SimpleNamespace as NS
+
+    eng = TimeSeriesEngine(Metrics())
+    assert actor_stall_signal(eng, None) is None        # no sequencer
+    now = time.time()
+    seq = NS(started_at=now - 200,
+             health={"fresh": NS(last_success=now - 5, runs=10,
+                                 consecutive_failures=0),
+                     "stalled": NS(last_success=now - 90, runs=10,
+                                   consecutive_failures=3),
+                     "never-ran": NS(last_success=None, runs=0,
+                                     consecutive_failures=0)})
+    node = NS(sequencer=seq)
+    worst = actor_stall_signal(eng, node)
+    # the least-recently-successful actor wins; the never-scheduled one
+    # is ignored rather than read as stalled-since-boot
+    assert 89 <= worst < 95
+
+
+def test_default_rules_pair_page_and_warn():
+    rules = default_rules()
+    names = {r.name for r in rules}
+    for slo in ("batch_proving_p95", "prover_reassignment_rate",
+                "store_corruption_rate", "l1_settlement_lag",
+                "sequencer_stall"):
+        assert f"{slo}:page" in names and f"{slo}:warn" in names
+    assert "sequencer_loop_p95:warn" in names
+    for r in rules:
+        assert r.severity in ("page", "warn")
+        assert r.description and r.runbook, f"{r.name} lacks docs"
+        assert r.for_count >= 2, f"{r.name} would page on a single sample"
+    eng = build_default_engine()
+    eng.evaluate()          # cold start over the stock set: quiet
+    assert not eng.active() and eng.transitions_total == 0
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+
+
+def test_snapshot_collect_sections_and_json():
+    bundle = snapshot.collect(None, reason="manual")
+    assert bundle["version"] == 1 and bundle["reason"] == "manual"
+    for key in ("metrics", "timeseries", "traces", "store", "tpu"):
+        assert key in bundle
+    assert bundle["alerts"] is None          # no engine attached
+    assert "counters" in bundle["metrics"]
+    assert "slowest" in bundle["traces"]
+    assert "cache" in bundle["tpu"] and "compiles" in bundle["tpu"]["cache"]
+    json.dumps(bundle, default=str)
+
+
+def test_snapshot_collect_sections_are_independently_guarded(monkeypatch):
+    monkeypatch.setattr(snapshot.METRICS, "snapshot",
+                        lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    bundle = snapshot.collect(None)
+    assert bundle["metrics"] == {"error": "RuntimeError: boom"}
+    # the broken section did not take the others down
+    assert "slowest" in bundle["traces"]
+
+
+def test_snapshot_write_prune_and_counter(tmp_path):
+    snapshot.configure(str(tmp_path), keep=2)
+    before = METRICS.counters.get("debug_snapshots_total", 0)
+    paths = [snapshot.write(reason=f"r{i}") for i in range(4)]
+    assert all(p is not None for p in paths)
+    remaining = sorted(os.listdir(tmp_path))
+    assert len(remaining) == 2              # pruned to the newest `keep`
+    assert os.path.basename(paths[-1]) in remaining
+    assert os.path.basename(paths[0]) not in remaining
+    with open(paths[-1]) as f:
+        assert json.load(f)["reason"] == "r3"
+    assert METRICS.counters["debug_snapshots_total"] == before + 4
+
+
+def test_snapshot_write_unconfigured_or_bad_dir_is_none(tmp_path):
+    snapshot.configure(None)
+    assert snapshot.write(reason="x") is None
+    # destination is a file, not a directory: swallowed, not raised
+    blocker = tmp_path / "blocker"
+    blocker.write_text("x")
+    assert snapshot.write(reason="x", directory=str(blocker)) is None
+
+
+def test_on_fatal_writes_snapshot(tmp_path):
+    snapshot.configure(str(tmp_path))
+    path = snapshot.on_fatal("prove_batches", RuntimeError("actor died"))
+    assert path is not None and "fatal-prove_batches" in path
+    with open(path) as f:
+        assert json.load(f)["reason"] == "fatal-prove_batches"
+
+
+# ---------------------------------------------------------------------------
+# RPC surface: degradation + health sections
+
+
+def test_ethrex_alerts_and_snapshot_degrade_on_l1_only_node():
+    node = Node(Genesis.from_json(GENESIS))
+    server = RpcServer(node, host="127.0.0.1", port=0).start()
+    try:
+        rpc = RpcSession(f"http://127.0.0.1:{server.port}")
+        out = rpc.call("ethrex_alerts", [])
+        assert out == {"enabled": False, "rules": [], "active": [],
+                       "recent": []}
+        bundle = rpc.call("ethrex_debug_snapshot", [])
+        assert bundle["alerts"] is None
+        assert "path" not in bundle          # no snapshot dir configured
+        assert "counters" in bundle["metrics"]
+        health = rpc.call("ethrex_health", [])
+        assert "alerts" not in health and "telemetry" not in health
+    finally:
+        server._httpd.shutdown()
+
+
+def test_health_includes_alerts_and_telemetry_sections():
+    node = Node(Genesis.from_json(GENESIS))
+    eng = TimeSeriesEngine(METRICS)
+    eng.sample_now(now=0.0)
+    rule = AlertRule("r:page", "page", lambda e, n: 99.0, 1.0, for_count=1)
+    alerts_eng = AlertEngine(engine=eng, rules=[rule], node=node)
+    alerts_eng.evaluate(now=0.0)
+    node.telemetry, node.alerts = eng, alerts_eng
+    health = RpcServer(node).handle(
+        {"jsonrpc": "2.0", "id": 1, "method": "ethrex_health",
+         "params": []})["result"]
+    assert health["alerts"]["firing"] == 1
+    assert health["alerts"]["page"] == 1
+    assert health["alerts"]["active"] == ["r:page"]
+    assert health["telemetry"]["samples"] == 1
+    assert health["telemetry"]["samplerRunning"] is False
+
+
+def test_monitor_renders_alerts_panel_and_degrades():
+    from ethrex_tpu.utils.monitor import render_lines
+
+    snap = {
+        "head": {"number": 1, "hash": "0x" + "00" * 32, "gas_used": 0,
+                 "gas_limit": 30_000_000, "txs": 0, "base_fee": 7,
+                 "timestamp": 0},
+        "recent": [],
+        "alerts": {"enabled": True,
+                   "active": [{"name": "store_corruption_rate:page",
+                               "severity": "page", "value": 0.5,
+                               "threshold": 0.1}],
+                   "recent": [{"event": "firing",
+                               "rule": "store_corruption_rate:page"}]},
+    }
+    lines = render_lines(snap, width=100)
+    assert any("alerts  firing 1" in ln for ln in lines)
+    assert any("store_corruption_rate:page" in ln and "[page]" in ln
+               for ln in lines)
+    # disabled engine (L1-only node): panel disappears entirely
+    snap["alerts"] = {"enabled": False, "active": [], "recent": []}
+    assert not any("alerts" in ln for ln in render_lines(snap, width=100))
+    # malformed payloads must not crash the panel
+    snap["alerts"] = {"enabled": True, "active": ["junk", {}],
+                      "recent": "junk"}
+    render_lines(snap, width=100)
+
+
+# ---------------------------------------------------------------------------
+# full stack: a real injected fault drives quiet -> firing -> resolved
+
+
+def test_alert_lifecycle_full_stack(tmp_path):
+    """FaultPlan-injected corrupt proofs push the reassignment rate over
+    an SLO threshold; the alert fires after hysteresis, is observable
+    through ethrex_alerts over real TCP, lands in a debug-snapshot
+    bundle (with windowed percentiles and TPU telemetry), and resolves
+    once the fault clears and the burn ages out of the window."""
+    node = Node(Genesis.from_json(GENESIS))
+    l1 = InMemoryL1([protocol.PROVER_EXEC])
+    seq = Sequencer(node, l1, SequencerConfig(
+        needed_prover_types=(protocol.PROVER_EXEC,)))
+    seq.coordinator.start()
+    node.sequencer = seq
+    server = None
+    try:
+        node.submit_transaction(_transfer(0))
+        seq.produce_block()
+        assert seq.commit_next_batch() is not None
+
+        eng = TimeSeriesEngine(METRICS)
+        rule = AlertRule(
+            "prover_reassignment_rate:warn", "warn",
+            rate_signal("proof_reassignments_total", window=60.0),
+            threshold=0.05, window=60.0, for_count=2, resolve_count=2)
+        alerts_eng = AlertEngine(engine=eng, rules=[rule], node=node)
+        node.telemetry, node.alerts = eng, alerts_eng
+        snapshot.configure(str(tmp_path))
+        server = RpcServer(node, host="127.0.0.1", port=0).start()
+        rpc = RpcSession(f"http://127.0.0.1:{server.port}")
+
+        # ---- quiet: cold start must not fire
+        t0 = time.time()
+        eng.sample_now(now=t0)
+        alerts_eng.evaluate(now=t0)
+        out = rpc.call("ethrex_alerts", [])
+        assert out["enabled"] is True and out["active"] == []
+
+        # ---- fault: three corrupt proofs, three submit rejections,
+        # three reassignments
+        before = METRICS.counters.get("proof_reassignments_total", 0)
+        with faults.injected(
+                FaultPlan(seed=5).corrupt("backend.prove", times=3)):
+            client = ProverClient(
+                protocol.PROVER_EXEC,
+                [("127.0.0.1", seq.coordinator.port)],
+                heartbeat_interval=0, backoff_base=0.01, rng_seed=4)
+            for _ in range(3):
+                assert client.poll_once() == 0
+        assert METRICS.counters["proof_reassignments_total"] == before + 3
+
+        # ---- burn: rate 3/10s = 0.3/s >= 0.05 — pending, then firing
+        eng.sample_now(now=t0 + 10)
+        alerts_eng.evaluate(now=t0 + 10)
+        assert alerts_eng.states[rule.name].state == "pending"
+        eng.sample_now(now=t0 + 20)
+        alerts_eng.evaluate(now=t0 + 20)
+        assert alerts_eng.states[rule.name].state == "firing"
+
+        out = rpc.call("ethrex_alerts", [])
+        active = out["active"]
+        assert [a["name"] for a in active] == [rule.name]
+        assert active[0]["value"] >= 0.05
+        assert any(h["event"] == "firing" for h in out["recent"])
+
+        # ---- flight recorder captured mid-incident
+        bundle = rpc.call("ethrex_debug_snapshot", [])
+        assert [a["name"] for a in bundle["alerts"]["active"]] == [rule.name]
+        rates = bundle["timeseries"]["rates"]
+        assert rates["proof_reassignments_total"] >= 0.05
+        # windowed percentiles from real traffic (the RPC calls above)
+        assert bundle["timeseries"]["percentiles"][
+            "rpc_request_seconds"]["p95"] > 0
+        assert "compiles" in bundle["tpu"]["cache"]
+        assert bundle["metrics"]["counters"][
+            "proof_reassignments_total"] == before + 3
+        # persisted to the configured dir, and readable back
+        assert bundle["path"] and os.path.exists(bundle["path"])
+        with open(bundle["path"]) as f:
+            assert json.load(f)["reason"] == "rpc"
+
+        # ---- recovery: fault cleared, the proof lands cleanly
+        assert client.poll_once() == 1
+        assert seq.rollup.get_proof(1, protocol.PROVER_EXEC) is not None
+
+        # ---- resolve: the burn ages out of the 60s window
+        eng.sample_now(now=t0 + 120)
+        alerts_eng.evaluate(now=t0 + 120)
+        assert alerts_eng.states[rule.name].state == "firing"  # 1 clear
+        eng.sample_now(now=t0 + 130)
+        alerts_eng.evaluate(now=t0 + 130)
+        assert alerts_eng.states[rule.name].state == "ok"
+
+        out = rpc.call("ethrex_alerts", [])
+        assert out["active"] == []
+        events = [h["event"] for h in out["recent"]]
+        assert events == ["firing", "resolved"]
+        assert METRICS.gauges["alerts_firing"] == 0
+    finally:
+        if server is not None:
+            server._httpd.shutdown()
+        seq.stop()

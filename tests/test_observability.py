@@ -170,6 +170,85 @@ def test_histogram_exposition_format():
     assert as_floats == sorted(as_floats) and len(set(as_floats)) == len(les)
 
 
+def test_bucket_le_labels_are_canonical_shortest_floats():
+    """Golden exposition: the `le` labels render as Python's shortest
+    repr of the float boundary — stable and joinable across scrapes,
+    whatever numeric type produced the boundary (satellite of the SLO
+    engine: windowed percentiles join samples on these labels)."""
+    from ethrex_tpu.utils.metrics import DEFAULT_BUCKETS, _fmt_le
+
+    m = Metrics()
+    m.observe("g_seconds", 0.5)
+    text = m.render()
+    les = [ln.split('le="')[1].split('"')[0]
+           for ln in text.splitlines() if ln.startswith("g_seconds_bucket")]
+    # the full golden ladder: 1ms * 2^i is exact under binary doubling,
+    # so every label is the clean decimal
+    assert les == [
+        "0.001", "0.002", "0.004", "0.008", "0.016", "0.032", "0.064",
+        "0.128", "0.256", "0.512", "1.024", "2.048", "4.096", "8.192",
+        "16.384", "32.768", "65.536", "131.072", "262.144", "524.288",
+        "+Inf"]
+    assert les[:-1] == [repr(b) for b in DEFAULT_BUCKETS]
+    # numpy scalars / ints / plain floats all canonicalise identically
+    import numpy as np
+
+    assert _fmt_le(np.float32(0.5)) == _fmt_le(0.5) == "0.5"
+    assert _fmt_le(np.int64(5)) == _fmt_le(5) == _fmt_le(5.0) == "5.0"
+
+
+def test_metrics_reset_clears_every_family():
+    m = Metrics()
+    m.inc("c_total", 3, "a counter")
+    m.set("g", 7)
+    m.observe("h_seconds", 0.1)
+    started = m.started
+    m.reset()
+    assert m.counters == {} and m.gauges == {} and m.histograms == {}
+    assert m.help == {}
+    assert m.started >= started
+    # a fresh registry still renders (uptime only)
+    assert "process_uptime_seconds" in m.render()
+    assert "c_total" not in m.render()
+
+
+def test_metrics_server_404_and_aborted_scrape():
+    """The scrape endpoint: unknown paths get a proper 404 with a
+    Content-Type, and a scraper that drops the connection mid-response
+    must not wedge the server thread."""
+    import socket
+    import urllib.error
+    import urllib.request
+
+    from ethrex_tpu.utils.metrics import METRICS as M, MetricsServer
+
+    M.inc("scrape_probe_total", 1, "probe")
+    server = MetricsServer(port=0).start()
+    try:
+        url = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{url}/metrics", timeout=5) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            assert b"scrape_probe_total" in r.read()
+        try:
+            urllib.request.urlopen(f"{url}/nope", timeout=5)
+            raise AssertionError("expected a 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+            assert e.headers["Content-Type"].startswith("text/plain")
+            assert e.read() == b"not found\n"
+        # an aborted scrape: connect, send the request, hang up before
+        # reading the response
+        s = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+        s.sendall(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+        s.close()
+        # the server is still healthy for the next scraper
+        with urllib.request.urlopen(f"{url}/metrics", timeout=5) as r:
+            assert r.status == 200
+    finally:
+        server.stop()
+
+
 def test_histograms_do_not_break_counters_and_gauges():
     m = Metrics()
     m.inc("things_total", 2, "things")

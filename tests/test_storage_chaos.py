@@ -457,8 +457,8 @@ def test_shutdown_manager_drains_full_stack(tmp_path):
     assert all(step["ok"] for step in report["steps"]), report
     assert report["durationSeconds"] < 20.0
     phases = [step["phase"] for step in report["steps"]]
-    assert phases == ["rpc", "sequencer", "producer",
-                      "flush-close", "flush-close"]
+    assert phases == ["snapshot", "rpc", "sequencer", "producer",
+                      "telemetry", "flush-close", "flush-close"]
     assert all(not t.is_alive() for t in seq._threads)
     assert node.store.backend.handle is None
     assert rollup.backend.handle is None

@@ -179,6 +179,80 @@ def test_bench_probe_reports_failure_detail(monkeypatch):
     assert err is not None and "TimeoutExpired" in err
 
 
+def test_every_metric_helper_has_help_text():
+    """Every record_*/observe_* helper in utils/metrics.py must attach
+    non-empty help text to each metric it touches — an undocumented
+    family in the exposition is a family nobody can alert on.  A metric
+    call carries its help as the second (or later) string literal, so
+    each METRICS.inc/set/observe or _observe_safe call inside a helper
+    must contain at least two non-empty string constants (name + help)
+    or an explicit help_text= keyword."""
+    import ast
+    import inspect
+
+    from ethrex_tpu.utils import metrics
+
+    tree = ast.parse(inspect.getsource(metrics))
+    offenders = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        if not (fn.name.startswith("record_")
+                or fn.name.startswith("observe_")):
+            continue
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            f = call.func
+            is_metric = (
+                (isinstance(f, ast.Attribute)
+                 and f.attr in ("inc", "set", "observe")
+                 and isinstance(f.value, ast.Name)
+                 and f.value.id == "METRICS")
+                or (isinstance(f, ast.Name) and f.id == "_observe_safe"))
+            if not is_metric:
+                continue
+            strings = [a.value for a in call.args
+                       if isinstance(a, ast.Constant)
+                       and isinstance(a.value, str) and a.value.strip()]
+            kw_help = any(
+                k.arg == "help_text" and isinstance(k.value, ast.Constant)
+                and isinstance(k.value.value, str) and k.value.value.strip()
+                for k in call.keywords)
+            if len(strings) < 2 and not kw_help:
+                offenders.append(f"{fn.name} (line {call.lineno})")
+    assert not offenders, \
+        f"metric calls without help text: {offenders}"
+
+
+def test_bench_check_regression_exit_codes(capsys):
+    """The CI regression gate: ok and missing-baseline pass (0), a
+    throughput drop past the threshold fails (2), a broken current
+    measurement is its own error (1)."""
+    import json as _json
+
+    import bench
+
+    def run(current, baseline, threshold=0.8):
+        code = bench.check_regression(current, baseline, threshold)
+        return code, _json.loads(capsys.readouterr().out.strip())
+
+    code, out = run({"value": 10.0}, {"value": 10.0})
+    assert (code, out["status"]) == (0, "ok")
+    assert out["ratio"] == 1.0
+    code, out = run({"value": 10.0}, {})
+    assert (code, out["status"]) == (0, "no-baseline")
+    code, out = run({"value": 5.0}, {"value": 10.0})
+    assert (code, out["status"]) == (2, "regression")
+    assert out["ratio"] == 0.5
+    # just inside the threshold: not a regression
+    code, out = run({"value": 8.5}, {"value": 10.0})
+    assert (code, out["status"]) == (0, "ok")
+    code, out = run({"value": None, "error": "probe failed"}, {"value": 10})
+    assert (code, out["status"]) == (1, "error")
+    assert out["detail"] == "probe failed"
+
+
 def test_fault_rule_after_skips_leading_occasions():
     """after=N arms a rule only from the N+1th matching occasion — the
     handle the chaos battery uses to hit the response leg of a two-leg
