@@ -47,6 +47,21 @@ class InvalidBlock(Exception):
     pass
 
 
+def _note_import_stage(stage: str, seconds: float) -> None:
+    """Sub-stage attribution (execute / merkleize / store_write) for
+    both import paths: the block_import_stage_seconds histogram plus the
+    perf profiler tree.  Telemetry contract: never raises into an
+    import."""
+    try:
+        from ..perf.profiler import record_stage
+        from ..utils.metrics import observe_import_stage
+
+        observe_import_stage(stage, seconds)
+        record_stage("l1_import", stage, seconds)
+    except Exception:
+        pass
+
+
 class DirtySnapshot:
     """Frozen copy of one block's dirty write set, duck-typing the slice
     of StateDB that apply_updates_to_tries consumes (dirty_accounts,
@@ -360,13 +375,17 @@ class Blockchain:
             t_exec = _time.perf_counter()
             outcome = self.execute_block(block, parent, state_db,
                                          bal_recorder=recorder)
-            observe_block_execution(_time.perf_counter() - t_exec)
+            dt_exec = _time.perf_counter() - t_exec
+            observe_block_execution(dt_exec)
+            _note_import_stage("execute", dt_exec)
             self._validate_block_outcome(header, outcome)
             if recorder is not None and \
                     recorder.build().hash() != bal.hash():
                 raise InvalidBlock("block access list mismatch")
+            t_mk = _time.perf_counter()
             new_root = self.store.apply_account_updates(
                 parent.state_root, outcome.state_db)
+            _note_import_stage("merkleize", _time.perf_counter() - t_mk)
             if new_root != header.state_root:
                 raise InvalidBlock(
                     f"state root mismatch: {new_root.hex()} != "
@@ -376,7 +395,9 @@ class Blockchain:
             # would absorb unrelated writes (review finding)
             self.store.discard_node_layer(header.number, header.hash)
             raise
+        t_sw = _time.perf_counter()
         self.store.add_block(block, outcome.receipts)
+        _note_import_stage("store_write", _time.perf_counter() - t_sw)
         observe_block_import(_time.perf_counter() - t_import)
 
     def generate_bal(self, block: Block, parent: BlockHeader):
@@ -441,6 +462,8 @@ class Blockchain:
         Execution state chains through one shared StateDB cache; each
         block's dirty writes are snapshotted (DirtySnapshot) at handoff,
         and the worker chains the trie roots block by block."""
+        import time as _time
+
         if not blocks:
             return
         # one diff layer per BATCH, tagged by its tail block: bulk-imported
@@ -448,6 +471,7 @@ class Blockchain:
         # to whatever unrelated layer was open (review finding)
         self.store.push_node_layer(blocks[-1].header.number,
                                    blocks[-1].header.hash)
+        t0 = _time.perf_counter()
         try:
             self._add_blocks_pipelined(blocks)
         except BaseException:
@@ -457,9 +481,19 @@ class Blockchain:
             self.store.discard_node_layer(blocks[-1].header.number,
                                           blocks[-1].header.hash)
             raise
+        wall = _time.perf_counter() - t0
+        try:
+            from ..utils.metrics import record_import_throughput
+
+            gas = sum(b.header.gas_used for b in blocks)
+            if wall > 0:
+                record_import_throughput(gas / wall / 1e6)
+        except Exception:
+            pass
 
     def _add_blocks_pipelined(self, blocks: list[Block]) -> None:
         import queue as queue_mod
+        import time as _time
 
         from ..evm.db import StateDB
         from ..storage.store import StoreSource
@@ -483,14 +517,20 @@ class Blockchain:
                 try:
                     snap.source = StoreSource(self.store, prev_root,
                                               header_overrides=overrides)
+                    t_mk = _time.perf_counter()
                     new_root = self.store.apply_account_updates(
                         prev_root, snap)
+                    _note_import_stage(
+                        "merkleize", _time.perf_counter() - t_mk)
                     if new_root != block.header.state_root:
                         raise InvalidBlock(
                             f"state root mismatch at block "
                             f"{block.header.number}: {new_root.hex()} != "
                             f"{block.header.state_root.hex()}")
+                    t_sw = _time.perf_counter()
                     self.store.add_block(block, receipts)
+                    _note_import_stage(
+                        "store_write", _time.perf_counter() - t_sw)
                     prev_root = new_root
                 except Exception as exc:  # noqa: BLE001 — joined below
                     failure.append(exc)
@@ -512,7 +552,9 @@ class Blockchain:
                     raise InvalidBlock("non-contiguous batch")
                 self.validate_header(header, prev)
                 self._validate_body_roots(block)
+                t_exec = _time.perf_counter()
                 outcome = self.execute_block(block, prev, state_db)
+                _note_import_stage("execute", _time.perf_counter() - t_exec)
                 self._validate_block_outcome(header, outcome)
                 snap = DirtySnapshot(state_db)
                 state_db.drain_dirty()

@@ -135,6 +135,12 @@ def _add_node_flags(parser: argparse.ArgumentParser):
                         "bundles (metrics, windows, alerts, traces, TPU "
                         "telemetry) written here on fatal actor errors, "
                         "shutdown, and ethrex_debug_snapshot calls")
+    parser.add_argument("--profile-dir", dest="profile_dir",
+                        default=_env("PROFILE_DIR"),
+                        help="opt-in continuous profiler destination: "
+                        "jax.profiler device traces (TensorBoard/XProf "
+                        "format) captured around each prove land here; "
+                        "unset keeps device tracing off (zero overhead)")
 
 
 def _load_genesis(args) -> Genesis | None:
@@ -360,6 +366,10 @@ def run_node(args) -> int:
 
     if args.debug_snapshot_dir:
         snapshot.configure(args.debug_snapshot_dir)
+    if getattr(args, "profile_dir", None):
+        from .perf import profiler as perf_profiler
+
+        perf_profiler.configure(args.profile_dir)
     node.start_telemetry(alerts=build_default_engine(node))
 
     # coordinated drain (utils/shutdown.py): rpc -> producer -> flush+close
@@ -499,6 +509,10 @@ def run_l2(args) -> int:
 
     if args.debug_snapshot_dir:
         snapshot.configure(args.debug_snapshot_dir)
+    if getattr(args, "profile_dir", None):
+        from .perf import profiler as perf_profiler
+
+        perf_profiler.configure(args.profile_dir)
     node.start_telemetry(alerts=build_default_engine(node))
 
     # coordinated drain: rpc -> prover clients -> sequencer (in-flight

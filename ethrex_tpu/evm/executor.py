@@ -7,6 +7,8 @@ function, plus the L2 variant's fee handling later in l2/.
 
 from __future__ import annotations
 
+import time as _time
+
 from ..crypto.keccak import keccak256
 from ..primitives.genesis import ChainConfig, Fork
 from ..primitives.transaction import TYPE_BLOB, TYPE_PRIVILEGED, Transaction
@@ -18,6 +20,17 @@ from .vm import EVM, BlockEnv, Message, TxResult, DELEGATION_PREFIX
 
 class InvalidTransaction(Exception):
     pass
+
+
+def _note_evm_stage(stage: str, seconds: float) -> None:
+    # per-tx attribution of ecrecover vs interpreter time — the two legs
+    # dominate L1 import's execute stage and scale differently (sig
+    # recovery is per-tx constant, the opcode loop is per-gas)
+    try:
+        from ..perf.profiler import record_stage
+        record_stage("evm", stage, seconds)
+    except Exception:
+        pass
 
 
 def validate_tx(tx: Transaction, sender: bytes, state: StateDB,
@@ -144,7 +157,9 @@ def execute_tx(tx: Transaction, state: StateDB, block: BlockEnv,
     if tx.tx_type == TYPE_PRIVILEGED:
         return execute_privileged_tx(tx, state, block, config, tracer)
     fork = config.fork_at(block.number, block.timestamp)
+    t_sig = _time.perf_counter()
     sender = tx.sender()
+    _note_evm_stage("sig_recovery", _time.perf_counter() - t_sig)
     if sender is None:
         raise InvalidTransaction("invalid signature")
     state.begin_tx()
@@ -185,6 +200,7 @@ def execute_tx(tx: Transaction, state: StateDB, block: BlockEnv,
         auth_refund = _apply_authorizations(tx, state, config)
 
     created = None
+    t_loop = _time.perf_counter()
     if tx.is_create:
         msg = Message(caller=sender, to=b"", code_address=b"",
                       value=tx.value, data=b"", gas=gas, is_create=True,
@@ -200,6 +216,7 @@ def execute_tx(tx: Transaction, state: StateDB, block: BlockEnv,
         if precompiles.get_precompile(tx.to, fork) is not None:
             msg.code_address = tx.to
         ok, gas_left, output = evm.execute_message(msg)
+    _note_evm_stage("opcode_loop", _time.perf_counter() - t_loop)
 
     # refunds (pre-London: capped at gas_used/2; EIP-3529: gas_used/5)
     gas_used = tx.gas_limit - gas_left

@@ -134,3 +134,25 @@ def build_prove_step(log_n: int, width: int, log_blowup: int = 2,
                    for _ in range(L)]),
     )
     return jax.jit(step), example_args
+
+
+def compile_prove_step(log_n: int, width: int, log_blowup: int = 2,
+                       log_final_size: int = 5, mesh=None):
+    """AOT-compiled fused prove step: (compiled, example_args, cost).
+
+    `compiled` is the executable (callable like the jitted fn); `cost`
+    is the raw `cost_analysis()` output — shape varies by jaxlib
+    version, feed it through perf.roofline._parse_cost — or None when
+    lowering/compiling ahead of time is unavailable (the jitted callable
+    is returned in that case, so callers always get something runnable).
+    The bench core microbench uses this to pair measured cells/s with
+    the kernel's static FLOPs."""
+    fn, example_args = build_prove_step(log_n, width, log_blowup,
+                                        log_final_size, mesh)
+    try:
+        specs = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                      for a in example_args)
+        compiled = fn.lower(*specs).compile()
+        return compiled, example_args, compiled.cost_analysis()
+    except Exception:
+        return fn, example_args, None

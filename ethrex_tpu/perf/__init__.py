@@ -1,0 +1,25 @@
+"""Performance observability: continuous profiling, roofline accounting
+and the bench suite (docs/PERFORMANCE.md).
+
+Three pillars, built on the PR-3 tracing spans and the PR-5
+timeseries/SLO substrate:
+
+- ``profiler``: a process-wide stage-attribution tree unifying the
+  block_until_ready-bounded prover stage spans with the L1 import legs
+  (execute / merkleize / store_write), the EVM split (sig_recovery /
+  opcode_loop) and the sorted trie commit, plus opt-in ``jax.profiler``
+  trace capture around a prove.
+- ``roofline``: XLA cost-model FLOPs/bytes per compiled STARK phase
+  program combined with measured wall-clock into achieved-FLOP/s and
+  utilization-vs-peak estimates.
+- ``bench_suite``: the measurement logic behind ``bench.py`` (the repo
+  root keeps a thin CLI shim), including the forced-CPU fallback for
+  hosts whose TPU plugin is present but dead, and the append-only
+  ``bench_history.jsonl`` trajectory.
+
+Everything here is telemetry and sits behind the never-raise contract:
+a failing hook degrades to missing numbers, never a failed prove or
+import.
+"""
+
+from . import profiler, roofline  # noqa: F401

@@ -1,0 +1,934 @@
+"""The bench suite: BASELINE measurements, backend probing, the CPU
+fallback, the append-only history, and the CI regression gate.  The
+repo-root ``bench.py`` is a thin CLI shim over this module.
+
+Headline: BASELINE config 1 — prove a 10-transfer block end-to-end on
+one TPU chip — plus BASELINE configs 2/4/5 attached to the same JSON
+line when the chip budget allows.
+
+The measured quantity is the full `--prover tpu` pipeline on a real
+committed batch: stateless re-execution, per-tx fine-log derivation, and
+the DEEP-FRI STARKs (state-update circuit, VM circuits, output binding),
+exactly what `TpuBackend.prove` ships to the proof coordinator, followed
+by an independent `verify`.
+
+Configs (BASELINE.md):
+  1 (headline)      10-transfer block, vm mode, 3 STARKs
+  2 (--measure-2)   100-tx ERC-20 batch, token mode, 4 STARKs
+  3 (BENCH_FULL=1)  1000-tx mixed transfer+token batch (opt-in: hours of
+                    compile on a cold cache)
+  4 (--measure-4)   Groth16 BN254 wrap (format=groth16 on the config-1
+                    batch: aggregation + wrap + full verify)
+  5 (--measure-5)   8-proof recursive aggregation (8 sponge STARKs in
+                    ONE outer FriVerifyAir proof, verified)
+
+vs_baseline is a measured-vs-measured gas rate: the reference's SP1-CUDA
+prover does a 7,898,434-gas mainnet block in 143 s on an RTX 4090
+(/root/reference/docs/l2/bench/prover_performance.md:7-9) = 55,234 gas/s;
+we report (batch_gas / wall_s) / 55,234.
+
+Resilience: the chip sits behind a flaky network tunnel.  Every
+measurement runs in a child process under a hard timeout with retries;
+successes are persisted to .bench_last.json; if the end-to-end
+measurement cannot run, the suite distinguishes two failure shapes:
+
+  * ABSENT chip (jax imports fine, default_backend is cpu): run the
+    same pipeline on CPU up front, tagged ``backend: "cpu"``.
+  * BROKEN chip (a present-but-dead TPU plugin hangs `jax.devices()`
+    so `detect_backend()` returns None): after the probe retries are
+    exhausted, probe a FORCED-CPU child (`jax.config.update` — the
+    plugin ignores JAX_PLATFORMS) and, when that works, run the
+    headline + core configs forced to CPU, again tagged
+    ``backend: "cpu"``.
+
+Either way the record carries real prover numbers with per-stage
+breakdowns and is NEVER cached to .bench_last.json as a chip record;
+only when both shapes fail does the suite degrade to the last cached
+chip record.  Every final record is also appended to
+``bench_history.jsonl`` (one JSON object per line, with ts + backend)
+so the perf trajectory survives .bench_last.json overwrites — the
+regression gate reads same-backend pairs out of this file.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...,
+"backend", "stages", "configs": {...}}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+BASELINE_GAS_PER_SEC = 7_898_434 / 143.0
+BASELINE_CELLS_PER_SEC = 1.0e8  # round-1/2 estimated anchor (fallback only)
+# this module lives at ethrex_tpu/perf/bench_suite.py; the CLI shim and
+# the state files live at the repo root next to it
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+BENCH_PATH = os.path.join(_REPO_ROOT, "bench.py")
+LAST_PATH = os.path.join(_REPO_ROOT, ".bench_last.json")
+HISTORY_PATH = os.path.join(_REPO_ROOT, "bench_history.jsonl")
+ATTEMPT_TIMEOUT = int(os.environ.get("BENCH_TIMEOUT", "3000"))
+ATTEMPTS = int(os.environ.get("BENCH_ATTEMPTS", "3"))
+PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
+NUM_TXS = int(os.environ.get("BENCH_TXS", "10"))
+
+# forces the cpu platform through jax.config BEFORE any backend is
+# touched: the axon TPU plugin ignores JAX_PLATFORMS, and a dead plugin
+# can hang jax.devices() indefinitely rather than erroring
+_FORCED_CPU_CHECK = ("import jax; "
+                     "jax.config.update('jax_platforms', 'cpu'); "
+                     "jax.devices()")
+
+
+def probe_backend_error() -> str | None:
+    """Cheap child-process jax.devices() probe so a dead tunnel costs
+    PROBE_TIMEOUT, not a full measurement timeout (the tunnel can hang
+    indefinitely rather than erroring).  Returns None when the backend is
+    usable, else a short diagnostic ("ExcType: message") so a degraded
+    record says WHY the probe failed."""
+    want_cpu = os.environ.get("BENCH_ALLOW_CPU") == "1"
+    check = ("import jax; assert jax.default_backend() != 'cpu'"
+             if not want_cpu else _FORCED_CPU_CHECK)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", check],
+            capture_output=True, timeout=PROBE_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        return f"TimeoutExpired: backend probe exceeded {PROBE_TIMEOUT}s"
+    if proc.returncode == 0:
+        return None
+    # last non-empty stderr line is the exception line of the traceback
+    stderr = proc.stderr.decode(errors="replace") if proc.stderr else ""
+    lines = [ln.strip() for ln in stderr.splitlines() if ln.strip()]
+    detail = lines[-1] if lines else f"exit code {proc.returncode}"
+    return detail[:400]
+
+
+def probe_backend() -> bool:
+    return probe_backend_error() is None
+
+
+def probe_cpu_error() -> str | None:
+    """Forced-CPU child probe for the dead-tunnel fallback: can this
+    host run JAX at all once the (possibly broken) accelerator plugin is
+    forced out of the way?  None when yes, else a short diagnostic."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _FORCED_CPU_CHECK],
+            capture_output=True, timeout=PROBE_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        return f"TimeoutExpired: forced-CPU probe exceeded {PROBE_TIMEOUT}s"
+    if proc.returncode == 0:
+        return None
+    stderr = proc.stderr.decode(errors="replace") if proc.stderr else ""
+    lines = [ln.strip() for ln in stderr.splitlines() if ln.strip()]
+    detail = lines[-1] if lines else f"exit code {proc.returncode}"
+    return detail[:400]
+
+
+def detect_backend() -> str | None:
+    """Child-process `jax.default_backend()` — distinguishes a CPU-only
+    host (jax imports fine, no chip plugged in) from a broken/hung
+    backend (None).  Drives the CPU fallback in main(): a host with no
+    chip should publish an honest backend=cpu record, not degrade after
+    three probe retries that can never pass."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            capture_output=True, timeout=PROBE_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode != 0:
+        return None
+    out = proc.stdout.decode(errors="replace").strip()
+    return out or None
+
+
+def _guard_backend() -> None:
+    if os.environ.get("BENCH_ALLOW_CPU") == "1":
+        # the axon TPU plugin ignores JAX_PLATFORMS; force CPU through
+        # jax.config before any backend is touched (CPU smoke runs only)
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    import jax
+
+    if (jax.default_backend() == "cpu"
+            and os.environ.get("BENCH_ALLOW_CPU") != "1"):
+        print("backend is cpu, refusing to publish", file=sys.stderr)
+        sys.exit(3)
+    from ethrex_tpu.utils.jax_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
+
+def measure() -> None:
+    """BASELINE config 1: one block of NUM_TXS plain transfers, proven
+    end-to-end and independently verified."""
+    _guard_backend()
+
+    from ethrex_tpu.crypto import secp256k1
+    from ethrex_tpu.guest.execution import ProgramInput
+    from ethrex_tpu.guest.witness import generate_witness
+    from ethrex_tpu.node import Node
+    from ethrex_tpu.primitives.genesis import Genesis
+    from ethrex_tpu.primitives.transaction import Transaction
+    from ethrex_tpu.prover.tpu_backend import TpuBackend
+
+    secret = 0xA11CE
+    sender = secp256k1.pubkey_to_address(secp256k1.pubkey_from_secret(secret))
+    genesis = {
+        "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+                   "shanghaiTime": 0, "cancunTime": 0},
+        "alloc": {"0x" + sender.hex(): {"balance": hex(10**21)}},
+        "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7",
+        "timestamp": "0x0",
+    }
+    node = Node(Genesis.from_json(genesis))
+    for n in range(NUM_TXS):
+        tx = Transaction(
+            tx_type=2, chain_id=1337, nonce=n,
+            max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+            gas_limit=21_000, to=bytes([0x50 + n]) * 20, value=1000 + n,
+        ).sign(secret)
+        node.submit_transaction(tx)
+    block = node.produce_block()
+    gas = block.header.gas_used
+    witness = generate_witness(node.chain, [block])
+    pi = ProgramInput(blocks=[block], witness=witness, config=node.config)
+
+    backend = TpuBackend()
+    # one warm-up prove compiles every XLA program (persistent-cached)
+    warm = backend.prove(pi, "stark")
+    assert warm.get("vm", {}).get("mode") == "transfer"
+
+    from ethrex_tpu.utils import tracing
+
+    t0 = time.perf_counter()
+    with tracing.span("bench.prove") as bench_span:
+        proof = backend.prove(pi, "stark")
+    wall = time.perf_counter() - t0
+    if not backend.verify(proof):
+        print("self-verification failed", file=sys.stderr)
+        sys.exit(4)
+
+    # per-stage breakdown from the profiling spans of the timed prove
+    stages = {}
+    if bench_span is not None:
+        stages = {k: round(v, 4) for k, v in sorted(
+            tracing.TRACER.stage_breakdown(bench_span.trace_id).items())}
+
+    gas_per_sec = gas / wall
+    print(json.dumps({
+        "metric": "transfer_batch_prove_wall_s",
+        "value": round(wall, 3),
+        "unit": "s",
+        "vs_baseline": round(gas_per_sec / BASELINE_GAS_PER_SEC, 4),
+        "batch_gas": gas,
+        "num_txs": NUM_TXS,
+        "gas_per_sec": round(gas_per_sec, 1),
+        "proofs_per_hour_chip": round(3600.0 / wall, 2),
+        "stages": stages,
+        "config": "BASELINE-1 (10-transfer block, vm mode, 3 STARKs)",
+    }))
+
+
+def _token_genesis(sender):
+    from ethrex_tpu.guest import token_template as tt
+
+    token = bytes.fromhex("7070" * 10)
+    storage = {hex(tt.balance_slot(sender)): hex(10**15)}
+    return token, {
+        "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+                   "shanghaiTime": 0, "cancunTime": 0},
+        "alloc": {
+            "0x" + sender.hex(): {"balance": hex(10**21)},
+            "0x" + token.hex(): {"balance": "0x0",
+                                 "code": "0x" + tt.TEMPLATE_CODE.hex(),
+                                 "storage": storage},
+        },
+        "gasLimit": hex(60_000_000), "baseFeePerGas": "0x7",
+        "timestamp": "0x0",
+    }
+
+
+def _span_stages(bench_span) -> dict:
+    """Stage breakdown of one timed region from its trace's spans."""
+    from ethrex_tpu.utils import tracing
+
+    if bench_span is None:
+        return {}
+    return {k: round(v, 4) for k, v in sorted(
+        tracing.TRACER.stage_breakdown(bench_span.trace_id).items())}
+
+
+def measure_config2() -> None:
+    """BASELINE config 2: a 100-tx ERC-20 batch, token mode, proven
+    end-to-end (state + transfer + token + binding STARKs), verified."""
+    _guard_backend()
+
+    from ethrex_tpu.crypto import secp256k1
+    from ethrex_tpu.guest import token_template as tt
+    from ethrex_tpu.guest.execution import ProgramInput
+    from ethrex_tpu.guest.witness import generate_witness
+    from ethrex_tpu.node import Node
+    from ethrex_tpu.primitives.genesis import Genesis
+    from ethrex_tpu.primitives.transaction import Transaction
+    from ethrex_tpu.prover.tpu_backend import TpuBackend
+    from ethrex_tpu.utils import tracing
+
+    n_txs = int(os.environ.get("BENCH_ERC20_TXS", "100"))
+    secret = 0xA11CE
+    sender = secp256k1.pubkey_to_address(
+        secp256k1.pubkey_from_secret(secret))
+    token, genesis = _token_genesis(sender)
+    node = Node(Genesis.from_json(genesis))
+    for n in range(n_txs):
+        node.submit_transaction(Transaction(
+            tx_type=2, chain_id=1337, nonce=n,
+            max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+            gas_limit=100_000, to=token, value=0,
+            data=tt.transfer_calldata(bytes([0x60 + n % 16]) * 20,
+                                      100 + n)).sign(secret))
+    block = node.produce_block()
+    gas = block.header.gas_used
+    assert len(block.body.transactions) == n_txs
+    witness = generate_witness(node.chain, [block])
+    pi = ProgramInput(blocks=[block], witness=witness, config=node.config)
+    backend = TpuBackend()
+    warm = backend.prove(pi, "stark")
+    assert warm.get("vm", {}).get("mode") == "token"
+    t0 = time.perf_counter()
+    with tracing.span("bench.prove") as bench_span:
+        proof = backend.prove(pi, "stark")
+    wall = time.perf_counter() - t0
+    if not backend.verify(proof):
+        print("self-verification failed", file=sys.stderr)
+        sys.exit(4)
+    print(json.dumps({
+        "metric": "erc20_batch_prove_wall_s", "value": round(wall, 3),
+        "unit": "s",
+        "vs_baseline": round((gas / wall) / BASELINE_GAS_PER_SEC, 4),
+        "batch_gas": gas, "num_txs": n_txs,
+        "gas_per_sec": round(gas / wall, 1),
+        "stages": _span_stages(bench_span),
+        "config": "BASELINE-2 (100-tx ERC-20 batch, token mode, 4 STARKs)",
+    }))
+
+
+def measure_config4() -> None:
+    """BASELINE config 4: Groth16 BN254 wrap — format=groth16 on the
+    config-1 batch (aggregation + R1CS wrap + pairing verify)."""
+    _guard_backend()
+
+    from ethrex_tpu.crypto import secp256k1
+    from ethrex_tpu.guest.execution import ProgramInput
+    from ethrex_tpu.guest.witness import generate_witness
+    from ethrex_tpu.node import Node
+    from ethrex_tpu.primitives.genesis import Genesis
+    from ethrex_tpu.primitives.transaction import Transaction
+    from ethrex_tpu.prover.tpu_backend import TpuBackend
+    from ethrex_tpu.utils import tracing
+
+    secret = 0xA11CE
+    sender = secp256k1.pubkey_to_address(
+        secp256k1.pubkey_from_secret(secret))
+    genesis = {
+        "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+                   "shanghaiTime": 0, "cancunTime": 0},
+        "alloc": {"0x" + sender.hex(): {"balance": hex(10**21)}},
+        "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7",
+        "timestamp": "0x0",
+    }
+    node = Node(Genesis.from_json(genesis))
+    for n in range(NUM_TXS):
+        node.submit_transaction(Transaction(
+            tx_type=2, chain_id=1337, nonce=n,
+            max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+            gas_limit=21_000, to=bytes([0x50 + n]) * 20,
+            value=1000 + n).sign(secret))
+    block = node.produce_block()
+    witness = generate_witness(node.chain, [block])
+    pi = ProgramInput(blocks=[block], witness=witness, config=node.config)
+    backend = TpuBackend()
+    warm = backend.prove(pi, "groth16")
+    assert "groth16" in warm
+    t0 = time.perf_counter()
+    with tracing.span("bench.prove") as bench_span:
+        proof = backend.prove(pi, "groth16")
+    wall = time.perf_counter() - t0
+    if not backend.verify(proof):
+        print("self-verification failed", file=sys.stderr)
+        sys.exit(4)
+    print(json.dumps({
+        "metric": "groth16_wrap_prove_wall_s", "value": round(wall, 3),
+        "unit": "s", "vs_baseline": 0.0,
+        "batch_gas": block.header.gas_used,
+        "stages": _span_stages(bench_span),
+        "config": "BASELINE-4 (config-1 batch, compressed + Groth16 wrap)",
+    }))
+
+
+def measure_config5() -> None:
+    """BASELINE config 5: 8-proof recursive aggregation — eight sponge
+    STARKs proven, then ONE outer FriVerifyAir STARK covering every FRI
+    query opening of all eight; verify_aggregated must accept."""
+    _guard_backend()
+
+    from ethrex_tpu.models import poseidon2_air as pair
+    from ethrex_tpu.stark import aggregate as agg_mod
+    from ethrex_tpu.stark import prover as stark_prover
+    from ethrex_tpu.stark.prover import StarkParams
+    from ethrex_tpu.utils import tracing
+
+    params = StarkParams(log_blowup=3, num_queries=40, log_final_size=4)
+    airs, proofs = [], []
+    for i in range(8):
+        limbs = pair.pad_message_limbs(list(range(16 * (i + 1))))
+        air = pair.Poseidon2SpongeAir(num_chunks=len(limbs) // 8)
+        trace = pair.generate_sponge_trace(limbs)
+        pub = pair.sponge_public_inputs(limbs)
+        proofs.append(stark_prover.prove(air, trace, pub, params))
+        airs.append(air)
+    # warm-up aggregation compiles the outer AIR's phase programs
+    agg_mod.aggregate(airs, proofs, params)
+    t0 = time.perf_counter()
+    with tracing.span("bench.prove") as bench_span:
+        agg = agg_mod.aggregate(airs, proofs, params)
+    wall = time.perf_counter() - t0
+    agg_mod.verify_aggregated(airs, agg, params)
+    print(json.dumps({
+        "metric": "aggregate8_prove_wall_s", "value": round(wall, 3),
+        "unit": "s", "vs_baseline": 0.0,
+        "stages": _span_stages(bench_span),
+        "config": "BASELINE-5 (8 STARKs -> one outer recursion proof)",
+    }))
+
+
+def measure_config3() -> None:
+    """BASELINE config 3 (opt-in, BENCH_FULL=1): 1000-tx mixed batch —
+    500 transfers + 500 token calls across blocks."""
+    _guard_backend()
+
+    from ethrex_tpu.crypto import secp256k1
+    from ethrex_tpu.guest import token_template as tt
+    from ethrex_tpu.guest.execution import ProgramInput
+    from ethrex_tpu.guest.witness import generate_witness
+    from ethrex_tpu.node import Node
+    from ethrex_tpu.primitives.genesis import Genesis
+    from ethrex_tpu.primitives.transaction import Transaction
+    from ethrex_tpu.prover.tpu_backend import TpuBackend
+    from ethrex_tpu.utils import tracing
+
+    secret = 0xA11CE
+    sender = secp256k1.pubkey_to_address(
+        secp256k1.pubkey_from_secret(secret))
+    token, genesis = _token_genesis(sender)
+    node = Node(Genesis.from_json(genesis))
+    nonce = 0
+    blocks = []
+    for _ in range(4):   # 4 blocks x 250 txs
+        for i in range(125):
+            node.submit_transaction(Transaction(
+                tx_type=2, chain_id=1337, nonce=nonce,
+                max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+                gas_limit=21_000, to=bytes([0x50 + i % 32]) * 20,
+                value=100 + i).sign(secret))
+            nonce += 1
+            node.submit_transaction(Transaction(
+                tx_type=2, chain_id=1337, nonce=nonce,
+                max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+                gas_limit=100_000, to=token, value=0,
+                data=tt.transfer_calldata(bytes([0x60 + i % 16]) * 20,
+                                          10 + i)).sign(secret))
+            nonce += 1
+        blocks.append(node.produce_block())
+    gas = sum(b.header.gas_used for b in blocks)
+    witness = generate_witness(node.chain, blocks)
+    pi = ProgramInput(blocks=blocks, witness=witness, config=node.config)
+    backend = TpuBackend()
+    warm = backend.prove(pi, "stark")
+    assert warm.get("vm", {}).get("mode") == "token"
+    t0 = time.perf_counter()
+    with tracing.span("bench.prove") as bench_span:
+        proof = backend.prove(pi, "stark")
+    wall = time.perf_counter() - t0
+    if not backend.verify(proof):
+        sys.exit(4)
+    print(json.dumps({
+        "metric": "mixed1000_batch_prove_wall_s", "value": round(wall, 3),
+        "unit": "s",
+        "vs_baseline": round((gas / wall) / BASELINE_GAS_PER_SEC, 4),
+        "batch_gas": gas, "num_txs": 1000,
+        "stages": _span_stages(bench_span),
+        "config": "BASELINE-3 (1000-tx mixed batch)",
+    }))
+
+
+def measure_mgas() -> None:
+    """L1 execution-throughput microbench (reference anchor: ~669 Mgas/s
+    live import on its bench box, docs/perf/README.md:126-131): build a
+    chain of full transfer blocks, then re-import it through the
+    PIPELINED path (execute N+1 while N merkleizes in the native C++
+    MPT engine) into a fresh store.  Host CPU only — no TPU needed."""
+    import os as _os
+
+    _os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")  # axon ignores the env
+    except Exception:
+        pass
+    from ethrex_tpu.blockchain.blockchain import Blockchain
+    from ethrex_tpu.blockchain.fork_choice import apply_fork_choice
+    from ethrex_tpu.crypto import secp256k1
+    from ethrex_tpu.node import Node
+    from ethrex_tpu.perf.profiler import PROFILER
+    from ethrex_tpu.primitives.genesis import Genesis
+    from ethrex_tpu.primitives.transaction import Transaction
+    from ethrex_tpu.storage.store import Store
+
+    num_blocks = int(os.environ.get("BENCH_MGAS_BLOCKS", "20"))
+    txs_per_block = int(os.environ.get("BENCH_MGAS_TXS", "400"))
+    secret = 0xA11CE
+    sender = secp256k1.pubkey_to_address(
+        secp256k1.pubkey_from_secret(secret))
+    genesis = {
+        "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+                   "shanghaiTime": 0, "cancunTime": 0},
+        "alloc": {"0x" + sender.hex(): {"balance": hex(10**24)}},
+        "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7",
+        "timestamp": "0x0",
+    }
+    node = Node(Genesis.from_json(genesis))
+    nonce = 0
+    blocks = []
+    for _ in range(num_blocks):
+        for i in range(txs_per_block):
+            node.submit_transaction(Transaction(
+                tx_type=2, chain_id=1337, nonce=nonce,
+                max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+                gas_limit=21_000, to=bytes([0x50 + i % 64]) * 20,
+                value=1 + i).sign(secret))
+            nonce += 1
+        blocks.append(node.produce_block())
+    gas = sum(b.header.gas_used for b in blocks)
+    # fresh store, re-import through full validation (pipelined)
+    store = Store()
+    gh = store.init_genesis(Genesis.from_json(genesis))
+    chain = Blockchain(store, node.config)
+    # stage attribution: the import path feeds the continuous profiler
+    # (execute / merkleize / store_write); deltas around the timed
+    # region isolate this import from the chain build above
+    before = PROFILER.stage_totals("l1_import")
+    t0 = time.perf_counter()
+    chain.add_blocks_pipelined(blocks)
+    wall = time.perf_counter() - t0
+    after = PROFILER.stage_totals("l1_import")
+    stages = {k: round(after.get(k, 0.0) - before.get(k, 0.0), 4)
+              for k in sorted(set(after) | set(before))
+              if after.get(k, 0.0) - before.get(k, 0.0) > 0}
+    apply_fork_choice(store, blocks[-1].hash)
+    assert store.head_header().hash == blocks[-1].hash
+    print(json.dumps({
+        "metric": "l1_import_mgas_per_sec",
+        "value": round(gas / wall / 1e6, 2),
+        "unit": "Mgas/s",
+        "vs_baseline": round((gas / wall / 1e6) / 669.0, 4),
+        "blocks": num_blocks, "txs": num_blocks * txs_per_block,
+        "batch_gas": gas, "wall_s": round(wall, 3),
+        "stages": stages or {"import": round(wall, 4)},
+        "config": "L1 pipelined import, ETH transfers (ref anchor "
+                  "669 Mgas/s, docs/perf/README.md:126-131)",
+    }))
+
+
+def measure_core() -> None:
+    """Fallback microbench: fully-jitted prove-core throughput (the round
+    1-2 metric, against its documented estimated anchor), now AOT-
+    compiled so the record pairs measured cells/s with the kernel's
+    static FLOPs and a utilization-vs-peak estimate."""
+    _guard_backend()
+    import jax
+
+    from ethrex_tpu.parallel.core import compile_prove_step
+    from ethrex_tpu.perf import roofline
+
+    t_c0 = time.perf_counter()
+    fn, args, cost = compile_prove_step(log_n=15, width=64, log_blowup=2,
+                                        log_final_size=5, mesh=None)
+    jax.block_until_ready(fn(*args))
+    t_compile = time.perf_counter() - t_c0
+    runs = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        runs.append(time.perf_counter() - t0)
+    wall = min(runs)
+    value = (1 << 15) * 64 / wall
+    parsed = roofline._parse_cost(cost)
+    flops = parsed.get("flops")
+    peak = roofline.peak_flops_estimate()
+    achieved = flops / wall if flops and wall > 0 else None
+    out = {
+        "metric": "stark_prove_core_trace_cells_per_sec",
+        "value": round(value, 1),
+        "unit": "cells/s",
+        "vs_baseline": round(value / BASELINE_CELLS_PER_SEC, 4),
+        "stages": {"compile_and_warmup": round(t_compile, 4),
+                   "best_of_5_runs": round(wall, 4)},
+        "note": "fallback microbench; baseline anchor is an estimate",
+    }
+    if flops:
+        out["flops"] = flops
+        out["achieved_flops_per_sec"] = round(achieved, 1)
+        out["utilization_vs_peak"] = round(achieved / peak, 6) \
+            if peak else None
+    print(json.dumps(out))
+
+
+def _attempt(flag: str, timeout: int) -> dict | None:
+    try:
+        proc = subprocess.run(
+            [sys.executable, BENCH_PATH, flag],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=_REPO_ROOT)
+    except subprocess.TimeoutExpired:
+        return {"_err": f"timeout {timeout}s"}
+    line = ""
+    for cand in reversed(proc.stdout.strip().splitlines()):
+        if cand.startswith("{"):
+            line = cand
+            break
+    if proc.returncode == 0 and line:
+        try:
+            return json.loads(line)
+        except ValueError:
+            return {"_err": "unparseable output"}
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+    return {"_err": f"rc={proc.returncode} " + " | ".join(tail[-3:])[:400]}
+
+
+EXTRA_TIMEOUT = int(os.environ.get("BENCH_EXTRA_TIMEOUT", "2700"))
+
+
+def _extra_configs() -> dict:
+    """BASELINE configs 2/4/5 (and 3 with BENCH_FULL=1), each in its own
+    child attempt; failures are recorded, not fatal."""
+    out = {}
+    flags = [("2", "--measure-2"), ("4", "--measure-4"),
+             ("5", "--measure-5")]
+    if os.environ.get("BENCH_FULL") == "1":
+        flags.append(("3", "--measure-3"))
+    for name, flag in flags:
+        probe_err = probe_backend_error()
+        if probe_err is not None:
+            out[name] = {"error": "backend probe failed",
+                         "detail": probe_err}
+            continue
+        res = _attempt(flag, EXTRA_TIMEOUT)
+        out[name] = res if res is not None else {"error": "no output"}
+    return out
+
+
+def _mgas_config() -> dict:
+    """The L1-side number (host CPU, chip-independent)."""
+    res = _attempt("--measure-mgas", min(EXTRA_TIMEOUT, 1200))
+    return res if res is not None else {"error": "no output"}
+
+
+def _core_config() -> dict:
+    """The prove-core cells/s microbench as a sub-record, so every suite
+    run (chip or CPU fallback) leaves a gateable kernel-throughput
+    number in the history."""
+    res = _attempt("--measure-core", min(EXTRA_TIMEOUT, 1500))
+    return res if res is not None else {"error": "no output"}
+
+
+# ---------------------------------------------------------------------------
+# append-only history
+
+def append_history(record: dict) -> None:
+    """One JSON line per final bench record (ts + backend + the full
+    record including sub-configs).  Append-only so the perf trajectory
+    survives .bench_last.json overwrites; never raises — a read-only
+    checkout must not break the bench."""
+    try:
+        entry = dict(record)
+        entry.setdefault("ts", time.time())
+        with open(HISTORY_PATH, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except Exception:
+        pass
+
+
+def _read_history() -> list[dict]:
+    out: list[dict] = []
+    try:
+        with open(HISTORY_PATH) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue    # a torn append must not kill the gate
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def _history_series(metric: str) -> list[tuple[str, float]]:
+    """Chronological (backend, value) pairs for one metric, pulled from
+    top-level records and their sub-configs.  Degraded records are
+    replays of old numbers, not measurements — excluded."""
+    series: list[tuple[str, float]] = []
+    for rec in _read_history():
+        if rec.get("degraded"):
+            continue
+        backend = rec.get("backend") or "unknown"
+        candidates = [rec]
+        cfgs = rec.get("configs")
+        if isinstance(cfgs, dict):
+            candidates += [c for c in cfgs.values() if isinstance(c, dict)]
+        for cand in candidates:
+            if (cand.get("metric") == metric
+                    and isinstance(cand.get("value"), (int, float))
+                    and cand["value"] > 0):
+                series.append((backend, float(cand["value"])))
+    return series
+
+
+# ---------------------------------------------------------------------------
+# CI regression gate
+
+REGRESSION_THRESHOLD = float(
+    os.environ.get("BENCH_REGRESSION_THRESHOLD", "0.8"))
+
+
+def check_regression(current: dict | None = None,
+                     baseline: dict | None = None,
+                     threshold: float = REGRESSION_THRESHOLD) -> int:
+    """CI gate: compare a fresh mgas run against the cached
+    .bench_last.json record.  Exit code 2 when current/baseline drops
+    below `threshold` (default 0.8, i.e. a >20% regression); 0 when OK
+    or when there is no baseline yet; 1 when the current measurement
+    itself failed.  Prints one JSON line either way."""
+    if current is None:
+        current = _mgas_config()
+    if baseline is None:
+        try:
+            with open(LAST_PATH) as f:
+                baseline = json.load(f).get("configs", {}).get("mgas", {})
+        except (OSError, ValueError):
+            baseline = {}
+    cur = current.get("value") if isinstance(current, dict) else None
+    base = baseline.get("value") if isinstance(baseline, dict) else None
+    out = {"metric": "mgas_regression_check", "current": cur,
+           "baseline": base, "threshold": threshold}
+    if not isinstance(cur, (int, float)) or cur <= 0:
+        out["status"] = "error"
+        out["detail"] = current.get("error", "no current measurement") \
+            if isinstance(current, dict) else "no current measurement"
+        print(json.dumps(out))
+        return 1
+    if not isinstance(base, (int, float)) or base <= 0:
+        out["status"] = "no-baseline"
+        print(json.dumps(out))
+        return 0
+    out["ratio"] = cur / base
+    out["status"] = "regression" if out["ratio"] < threshold else "ok"
+    print(json.dumps(out))
+    return 2 if out["status"] == "regression" else 0
+
+
+def check_history_metric(metric: str,
+                         threshold: float = REGRESSION_THRESHOLD,
+                         lower_is_better: bool = False) -> int:
+    """Gate one metric on its last two SAME-BACKEND history entries (a
+    chip number must never be judged against a CPU-fallback number).
+    For lower-is-better metrics (wall-clock) the ratio is inverted so
+    `ratio < threshold` always means "got worse".  Exit code 2 on
+    regression, else 0 (including no/insufficient history)."""
+    series = _history_series(metric)
+    out: dict = {"metric": f"{metric}_regression_check",
+                 "threshold": threshold}
+    if not series:
+        out["status"] = "no-baseline"
+        print(json.dumps(out))
+        return 0
+    backend = series[-1][0]
+    same = [v for b, v in series if b == backend]
+    out["backend"] = backend
+    if len(same) < 2:
+        out["status"] = "no-baseline"
+        out["detail"] = f"fewer than two {backend} records in history"
+        print(json.dumps(out))
+        return 0
+    cur, base = same[-1], same[-2]
+    out["current"] = cur
+    out["baseline"] = base
+    out["ratio"] = (base / cur) if lower_is_better else (cur / base)
+    out["status"] = "regression" if out["ratio"] < threshold else "ok"
+    print(json.dumps(out))
+    return 2 if out["status"] == "regression" else 0
+
+
+def check_regression_suite(threshold: float = REGRESSION_THRESHOLD) -> int:
+    """The full --check-regression gate: live mgas vs .bench_last.json
+    (the original check), plus same-backend history gates on the prover
+    numbers — headline wall (lower is better) and prove-core cells/s —
+    so kernel wins get locked in the way mgas wins already are.  One
+    JSON line per check; exit code is the worst individual code
+    (2 regression > 1 error > 0 ok)."""
+    codes = [
+        check_regression(threshold=threshold),
+        check_history_metric("transfer_batch_prove_wall_s",
+                             threshold=threshold, lower_is_better=True),
+        check_history_metric("stark_prove_core_trace_cells_per_sec",
+                             threshold=threshold),
+    ]
+    if 2 in codes:
+        return 2
+    return max(codes)
+
+
+# ---------------------------------------------------------------------------
+# top-level suite
+
+def _publish(result: dict, cpu_fallback: bool) -> None:
+    """Attach sub-configs + backend tag, persist, and print the one
+    final JSON line.  Only chip records feed the .bench_last.json
+    degraded-replay cache; EVERY record lands in the history."""
+    if cpu_fallback:
+        result["backend"] = "cpu"
+        if os.environ.get("BENCH_SKIP_EXTRAS") != "1":
+            # chip-bound extras (2/4/5) are pointless on CPU; the
+            # L1-side mgas number is chip-independent, and the core
+            # microbench keeps the kernel-throughput history alive
+            result["configs"] = {"mgas": _mgas_config(),
+                                 "core": _core_config()}
+    else:
+        result.setdefault("backend", detect_backend() or "chip")
+        if os.environ.get("BENCH_SKIP_EXTRAS") != "1":
+            result["configs"] = _extra_configs()
+            result["configs"]["mgas"] = _mgas_config()
+            result["configs"]["core"] = _core_config()
+        # only chip records feed the degraded-replay cache
+        try:
+            with open(LAST_PATH, "w") as f:
+                json.dump(result, f)
+        except OSError:
+            pass
+    append_history(result)
+    print(json.dumps(result))
+
+
+def main() -> None:
+    cpu_fallback = False
+    if (os.environ.get("BENCH_ALLOW_CPU") != "1"
+            and detect_backend() == "cpu"):
+        # CPU-only host: the tunnel is ABSENT, not flaky — the chip probe
+        # can never pass, and retrying it three times only produces a
+        # degraded record with no number at all.  Run the same headline
+        # pipeline on CPU instead, tagged backend=cpu so the record is
+        # never mistaken for (or cached as) a chip measurement.
+        os.environ["BENCH_ALLOW_CPU"] = "1"
+        cpu_fallback = True
+    last_err = ""
+    for attempt in range(ATTEMPTS):
+        probe_err = probe_backend_error()
+        if probe_err is not None:
+            last_err = (f"attempt {attempt + 1}: backend probe failed "
+                        f"({probe_err})")
+            time.sleep(10)
+            continue
+        result = _attempt("--measure", ATTEMPT_TIMEOUT)
+        if result is not None and "_err" not in result:
+            _publish(result, cpu_fallback)
+            return
+        last_err = f"attempt {attempt + 1}: {result.get('_err', '?')}"
+        time.sleep(10)
+    # dead-tunnel fallback: a present-but-BROKEN plugin makes
+    # detect_backend() return None (so the CPU-only branch above never
+    # fired) while every chip probe fails.  If a forced-CPU child works,
+    # the host can still produce real prover numbers — run the headline
+    # pipeline forced to CPU rather than publishing value: 0.0.
+    if not cpu_fallback and probe_cpu_error() is None:
+        os.environ["BENCH_ALLOW_CPU"] = "1"
+        result = _attempt("--measure", ATTEMPT_TIMEOUT)
+        if result is not None and "_err" not in result:
+            result["fallback_reason"] = last_err
+            _publish(result, cpu_fallback=True)
+            return
+        last_err = (f"forced-CPU fallback: {result.get('_err', '?')} "
+                    f"(after {last_err})")
+    # live fallback: the core microbench before any cached degradation
+    if probe_backend():
+        result = _attempt("--measure-core", min(ATTEMPT_TIMEOUT, 1500))
+        if result is not None and "_err" not in result:
+            result["degraded"] = True
+            result["error"] = last_err
+            append_history(result)
+            print(json.dumps(result))
+            return
+    result = {
+        "metric": "transfer_batch_prove_wall_s",
+        "value": 0.0,
+        "unit": "s",
+        "vs_baseline": 0.0,
+    }
+    try:
+        with open(LAST_PATH) as f:
+            cached = json.load(f)
+        # never replay a cached record of a different metric (e.g. the
+        # retired cells/s line with its estimated-anchor vs_baseline)
+        if cached.get("metric") == result["metric"]:
+            result = cached
+    except (OSError, ValueError):
+        pass
+    result["degraded"] = True
+    result["error"] = last_err
+    if os.environ.get("BENCH_SKIP_EXTRAS") != "1":
+        # the L1-side number needs no chip: measure it even degraded
+        result.setdefault("configs", {})["mgas"] = _mgas_config()
+    append_history(result)
+    print(json.dumps(result))
+
+
+def cli(argv: list[str] | None = None) -> None:
+    """Flag dispatch for the bench.py shim (and `python -m`)."""
+    argv = sys.argv if argv is None else argv
+    if "--measure-core" in argv:
+        measure_core()
+    elif "--measure-mgas" in argv:
+        measure_mgas()
+    elif "--measure-2" in argv:
+        measure_config2()
+    elif "--measure-3" in argv:
+        measure_config3()
+    elif "--measure-4" in argv:
+        measure_config4()
+    elif "--measure-5" in argv:
+        measure_config5()
+    elif "--measure" in argv:
+        measure()
+    elif "--check-regression" in argv:
+        sys.exit(check_regression_suite())
+    else:
+        main()
+
+
+if __name__ == "__main__":
+    cli()

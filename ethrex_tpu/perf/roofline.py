@@ -1,0 +1,201 @@
+"""Roofline accounting for the compiled STARK phase programs.
+
+XLA's cost model (``compiled.cost_analysis()``) reports static FLOPs and
+bytes-accessed per executable; the prover records each phase's
+block_until_ready-bounded wall-clock.  Together they give per-kernel
+achieved-FLOP/s, arithmetic intensity (FLOPs/byte) and a
+utilization-vs-peak estimate — the same view a training stack's
+continuous profiler provides, applied to proving kernels.
+
+Caveats (documented in docs/PERFORMANCE.md and carried in the report):
+
+- cost_analysis shape varies by jaxlib version (list of dicts, a bare
+  dict, None on some backends) and may omit either key; every form is
+  tolerated and missing numbers surface as null, never an error.
+- XLA counts u32 modular-arithmetic ops as "flops"; utilization against
+  a floating-point peak is a consistent *relative* signal across runs
+  on one backend, not an absolute MXU occupancy.
+- The peak is an estimate: override with ``ETHREX_PEAK_FLOPS`` (flop/s)
+  for a calibrated roof; otherwise a per-backend default is used.
+
+Every entry point is exception-guarded: a failing cost_analysis can
+never fail a prove (acceptance criterion).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from ..utils.metrics import record_kernel_flops
+
+# rough per-backend peak-FLOP/s defaults (override: ETHREX_PEAK_FLOPS).
+# tpu: one modern TPU chip's dense-unit order of magnitude; cpu: cores x
+# ~8 u32 SIMD lanes x ~2GHz — both deliberately coarse anchors.
+_PEAK_DEFAULTS = {"tpu": 275.0e12, "gpu": 80.0e12}
+
+
+def _cpu_peak() -> float:
+    return float(os.cpu_count() or 1) * 8.0 * 2.0e9
+
+
+def peak_flops_estimate(backend: str | None = None) -> float | None:
+    env = os.environ.get("ETHREX_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    if backend is None:
+        try:
+            import jax
+
+            backend = jax.default_backend()
+        except Exception:
+            return None
+    if backend == "cpu":
+        return _cpu_peak()
+    return _PEAK_DEFAULTS.get(backend)
+
+
+def _parse_cost(cost) -> dict:
+    """Normalize any cost_analysis() shape to {'flops', 'bytes'} with
+    float-or-None values.  jax 0.4.x returns a list with one dict per
+    computation; older/newer versions return a bare dict; CPU backends
+    may return None or omit keys."""
+    out = {"flops": None, "bytes": None}
+    if cost is None:
+        return out
+    entries = cost if isinstance(cost, (list, tuple)) else [cost]
+    flops = 0.0
+    nbytes = 0.0
+    saw_flops = saw_bytes = False
+    for entry in entries:
+        if not isinstance(entry, dict):
+            continue
+        f = entry.get("flops")
+        if isinstance(f, (int, float)) and f >= 0:
+            flops += float(f)
+            saw_flops = True
+        b = entry.get("bytes accessed")
+        if isinstance(b, (int, float)) and b >= 0:
+            nbytes += float(b)
+            saw_bytes = True
+    if saw_flops:
+        out["flops"] = flops
+    if saw_bytes:
+        out["bytes"] = nbytes
+    return out
+
+
+class RooflineRegistry:
+    """Per (air, kernel) static cost + measured wall accumulator."""
+
+    MAX_KEYS = 256
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._kernels: dict[tuple[str, str], dict] = {}
+
+    def _cell(self, air: str, kernel: str) -> dict | None:
+        key = (str(air), str(kernel))
+        cell = self._kernels.get(key)
+        if cell is None:
+            if len(self._kernels) >= self.MAX_KEYS:
+                return None
+            cell = self._kernels[key] = {
+                "flops": None, "bytes": None,
+                "wallCount": 0, "wallTotal": 0.0, "wallLast": None,
+                "wallMin": None,
+            }
+        return cell
+
+    def record_cost(self, air: str, kernel: str, cost) -> None:
+        parsed = _parse_cost(cost)
+        with self._lock:
+            cell = self._cell(air, kernel)
+            if cell is None:
+                return
+            if parsed["flops"] is not None:
+                cell["flops"] = parsed["flops"]
+            if parsed["bytes"] is not None:
+                cell["bytes"] = parsed["bytes"]
+
+    def record_wall(self, air: str, kernel: str, seconds: float) -> None:
+        sec = float(seconds)
+        with self._lock:
+            cell = self._cell(air, kernel)
+            if cell is None:
+                return
+            cell["wallCount"] += 1
+            cell["wallTotal"] += sec
+            cell["wallLast"] = sec
+            if cell["wallMin"] is None or sec < cell["wallMin"]:
+                cell["wallMin"] = sec
+            flops = cell["flops"]
+        # export gauges outside the lock; achieved-FLOP/s uses the LAST
+        # wall (the gauge is "current", the report also carries min/avg)
+        if flops and sec > 0:
+            peak = peak_flops_estimate()
+            achieved = flops / sec
+            util = achieved / peak if peak else None
+            record_kernel_flops(air, kernel, flops, achieved, util)
+
+    def report(self) -> dict:
+        peak = peak_flops_estimate()
+        with self._lock:
+            cells = {k: dict(v) for k, v in self._kernels.items()}
+        kernels = []
+        for (air, kernel), c in sorted(cells.items()):
+            flops, nbytes = c["flops"], c["bytes"]
+            last = c["wallLast"]
+            achieved = flops / last if flops and last else None
+            kernels.append({
+                "air": air, "kernel": kernel,
+                "flops": flops, "bytes": nbytes,
+                "intensityFlopsPerByte":
+                    round(flops / nbytes, 3) if flops and nbytes else None,
+                "wallCount": c["wallCount"],
+                "wallLastSeconds":
+                    round(last, 6) if last is not None else None,
+                "wallMinSeconds":
+                    round(c["wallMin"], 6)
+                    if c["wallMin"] is not None else None,
+                "wallAvgSeconds":
+                    round(c["wallTotal"] / c["wallCount"], 6)
+                    if c["wallCount"] else None,
+                "achievedFlopsPerSec":
+                    round(achieved, 1) if achieved else None,
+                "utilizationVsPeak":
+                    round(achieved / peak, 6)
+                    if achieved and peak else None,
+            })
+        return {"peakFlopsEstimate": peak,
+                "peakSource": "env" if os.environ.get("ETHREX_PEAK_FLOPS")
+                else "default",
+                "kernels": kernels}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._kernels.clear()
+
+
+ROOFLINE = RooflineRegistry()
+
+
+def record_cost(air: str, kernel: str, cost) -> None:
+    """Never-raise hook: fold one compiled program's cost_analysis()
+    output (any shape, including None) into the registry."""
+    try:
+        ROOFLINE.record_cost(air, kernel, cost)
+    except Exception:
+        pass
+
+
+def record_wall(air: str, kernel: str, seconds: float) -> None:
+    """Never-raise hook: fold one measured phase wall-clock in and
+    refresh the prover_kernel_* gauges."""
+    try:
+        ROOFLINE.record_wall(air, kernel, seconds)
+    except Exception:
+        pass

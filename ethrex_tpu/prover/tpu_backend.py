@@ -402,10 +402,24 @@ class TpuBackend(ProverBackend):
         self.mesh = mesh
 
     def prove(self, program_input: ProgramInput, proof_format: str) -> dict:
+        import time as _time
+
+        from ..perf import profiler as perf_profiler
+
         # one root span per prove so per-stage child spans form a single
-        # subtree even when no caller opened a trace (e.g. bench)
+        # subtree even when no caller opened a trace (e.g. bench); the
+        # profiler.capture is a no-op unless --profile-dir opted in to
+        # device tracing
+        t0 = _time.perf_counter()
         with tracing.span("backend.prove", format=proof_format):
-            out = self._prove_impl(program_input, proof_format)
+            with perf_profiler.capture("prove"):
+                out = self._prove_impl(program_input, proof_format)
+        try:
+            from ..utils.metrics import record_proof_wall
+
+            record_proof_wall(_time.perf_counter() - t0)
+        except Exception:
+            pass
         # refresh device-memory / live-array gauges while the runtime
         # still holds this proof's peak allocations (never raises)
         from ..utils.jax_cache import update_metrics_gauges

@@ -147,6 +147,8 @@ class RpcServer:
             # SLO/alert engine + flight recorder (docs/OBSERVABILITY.md)
             "ethrex_alerts": lambda: _alerts(node),
             "ethrex_debug_snapshot": lambda: _debug_snapshot(node),
+            # continuous profiler + roofline (docs/PERFORMANCE.md)
+            "ethrex_perf": lambda: _perf(node),
         }
 
     def handle(self, request: dict):
@@ -489,6 +491,38 @@ def _alerts(node):
     return out
 
 
+def _perf(node):
+    """ethrex_perf: stage-attribution tree + roofline report + live
+    throughput gauges.  The profiler and roofline registries are
+    process-global, so this answers on every node flavor; sections that
+    fail (or never populated — e.g. roofline on an L1-only node that
+    never compiled a prover kernel) degrade to stubs, not errors."""
+    out = {"enabled": True}
+    try:
+        from ..perf import profiler
+        out["profiler"] = profiler.PROFILER.tree()
+    except Exception as exc:  # noqa: BLE001 — telemetry endpoint
+        out["profiler"] = {"error": f"{type(exc).__name__}: {exc}"}
+    try:
+        from ..perf import roofline
+        out["roofline"] = roofline.ROOFLINE.report()
+    except Exception as exc:  # noqa: BLE001 — telemetry endpoint
+        out["roofline"] = {"error": f"{type(exc).__name__}: {exc}"}
+    try:
+        from ..utils.metrics import METRICS
+        with METRICS.lock:
+            gauges = dict(METRICS.gauges)
+        out["throughput"] = {
+            name: gauges.get(name)
+            for name in ("l1_import_mgas_per_sec",
+                         "prover_trace_cells_per_sec",
+                         "proofs_per_hour")
+        }
+    except Exception as exc:  # noqa: BLE001 — telemetry endpoint
+        out["throughput"] = {"error": f"{type(exc).__name__}: {exc}"}
+    return out
+
+
 def _debug_snapshot(node):
     """ethrex_debug_snapshot: return a flight-recorder bundle, and
     persist it when --debug-snapshot-dir configured a destination."""
@@ -528,6 +562,21 @@ def _health(node):
     if sd is not None:
         out["shutdown"] = {"phase": sd.phase,
                            "durationSeconds": sd.duration}
+    try:
+        from ..perf import profiler, roofline
+
+        rep = roofline.ROOFLINE.report()
+        tree = profiler.PROFILER.tree()
+        kernels = rep.get("kernels") or []
+        utils = [k["utilizationVsPeak"] for k in kernels
+                 if k.get("utilizationVsPeak") is not None]
+        out["perf"] = {
+            "componentsProfiled": sorted(tree.get("components", {})),
+            "kernelsProfiled": len(kernels),
+            "maxUtilizationVsPeak": max(utils) if utils else None,
+        }
+    except Exception:  # noqa: BLE001 — health must answer regardless
+        pass
     seq = getattr(node, "sequencer", None)
     if seq is not None:
         from ..storage.persistent import storage_stats

@@ -22,6 +22,7 @@ docs/SOUNDNESS.md.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 
 import numpy as np
@@ -77,24 +78,104 @@ def _mesh_key(mesh):
 
 
 def _phases(air: Air, log_n: int, lb: int, shift: int, mesh=None):
-    """Jitted phase programs, cached by *structural* AIR identity.
+    """Phase programs, cached by *structural* AIR identity.
 
     Keyed on (type, width, degree, pub-count) rather than object identity so
     `prove(MixerAir(16), ...)` in a loop reuses compiled programs.  AIRs with
     extra structure-affecting parameters must reflect them in `cache_key()`.
+
+    On the single-device path the programs are AOT-compiled (lower +
+    compile against ShapeDtypeStructs) so the XLA cost model is captured
+    for roofline accounting; `record_kernel_build` therefore now times
+    trace + staging + backend compile for a cache miss.
     """
     key = (air.cache_key(), log_n, lb, shift, _mesh_key(mesh))
     cached = _PHASE_CACHE.get(key)
     if cached is not None:
         return cached
     t0 = time.perf_counter()
-    built = _build_phases(air, log_n, lb, shift, mesh)
+    built = _aot_phases(air, log_n, lb,
+                        _build_phases(air, log_n, lb, shift, mesh), mesh)
     _PHASE_CACHE[key] = built
     # retrace telemetry: every miss here is a fresh set of phase programs
-    # (trace + jit staging; XLA compile time lands separately through
-    # jax.monitoring in utils/jax_cache.py)
     record_kernel_build(type(air).__name__, time.perf_counter() - t0)
     return built
+
+
+_KERNELS = ("commit", "quotient", "open", "deep")
+
+
+def _record_phase_cost(air_name: str, kernel: str, compiled) -> None:
+    # roofline hooks are telemetry: a failing cost_analysis (None on some
+    # backends, shape drift across jaxlib versions) can never fail a prove
+    try:
+        from ..perf import roofline
+
+        roofline.record_cost(air_name, kernel, compiled.cost_analysis())
+    except Exception:
+        pass
+
+
+def _record_phase_wall(air_name: str, kernel: str, seconds: float) -> None:
+    try:
+        from ..perf import roofline
+
+        roofline.record_wall(air_name, kernel, seconds)
+    except Exception:
+        pass
+
+
+def _record_prove_throughput(cells: int, seconds: float) -> None:
+    try:
+        if seconds > 0:
+            from ..utils.metrics import record_prover_throughput
+
+            record_prover_throughput(cells / seconds)
+    except Exception:
+        pass
+
+
+def _aot_phases(air: Air, log_n: int, lb: int, phases, mesh):
+    """AOT-compile the four phase programs against their (statically
+    known) argument shapes and register each executable's XLA cost
+    analysis with the roofline registry.
+
+    Single-device path only: with a mesh the lazily-jitted programs are
+    kept (an AOT executable pins input placement, and the sharded path
+    is exercised against virtual device counts in tests).  Any lowering
+    or compile failure falls back to the jitted callable for that phase
+    — the prove still runs, the kernel just has no static cost entry.
+    ETHREX_PERF_NO_AOT=1 forces the fallback (drills, A/B timing)."""
+    if mesh is not None or os.environ.get("ETHREX_PERF_NO_AOT") == "1":
+        return phases
+    n = 1 << log_n
+    w = air.width
+    B = 1 << lb
+    N = n << lb
+    try:
+        nb = len(air.boundaries([0] * air.num_pub_inputs, n))
+        u32 = jnp.uint32
+        S = jax.ShapeDtypeStruct
+        e = S((4,), u32)
+        specs = {
+            "commit": (S((w, n), u32),),
+            "quotient": (S((w, N), u32), e, S((nb,), u32)),
+            "open": (S((w, n), u32), S((B, n, 4), u32), e, e),
+            "deep": (S((N, w), u32), S((B, 4, N), u32), S((w, 4), u32),
+                     S((w, 4), u32), S((B, 4), u32), e, e, e),
+        }
+    except Exception:
+        return phases
+    air_name = type(air).__name__
+    out = []
+    for kernel, fn in zip(_KERNELS, phases):
+        try:
+            compiled = fn.lower(*specs[kernel]).compile()
+            _record_phase_cost(air_name, kernel, compiled)
+            out.append(compiled)
+        except Exception:
+            out.append(fn)
+    return tuple(out)
 
 
 def _build_phases(air: Air, log_n: int, lb: int, shift: int, mesh=None):
@@ -299,6 +380,8 @@ def _prove(air: Air, trace: np.ndarray, pub_inputs: list[int],
     g_n = bb.root_of_unity(log_n)
     p_commit, p_quotient, p_open, p_deep = _phases(air, log_n, lb, shift,
                                                    mesh)
+    air_name = type(air).__name__
+    t_prove0 = time.perf_counter()
 
     ch = Challenger()
     ch.absorb_elems([n, w, B])
@@ -313,10 +396,14 @@ def _prove(air: Air, trace: np.ndarray, pub_inputs: list[int],
     with tracing.span("prove.trace_lde", stage="trace_lde",
                       width=w, n=n):
         cols = bb.to_mont(jnp.asarray(trace.T.astype(np.uint32)))   # (w, n)
+        t_k = time.perf_counter()
         lde_cols, lde_rows, levels_t = p_commit(cols)
         jax.block_until_ready((lde_cols, lde_rows))
     with tracing.span("prove.merkle_commit", stage="merkle_commit"):
         jax.block_until_ready(levels_t)
+        # the commit kernel's roofline wall spans both bounded waits
+        # (the LDE and Merkle tree are ONE fused executable)
+        _record_phase_wall(air_name, "commit", time.perf_counter() - t_k)
         trace_root = levels_t[-1][0]
         ch.absorb_digest(trace_root)
     alpha = ch.sample_ext()
@@ -327,9 +414,11 @@ def _prove(air: Air, trace: np.ndarray, pub_inputs: list[int],
         bound_vals = bb.to_mont(jnp.asarray(
             np.array([v % bb.P for (_, _, v) in bounds],
                      dtype=np.uint32)))
+        t_k = time.perf_counter()
         chunks, q_lde, q_rows, levels_q = p_quotient(
             lde_cols, ext.to_device(alpha), bound_vals)
         jax.block_until_ready(levels_q)
+        _record_phase_wall(air_name, "quotient", time.perf_counter() - t_k)
         q_root = levels_q[-1][0]
         ch.absorb_digest(q_root)
     zeta = ch.sample_ext()
@@ -337,21 +426,27 @@ def _prove(air: Air, trace: np.ndarray, pub_inputs: list[int],
     # ---- 3. out-of-domain openings --------------------------------------
     with tracing.span("prove.openings", stage="openings"):
         zeta_g = ext.h_mul(zeta, ext.h_from_base(g_n))
+        t_k = time.perf_counter()
         t_z_dev, t_zg_dev, q_z_dev = p_open(
             cols, chunks, ext.to_device(zeta), ext.to_device(zeta_g))
         t_at_z = [tuple(int(x) for x in row) for row in _canon(t_z_dev)]
         t_at_zg = [tuple(int(x) for x in row)
                    for row in _canon(t_zg_dev)]
         q_at_z = [tuple(int(x) for x in row) for row in _canon(q_z_dev)]
+        # _canon host-transfers force the sync, so the wall is bounded
+        _record_phase_wall(air_name, "open", time.perf_counter() - t_k)
         for tup in t_at_z + t_at_zg + q_at_z:
             ch.absorb_ext(tup)
     gamma = ch.sample_ext()
 
     # ---- 4. DEEP composition + 5. FRI ------------------------------------
     with tracing.span("prove.fri_fold", stage="fri_fold"):
+        t_k = time.perf_counter()
         F = p_deep(lde_rows, q_lde, t_z_dev, t_zg_dev, q_z_dev,
                    ext.to_device(zeta), ext.to_device(zeta_g),
                    ext.to_device(gamma))
+        jax.block_until_ready(F)
+        _record_phase_wall(air_name, "deep", time.perf_counter() - t_k)
         fparams = fri.FriParams(
             log_blowup=lb, num_queries=params.num_queries,
             log_final_size=params.log_final_size, shift=shift,
@@ -385,6 +480,9 @@ def _prove(air: Air, trace: np.ndarray, pub_inputs: list[int],
                         merkle.open_path_canonical(levels_c, idx)
             openings.append(entry)
 
+    # live throughput gauge: trace cells proven per end-to-end second
+    # (transcript + host query openings included — the honest number)
+    _record_prove_throughput(n * w, time.perf_counter() - t_prove0)
     return {
         "n": n, "width": w, "log_blowup": lb,
         "pub_inputs": [int(v) % bb.P for v in pub_inputs],

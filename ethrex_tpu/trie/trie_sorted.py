@@ -14,7 +14,17 @@ randomized sets).
 
 from __future__ import annotations
 
+import time as _time
+
 from .trie import EMPTY_TRIE_ROOT, Trie, bytes_to_nibbles
+
+
+def _note_trie_commit(seconds: float) -> None:
+    try:
+        from ..perf.profiler import record_stage
+        record_stage("trie", "sorted_commit", seconds)
+    except Exception:
+        pass
 
 
 def _build(items: list, lo: int, hi: int, depth: int):
@@ -76,15 +86,18 @@ def build_from_sorted(pairs, nodes: dict | None = None,
         items.append((bytes(key), bytes(value)))
     if not items:
         return EMPTY_TRIE_ROOT, Trie(store)
+    t0 = _time.perf_counter()
     if use_native:
         from . import native_mpt
 
         if native_mpt.available():
             eng = native_mpt.NativeMpt()
             root = eng.apply(store, EMPTY_TRIE_ROOT, items)
+            _note_trie_commit(_time.perf_counter() - t0)
             return root, Trie.from_nodes(root, store, share=True)
     trie = Trie(store)
     trie._root = _build([(bytes_to_nibbles(k), v) for k, v in items],
                         0, len(items), 0)
     root = trie.commit()
+    _note_trie_commit(_time.perf_counter() - t0)
     return root, trie

@@ -50,6 +50,8 @@ class AlertRule:
     resolve_count: int = 2             # consecutive clears before resolving
     description: str = ""
     runbook: str = ""
+    below: bool = False                # breach when value <= threshold
+                                       # (throughput floors, not ceilings)
 
 
 class _RuleState:
@@ -98,7 +100,10 @@ class AlertEngine:
                     st.last_error = f"{type(exc).__name__}: {exc}"
                     self.eval_errors += 1
                 st.last_value = value
-                breached = value is not None and value >= rule.threshold
+                if rule.below:
+                    breached = value is not None and value <= rule.threshold
+                else:
+                    breached = value is not None and value >= rule.threshold
                 if breached:
                     st.breach_streak += 1
                     st.ok_streak = 0
@@ -136,6 +141,7 @@ class AlertEngine:
         return {"name": rule.name, "severity": rule.severity,
                 "state": st.state, "value": st.last_value,
                 "threshold": rule.threshold, "window": rule.window,
+                "below": rule.below,
                 "since": st.since, "description": rule.description,
                 "runbook": rule.runbook, "error": st.last_error}
 
@@ -169,6 +175,12 @@ def p95_signal(histogram: str, window: float = 300.0):
         p = eng.percentiles(histogram, qs=(0.95,), window=window)
         return None if p is None else p.get("p95")
     return sig
+
+
+def gauge_signal(gauge: str):
+    """Latest sampled value of a plain gauge (None before the first
+    sample, so a node that never touched the subsystem never alerts)."""
+    return lambda eng, node: eng.gauge(gauge)
 
 
 def settlement_lag_signal(eng, node):
@@ -278,6 +290,24 @@ def default_rules(node=None) -> list:
            description="Actor loop p95 over 10m exceeds 5s",
            runbook="An actor body is slow; sequencer_actor_seconds is "
                    "labelled per actor."),
+        # throughput floors (below=True: a gauge COLLAPSING is the
+        # breach; None before the first sample never alerts, so L1-only
+        # or idle nodes stay quiet — docs/PERFORMANCE.md)
+        mk("l1_import_throughput_floor:warn", "warn",
+           gauge_signal("l1_import_mgas_per_sec"), 0.1,
+           window=60.0, for_count=3, resolve_count=3, below=True,
+           description="L1 import throughput below 0.1 Mgas/s",
+           runbook="Check block_import_stage_seconds (execute vs "
+                   "merkleize vs store_write) and ethrex_perf's l1_import "
+                   "attribution for the collapsed stage."),
+        mk("prover_throughput_floor:warn", "warn",
+           gauge_signal("prover_trace_cells_per_sec"), 1e4,
+           window=60.0, for_count=3, resolve_count=3, below=True,
+           description="Prover throughput below 10k trace cells/s",
+           runbook="Compare ethrex_perf roofline utilization against "
+                   "the last bench_history.jsonl record; a collapsed "
+                   "kernel usually means recompilation churn or a "
+                   "fallen-back backend."),
     ]
 
 

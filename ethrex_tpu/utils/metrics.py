@@ -58,6 +58,8 @@ class Metrics:
         self.lock = threading.Lock()
         self.counters: dict[str, float] = {}
         self.gauges: dict[str, float] = {}
+        # labelled gauge families: name -> {sorted labels tuple: value}
+        self.lgauges: dict[str, dict[tuple, float]] = {}
         self.histograms: dict[str, _Histogram] = {}
         self.help: dict[str, str] = {}
         self.started = time.time()
@@ -71,6 +73,17 @@ class Metrics:
     def set(self, name: str, value: float, help_text: str = ""):
         with self.lock:
             self.gauges[name] = value
+            if help_text:
+                self.help[name] = help_text
+
+    def set_labeled(self, name: str, labels: dict, value: float,
+                    help_text: str = ""):
+        """Set one series of a labelled gauge family (e.g. per-kernel
+        roofline gauges, prover_kernel_flops{air,stage})."""
+        key = tuple(sorted((labels or {}).items()))
+        with self.lock:
+            fam = self.lgauges.setdefault(name, {})
+            fam[key] = float(value)
             if help_text:
                 self.help[name] = help_text
 
@@ -108,6 +121,10 @@ class Metrics:
             return {"ts": time.time(),
                     "counters": dict(self.counters),
                     "gauges": dict(self.gauges),
+                    "labeled_gauges": {
+                        name: [{"labels": dict(labels), "value": value}
+                               for labels, value in fam.items()]
+                        for name, fam in self.lgauges.items()},
                     "histograms": hists}
 
     def reset(self):
@@ -116,6 +133,7 @@ class Metrics:
         with self.lock:
             self.counters.clear()
             self.gauges.clear()
+            self.lgauges.clear()
             self.histograms.clear()
             self.help.clear()
             self.started = time.time()
@@ -152,6 +170,12 @@ class Metrics:
                     lines.append(f"# HELP {name} {self.help[name]}")
                 lines.append(f"# TYPE {name} gauge")
                 lines.append(f"{name} {value}")
+            for name, fam in sorted(self.lgauges.items()):
+                if name in self.help:
+                    lines.append(f"# HELP {name} {self.help[name]}")
+                lines.append(f"# TYPE {name} gauge")
+                for labels, value in sorted(fam.items()):
+                    lines.append(f"{name}{{{_fmt_labels(labels)}}} {value}")
             self._render_histograms(lines)
             lines.append("# TYPE process_uptime_seconds gauge")
             lines.append(
@@ -384,6 +408,61 @@ def observe_block_import(seconds: float):
 def observe_actor_iteration(actor: str, seconds: float):
     _observe_safe("sequencer_actor_seconds", seconds, {"actor": actor},
                   "Sequencer actor loop iteration latency")
+
+
+def observe_import_stage(stage: str, seconds: float):
+    """Sub-stage attribution of block import (execute / merkleize /
+    store_write), both the per-block and the pipelined path."""
+    _observe_safe("block_import_stage_seconds", seconds, {"stage": stage},
+                  "Block import sub-stage latency (execute / merkleize / "
+                  "store_write legs of add_block and the pipelined "
+                  "importer)")
+
+
+def record_kernel_flops(air: str, kernel: str, flops: float,
+                        achieved: float | None = None,
+                        utilization: float | None = None):
+    """Roofline gauges for one compiled STARK phase program (never
+    raises: called from the prover hot path)."""
+    try:
+        labels = {"air": air, "stage": kernel}
+        METRICS.set_labeled(
+            "prover_kernel_flops", labels, flops,
+            help_text="XLA cost-model FLOPs of the compiled STARK phase "
+                      "program (static, per air+stage)")
+        if achieved is not None:
+            METRICS.set_labeled(
+                "prover_kernel_achieved_flops_per_sec", labels, achieved,
+                help_text="Cost-model FLOPs divided by the last measured "
+                          "stage wall-clock")
+        if utilization is not None:
+            METRICS.set_labeled(
+                "prover_kernel_utilization", labels, utilization,
+                help_text="Achieved-FLOP/s over the estimated backend "
+                          "peak (see docs/PERFORMANCE.md caveats)")
+    except Exception:
+        pass
+
+
+def record_import_throughput(mgas_per_sec: float):
+    METRICS.set("l1_import_mgas_per_sec", mgas_per_sec,
+                "Execution throughput of the last pipelined block-batch "
+                "import (Mgas/s; the bench headline L1 number, live)")
+
+
+def record_prover_throughput(cells_per_sec: float):
+    METRICS.set("prover_trace_cells_per_sec", cells_per_sec,
+                "Trace cells proven per second in the last STARK prove "
+                "(n x width over end-to-end prove wall-clock)")
+
+
+def record_proof_wall(seconds: float):
+    """Derive the proofs_per_hour throughput gauge from one end-to-end
+    backend prove wall-clock."""
+    if seconds > 0:
+        METRICS.set("proofs_per_hour", 3600.0 / seconds,
+                    "Extrapolated proofs per hour from the last "
+                    "end-to-end backend prove wall-clock")
 
 
 class MetricsServer:

@@ -65,6 +65,11 @@ def snapshot(rpc: RpcSession, blocks: int = 8) -> dict:
     except Exception:
         out["alerts"] = None
     try:
+        # older nodes don't serve the perf namespace; skip the panel
+        out["perf"] = rpc.call("ethrex_perf", [])
+    except Exception:
+        out["perf"] = None
+    try:
         out["peers"] = len(rpc.call("admin_peers", []))
     except Exception:
         out["peers"] = None
@@ -161,6 +166,64 @@ def _alerts_lines(snap: dict, width: int) -> list[str]:
     return lines
 
 
+def _perf_lines(snap: dict, width: int) -> list[str]:
+    """Performance panel: live throughput gauges, the stage-attribution
+    tree's top components, and per-kernel roofline utilization.
+    Defensive like the other panels — an older node without ethrex_perf
+    yields None (no panel); empty profiler/roofline sections shrink the
+    panel rather than erroring."""
+    perf = snap.get("perf")
+    if not isinstance(perf, dict) or not perf.get("enabled"):
+        return []
+    lines = ["─" * width, " performance"]
+    tp = perf.get("throughput")
+    if isinstance(tp, dict):
+        def fmt(v):
+            return f"{v:.3g}" if isinstance(v, (int, float)) else "—"
+        lines.append(
+            f"   import {fmt(tp.get('l1_import_mgas_per_sec')):>8} Mgas/s"
+            f"   prover {fmt(tp.get('prover_trace_cells_per_sec')):>10}"
+            f" cells/s   proofs/h {fmt(tp.get('proofs_per_hour')):>8}")
+    prof = perf.get("profiler")
+    comps = prof.get("components") if isinstance(prof, dict) else None
+    if isinstance(comps, dict) and comps:
+        ranked = sorted(comps.items(),
+                        key=lambda kv: kv[1].get("totalSeconds", 0)
+                        if isinstance(kv[1], dict) else 0, reverse=True)
+        for name, comp in ranked[:4]:
+            if not isinstance(comp, dict):
+                continue
+            stages = comp.get("stages") or {}
+            top = sorted(stages.items(),
+                         key=lambda kv: kv[1].get("totalSeconds", 0)
+                         if isinstance(kv[1], dict) else 0,
+                         reverse=True)[:3]
+            parts = "  ".join(
+                f"{s} {100 * st.get('share', 0):.0f}%" for s, st in top
+                if isinstance(st, dict))
+            total = comp.get("totalSeconds")
+            shown = f"{total:.2f}s" if isinstance(total, (int, float)) \
+                else "—"
+            lines.append(f"   {name:<12} {shown:>9}  {parts}")
+    roof = perf.get("roofline")
+    kernels = roof.get("kernels") if isinstance(roof, dict) else None
+    if isinstance(kernels, list) and kernels:
+        lines.append("   roofline (utilization vs peak)")
+        for k in kernels[:4]:
+            if not isinstance(k, dict):
+                continue
+            util = k.get("utilizationVsPeak")
+            shown = f"{100 * util:.1f}%" if isinstance(util,
+                                                      (int, float)) else "—"
+            flops = k.get("flops")
+            fshown = f"{flops:.3g}" if isinstance(flops,
+                                                  (int, float)) else "—"
+            lines.append(f"   {str(k.get('air', '?')):<20}"
+                         f" {str(k.get('kernel', '?')):<10}"
+                         f" flops {fshown:>10}  util {shown:>7}")
+    return lines if len(lines) > 2 else []
+
+
 def render_lines(snap: dict, width: int = 100) -> list[str]:
     """Snapshot -> dashboard lines (pure; the curses loop just blits)."""
     h = snap["head"]
@@ -196,6 +259,7 @@ def render_lines(snap: dict, width: int = 100) -> list[str]:
         for k, v in items:
             lines.append(f"   {k}: {v}")
     lines.extend(_alerts_lines(snap, width))
+    lines.extend(_perf_lines(snap, width))
     lines.extend(_latency_lines(snap, width))
     lines.extend(_storage_lines(snap, width))
     lines.append("─" * width)

@@ -71,6 +71,13 @@ def _store(node):
     return storage_stats()
 
 
+def _perf():
+    from ..perf import profiler, roofline
+
+    return {"profiler": profiler.PROFILER.tree(),
+            "roofline": roofline.ROOFLINE.report()}
+
+
 def collect(node=None, reason: str = "manual") -> dict:
     """Assemble a snapshot bundle.  Never raises; every section is
     independently guarded."""
@@ -88,6 +95,7 @@ def collect(node=None, reason: str = "manual") -> dict:
         "health": _section(lambda: _health(node)),
         "store": _section(lambda: _store(node)),
         "tpu": _section(jax_cache.runtime_telemetry),
+        "perf": _section(_perf),
     }
 
 

@@ -201,6 +201,13 @@ class Tracer:
 
 TRACER = Tracer()
 
+# Stage observers: callables (span_name, stage, seconds) invoked on every
+# stage-span exit, after the prover_stage_seconds observation.  The perf
+# profiler (ethrex_tpu/perf/profiler.py) registers here to fold stage
+# spans into its attribution tree.  Observers run under the same
+# never-raise guard as the rest of span exit.
+STAGE_OBSERVERS: list = []
+
 
 class span:
     """Context manager opening a span under the current thread context.
@@ -253,6 +260,11 @@ class span:
                 if self._stage:
                     from . import metrics
                     metrics.observe_prover_stage(self._stage, sp.seconds)
+                    for obs in STAGE_OBSERVERS:
+                        try:
+                            obs(self._name, self._stage, sp.seconds)
+                        except Exception:
+                            pass
         except Exception:
             pass
         return False

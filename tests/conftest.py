@@ -65,18 +65,26 @@ def _metrics_isolation():
 
     with METRICS.lock:
         saved = (dict(METRICS.counters), dict(METRICS.gauges),
-                 copy.deepcopy(METRICS.histograms), dict(METRICS.help))
+                 copy.deepcopy(METRICS.histograms), dict(METRICS.help),
+                 copy.deepcopy(METRICS.lgauges))
     yield
+    from ethrex_tpu.perf import profiler, roofline
     from ethrex_tpu.utils import snapshot, timeseries
 
     timeseries.ENGINE.stop(timeout=2.0)
     timeseries.ENGINE.clear()
     snapshot.configure(None)
+    # perf accumulators are process-global like METRICS: reset so one
+    # test's prove cannot leak stage/kernel rows into another's report
+    profiler.PROFILER.reset()
+    profiler.configure(None)
+    roofline.ROOFLINE.reset()
     with METRICS.lock:
         METRICS.counters = dict(saved[0])
         METRICS.gauges = dict(saved[1])
         METRICS.histograms = saved[2]
         METRICS.help = dict(saved[3])
+        METRICS.lgauges = saved[4]
 
 
 @pytest.fixture(autouse=True)

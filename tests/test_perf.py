@@ -1,0 +1,606 @@
+"""Performance-observability battery (docs/PERFORMANCE.md): the
+continuous stage profiler, roofline accounting, the perf surfaces
+(metrics exposition, snapshot, ethrex_perf RPC, monitor panel, alert
+floors), and the bench suite's CPU fallback + history regression gate.
+
+The never-raise drills matter most: every perf hook sits inside the
+prover or import hot path, so a malformed cost_analysis() or a broken
+jax.profiler must degrade to missing telemetry, never a failed prove."""
+
+import json
+import os
+
+import pytest
+
+from ethrex_tpu.perf import bench_suite, profiler, roofline
+from ethrex_tpu.utils import tracing
+from ethrex_tpu.utils.metrics import (
+    METRICS, observe_import_stage, record_import_throughput,
+    record_kernel_flops, record_proof_wall, record_prover_throughput)
+
+
+# ---------------------------------------------------------------------------
+# stage profiler
+
+def test_profiler_accumulates_and_builds_tree():
+    p = profiler.StageProfiler()
+    p.record("l1_import", "execute", 0.5)
+    p.record("l1_import", "execute", 1.5)
+    p.record("l1_import", "merkleize", 2.0)
+    tree = p.tree()
+    comp = tree["components"]["l1_import"]
+    assert comp["totalSeconds"] == pytest.approx(4.0)
+    ex = comp["stages"]["execute"]
+    assert ex["count"] == 2
+    assert ex["totalSeconds"] == pytest.approx(2.0)
+    assert ex["meanSeconds"] == pytest.approx(1.0)
+    assert ex["maxSeconds"] == pytest.approx(1.5)
+    assert ex["lastSeconds"] == pytest.approx(1.5)
+    assert ex["share"] == pytest.approx(0.5)
+    assert p.stage_totals("l1_import") == {
+        "execute": pytest.approx(2.0), "merkleize": pytest.approx(2.0)}
+    assert tree["droppedKeys"] == 0
+    p.reset()
+    assert p.tree() == {"components": {}, "droppedKeys": 0}
+
+
+def test_profiler_never_raises_and_bounds_cardinality():
+    p = profiler.StageProfiler()
+    # garbage seconds must be swallowed, not raised (hot-path contract)
+    p.record("c", "s", "not-a-number")
+    p.record("c", "s", None)
+    p.record(object(), object(), 1.0)   # coerced via str(), still lands
+    assert "c" not in p.tree()["components"]  # bad rows never landed
+    # runaway label cardinality is clamped at MAX_KEYS
+    p2 = profiler.StageProfiler()
+    for i in range(profiler.MAX_KEYS + 7):
+        p2.record("c", f"stage{i}", 0.001)
+    tree = p2.tree()
+    assert len(tree["components"]["c"]["stages"]) == profiler.MAX_KEYS
+    assert tree["droppedKeys"] == 7
+
+
+def test_span_observer_folds_stages_by_component():
+    with tracing.span("prove.quotient", stage="quotient"):
+        pass
+    with tracing.span("backend.execute", stage="execute"):
+        pass
+    with tracing.span("novel.thing", stage="brand_new_stage"):
+        pass
+    comps = profiler.PROFILER.tree()["components"]
+    assert "quotient" in comps["stark"]["stages"]
+    assert "execute" in comps["prover"]["stages"]
+    assert "brand_new_stage" in comps["other"]["stages"]
+
+
+def test_raising_stage_observer_cannot_break_spans():
+    def bomb(name, stage, seconds):
+        raise RuntimeError("observer bomb")
+
+    tracing.STAGE_OBSERVERS.append(bomb)
+    try:
+        with tracing.span("prove.quotient", stage="quotient"):
+            pass
+    finally:
+        tracing.STAGE_OBSERVERS.remove(bomb)
+    # the well-behaved observer after/before the bomb still recorded
+    comps = profiler.PROFILER.tree()["components"]
+    assert "quotient" in comps.get("stark", {}).get("stages", {})
+
+
+def test_capture_is_noop_without_destination_and_never_raises(
+        tmp_path, monkeypatch):
+    import jax
+
+    profiler.configure(None)
+    assert profiler.configured_dir() is None
+    with profiler.capture("prove") as cap:
+        assert cap._started is False          # no dir -> transparent no-op
+
+    # a broken jax.profiler must not break the wrapped body
+    def boom(*a, **kw):
+        raise RuntimeError("profiler plugin broken")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    profiler.configure(str(tmp_path / "traces"))
+    ran = []
+    with profiler.capture("prove"):
+        ran.append(True)
+    assert ran == [True]
+    assert profiler._TRACE_ACTIVE is False    # slot released for next try
+
+
+def test_capture_is_single_flight(tmp_path, monkeypatch):
+    import jax
+
+    calls = {"start": 0, "stop": 0}
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.__setitem__(
+                            "start", calls["start"] + 1))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.__setitem__("stop", calls["stop"] + 1))
+    profiler.configure(str(tmp_path))
+    with profiler.capture("outer"):
+        with profiler.capture("inner"):   # nested: degrades to no-op
+            pass
+        assert calls == {"start": 1, "stop": 0}
+    assert calls == {"start": 1, "stop": 1}
+
+
+# ---------------------------------------------------------------------------
+# roofline
+
+def test_parse_cost_tolerates_every_shape():
+    pc = roofline._parse_cost
+    assert pc(None) == {"flops": None, "bytes": None}
+    assert pc([]) == {"flops": None, "bytes": None}
+    assert pc(["garbage", 42]) == {"flops": None, "bytes": None}
+    assert pc({"flops": "NaN-ish"}) == {"flops": None, "bytes": None}
+    assert pc([{"flops": 5.0}]) == {"flops": 5.0, "bytes": None}
+    assert pc({"bytes accessed": 7}) == {"flops": None, "bytes": 7.0}
+    # list-of-dicts (jax 0.4.x): entries sum
+    assert pc([{"flops": 2, "bytes accessed": 3},
+               {"flops": 4}]) == {"flops": 6.0, "bytes": 3.0}
+
+
+def test_roofline_partial_cost_yields_null_fields_not_errors():
+    roofline.record_cost("A", "commit", None)
+    roofline.record_cost("A", "quotient", [{"bytes accessed": 64.0}])
+    roofline.record_wall("A", "commit", 0.25)
+    rep = roofline.ROOFLINE.report()
+    by_kernel = {k["kernel"]: k for k in rep["kernels"]
+                 if k["air"] == "A"}
+    commit = by_kernel["commit"]
+    assert commit["flops"] is None
+    assert commit["wallLastSeconds"] == pytest.approx(0.25)
+    assert commit["achievedFlopsPerSec"] is None
+    assert commit["utilizationVsPeak"] is None
+    quotient = by_kernel["quotient"]
+    assert quotient["bytes"] == 64.0
+    assert quotient["intensityFlopsPerByte"] is None
+    # module-level hooks swallow even structurally hostile input
+    roofline.record_cost("A", "open", object())
+    roofline.record_wall("A", "open", "not-a-float")
+
+
+def test_roofline_report_and_gauges_with_calibrated_peak(monkeypatch):
+    monkeypatch.setenv("ETHREX_PEAK_FLOPS", "1e9")
+    roofline.record_cost(
+        "FibAir", "commit", [{"flops": 2.0e9, "bytes accessed": 1.0e6}])
+    roofline.record_wall("FibAir", "commit", 2.0)
+    rep = roofline.ROOFLINE.report()
+    assert rep["peakFlopsEstimate"] == 1e9
+    assert rep["peakSource"] == "env"
+    (k,) = [k for k in rep["kernels"] if k["air"] == "FibAir"]
+    assert k["achievedFlopsPerSec"] == pytest.approx(1.0e9)
+    assert k["utilizationVsPeak"] == pytest.approx(1.0)
+    assert k["intensityFlopsPerByte"] == pytest.approx(2000.0)
+    # the live gauges were exported with full labels
+    text = METRICS.render()
+    assert ('prover_kernel_flops{air="FibAir",stage="commit"} '
+            "2000000000.0") in text
+    assert ('prover_kernel_achieved_flops_per_sec'
+            '{air="FibAir",stage="commit"}') in text
+    assert ('prover_kernel_utilization{air="FibAir",stage="commit"} '
+            "1.0") in text
+
+
+def test_peak_estimate_fallbacks(monkeypatch):
+    monkeypatch.delenv("ETHREX_PEAK_FLOPS", raising=False)
+    assert roofline.peak_flops_estimate("cpu") == roofline._cpu_peak()
+    assert roofline.peak_flops_estimate("tpu") == 275.0e12
+    assert roofline.peak_flops_estimate("quantum") is None
+    monkeypatch.setenv("ETHREX_PEAK_FLOPS", "not-a-number")
+    assert roofline.peak_flops_estimate("tpu") == 275.0e12  # bad env ignored
+
+
+# ---------------------------------------------------------------------------
+# metrics exposition (golden lines)
+
+def test_perf_metric_families_render_with_help_text():
+    observe_import_stage("execute", 0.1)
+    observe_import_stage("merkleize", 0.2)
+    record_import_throughput(12.5)
+    record_prover_throughput(3.0e6)
+    record_proof_wall(7200.0)
+    record_kernel_flops("Air", "deep", 1000.0, 500.0, 0.25)
+    text = METRICS.render()
+    assert "# HELP block_import_stage_seconds" in text
+    assert '# TYPE block_import_stage_seconds histogram' in text
+    # exposition shape, not exact counts: the process-global registry
+    # may carry residue recorded between tests (thread teardown etc.)
+    assert 'block_import_stage_seconds_bucket{stage="execute"' in text
+    assert 'block_import_stage_seconds_count{stage="merkleize"}' in text
+    assert "# HELP l1_import_mgas_per_sec" in text
+    assert "l1_import_mgas_per_sec 12.5" in text
+    assert "prover_trace_cells_per_sec 3000000.0" in text
+    assert "proofs_per_hour 0.5" in text
+    assert "# HELP prover_kernel_flops" in text
+    assert 'prover_kernel_flops{air="Air",stage="deep"} 1000.0' in text
+
+
+def test_record_proof_wall_guards_nonpositive():
+    before = METRICS.gauges.get("proofs_per_hour")
+    record_proof_wall(0.0)
+    record_proof_wall(-5.0)
+    assert METRICS.gauges.get("proofs_per_hour") == before
+
+
+# ---------------------------------------------------------------------------
+# import-path stage attribution (pipelined)
+
+def test_pipelined_import_attributes_substages():
+    from ethrex_tpu.blockchain.blockchain import Blockchain
+    from ethrex_tpu.crypto import secp256k1
+    from ethrex_tpu.node import Node
+    from ethrex_tpu.primitives.genesis import Genesis
+    from ethrex_tpu.primitives.transaction import Transaction
+    from ethrex_tpu.storage.store import Store
+
+    secret = 0xA11CE
+    sender = secp256k1.pubkey_to_address(
+        secp256k1.pubkey_from_secret(secret))
+    genesis = {
+        "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+                   "shanghaiTime": 0, "cancunTime": 0},
+        "alloc": {"0x" + sender.hex(): {"balance": hex(10**21)}},
+        "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7",
+        "timestamp": "0x0",
+    }
+    node = Node(Genesis.from_json(genesis))
+    nonce = 0
+    blocks = []
+    for _ in range(3):
+        for _ in range(4):
+            node.submit_transaction(Transaction(
+                tx_type=2, chain_id=1337, nonce=nonce,
+                max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+                gas_limit=21_000, to=bytes([0x42]) * 20,
+                value=100 + nonce).sign(secret))
+            nonce += 1
+        blocks.append(node.produce_block())
+
+    store = Store()
+    store.init_genesis(Genesis.from_json(genesis))
+    chain = Blockchain(store, node.config)
+    before = profiler.PROFILER.stage_totals("l1_import")
+    chain.add_blocks_pipelined(blocks)
+    after = profiler.PROFILER.stage_totals("l1_import")
+    for stage in ("execute", "merkleize", "store_write"):
+        assert after.get(stage, 0.0) > before.get(stage, 0.0), stage
+    # the same legs flow into the labelled histogram
+    hist = METRICS.histograms["block_import_stage_seconds"]
+    seen = {dict(labels)["stage"] for labels in hist.series}
+    assert {"execute", "merkleize", "store_write"} <= seen
+    # and the pipelined wall updates the live throughput gauge
+    assert METRICS.gauges.get("l1_import_mgas_per_sec", 0.0) > 0.0
+    # the EVM split recorded under the evm component during execution
+    evm_stages = profiler.PROFILER.stage_totals("evm")
+    assert evm_stages.get("sig_recovery", 0.0) > 0.0
+    assert evm_stages.get("opcode_loop", 0.0) > 0.0
+
+
+# ---------------------------------------------------------------------------
+# a real (tiny) prove populates roofline + profiler + throughput
+
+def test_tiny_prove_populates_roofline_and_profiler():
+    from ethrex_tpu.models import fibonacci as fib
+    from ethrex_tpu.stark import prover, verifier
+    from ethrex_tpu.stark.prover import StarkParams
+
+    params = StarkParams(log_blowup=2, num_queries=16, log_final_size=4)
+    # force an AOT rebuild so cost_analysis lands even when an earlier
+    # test already compiled these phases (cost is recorded at build)
+    prover._PHASE_CACHE.clear()
+    air = fib.FibonacciAir()
+    trace = fib.generate_trace(64)
+    proof = prover.prove(air, trace, fib.public_inputs(trace), params)
+    assert verifier.verify(air, proof, params)
+
+    rep = roofline.ROOFLINE.report()
+    kernels = {k["kernel"]: k for k in rep["kernels"]
+               if k["air"] == "FibonacciAir"}
+    assert set(kernels) >= {"commit", "quotient", "open", "deep"}
+    with_cost = [k for k in kernels.values() if k["flops"]]
+    assert with_cost, "no kernel captured a static cost"
+    assert all(k["wallCount"] >= 1 for k in kernels.values())
+    assert any(k["achievedFlopsPerSec"] for k in with_cost)
+
+    comps = profiler.PROFILER.tree()["components"]
+    assert {"merkle_commit", "quotient", "fri_fold", "query"} <= set(
+        comps["stark"]["stages"])
+    assert METRICS.gauges.get("prover_trace_cells_per_sec", 0.0) > 0.0
+    # the full stack shows up on every surface: exposition...
+    assert 'prover_kernel_flops{air="FibonacciAir"' in METRICS.render()
+    # ...the flight-recorder snapshot...
+    from ethrex_tpu.utils import snapshot
+    bundle = snapshot.collect(None, reason="test")
+    assert "stark" in bundle["perf"]["profiler"]["components"]
+    assert bundle["perf"]["roofline"]["kernels"]
+
+
+# ---------------------------------------------------------------------------
+# RPC + health + monitor surfaces
+
+def _l1_node():
+    from ethrex_tpu.crypto import secp256k1
+    from ethrex_tpu.node import Node
+    from ethrex_tpu.primitives.genesis import Genesis
+
+    secret = 0xA11CE
+    sender = secp256k1.pubkey_to_address(
+        secp256k1.pubkey_from_secret(secret))
+    return Node(Genesis.from_json({
+        "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+                   "shanghaiTime": 0, "cancunTime": 0},
+        "alloc": {"0x" + sender.hex(): {"balance": hex(10**21)}},
+        "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7",
+        "timestamp": "0x0",
+    }))
+
+
+def test_ethrex_perf_rpc_degrades_gracefully_on_l1_only_node():
+    from ethrex_tpu.rpc.server import RpcServer
+
+    server = RpcServer(_l1_node())
+    resp = server.handle({"jsonrpc": "2.0", "id": 1,
+                          "method": "ethrex_perf", "params": []})
+    perf = resp["result"]
+    assert perf["enabled"] is True
+    # an L1-only node that never proved still answers with valid,
+    # merely-empty sections — never an RPC error
+    assert "components" in perf["profiler"]
+    assert perf["roofline"]["kernels"] == []
+    assert set(perf["throughput"]) == {
+        "l1_import_mgas_per_sec", "prover_trace_cells_per_sec",
+        "proofs_per_hour"}
+    assert all(v is None for v in perf["throughput"].values())
+
+    # once gauges exist they flow through verbatim
+    record_import_throughput(42.0)
+    perf = server.handle({"jsonrpc": "2.0", "id": 2,
+                          "method": "ethrex_perf",
+                          "params": []})["result"]
+    assert perf["throughput"]["l1_import_mgas_per_sec"] == 42.0
+
+    health = server.handle({"jsonrpc": "2.0", "id": 3,
+                            "method": "ethrex_health",
+                            "params": []})["result"]
+    assert health["perf"]["kernelsProfiled"] == 0
+    assert health["perf"]["maxUtilizationVsPeak"] is None
+    assert isinstance(health["perf"]["componentsProfiled"], list)
+
+
+def test_monitor_perf_panel_renders_and_degrades():
+    from ethrex_tpu.utils.monitor import _perf_lines
+
+    # no ethrex_perf (older node) and disabled both yield no panel
+    assert _perf_lines({"perf": None}, 100) == []
+    assert _perf_lines({"perf": {"enabled": False}}, 100) == []
+    snap = {"perf": {
+        "enabled": True,
+        "throughput": {"l1_import_mgas_per_sec": 12.5,
+                       "prover_trace_cells_per_sec": 3.1e6,
+                       "proofs_per_hour": None},
+        "profiler": {"components": {
+            "stark": {"totalSeconds": 8.0, "stages": {
+                "fri_fold": {"totalSeconds": 6.0, "share": 0.75},
+                "quotient": {"totalSeconds": 2.0, "share": 0.25}}}}},
+        "roofline": {"kernels": [
+            {"air": "FibonacciAir", "kernel": "quotient",
+             "flops": 3.9e7, "utilizationVsPeak": 0.37}]},
+    }}
+    lines = _perf_lines(snap, 100)
+    text = "\n".join(lines)
+    assert " performance" in text
+    assert "12.5 Mgas/s" in text
+    assert "stark" in text and "fri_fold 75%" in text
+    assert "FibonacciAir" in text and "37.0%" in text
+
+
+def test_throughput_floor_alerts_fire_below_not_above():
+    from ethrex_tpu.utils.alerts import AlertEngine, AlertRule
+
+    value = {"v": None}
+    rule = AlertRule(
+        name="floor:warn", severity="warn",
+        signal=lambda eng, node: value["v"], threshold=0.1,
+        for_count=2, resolve_count=1, below=True)
+    eng = AlertEngine(rules=[rule])
+    eng.evaluate()                      # None: a never-sampled gauge
+    assert eng.active() == []           # must not alert (idle L1 node)
+    value["v"] = 5.0
+    eng.evaluate()
+    eng.evaluate()
+    assert eng.active() == []           # healthy throughput, above floor
+    value["v"] = 0.05
+    eng.evaluate()
+    assert eng.active() == []           # first breach: pending only
+    eng.evaluate()
+    (alert,) = eng.active()
+    assert alert["name"] == "floor:warn"
+    assert alert["below"] is True
+    value["v"] = 5.0
+    eng.evaluate()
+    assert eng.active() == []           # recovered
+
+
+def test_default_rules_include_throughput_floors():
+    from ethrex_tpu.utils.alerts import default_rules
+
+    by_name = {r.name: r for r in default_rules(None)}
+    assert by_name["l1_import_throughput_floor:warn"].below is True
+    assert by_name["prover_throughput_floor:warn"].below is True
+
+
+# ---------------------------------------------------------------------------
+# bench suite: CPU fallback + history + regression gate
+
+_HEADLINE = {
+    "metric": "transfer_batch_prove_wall_s", "value": 12.3, "unit": "s",
+    "vs_baseline": 0.02, "batch_gas": 210000, "num_txs": 10,
+    "stages": {"execute": 1.0, "state_proof": 9.0},
+}
+
+
+def _wire_bench(monkeypatch, tmp_path, *, detect, probe_err, cpu_err):
+    monkeypatch.setattr(bench_suite, "HISTORY_PATH",
+                        str(tmp_path / "history.jsonl"))
+    monkeypatch.setattr(bench_suite, "LAST_PATH",
+                        str(tmp_path / "last.json"))
+    monkeypatch.setattr(bench_suite, "ATTEMPTS", 2)
+    monkeypatch.setattr(bench_suite.time, "sleep", lambda s: None)
+    monkeypatch.setattr(bench_suite, "detect_backend", lambda: detect)
+    monkeypatch.setattr(bench_suite, "probe_backend_error",
+                        lambda: probe_err)
+    monkeypatch.setattr(bench_suite, "probe_cpu_error", lambda: cpu_err)
+    monkeypatch.setattr(
+        bench_suite, "_mgas_config",
+        lambda: {"metric": "l1_import_mgas_per_sec", "value": 30.0,
+                 "stages": {"execute": 1.0, "merkleize": 0.5,
+                            "store_write": 0.2}})
+    monkeypatch.setattr(
+        bench_suite, "_core_config",
+        lambda: {"metric": "stark_prove_core_trace_cells_per_sec",
+                 "value": 2.0e6})
+    monkeypatch.delenv("BENCH_ALLOW_CPU", raising=False)
+    monkeypatch.delenv("BENCH_SKIP_EXTRAS", raising=False)
+
+
+def _history(tmp_path):
+    with open(tmp_path / "history.jsonl") as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def test_bench_dead_tunnel_falls_back_to_forced_cpu(
+        monkeypatch, tmp_path, capsys):
+    """A present-but-BROKEN plugin (detect_backend None, every chip probe
+    failing) must still yield a REAL forced-CPU record — the dead-tunnel
+    fix — and that record is never cached as a chip baseline."""
+    _wire_bench(monkeypatch, tmp_path, detect=None,
+                probe_err="RuntimeError: tunnel is dead", cpu_err=None)
+    calls = []
+
+    def fake_attempt(flag, timeout):
+        calls.append((flag, os.environ.get("BENCH_ALLOW_CPU")))
+        return dict(_HEADLINE)
+
+    monkeypatch.setattr(bench_suite, "_attempt", fake_attempt)
+    bench_suite.main()
+    record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert record["backend"] == "cpu"
+    assert record["value"] == 12.3
+    assert "degraded" not in record
+    assert "tunnel is dead" in record["fallback_reason"]
+    assert record["stages"]["state_proof"] == 9.0
+    # the fallback prove ran with the forced-CPU escape hatch armed
+    assert calls == [("--measure", "1")]
+    # sub-records still attached: mgas with its import attribution + core
+    assert record["configs"]["mgas"]["stages"]["merkleize"] == 0.5
+    assert record["configs"]["core"]["value"] == 2.0e6
+    # appended to history, NOT cached as a chip record
+    (entry,) = _history(tmp_path)
+    assert entry["backend"] == "cpu" and "ts" in entry
+    assert not (tmp_path / "last.json").exists()
+
+
+def test_bench_cpu_only_host_runs_upfront_fallback(
+        monkeypatch, tmp_path, capsys):
+    """ABSENT chip (jax says backend=cpu): no probe retries, the headline
+    runs on CPU immediately."""
+    _wire_bench(monkeypatch, tmp_path, detect="cpu",
+                probe_err=None, cpu_err=None)
+    monkeypatch.setattr(bench_suite, "_attempt",
+                        lambda flag, timeout: dict(_HEADLINE))
+    bench_suite.main()
+    record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert record["backend"] == "cpu"
+    assert "fallback_reason" not in record
+    assert "degraded" not in record
+    assert not (tmp_path / "last.json").exists()
+    assert len(_history(tmp_path)) == 1
+
+
+def test_bench_degrades_only_when_even_cpu_is_broken(
+        monkeypatch, tmp_path, capsys):
+    _wire_bench(monkeypatch, tmp_path, detect=None,
+                probe_err="RuntimeError: tunnel is dead",
+                cpu_err="ImportError: jaxlib hosed")
+    monkeypatch.setattr(bench_suite, "_attempt",
+                        lambda flag, timeout: {"_err": "should not run"})
+    bench_suite.main()
+    record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert record["degraded"] is True
+    assert record["value"] == 0.0
+    assert "tunnel is dead" in record["error"]
+    # degraded replays are poison for the gate: excluded from the series
+    (entry,) = _history(tmp_path)
+    assert entry["degraded"] is True
+    assert bench_suite._history_series("transfer_batch_prove_wall_s") == []
+
+
+def test_history_series_and_same_backend_gate(
+        monkeypatch, tmp_path, capsys):
+    monkeypatch.setattr(bench_suite, "HISTORY_PATH",
+                        str(tmp_path / "history.jsonl"))
+    wall = "transfer_batch_prove_wall_s"
+    cells = "stark_prove_core_trace_cells_per_sec"
+    bench_suite.append_history(
+        {"metric": wall, "value": 10.0, "backend": "tpu",
+         "configs": {"core": {"metric": cells, "value": 100.0}}})
+    bench_suite.append_history(
+        {"metric": wall, "value": 25.0, "backend": "tpu",
+         "configs": {"core": {"metric": cells, "value": 40.0}}})
+    assert bench_suite._history_series(wall) == [
+        ("tpu", 10.0), ("tpu", 25.0)]
+    # sub-config metrics are first-class series entries
+    assert bench_suite._history_series(cells) == [
+        ("tpu", 100.0), ("tpu", 40.0)]
+
+    # wall is lower-is-better: 10s -> 25s is a 0.4 ratio, a regression
+    code = bench_suite.check_history_metric(wall, 0.8,
+                                            lower_is_better=True)
+    out = json.loads(capsys.readouterr().out.strip())
+    assert (code, out["status"]) == (2, "regression")
+    assert out["ratio"] == pytest.approx(0.4)
+    # cells is higher-is-better: 100 -> 40 also regresses
+    assert bench_suite.check_history_metric(cells, 0.8) == 2
+    capsys.readouterr()
+
+    # a CPU-fallback record must NOT be judged against the chip numbers
+    bench_suite.append_history(
+        {"metric": wall, "value": 500.0, "backend": "cpu"})
+    code = bench_suite.check_history_metric(wall, 0.8,
+                                            lower_is_better=True)
+    out = json.loads(capsys.readouterr().out.strip())
+    assert (code, out["status"]) == (0, "no-baseline")
+    assert out["backend"] == "cpu"
+    # a second cpu record forms a same-backend pair
+    bench_suite.append_history(
+        {"metric": wall, "value": 510.0, "backend": "cpu"})
+    code = bench_suite.check_history_metric(wall, 0.8,
+                                            lower_is_better=True)
+    out = json.loads(capsys.readouterr().out.strip())
+    assert (code, out["status"]) == (0, "ok")
+    assert out["baseline"] == 500.0 and out["current"] == 510.0
+
+    # torn trailing line (crash mid-append) must not kill the gate
+    with open(tmp_path / "history.jsonl", "a") as f:
+        f.write('{"metric": "transfer_batch_pro')
+    assert len(bench_suite._history_series(wall)) == 4
+
+
+def test_check_regression_suite_worst_code_wins(monkeypatch):
+    def codes(mgas, wall, cells):
+        monkeypatch.setattr(bench_suite, "check_regression",
+                            lambda threshold: mgas)
+        monkeypatch.setattr(
+            bench_suite, "check_history_metric",
+            lambda metric, threshold, lower_is_better=False:
+                wall if "wall" in metric else cells)
+        return bench_suite.check_regression_suite()
+
+    assert codes(0, 0, 0) == 0
+    assert codes(1, 0, 0) == 1       # broken measurement: error, not pass
+    assert codes(0, 2, 0) == 2       # headline wall regressed
+    assert codes(1, 0, 2) == 2       # regression outranks error

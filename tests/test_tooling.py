@@ -132,7 +132,9 @@ def test_no_bare_print_in_library_modules():
     import ethrex_tpu
 
     root = pathlib.Path(ethrex_tpu.__file__).parent
-    allow = {"cli.py", "repl.py", "monitor.py"}
+    # bench_suite is the bench.py CLI's engine: its contract is ONE JSON
+    # line on stdout per measurement, so it owns stdout like cli/repl
+    allow = {"cli.py", "repl.py", "monitor.py", "bench_suite.py"}
     pat = re.compile(r"(?<![A-Za-z0-9_.])print\(")
     offenders = []
     for path in sorted(root.rglob("*.py")):
@@ -180,49 +182,83 @@ def test_bench_probe_reports_failure_detail(monkeypatch):
 
 
 def test_every_metric_helper_has_help_text():
-    """Every record_*/observe_* helper in utils/metrics.py must attach
-    non-empty help text to each metric it touches — an undocumented
-    family in the exposition is a family nobody can alert on.  A metric
-    call carries its help as the second (or later) string literal, so
-    each METRICS.inc/set/observe or _observe_safe call inside a helper
-    must contain at least two non-empty string constants (name + help)
-    or an explicit help_text= keyword."""
+    """Every record_*/observe_* helper in utils/metrics.py AND the perf
+    package must attach non-empty help text to each metric it touches —
+    an undocumented family in the exposition is a family nobody can
+    alert on.  A metric call carries its help as the second (or later)
+    string literal, so each METRICS.inc/set/observe/set_labeled or
+    _observe_safe call inside a helper must contain at least two
+    non-empty string constants (name + help) or an explicit help_text=
+    keyword."""
     import ast
     import inspect
 
+    from ethrex_tpu.perf import bench_suite, profiler, roofline
     from ethrex_tpu.utils import metrics
 
-    tree = ast.parse(inspect.getsource(metrics))
     offenders = []
-    for fn in ast.walk(tree):
-        if not isinstance(fn, ast.FunctionDef):
-            continue
-        if not (fn.name.startswith("record_")
-                or fn.name.startswith("observe_")):
-            continue
-        for call in ast.walk(fn):
-            if not isinstance(call, ast.Call):
+    for mod in (metrics, profiler, roofline, bench_suite):
+        tree = ast.parse(inspect.getsource(mod))
+        for fn in ast.walk(tree):
+            if not isinstance(fn, ast.FunctionDef):
                 continue
-            f = call.func
-            is_metric = (
-                (isinstance(f, ast.Attribute)
-                 and f.attr in ("inc", "set", "observe")
-                 and isinstance(f.value, ast.Name)
-                 and f.value.id == "METRICS")
-                or (isinstance(f, ast.Name) and f.id == "_observe_safe"))
-            if not is_metric:
+            if not (fn.name.startswith("record_")
+                    or fn.name.startswith("observe_")):
                 continue
-            strings = [a.value for a in call.args
-                       if isinstance(a, ast.Constant)
-                       and isinstance(a.value, str) and a.value.strip()]
-            kw_help = any(
-                k.arg == "help_text" and isinstance(k.value, ast.Constant)
-                and isinstance(k.value.value, str) and k.value.value.strip()
-                for k in call.keywords)
-            if len(strings) < 2 and not kw_help:
-                offenders.append(f"{fn.name} (line {call.lineno})")
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                f = call.func
+                is_metric = (
+                    (isinstance(f, ast.Attribute)
+                     and f.attr in ("inc", "set", "observe", "set_labeled")
+                     and isinstance(f.value, ast.Name)
+                     and f.value.id == "METRICS")
+                    or (isinstance(f, ast.Name) and f.id == "_observe_safe"))
+                if not is_metric:
+                    continue
+                strings = [a.value for a in call.args
+                           if isinstance(a, ast.Constant)
+                           and isinstance(a.value, str) and a.value.strip()]
+                kw_help = any(
+                    k.arg == "help_text"
+                    and isinstance(k.value, ast.Constant)
+                    and isinstance(k.value.value, str)
+                    and k.value.value.strip()
+                    for k in call.keywords)
+                if len(strings) < 2 and not kw_help:
+                    offenders.append(f"{mod.__name__}.{fn.name} "
+                                     f"(line {call.lineno})")
     assert not offenders, \
         f"metric calls without help text: {offenders}"
+
+
+def test_every_bench_config_emits_stages():
+    """Every bench measurement must publish a non-empty per-stage
+    breakdown: a wall-clock number without attribution cannot drive the
+    ROADMAP speed items.  Statically require each measure_* function in
+    the bench suite to build its JSON record with a "stages" key."""
+    import ast
+    import inspect
+
+    from ethrex_tpu.perf import bench_suite
+
+    tree = ast.parse(inspect.getsource(bench_suite))
+    offenders = []
+    for fn in tree.body:
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        if not fn.name.startswith("measure"):
+            continue
+        has_stages = any(
+            isinstance(node, ast.Dict) and any(
+                isinstance(k, ast.Constant) and k.value == "stages"
+                for k in node.keys)
+            for node in ast.walk(fn))
+        if not has_stages:
+            offenders.append(fn.name)
+    assert not offenders, \
+        f"bench configs without a stages breakdown: {offenders}"
 
 
 def test_bench_check_regression_exit_codes(capsys):
