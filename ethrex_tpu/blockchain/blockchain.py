@@ -22,6 +22,7 @@ from ..evm.executor import InvalidTransaction, execute_tx
 from ..evm.vm import EVM, BlockEnv, Message
 from ..storage.store import Store
 from ..trie.trie import trie_root_from_items
+from . import sender_recovery
 
 ELASTICITY_MULTIPLIER = 2
 BASE_FEE_MAX_CHANGE_DENOMINATOR = 8
@@ -355,6 +356,10 @@ class Blockchain:
             raise InvalidBlock("unknown parent")
         self.validate_header(header, parent)
         self._validate_body_roots(block)
+        # batched sender recovery ahead of execution (ethrex
+        # add_block_pipeline): the executor's inline tx.sender() becomes
+        # a cache hit; the batch wall lands in evm/sig_recovery
+        sender_recovery.recover_senders(block.body.transactions)
         # diff layering (storage/layering.py): this block's trie nodes go
         # into a per-block in-memory layer; settling flattens layers to
         # the durable backend once finalized (or past the settle window)
@@ -543,8 +548,13 @@ class Blockchain:
         worker = threading.Thread(target=merkleizer, daemon=True)
         worker.start()
         prev = parent
+        # overlap sender recovery with execution: block N+1's senders
+        # recover on the pool while block N executes/merkleizes (the
+        # native engine's C calls drop the GIL, so this is real overlap)
+        pending = sender_recovery.recover_senders_async(
+            blocks[0].body.transactions)
         try:
-            for block in blocks:
+            for i, block in enumerate(blocks):
                 if failure:
                     break
                 header = block.header
@@ -552,9 +562,16 @@ class Blockchain:
                     raise InvalidBlock("non-contiguous batch")
                 self.validate_header(header, prev)
                 self._validate_body_roots(block)
+                nxt = None
+                if i + 1 < len(blocks):
+                    nxt = sender_recovery.recover_senders_async(
+                        blocks[i + 1].body.transactions)
+                pending.wait()
                 t_exec = _time.perf_counter()
                 outcome = self.execute_block(block, prev, state_db)
                 _note_import_stage("execute", _time.perf_counter() - t_exec)
+                if nxt is not None:
+                    pending = nxt
                 self._validate_block_outcome(header, outcome)
                 snap = DirtySnapshot(state_db)
                 state_db.drain_dirty()
@@ -586,6 +603,10 @@ class Blockchain:
         parent = self.store.get_header(blocks[0].header.parent_hash)
         if parent is None:
             raise InvalidBlock("unknown parent")
+        # recover every sender in the batch up front, in one parallel
+        # pass (ethrex add_blocks_in_batch recovers ahead of the loop)
+        sender_recovery.recover_senders(
+            [tx for b in blocks for tx in b.body.transactions])
         overrides = {parent.number: parent.hash}
         source = StoreSource(self.store, parent.state_root,
                              header_overrides=overrides)

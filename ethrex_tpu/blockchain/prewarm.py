@@ -11,6 +11,10 @@ on rayon workers inside the node process; here the producer loop calls
 warmed state is the Store's table caches (the persistent backend's read
 cache when --datadir is set; the shared in-memory tables otherwise) —
 the StateDB scratch layer itself is dropped.
+
+Senders are batch-recovered up front (`sender_recovery.recover_senders`)
+so speculative runs reuse one recovery per tx instead of re-deriving
+inline — and the caches seeded here survive into the real block build.
 """
 
 from __future__ import annotations
@@ -20,6 +24,35 @@ import time
 from ..evm.db import StateDB
 from ..evm.executor import execute_tx
 from ..evm.vm import BlockEnv
+from . import sender_recovery
+
+
+class _DeadlineAbort(Exception):
+    """Raised by the deadline tracer to bail out of a long tx run."""
+
+
+class _DeadlineTracer:
+    """Frame-boundary deadline guard for speculative runs.
+
+    Checks the clock on every call-frame enter/exit — cheap (no per-step
+    hook, so the native dispatch loop stays active) yet bounds how long a
+    call-heavy tx can overrun the idle window.  A single hot frame with
+    no sub-calls still runs to completion; the producer loop's own
+    deadline check between txs is the backstop for those.
+    """
+
+    __slots__ = ("deadline",)
+
+    def __init__(self, deadline: float):
+        self.deadline = deadline
+
+    def enter(self, msg):
+        if time.monotonic() >= self.deadline:
+            raise _DeadlineAbort
+
+    def exit(self, ok, gas_left, out):
+        if time.monotonic() >= self.deadline:
+            raise _DeadlineAbort
 
 
 def prewarm_transactions(chain, parent_header, txs,
@@ -27,8 +60,10 @@ def prewarm_transactions(chain, parent_header, txs,
                          max_txs: int = 256) -> int:
     """Speculatively execute up to `max_txs` transactions against the
     parent state; returns how many ran.  Never mutates canonical state
-    (scratch StateDB, discarded) and never raises — a failing tx just
-    stops warming that sender's lane."""
+    (scratch StateDB, discarded) and never raises — a failing tx is
+    skipped and warming continues with the next one.  Past `deadline`
+    (checked between txs and at call-frame boundaries inside them) the
+    pass stops."""
     from ..storage.store import StoreSource
 
     if not txs:
@@ -37,6 +72,13 @@ def prewarm_transactions(chain, parent_header, txs,
         source = StoreSource(chain.store, parent_header.state_root)
     except Exception:
         return 0
+    txs = txs[:max_txs]
+    try:
+        # one batched recovery instead of per-run inline derivation; the
+        # seeded caches are reused by the real block build afterwards
+        sender_recovery.recover_senders(txs)
+    except Exception:
+        pass  # speculation only; inline recovery remains the backstop
     state = StateDB(source)
     env = BlockEnv(
         number=parent_header.number + 1,
@@ -47,15 +89,19 @@ def prewarm_transactions(chain, parent_header, txs,
         excess_blob_gas=parent_header.excess_blob_gas or 0,
         prev_randao=parent_header.prev_randao or b"\x00" * 32,
     )
+    tracer = _DeadlineTracer(deadline) if deadline is not None else None
     ran = 0
-    for tx in txs[:max_txs]:
+    for tx in txs:
         if deadline is not None and time.monotonic() >= deadline:
             break
         try:
-            execute_tx(tx, state, env, chain.config)
+            execute_tx(tx, state, env, chain.config, tracer=tracer)
             ran += 1
+        except _DeadlineAbort:
+            break
         except Exception:
             # speculation only: any failure (InvalidTransaction or a bug
-            # surfaced by a malformed tx) just skips this warm lane
+            # surfaced by a malformed tx) just skips this tx; later txs
+            # still warm their lanes
             continue
     return ran

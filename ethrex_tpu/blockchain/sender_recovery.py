@@ -1,0 +1,185 @@
+"""Batched, parallel transaction sender recovery — off the execute path.
+
+ethrex recovers a block's senders ahead of execution instead of inline in
+the tx loop (`add_block_pipeline` / `add_blocks_in_batch`); this module is
+that stage.  `recover_senders(txs)` recovers every uncached sender in one
+batched pass and seeds each tx's `_sender` cache (including the
+failed-recovery sentinel), so the executor's inline `tx.sender()` becomes
+a dict-speed cache hit.
+
+Engine selection:
+
+* **native present** (`crypto/native_secp256k1.py`, built from
+  `native/secp256k1.c`): the tx list is sliced across a bounded thread
+  pool and each worker runs one C `recover_batch` call over its slice.
+  The C call releases the GIL, so the slices recover genuinely in
+  parallel.
+* **native absent**: serial pure-Python recovery — threads cannot help a
+  GIL-bound big-int loop, and correctness must not depend on the native
+  build.
+
+Pool sizing: `ETHREX_SENDER_WORKERS` env or `configure(workers=...)`
+(wired to `--sender-workers`); default `min(8, cpu_count)`.
+
+The batched wall-clock is recorded into the existing `evm/sig_recovery`
+profiler stage so PR-6's attribution stays honest — after this stage runs,
+the executor's own per-tx `sig_recovery` samples are cache hits (~µs), and
+the batch wall carries the real cost.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from ..crypto import native_secp256k1, secp256k1
+from ..perf.profiler import record_stage
+from ..primitives.transaction import SENDER_INVALID, TYPE_PRIVILEGED
+from ..utils import metrics
+
+_HALF_N = secp256k1.N // 2
+
+_lock = threading.Lock()
+_configured: int | None = None
+_pool: ThreadPoolExecutor | None = None
+_pool_size = 0
+
+
+def configure(workers: int | None) -> None:
+    """Set the worker-pool size (CLI `--sender-workers`).  `None` keeps
+    the env/default resolution; the pool is rebuilt lazily on change."""
+    global _configured
+    with _lock:
+        _configured = int(workers) if workers else None
+
+
+def worker_count() -> int:
+    """Resolved pool size: configure() > ETHREX_SENDER_WORKERS > default."""
+    if _configured:
+        return max(1, _configured)
+    env = os.environ.get("ETHREX_SENDER_WORKERS", "")
+    try:
+        if env and int(env) > 0:
+            return int(env)
+    except ValueError:
+        pass
+    return max(1, min(8, os.cpu_count() or 1))
+
+
+def _get_pool() -> ThreadPoolExecutor:
+    global _pool, _pool_size
+    size = worker_count()
+    with _lock:
+        if _pool is None or _pool_size != size:
+            if _pool is not None:
+                _pool.shutdown(wait=False)
+            _pool = ThreadPoolExecutor(
+                max_workers=size, thread_name_prefix="sender-recovery")
+            _pool_size = size
+        return _pool
+
+
+def _collect(txs):
+    """Uncached signature work items: (tx, msg_hash, r, s, rec_id).
+
+    Invalid-by-inspection txs (high-s, bad v) get their sentinel seeded
+    here — no EC math needed for those.
+    """
+    items = []
+    for tx in txs:
+        if tx.tx_type == TYPE_PRIVILEGED or tx._sender is not None:
+            continue
+        if tx.s > _HALF_N:
+            tx._sender = SENDER_INVALID
+            continue
+        rec = tx.recovery_id()
+        if rec is None:
+            tx._sender = SENDER_INVALID
+            continue
+        items.append((tx, tx.signing_hash(), tx.r, tx.s, rec))
+    return items
+
+
+def _recover_slice_native(items):
+    from ..crypto.keccak import keccak256
+
+    pubs = native_secp256k1.recover_batch(
+        [(msg, r, s, rec) for _, msg, r, s, rec in items])
+    for (tx, _, _, _, _), pub in zip(items, pubs):
+        tx._sender = SENDER_INVALID if pub is None else keccak256(pub)[12:]
+
+
+def _recover_serial_python(items):
+    for tx, msg, r, s, rec in items:
+        addr = secp256k1.recover_address(msg, r, s, rec)
+        tx._sender = SENDER_INVALID if addr is None else addr
+
+
+def recover_senders(txs, record: bool = True) -> int:
+    """Recover and cache the sender of every tx in `txs`.
+
+    Returns the number of signatures actually recovered (cache hits and
+    invalid-by-inspection txs are excluded).  Safe to call concurrently
+    with readers of `tx.sender()` for *other* txs; callers overlap it
+    with the previous block's execute/merkleize, never with execution of
+    the same txs.
+    """
+    items = _collect(txs)
+    if not items:
+        return 0
+    t0 = time.perf_counter()
+    if native_secp256k1.available():
+        pool = _get_pool()
+        size = _pool_size
+        # one batched C call per worker slice; slices of < 4 sigs are not
+        # worth a dispatch, so small blocks collapse to fewer slices
+        per = max(4, (len(items) + size - 1) // size)
+        slices = [items[i:i + per] for i in range(0, len(items), per)]
+        if len(slices) == 1:
+            _recover_slice_native(slices[0])
+        else:
+            list(pool.map(_recover_slice_native, slices))
+    else:
+        _recover_serial_python(items)
+    wall = time.perf_counter() - t0
+    if record:
+        record_stage("evm", "sig_recovery", wall)
+        metrics.record_senders_recovered(len(items))
+        metrics.observe_sender_recovery_batch(wall)
+    return len(items)
+
+
+class PendingRecovery:
+    """Handle to an in-flight background recovery (pipeline overlap)."""
+
+    def __init__(self, thread: threading.Thread | None):
+        self._thread = thread
+
+    def wait(self, timeout: float | None = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+
+_DONE = PendingRecovery(None)  # empty batch: wait() is a no-op
+
+
+def recover_senders_async(txs) -> PendingRecovery:
+    """Kick off recovery for `txs` on a background thread and return a
+    handle; used by the pipelined importer to overlap block N+1's sender
+    recovery with block N's execute/merkleize.  Exceptions are swallowed
+    — the executor's inline recovery is the correctness backstop."""
+    if not txs:
+        return _DONE
+
+    def run():
+        try:
+            recover_senders(txs)
+        except Exception:
+            pass
+
+    t = threading.Thread(target=run, daemon=True,
+                         name="sender-recovery-prefetch")
+    t.start()
+    return PendingRecovery(t)

@@ -141,6 +141,11 @@ def _add_node_flags(parser: argparse.ArgumentParser):
                         "jax.profiler device traces (TensorBoard/XProf "
                         "format) captured around each prove land here; "
                         "unset keeps device tracing off (zero overhead)")
+    parser.add_argument("--sender-workers", dest="sender_workers", type=int,
+                        default=_env_int("SENDER_WORKERS", 0),
+                        help="thread-pool size for batched sender "
+                        "recovery (native secp256k1 engine); 0 = "
+                        "min(8, cpu_count)")
 
 
 def _load_genesis(args) -> Genesis | None:
@@ -370,6 +375,10 @@ def run_node(args) -> int:
         from .perf import profiler as perf_profiler
 
         perf_profiler.configure(args.profile_dir)
+    if getattr(args, "sender_workers", 0):
+        from .blockchain import sender_recovery
+
+        sender_recovery.configure(args.sender_workers)
     node.start_telemetry(alerts=build_default_engine(node))
 
     # coordinated drain (utils/shutdown.py): rpc -> producer -> flush+close
@@ -513,6 +522,10 @@ def run_l2(args) -> int:
         from .perf import profiler as perf_profiler
 
         perf_profiler.configure(args.profile_dir)
+    if getattr(args, "sender_workers", 0):
+        from .blockchain import sender_recovery
+
+        sender_recovery.configure(args.sender_workers)
     node.start_telemetry(alerts=build_default_engine(node))
 
     # coordinated drain: rpc -> prover clients -> sequencer (in-flight

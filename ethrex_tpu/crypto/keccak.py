@@ -58,6 +58,12 @@ def _load_native():
         return _lib
 
 
+def available() -> bool:
+    """True when the native keccak engine loaded (every native wrapper
+    exposes this probe; lint-enforced in tests/test_tooling.py)."""
+    return bool(_load_native())
+
+
 # ---------------------------------------------------------------------------
 # Pure-Python fallback (from the Keccak spec)
 # ---------------------------------------------------------------------------

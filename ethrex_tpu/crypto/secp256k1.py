@@ -220,6 +220,21 @@ def pubkey_to_address(pubkey) -> bytes:
 
 
 def recover_address(msg_hash: bytes, r: int, s: int, rec_id: int):
+    """Recover the 20-byte sender address, or None.
+
+    Dispatches to the native engine when present (same acceptance set,
+    differentially tested); ``recover`` above stays pure Python and is
+    the behavioral oracle.
+    """
+    from . import native_secp256k1
+
+    if native_secp256k1.available():
+        raw = native_secp256k1.recover_pubkey_bytes(msg_hash, r, s, rec_id)
+        if raw is None:
+            return None
+        from .keccak import keccak256
+
+        return keccak256(raw)[12:]
     pub = recover(msg_hash, r, s, rec_id)
     if pub is None:
         return None

@@ -26,6 +26,11 @@ TYPE_SET_CODE = 0x04
 # authorized by inclusion on L1, like the reference's PrivilegedL2Transaction)
 TYPE_PRIVILEGED = 0x7E
 
+# Memoized "signature recovery failed" marker for the `_sender` cache.
+# `None` there means "not computed yet", so failures need a distinct value
+# or every sender() call on an invalid-signature tx re-runs full EC math.
+SENDER_INVALID = object()
+
 
 def _addr(b) -> bytes:
     b = bytes(b)
@@ -258,14 +263,20 @@ class Transaction:
         if self._sender is None:
             # EIP-2: reject high-s for all included txs (homestead onward)
             if self.s > secp256k1.N // 2:
-                return None
-            rec = self.recovery_id()
-            if rec is None:
-                return None
-            self._sender = secp256k1.recover_address(
-                self.signing_hash(), self.r, self.s, rec
-            )
-        return self._sender
+                self._sender = SENDER_INVALID
+            else:
+                rec = self.recovery_id()
+                if rec is None:
+                    self._sender = SENDER_INVALID
+                else:
+                    addr = secp256k1.recover_address(
+                        self.signing_hash(), self.r, self.s, rec
+                    )
+                    # memoize failures too: without the sentinel an
+                    # invalid signature re-runs full EC recovery on
+                    # every sender() call
+                    self._sender = SENDER_INVALID if addr is None else addr
+        return None if self._sender is SENDER_INVALID else self._sender
 
     # ---------------- fee helpers ----------------
     def max_fee(self) -> int:

@@ -570,10 +570,15 @@ def _health(node):
         kernels = rep.get("kernels") or []
         utils = [k["utilizationVsPeak"] for k in kernels
                  if k.get("utilizationVsPeak") is not None]
+        from ..crypto import native_secp256k1
+
         out["perf"] = {
             "componentsProfiled": sorted(tree.get("components", {})),
             "kernelsProfiled": len(kernels),
             "maxUtilizationVsPeak": max(utils) if utils else None,
+            # which sender-recovery engine is live: the native C engine
+            # or the pure-Python fallback (docs/PERFORMANCE.md)
+            "nativeSecp256k1": native_secp256k1.available(),
         }
     except Exception:  # noqa: BLE001 — health must answer regardless
         pass

@@ -106,6 +106,18 @@ def _load():
         return _lib
 
 
+def available() -> bool:
+    """True when the native KV engine builds and loads.  Unlike the other
+    native wrappers there is no pure-Python data path behind this one —
+    the in-memory Store is the fallback at the architecture level (no
+    --datadir); the probe exists so callers and the tooling lint can
+    treat every native module uniformly."""
+    try:
+        return bool(_load())
+    except (OSError, subprocess.CalledProcessError):
+        return False
+
+
 # ---------------------------------------------------------------------------
 # corruption / recovery statistics (process-wide, health-readable)
 # ---------------------------------------------------------------------------

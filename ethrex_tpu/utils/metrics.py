@@ -456,6 +456,19 @@ def record_prover_throughput(cells_per_sec: float):
                 "(n x width over end-to-end prove wall-clock)")
 
 
+def record_senders_recovered(count: int):
+    METRICS.inc("senders_recovered_total", count,
+                "Transaction senders recovered by the batched "
+                "sender-recovery stage (either engine; excludes "
+                "cache hits)")
+
+
+def observe_sender_recovery_batch(seconds: float):
+    _observe_safe("sender_recovery_batch_seconds", seconds, None,
+                  "Wall-clock of one batched sender-recovery call "
+                  "(whole tx list, all pool workers joined)")
+
+
 def record_proof_wall(seconds: float):
     """Derive the proofs_per_hour throughput gauge from one end-to-end
     backend prove wall-clock."""

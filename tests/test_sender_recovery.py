@@ -1,0 +1,346 @@
+"""Batched sender recovery + native secp256k1 engine battery.
+
+Three contracts drilled here:
+
+* the native engine (native/secp256k1.c) accepts EXACTLY the inputs the
+  pure-Python oracle (crypto/secp256k1.py) accepts and returns the
+  identical point — differential fuzz over signed round-trips and
+  adversarial signatures (high-s, r >= N, rec_id 2/3 with r + N >= P,
+  non-residue x, zero r/s, out-of-range rec_id);
+* batched recovery under the worker pool yields byte-identical senders
+  to serial `tx.sender()` for every tx type (legacy pre/post-155, 2930,
+  1559, blob, 7702), seeding the `_sender` cache including the
+  failed-recovery sentinel;
+* the pipeline seats (add_block / add_blocks_in_batch / pipelined
+  import / prewarm) produce the same chain with recovery batched as the
+  tx-loop-inline recovery did.
+"""
+
+import random
+
+import pytest
+
+from ethrex_tpu.blockchain import sender_recovery
+from ethrex_tpu.crypto import native_secp256k1, secp256k1
+from ethrex_tpu.crypto.keccak import keccak256
+from ethrex_tpu.primitives import rlp
+from ethrex_tpu.primitives.transaction import (SENDER_INVALID, Transaction,
+                                               TYPE_BLOB, TYPE_SET_CODE)
+
+needs_native = pytest.mark.skipif(not native_secp256k1.available(),
+                                  reason="native secp256k1 not built")
+
+N = secp256k1.N
+P = secp256k1.P
+
+
+def _oracle_pub64(msg, r, s, rec):
+    pub = secp256k1.recover(msg, r, s, rec)
+    if pub is None:
+        return None
+    return pub[0].to_bytes(32, "big") + pub[1].to_bytes(32, "big")
+
+
+# a small r whose x-coordinate is off the curve (x^3 + 7 a non-residue),
+# forcing the expensive "recovery failed" path rather than a cheap check
+NON_RESIDUE_R = next(r for r in range(2, 100)
+                     if secp256k1.recover(b"\x55" * 32, r, 1, 0) is None)
+
+
+@needs_native
+def test_differential_fuzz_signed_roundtrips():
+    rng = random.Random(0xEC)
+    for i in range(40):
+        secret = rng.randrange(1, N)
+        msg = rng.randrange(0, 1 << 256).to_bytes(32, "big")
+        r, s, rec = secp256k1.sign(msg, secret)
+        if i % 3 == 1:
+            s = N - s  # high-s twin: both engines must accept + agree
+            rec ^= 1
+        native = native_secp256k1.recover_pubkey_bytes(msg, r, s, rec)
+        assert native == _oracle_pub64(msg, r, s, rec)
+        assert native is not None
+        # address dispatcher agrees with the pure pipeline
+        assert secp256k1.recover_address(msg, r, s, rec) == \
+            secp256k1.pubkey_to_address(secp256k1.recover(msg, r, s, rec))
+
+
+@needs_native
+def test_differential_fuzz_adversarial_inputs():
+    rng = random.Random(0xAD)
+    r_edges = [0, 1, NON_RESIDUE_R, N - 1, N, N + 1, P - N, P - N + 1,
+               P - N - 1, (1 << 256) - 1]
+    s_edges = [0, 1, N // 2, N // 2 + 1, N - 1, N, (1 << 256) - 1]
+    for _ in range(400):
+        msg = rng.randrange(0, 1 << 256).to_bytes(32, "big")
+        r = rng.choice(r_edges + [rng.randrange(1, N)])
+        s = rng.choice(s_edges + [rng.randrange(1, N)])
+        rec = rng.randrange(0, 4)  # rec 2/3 exercises the r + N >= P gate
+        native = native_secp256k1.recover_pubkey_bytes(msg, r, s, rec)
+        assert native == _oracle_pub64(msg, r, s, rec), (r, s, rec)
+    # out-of-range rec_id rejected without reaching the C layer
+    assert native_secp256k1.recover(b"\x01" * 32, 1, 1, 4) is None
+    assert native_secp256k1.recover(b"\x01" * 32, 1, 1, -1) is None
+
+
+@needs_native
+def test_native_batch_matches_single_calls():
+    rng = random.Random(0xBA)
+    items = []
+    for i in range(24):
+        secret = rng.randrange(1, N)
+        msg = rng.randrange(0, 1 << 256).to_bytes(32, "big")
+        r, s, rec = secp256k1.sign(msg, secret)
+        if i % 4 == 0:
+            r = NON_RESIDUE_R  # invalid entries interleaved with valid
+        items.append((msg, r, s, rec))
+    batch = native_secp256k1.recover_batch(items)
+    singles = [native_secp256k1.recover_pubkey_bytes(*it) for it in items]
+    assert batch == singles
+    assert any(b is None for b in batch) and any(b for b in batch)
+    assert native_secp256k1.recover_batch([]) == []
+
+
+def _tx_of_every_type():
+    """One signed tx per wire format, plus an unrecoverable one."""
+    to = bytes([0x42]) * 20
+    txs = [
+        Transaction(tx_type=0, chain_id=None, nonce=0, gas_price=10**10,
+                    gas_limit=21_000, to=to, value=1).sign(0xAA1),
+        Transaction(tx_type=0, chain_id=1337, nonce=1, gas_price=10**10,
+                    gas_limit=21_000, to=to, value=2).sign(0xAA2),
+        Transaction(tx_type=1, chain_id=1337, nonce=2, gas_price=10**10,
+                    gas_limit=25_000, to=to, value=3,
+                    access_list=[(to, [1, 2])]).sign(0xAA3),
+        Transaction(tx_type=2, chain_id=1337, nonce=3,
+                    max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+                    gas_limit=21_000, to=to, value=4).sign(0xAA4),
+        Transaction(tx_type=TYPE_BLOB, chain_id=1337, nonce=4,
+                    max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+                    gas_limit=21_000, to=to, value=5,
+                    max_fee_per_blob_gas=10**10,
+                    blob_versioned_hashes=[b"\x01" + b"\x22" * 31],
+                    ).sign(0xAA5),
+        Transaction(tx_type=TYPE_SET_CODE, chain_id=1337, nonce=5,
+                    max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+                    gas_limit=80_000, to=to, value=6,
+                    authorization_list=[{
+                        "chain_id": 1337, "address": to, "nonce": 0,
+                        "y_parity": 0, "r": 1, "s": 1}]).sign(0xAA6),
+    ]
+    bad = Transaction(tx_type=2, chain_id=1337, nonce=6,
+                      max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+                      gas_limit=21_000, to=to, value=7)
+    bad.v, bad.r, bad.s = 0, NON_RESIDUE_R, 1
+    return txs + [bad]
+
+
+def test_batched_recovery_matches_serial_every_tx_type():
+    serial = _tx_of_every_type()
+    expected = [tx.sender() for tx in serial]
+    assert expected[-1] is None and all(a is not None for a in expected[:-1])
+
+    # wire round-trip drops the caches — recovery really runs cold
+    batched = [Transaction.decode_canonical(tx.encode_canonical()) for tx in serial]
+    assert all(tx._sender is None for tx in batched)
+    n = sender_recovery.recover_senders(batched)
+    assert n == len(batched)
+    assert [tx.sender() for tx in batched] == expected
+    # caches are seeded, including the failed-recovery sentinel
+    assert batched[-1]._sender is SENDER_INVALID
+    assert all(tx._sender == exp for tx, exp in
+               zip(batched[:-1], expected[:-1]))
+    # a second pass is a pure cache hit
+    assert sender_recovery.recover_senders(batched) == 0
+
+
+def test_batched_recovery_under_forced_pool_matches_serial():
+    """Force a multi-worker pool (even on 1-CPU hosts) and a slice size
+    that splits the batch, then check byte-identical results."""
+    serial = _tx_of_every_type() * 3
+    expected = [tx.sender() for tx in serial]
+    batched = [Transaction.decode_canonical(tx.encode_canonical()) for tx in serial]
+    sender_recovery.configure(4)
+    try:
+        assert sender_recovery.worker_count() == 4
+        sender_recovery.recover_senders(batched)
+    finally:
+        sender_recovery.configure(None)
+    assert [tx.sender() for tx in batched] == expected
+
+
+def test_worker_count_resolution(monkeypatch):
+    sender_recovery.configure(None)
+    monkeypatch.setenv("ETHREX_SENDER_WORKERS", "3")
+    assert sender_recovery.worker_count() == 3
+    monkeypatch.setenv("ETHREX_SENDER_WORKERS", "junk")
+    assert sender_recovery.worker_count() >= 1
+    monkeypatch.delenv("ETHREX_SENDER_WORKERS")
+    sender_recovery.configure(2)
+    try:
+        assert sender_recovery.worker_count() == 2
+    finally:
+        sender_recovery.configure(None)
+
+
+def test_invalid_signature_memoized_not_recomputed(monkeypatch):
+    """The expensive failure path must run EC recovery exactly once."""
+    from ethrex_tpu.primitives import transaction as tx_mod
+
+    calls = {"n": 0}
+    real = secp256k1.recover_address
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(tx_mod.secp256k1, "recover_address", counting)
+    bad = Transaction(tx_type=2, chain_id=1337, gas_limit=21_000,
+                      to=bytes([0x42]) * 20)
+    bad.v, bad.r, bad.s = 0, NON_RESIDUE_R, 1
+    for _ in range(5):
+        assert bad.sender() is None
+    assert calls["n"] == 1
+    assert bad._sender is SENDER_INVALID
+    # re-signing resets the cache: the sentinel must not stick
+    bad.sign(0xBB1)
+    assert bad.sender() is not None
+
+
+def test_cheap_failures_never_reach_recovery(monkeypatch):
+    from ethrex_tpu.primitives import transaction as tx_mod
+
+    def boom(*a, **kw):
+        raise AssertionError("EC recovery must not run")
+
+    monkeypatch.setattr(tx_mod.secp256k1, "recover_address", boom)
+    to = bytes([0x42]) * 20
+    high_s = Transaction(tx_type=2, chain_id=1337, gas_limit=21_000, to=to)
+    high_s.v, high_s.r, high_s.s = 0, 1, N - 1  # high-s (EIP-2)
+    assert high_s.sender() is None
+    assert high_s._sender is SENDER_INVALID
+    bad_v = Transaction(tx_type=2, chain_id=1337, gas_limit=21_000, to=to)
+    bad_v.v, bad_v.r, bad_v.s = 7, 1, 1  # invalid y_parity for typed tx
+    assert bad_v.sender() is None
+    assert bad_v._sender is SENDER_INVALID
+
+
+def test_7702_authorization_recovery_native_python_agree():
+    """_apply_authorizations recovers authorities through the same
+    dispatching recover_address; both engines must name the same
+    authority for a well-formed tuple."""
+    secret = 0xC0FFEE
+    authority = secp256k1.pubkey_to_address(
+        secp256k1.pubkey_from_secret(secret))
+    delegate = bytes([0x77]) * 20
+    msg = keccak256(b"\x05" + rlp.encode([1337, delegate, 9]))
+    r, s, y_parity = secp256k1.sign(msg, secret)
+    via_dispatch = secp256k1.recover_address(msg, r, s, y_parity)
+    pure = secp256k1.pubkey_to_address(secp256k1.recover(msg, r, s, y_parity))
+    assert via_dispatch == pure == authority
+    if native_secp256k1.available():
+        raw = native_secp256k1.recover_pubkey_bytes(msg, r, s, y_parity)
+        assert keccak256(raw)[12:] == authority
+
+
+def test_pure_python_fallback_path(monkeypatch):
+    """With the native engine unavailable, batched recovery degrades to
+    serial pure-Python and still produces identical senders."""
+    serial = _tx_of_every_type()
+    expected = [tx.sender() for tx in serial]
+    batched = [Transaction.decode_canonical(tx.encode_canonical()) for tx in serial]
+    monkeypatch.setattr(native_secp256k1, "available", lambda: False)
+    n = sender_recovery.recover_senders(batched)
+    assert n == len(batched)
+    assert [tx.sender() for tx in batched] == expected
+
+
+def test_async_recovery_seeds_caches():
+    txs = [Transaction.decode_canonical(t.encode_canonical()) for t in _tx_of_every_type()]
+    pending = sender_recovery.recover_senders_async(txs)
+    pending.wait()
+    assert all(t._sender is not None for t in txs)
+    assert sender_recovery.recover_senders_async([]).wait() is None
+
+# ---------------------------------------------------------------------------
+# Prewarm deadline + skip behavior (blockchain/prewarm.py)
+# ---------------------------------------------------------------------------
+
+SECRET = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
+SENDER = secp256k1.pubkey_to_address(secp256k1.pubkey_from_secret(SECRET))
+GENESIS = {
+    "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+               "shanghaiTime": 0, "cancunTime": 0},
+    "alloc": {"0x" + SENDER.hex(): {"balance": hex(10**21)}},
+    "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7", "timestamp": "0x0",
+}
+
+
+def _funded_tx(nonce, value=100):
+    return Transaction(
+        tx_type=2, chain_id=1337, nonce=nonce,
+        max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+        gas_limit=21_000, to=bytes([0x42]) * 20, value=value).sign(SECRET)
+
+
+def _fresh_node():
+    from ethrex_tpu.node import Node
+    from ethrex_tpu.primitives.genesis import Genesis
+    return Node(Genesis.from_json(GENESIS))
+
+
+def test_prewarm_expired_deadline_runs_nothing():
+    import time
+
+    from ethrex_tpu.blockchain.prewarm import prewarm_transactions
+
+    node = _fresh_node()
+    parent = node.store.head_header()
+    txs = [_funded_tx(n) for n in range(3)]
+    assert prewarm_transactions(node.chain, parent, txs,
+                                deadline=time.monotonic() - 1) == 0
+
+
+def test_prewarm_skips_failing_tx_and_continues():
+    from ethrex_tpu.blockchain.prewarm import prewarm_transactions
+
+    node = _fresh_node()
+    parent = node.store.head_header()
+    bad = Transaction(tx_type=2, chain_id=1337, nonce=1,
+                      max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+                      gas_limit=21_000, to=bytes([0x42]) * 20, value=1)
+    bad.v, bad.r, bad.s = 0, NON_RESIDUE_R, 1  # unrecoverable sender
+    txs = [_funded_tx(0), bad, _funded_tx(1), _funded_tx(2)]
+    ran = prewarm_transactions(node.chain, parent, txs)
+    # the invalid tx is skipped, not the whole lane
+    assert ran == 3
+
+
+def test_prewarm_deadline_tracer_frame_boundary_abort():
+    import time
+
+    from ethrex_tpu.blockchain.prewarm import (_DeadlineAbort,
+                                               _DeadlineTracer)
+
+    live = _DeadlineTracer(time.monotonic() + 60)
+    live.enter(None)
+    live.exit(True, 0, b"")  # before the deadline: no-ops
+    dead = _DeadlineTracer(time.monotonic() - 1)
+    with pytest.raises(_DeadlineAbort):
+        dead.enter(None)
+    with pytest.raises(_DeadlineAbort):
+        dead.exit(True, 0, b"")
+    # no per-step hook: the native opcode loop must stay dispatched
+    assert not hasattr(live, "step")
+
+
+def test_prewarm_seeds_sender_caches_for_real_build():
+    from ethrex_tpu.blockchain.prewarm import prewarm_transactions
+
+    node = _fresh_node()
+    parent = node.store.head_header()
+    txs = [Transaction.decode_canonical(_funded_tx(n).encode_canonical())
+           for n in range(3)]
+    assert all(t._sender is None for t in txs)
+    assert prewarm_transactions(node.chain, parent, txs) == 3
+    assert all(t._sender == SENDER for t in txs)

@@ -147,6 +147,41 @@ def test_no_bare_print_in_library_modules():
         f"bare print() in library modules (use logging): {offenders}"
 
 
+def test_every_native_source_has_probed_fallback():
+    """Every native/*.c / *.cpp engine must have a Python wrapper module
+    with an `available()` probe, so callers can gate on the native path
+    uniformly and nothing hard-fails without a toolchain.  A new native
+    source must be registered here with its wrapper."""
+    import importlib
+    import os
+    import pathlib
+
+    import ethrex_tpu
+
+    wrappers = {
+        "evm.cpp": "ethrex_tpu.evm.native_vm",
+        "keccak.c": "ethrex_tpu.crypto.keccak",
+        "kvstore.cpp": "ethrex_tpu.storage.persistent",
+        "mpt.cpp": "ethrex_tpu.trie.native_mpt",
+        "secp256k1.c": "ethrex_tpu.crypto.native_secp256k1",
+    }
+    native_dir = pathlib.Path(ethrex_tpu.__file__).parent.parent / "native"
+    sources = sorted(p.name for p in native_dir.iterdir()
+                     if p.suffix in (".c", ".cpp"))
+    unmapped = [s for s in sources if s not in wrappers]
+    assert not unmapped, \
+        f"native sources without a registered Python wrapper: {unmapped}"
+    for src, mod_name in sorted(wrappers.items()):
+        assert os.path.exists(native_dir / src), \
+            f"{mod_name} wraps native/{src}, which does not exist"
+        mod = importlib.import_module(mod_name)
+        probe = getattr(mod, "available", None)
+        assert callable(probe), \
+            f"{mod_name} (wrapper for native/{src}) lacks available()"
+        assert isinstance(probe(), bool), \
+            f"{mod_name}.available() must return a bool"
+
+
 def test_bench_probe_reports_failure_detail(monkeypatch):
     """A degraded bench record must say WHY the backend probe failed —
     the last exception line of the child's stderr, or the timeout."""
