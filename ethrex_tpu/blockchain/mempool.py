@@ -5,14 +5,47 @@ blob support; simplified admission rules for round 1)."""
 from __future__ import annotations
 
 import threading
+import time
 
 from ..primitives.transaction import TYPE_BLOB, Transaction
+from ..utils.metrics import (record_mempool_admission,
+                             record_mempool_eviction,
+                             record_mempool_occupancy,
+                             record_mempool_rejection, observe_time_in_pool)
 
 MIN_REPLACEMENT_BUMP = 10  # percent
 
 
 class MempoolError(Exception):
-    pass
+    """Admission failure.  Subclasses carry a machine-readable ``reason``
+    label so rejection counters are labelled truthfully; the message
+    strings are part of the RPC error surface and stay unchanged."""
+
+    reason = "other"
+
+
+class PrivilegedTxError(MempoolError):
+    reason = "privileged"
+
+
+class InvalidSignatureError(MempoolError):
+    reason = "invalid_signature"
+
+
+class NonceTooLowError(MempoolError):
+    reason = "nonce_too_low"
+
+
+class InsufficientFundsError(MempoolError):
+    reason = "insufficient_funds"
+
+
+class BlobsMissingError(MempoolError):
+    reason = "blobs_missing"
+
+
+class UnderpricedError(MempoolError):
+    reason = "underpriced"
 
 
 MAX_BLOB_MEMPOOL_SIZE = 512   # reference: mempool.rs:49
@@ -35,6 +68,29 @@ class Mempool:
         # arrival hooks (e.g. pending-tx RPC filters); invoked OUTSIDE
         # self.lock so subscribers may take their own locks freely
         self.on_add: list = []
+        # flow accounting (pool-local so ethrex_health survives metric
+        # registry resets): admission timestamps for the time-in-pool
+        # histogram, plus admission/rejection/eviction tallies
+        self.added_at: dict[bytes, float] = {}
+        self.admitted = 0
+        self.rejections: dict[str, int] = {}
+        self.evictions: dict[str, int] = {}
+
+    def _reject(self, err: MempoolError) -> MempoolError:
+        with self.lock:
+            self.rejections[err.reason] = \
+                self.rejections.get(err.reason, 0) + 1
+        record_mempool_rejection(err.reason)
+        return err
+
+    def _utilization(self) -> float:
+        blob = len(self.blobs_bundles)
+        regular = len(self.by_hash) - blob
+        return max(regular / self.capacity if self.capacity else 0.0,
+                   blob / self.blob_capacity if self.blob_capacity else 0.0)
+
+    def _publish_occupancy_locked(self) -> None:
+        record_mempool_occupancy(len(self.by_hash), self._utilization())
 
     def add_transaction(self, tx: Transaction, sender_nonce: int,
                         sender_balance: int, base_fee: int,
@@ -42,27 +98,35 @@ class Mempool:
         from ..primitives.transaction import TYPE_PRIVILEGED
 
         if tx.tx_type == TYPE_PRIVILEGED:
-            raise MempoolError("privileged txs bypass the mempool")
+            raise self._reject(
+                PrivilegedTxError("privileged txs bypass the mempool"))
         sender = tx.sender()
         if sender is None:
-            raise MempoolError("invalid signature")
+            raise self._reject(InvalidSignatureError("invalid signature"))
         if tx.nonce < sender_nonce:
-            raise MempoolError("nonce too low")
+            raise self._reject(NonceTooLowError("nonce too low"))
         if tx.gas_limit * tx.max_fee() + tx.value > sender_balance:
-            raise MempoolError("insufficient funds")
+            raise self._reject(InsufficientFundsError("insufficient funds"))
         if tx.tx_type == TYPE_BLOB and blobs_bundle is None:
-            raise MempoolError("blob tx requires blobs bundle")
+            raise self._reject(
+                BlobsMissingError("blob tx requires blobs bundle"))
         with self.lock:
             queue = self.by_sender.setdefault(sender, {})
             existing = queue.get(tx.nonce)
             if existing is not None:
                 bump = existing.max_fee() * (100 + MIN_REPLACEMENT_BUMP) // 100
                 if tx.max_fee() < bump:
-                    raise MempoolError("replacement underpriced")
+                    raise self._reject(
+                        UnderpricedError("replacement underpriced"))
                 self.by_hash.pop(existing.hash, None)
                 self.blobs_bundles.pop(existing.hash, None)
+                self.added_at.pop(existing.hash, None)
+                self.evictions["replaced"] = \
+                    self.evictions.get("replaced", 0) + 1
+                record_mempool_eviction("replaced")
             queue[tx.nonce] = tx
             self.by_hash[tx.hash] = tx
+            self.added_at[tx.hash] = time.monotonic()
             if blobs_bundle is not None:
                 self.blobs_bundles[tx.hash] = blobs_bundle
                 self._evict_worst_blob()
@@ -78,6 +142,18 @@ class Mempool:
                         h for h in self.txs_order
                         if h in self.by_hash
                         and h not in self.blobs_bundles]
+            # a full blob sub-pool may pick the INCOMING tx as its own
+            # least-includable eviction victim: admission succeeded
+            # (pinned behavior — the hash is returned) but the pool is
+            # effectively full for it, so count it truthfully
+            if tx.hash not in self.by_hash:
+                self.rejections["pool_full"] = \
+                    self.rejections.get("pool_full", 0) + 1
+                record_mempool_rejection("pool_full")
+            else:
+                self.admitted += 1
+                record_mempool_admission()
+            self._publish_occupancy_locked()
         for hook in list(self.on_add):
             hook(tx.hash)
         return tx.hash
@@ -92,6 +168,8 @@ class Mempool:
             oldest = self.txs_order.pop(0)
             if oldest in self.by_hash and oldest not in self.blobs_bundles:
                 self._remove_locked(oldest)
+                self.evictions["fifo"] = self.evictions.get("fifo", 0) + 1
+                record_mempool_eviction("fifo")
 
     def _evict_worst_blob(self) -> None:
         """Evict the LEAST INCLUDABLE blob tx past the blob sub-pool cap:
@@ -121,12 +199,16 @@ class Mempool:
             if worst is None:
                 break
             self._remove_locked(worst)
+            self.evictions["blob_pool_full"] = \
+                self.evictions.get("blob_pool_full", 0) + 1
+            record_mempool_eviction("blob_pool_full")
 
     def _remove_locked(self, tx_hash: bytes):
         tx = self.by_hash.pop(tx_hash, None)
         if tx is None:
             return
         self.blobs_bundles.pop(tx_hash, None)
+        self.added_at.pop(tx_hash, None)
         sender = tx.sender()
         queue = self.by_sender.get(sender)
         if queue and queue.get(tx.nonce) is tx:
@@ -134,9 +216,48 @@ class Mempool:
             if not queue:
                 del self.by_sender[sender]
 
-    def remove_transaction(self, tx_hash: bytes):
+    def remove_transaction(self, tx_hash: bytes, reason: str | None = None):
+        """Drop a tx.  ``reason="included"`` (block production) feeds the
+        admission→inclusion time-in-pool histogram; any other reason is
+        counted as a post-admission eviction (e.g. ``invalid_at_build``);
+        None is a silent administrative removal."""
         with self.lock:
+            present = tx_hash in self.by_hash
+            dwell = None
+            if present and reason == "included":
+                t0 = self.added_at.get(tx_hash)
+                if t0 is not None:
+                    dwell = time.monotonic() - t0
             self._remove_locked(tx_hash)
+            if present and reason is not None and reason != "included":
+                self.evictions[reason] = self.evictions.get(reason, 0) + 1
+                record_mempool_eviction(reason)
+            if present:
+                self._publish_occupancy_locked()
+        if dwell is not None:
+            observe_time_in_pool(dwell)
+
+    def stats_json(self, top_k: int = 5) -> dict:
+        """Flow-accounting summary for ethrex_health: occupancy,
+        admission/rejection/eviction tallies by reason, and the top-k
+        deepest per-sender queues (spam/hot-sender visibility)."""
+        with self.lock:
+            blob = len(self.blobs_bundles)
+            depths = sorted(((len(q), s) for s, q in self.by_sender.items()),
+                            reverse=True)[:max(0, top_k)]
+            return {
+                "size": len(self.by_hash),
+                "regular": len(self.by_hash) - blob,
+                "blob": blob,
+                "capacity": self.capacity,
+                "blobCapacity": self.blob_capacity,
+                "utilization": round(self._utilization(), 6),
+                "admitted": self.admitted,
+                "rejections": dict(sorted(self.rejections.items())),
+                "evictions": dict(sorted(self.evictions.items())),
+                "topSenders": [{"sender": "0x" + s.hex(), "txs": n}
+                               for n, s in depths],
+            }
 
     def get_transaction(self, tx_hash: bytes) -> Transaction | None:
         return self.by_hash.get(tx_hash)

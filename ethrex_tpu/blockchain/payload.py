@@ -93,7 +93,8 @@ def build_payload(chain: Blockchain, parent: BlockHeader,
             result = execute_tx(tx, state, env, config)
         except InvalidTransaction:
             if mempool is not None:
-                mempool.remove_transaction(tx.hash)
+                mempool.remove_transaction(tx.hash,
+                                           reason="invalid_at_build")
             continue
         gas_used += result.gas_used
         blob_gas += tx_blob_gas

@@ -74,6 +74,11 @@ def _add_node_flags(parser: argparse.ArgumentParser):
     parser.add_argument("--ws.port", dest="ws_port", type=int,
                         default=_env_int("WS_PORT", 0),
                         help="WebSocket JSON-RPC + subscriptions (0 = off)")
+    parser.add_argument("--rpc-backlog", dest="rpc_backlog", type=int,
+                        default=_env_int("RPC_BACKLOG", 128),
+                        help="TCP listen backlog for the RPC listeners "
+                             "(HTTP, Engine API, WebSocket); saturation "
+                             "shows up as rpc_connections_reset_total")
     parser.add_argument("--block-time", dest="block_time", type=float,
                         default=_env_float("BLOCK_TIME", 1.0),
                         help="dev block production interval (s)")
@@ -304,7 +309,8 @@ def run_node(args) -> int:
     coinbase = bytes.fromhex(args.coinbase.removeprefix("0x"))
     store = _open_store(args.datadir)
     node = Node(genesis, coinbase=coinbase, store=store)
-    server = RpcServer(node, args.http_addr, args.http_port).start()
+    server = RpcServer(node, args.http_addr, args.http_port,
+                       backlog=args.rpc_backlog).start()
     print(f"genesis hash: 0x{node.genesis_header.hash.hex()}")
     print(f"JSON-RPC listening on http://{args.http_addr}:{server.port}")
     authrpc = None
@@ -322,14 +328,16 @@ def run_node(args) -> int:
             print(f"generated JWT secret (pass to your CL): "
                   f"{jwt_secret.hex()}")
         authrpc = RpcServer(node, args.authrpc_addr, args.authrpc_port,
-                            jwt_secret=jwt_secret, engine=True).start()
+                            jwt_secret=jwt_secret, engine=True,
+                            backlog=args.rpc_backlog).start()
         print(f"Engine API listening on http://{args.authrpc_addr}:"
               f"{authrpc.port}")
     ws = None
     if args.ws_port:
         from .rpc.websocket import WsServer
 
-        ws = WsServer(server, args.http_addr, args.ws_port).start()
+        ws = WsServer(server, args.http_addr, args.ws_port,
+                      backlog=args.rpc_backlog).start()
         print(f"WebSocket JSON-RPC on ws://{args.http_addr}:{ws.port}")
     metrics = None
     if args.metrics_port:
@@ -488,7 +496,8 @@ def run_l2(args) -> int:
     seq = Sequencer(node, l1, cfg, rollup=rollup)
     node.sequencer = seq
 
-    server = RpcServer(node, args.http_addr, args.http_port).start()
+    server = RpcServer(node, args.http_addr, args.http_port,
+                       backlog=getattr(args, "rpc_backlog", None)).start()
     print(f"genesis hash: 0x{node.genesis_header.hash.hex()}")
     print(f"L2 JSON-RPC listening on http://{args.http_addr}:{server.port}")
     latest = rollup.latest_batch_number()

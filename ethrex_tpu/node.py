@@ -77,6 +77,13 @@ class Node:
         if sender is None:
             raise InvalidTransaction("invalid signature")
         if tx.chain_id is not None and tx.chain_id != self.config.chain_id:
+            # counted against the pool's flow accounting even though the
+            # check runs above it: RPC rejection reasons share one ledger
+            from .utils.metrics import record_mempool_rejection
+
+            self.mempool.rejections["wrong_chain_id"] = \
+                self.mempool.rejections.get("wrong_chain_id", 0) + 1
+            record_mempool_rejection("wrong_chain_id")
             raise InvalidTransaction("wrong chain id")
         root = self.head_state_root()
         acct = self.store.account_state(root, sender)
@@ -117,7 +124,7 @@ class Node:
                 self.chain.add_block(result.block)
                 apply_fork_choice(self.store, result.block.hash)
             for tx in result.block.body.transactions:
-                self.mempool.remove_transaction(tx.hash)
+                self.mempool.remove_transaction(tx.hash, reason="included")
             from .utils.metrics import record_block
 
             record_block(result.block, time.monotonic() - t0)

@@ -1,0 +1,578 @@
+"""Open-loop load harness for the JSON-RPC serving layer.
+
+The legacy generator (`utils/load_test.py`, now a shim over this
+module) is CLOSED-loop: it fires the next request only after the
+previous one returns, so a slow server throttles the generator and the
+measured latencies silently omit exactly the stalls that matter
+("coordinated omission" — see the Tail at Scale discussion in
+docs/PERFORMANCE.md).  This harness is OPEN-loop:
+
+- arrival times are PRECOMPUTED from a fixed or Poisson schedule before
+  the clock starts, so response times cannot stretch interarrival gaps;
+- a send slot with no free worker is counted as MISSED, never deferred —
+  the offered rate is honest even when the server melts;
+- per-request latency is measured from the SCHEDULED send instant to the
+  response, into the shared exponential-bucket histogram ladder
+  (utils/metrics.DEFAULT_BUCKETS), so server stalls surface as rising
+  tail latency instead of a quietly reduced send rate;
+- sweep mode replays the schedule at several offered rates over real TCP
+  and reports max-sustainable-rate plus p50/p95/p99/error-rate per rate.
+
+Traffic is a configurable mix of value transfers and token-template
+calls (a per-caller balance-increment contract) from many simulated
+funded senders, all pre-signed before the clock starts so signing cost
+never pollutes the schedule.
+
+Usage (open-loop):
+    python -m ethrex_tpu.perf.loadgen --url http://127.0.0.1:8545 \
+        --key <hex> --rates 10,25,50 --duration 5 --arrivals poisson
+
+The legacy closed-loop flags (--txs/--mode) still work and run the old
+inclusion-throughput measurement unchanged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import http.client
+import json
+import queue
+import random
+import threading
+import time
+import urllib.request
+from urllib.parse import urlparse
+
+from ..crypto import secp256k1
+from ..primitives.transaction import TYPE_DYNAMIC_FEE, Transaction
+from ..utils.metrics import Metrics
+
+DEFAULT_KEY = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
+
+# counter contract: every call increments slot 0 (the "IO" load shape;
+# kept here verbatim for the utils/load_test shim)
+SSTORE_RUNTIME = "5f546001015f5500"
+SSTORE_INITCODE = "67" + SSTORE_RUNTIME + "5f5260086018f3"
+
+# token template: every call increments the CALLER-keyed storage slot —
+# the balance-update shape of an ERC20 transfer without the calldata
+# decoding (CALLER SLOAD 1 ADD CALLER SSTORE STOP)
+TOKEN_RUNTIME = "3354600101335500"
+TOKEN_INITCODE = "67" + TOKEN_RUNTIME + "5f5260086018f3"
+
+# a run is "sustainable" at an offered rate when errors stay under 1%
+# and the generator actually delivered ≥95% of the schedule (missed
+# sends mean the local worker pool, not the server, was the bottleneck)
+MAX_ERROR_RATE = 0.01
+MIN_ACHIEVED_FRAC = 0.95
+
+
+class LoadgenError(RuntimeError):
+    """Transport failure or JSON-RPC error response during a run."""
+
+
+def observe_request_latency(registry, kind: str, seconds: float):
+    """Record one send-timestamp→response latency into the run's
+    registry (same exponential-bucket ladder as the server side, so the
+    client-observed and server-observed histograms are joinable)."""
+    registry.observe("loadgen_request_seconds", seconds, {"kind": kind},
+                     help_text="Open-loop request latency measured from "
+                               "the SCHEDULED send instant to the "
+                               "response, so server stalls surface as "
+                               "latency, never as a reduced send rate")
+
+
+def build_schedule(rate: float, duration: float, arrivals: str = "fixed",
+                   seed: int = 0) -> list[float]:
+    """Arrival offsets (seconds from run start), precomputed so nothing
+    the server does can stretch the interarrival gaps.
+
+    fixed: deterministic 1/rate spacing.  poisson: exponential
+    interarrival gaps (seeded), the memoryless arrival process real
+    traffic approximates."""
+    if rate <= 0 or duration <= 0:
+        return []
+    out: list[float] = []
+    t = 0.0
+    rng = random.Random(seed)
+    while True:
+        t += (1.0 / rate) if arrivals == "fixed" else rng.expovariate(rate)
+        if t > duration:
+            return out
+        out.append(t)
+
+
+def percentile_from_rows(buckets, rows, q: float) -> float | None:
+    """Percentile estimate from cumulative-per-bucket histogram rows
+    (the _Histogram layout), interpolated inside the winning bucket and
+    capped at the last finite boundary for +Inf — the same estimator as
+    timeseries.percentiles, over absolute counts instead of deltas."""
+    if not rows:
+        return None
+    nb = len(buckets)
+    counts = [0] * (nb + 1)
+    for row in rows:
+        for i in range(nb + 1):
+            counts[i] += row[i]
+    total = counts[nb]
+    if total <= 0:
+        return None
+    rank = q * total
+    value = buckets[-1]
+    lower, prev = 0.0, 0
+    for i, le in enumerate(buckets):
+        if counts[i] >= rank:
+            span = counts[i] - prev
+            frac = (rank - prev) / span if span else 1.0
+            value = lower + frac * (le - lower)
+            break
+        lower, prev = le, counts[i]
+    return value
+
+
+def derive_secrets(n: int, seed: int = 0) -> list[int]:
+    """Deterministic simulated-sender keys (never real funds)."""
+    out = []
+    for i in range(n):
+        h = hashlib.sha256(f"ethrex-loadgen-{seed}-{i}".encode()).digest()
+        out.append(int.from_bytes(h, "big") % (secp256k1.N - 1) + 1)
+    return out
+
+
+class RpcConn:
+    """One persistent JSON-RPC HTTP connection (keep-alive), with a
+    single reconnect retry so a server-side idle close between runs does
+    not read as a request error."""
+
+    def __init__(self, url: str, timeout: float = 30.0):
+        u = urlparse(url)
+        self.host = u.hostname or "127.0.0.1"
+        self.port = u.port or 80
+        self.path = u.path or "/"
+        self.timeout = timeout
+        self._conn: http.client.HTTPConnection | None = None
+
+    def close(self):
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+    def post(self, body: bytes) -> dict:
+        data = None
+        for attempt in (0, 1):
+            try:
+                if self._conn is None:
+                    self._conn = http.client.HTTPConnection(
+                        self.host, self.port, timeout=self.timeout)
+                self._conn.request("POST", self.path, body,
+                                   {"Content-Type": "application/json"})
+                resp = self._conn.getresponse()
+                data = resp.read()
+                if resp.status != 200:
+                    raise LoadgenError(f"HTTP {resp.status}")
+                break
+            except (http.client.HTTPException, OSError) as exc:
+                self.close()
+                if attempt:
+                    raise LoadgenError(f"transport: {exc}") from exc
+        try:
+            return json.loads(data)
+        except (json.JSONDecodeError, TypeError) as exc:
+            raise LoadgenError(f"bad response: {exc}") from exc
+
+    def call(self, method: str, params: list):
+        out = self.post(json.dumps(
+            {"jsonrpc": "2.0", "id": 1, "method": method,
+             "params": params}).encode())
+        if "error" in out:
+            raise LoadgenError(f"{method}: {out['error']}")
+        return out.get("result")
+
+
+def _body(method: str, params: list, rid: int = 1) -> bytes:
+    return json.dumps({"jsonrpc": "2.0", "id": rid, "method": method,
+                       "params": params}).encode()
+
+
+class Harness:
+    """Open-loop load harness against one JSON-RPC endpoint.
+
+    payload="tx" sends pre-signed transactions from `senders` simulated
+    accounts (mix of transfers and token-template calls; requires
+    setup() against a funded root key).  payload="ping" sends
+    eth_blockNumber — serving-layer load with no chain setup, which is
+    what the open-loop unit tests and read-path sweeps use."""
+
+    def __init__(self, url: str, key: int = DEFAULT_KEY, senders: int = 8,
+                 token_frac: float = 0.25, workers: int = 16,
+                 timeout: float = 10.0, seed: int = 0,
+                 payload: str = "tx"):
+        self.url = url
+        self.key = key
+        self.token_frac = token_frac
+        self.workers = workers
+        self.timeout = timeout
+        self.seed = seed
+        self.payload = payload
+        self.secrets = derive_secrets(senders, seed) if payload == "tx" \
+            else []
+        self.addresses = [secp256k1.pubkey_to_address(
+            secp256k1.pubkey_from_secret(s)) for s in self.secrets]
+        self.chain_id: int | None = None
+        self.token_address: bytes | None = None
+
+    # -- setup (closed-loop, before any clock starts) -------------------
+    def setup(self, fund_wei: int = 10 ** 18,
+              produce: bool = True) -> None:
+        """Fund the simulated senders from the root key and deploy the
+        token template.  Runs closed-loop: setup cost must never pollute
+        the measured schedule."""
+        if self.payload != "tx":
+            return
+        rpc = RpcConn(self.url, timeout=30.0)
+        try:
+            self.chain_id = int(rpc.call("eth_chainId", []), 16)
+            root = secp256k1.pubkey_to_address(
+                secp256k1.pubkey_from_secret(self.key))
+            nonce = int(rpc.call("eth_getTransactionCount",
+                                 ["0x" + root.hex(), "pending"]), 16)
+            for addr in self.addresses:
+                tx = Transaction(
+                    tx_type=TYPE_DYNAMIC_FEE, chain_id=self.chain_id,
+                    nonce=nonce, max_priority_fee_per_gas=1,
+                    max_fee_per_gas=10 ** 10, gas_limit=21_000,
+                    to=addr, value=fund_wei).sign(self.key)
+                rpc.call("eth_sendRawTransaction",
+                         ["0x" + tx.encode_canonical().hex()])
+                nonce += 1
+            deploy = Transaction(
+                tx_type=TYPE_DYNAMIC_FEE, chain_id=self.chain_id,
+                nonce=nonce, max_priority_fee_per_gas=1,
+                max_fee_per_gas=10 ** 10, gas_limit=200_000, to=b"",
+                data=bytes.fromhex(TOKEN_INITCODE)).sign(self.key)
+            rpc.call("eth_sendRawTransaction",
+                     ["0x" + deploy.encode_canonical().hex()])
+            if produce:
+                rpc.call("ethrex_produceBlock", [])
+            receipt = None
+            deadline = time.time() + 30
+            while receipt is None and time.time() < deadline:
+                receipt = rpc.call("eth_getTransactionReceipt",
+                                   ["0x" + deploy.hash.hex()])
+                if receipt is None:
+                    time.sleep(0.2)
+            if receipt is None or receipt.get("status") != "0x1":
+                raise LoadgenError("token template deploy failed")
+            self.token_address = bytes.fromhex(
+                receipt["contractAddress"][2:])
+        finally:
+            rpc.close()
+
+    # -- request pre-build ---------------------------------------------
+    def _build_requests(self, n: int) -> list[tuple[str, bytes]]:
+        """Pre-sign/pre-encode every request body before the clock
+        starts, so signing cost cannot eat into send slots."""
+        if self.payload != "tx":
+            return [("ping", _body("eth_blockNumber", [], i))
+                    for i in range(n)]
+        if self.chain_id is None:
+            raise LoadgenError("setup() must run before a tx-mode run")
+        rpc = RpcConn(self.url, timeout=30.0)
+        try:
+            nonces = [int(rpc.call("eth_getTransactionCount",
+                                   ["0x" + a.hex(), "pending"]), 16)
+                      for a in self.addresses]
+        finally:
+            rpc.close()
+        rng = random.Random(self.seed + n)
+        out: list[tuple[str, bytes]] = []
+        for i in range(n):
+            s = i % len(self.secrets)
+            token = (self.token_address is not None
+                     and rng.random() < self.token_frac)
+            tx = Transaction(
+                tx_type=TYPE_DYNAMIC_FEE, chain_id=self.chain_id,
+                nonce=nonces[s], max_priority_fee_per_gas=1,
+                max_fee_per_gas=10 ** 10,
+                gas_limit=100_000 if token else 21_000,
+                to=self.token_address if token else bytes([0xAA]) * 20,
+                value=0 if token else 1).sign(self.secrets[s])
+            nonces[s] += 1
+            out.append(("token" if token else "transfer",
+                        _body("eth_sendRawTransaction",
+                              ["0x" + tx.encode_canonical().hex()], i)))
+        return out
+
+    # -- the open loop --------------------------------------------------
+    def run(self, rate: float, duration: float = 5.0,
+            arrivals: str = "fixed") -> dict:
+        """One open-loop run at a single offered rate over real TCP."""
+        schedule = build_schedule(rate, duration, arrivals, self.seed)
+        requests = self._build_requests(len(schedule))
+        registry = Metrics()
+        jobs: queue.Queue = queue.Queue()
+        idle = threading.Semaphore(self.workers)
+        lock = threading.Lock()
+        stats = {"sent": 0, "errors": 0}
+        kinds: dict[str, int] = {}
+
+        def worker():
+            conn = RpcConn(self.url, timeout=self.timeout)
+            try:
+                while True:
+                    item = jobs.get()
+                    if item is None:
+                        return
+                    target, kind, body = item
+                    err = False
+                    try:
+                        out = conn.post(body)
+                        err = "error" in out
+                    except LoadgenError:
+                        err = True
+                    latency = time.monotonic() - target
+                    observe_request_latency(registry, kind, latency)
+                    with lock:
+                        stats["sent"] += 1
+                        kinds[kind] = kinds.get(kind, 0) + 1
+                        if err:
+                            stats["errors"] += 1
+                    idle.release()
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self.workers)]
+        for t in threads:
+            t.start()
+        missed = 0
+        start = time.monotonic() + 0.02
+        for offset, (kind, body) in zip(schedule, requests):
+            target = start + offset
+            delay = target - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            # open-loop contract: a slot with no free worker is counted
+            # and DROPPED — deferring it would serialize sends behind
+            # server latency, which is exactly coordinated omission
+            if not idle.acquire(blocking=False):
+                missed += 1
+                continue
+            jobs.put((target, kind, body))
+        for _ in threads:
+            jobs.put(None)
+        for t in threads:
+            t.join(timeout=self.timeout + 5.0)
+
+        snap = registry.snapshot()
+        hist = snap["histograms"].get("loadgen_request_seconds")
+        lat: dict = {"count": 0, "meanSeconds": None,
+                     "p50": None, "p95": None, "p99": None}
+        if hist is not None:
+            rows = [s["counts"] for s in hist["series"]]
+            buckets = hist["buckets"]
+            count = sum(r[-1] for r in rows)
+            total = sum(s["sum"] for s in hist["series"])
+            lat["count"] = count
+            lat["meanSeconds"] = (total / count) if count else None
+            for q in (0.50, 0.95, 0.99):
+                lat[f"p{int(q * 100)}"] = percentile_from_rows(
+                    buckets, rows, q)
+        sent = stats["sent"]
+        return {
+            "offeredRate": rate,
+            "arrivals": arrivals,
+            "durationSeconds": duration,
+            "scheduled": len(schedule),
+            "sent": sent,
+            "missed": missed,
+            "errors": stats["errors"],
+            "achievedRate": round(sent / duration, 3) if duration else 0.0,
+            "errorRate": round(stats["errors"] / sent, 6) if sent else 0.0,
+            "kinds": dict(sorted(kinds.items())),
+            "latency": lat,
+        }
+
+    def sweep(self, rates, duration: float = 5.0,
+              arrivals: str = "fixed",
+              max_error_rate: float = MAX_ERROR_RATE,
+              min_achieved_frac: float = MIN_ACHIEVED_FRAC) -> dict:
+        """Run the schedule at each offered rate (ascending) and report
+        the highest rate the server sustained: errors under
+        max_error_rate and ≥ min_achieved_frac of the schedule actually
+        delivered."""
+        results = [self.run(r, duration, arrivals)
+                   for r in sorted(rates)]
+        sustainable = None
+        for rep in results:
+            offered = rep["offeredRate"]
+            delivered = rep["sent"] / rep["scheduled"] \
+                if rep["scheduled"] else 0.0
+            if (rep["errorRate"] <= max_error_rate
+                    and delivered >= min_achieved_frac):
+                sustainable = offered
+        return {
+            "arrivals": arrivals,
+            "durationSeconds": duration,
+            "maxSustainableRate": sustainable,
+            "maxErrorRate": max_error_rate,
+            "minAchievedFrac": min_achieved_frac,
+            "rates": results,
+        }
+
+
+# ---------------------------------------------------------------------------
+# legacy closed-loop generator (moved verbatim from utils/load_test.py;
+# measures inclusion throughput, NOT serving tail — see module docstring)
+
+
+def _rpc(url: str, method: str, *params):
+    payload = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                          "params": list(params)}).encode()
+    req = urllib.request.Request(
+        url, data=payload, headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        out = json.loads(resp.read())
+    if "error" in out:
+        raise RuntimeError(f"{method}: {out['error']}")
+    return out["result"]
+
+
+def run_load(url: str, secret: int, num_txs: int,
+             mode: str = "transfer") -> dict:
+    sender = secp256k1.pubkey_to_address(
+        secp256k1.pubkey_from_secret(secret))
+    chain_id = int(_rpc(url, "eth_chainId"), 16)
+    nonce = int(_rpc(url, "eth_getTransactionCount",
+                     "0x" + sender.hex(), "pending"), 16)
+    target = bytes.fromhex("aa" * 20)
+    gas_limit = 21000
+    data = b""
+    if mode == "sstore":
+        deploy = Transaction(
+            tx_type=TYPE_DYNAMIC_FEE, chain_id=chain_id, nonce=nonce,
+            max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+            gas_limit=200_000, to=b"",
+            data=bytes.fromhex(SSTORE_INITCODE)).sign(secret)
+        _rpc(url, "eth_sendRawTransaction",
+             "0x" + deploy.encode_canonical().hex())
+        receipt = None
+        deadline = time.time() + 30
+        while receipt is None and time.time() < deadline:
+            receipt = _rpc(url, "eth_getTransactionReceipt",
+                           "0x" + deploy.hash.hex())
+            time.sleep(0.2)
+        if receipt is None:
+            raise RuntimeError("deploy was not mined")
+        if receipt["status"] != "0x1":
+            raise RuntimeError("counter deploy reverted")
+        target = bytes.fromhex(receipt["contractAddress"][2:])
+        gas_limit = 100_000
+        nonce += 1
+
+    start_block = int(_rpc(url, "eth_blockNumber"), 16)
+    t0 = time.time()
+    for i in range(num_txs):
+        tx = Transaction(
+            tx_type=TYPE_DYNAMIC_FEE, chain_id=chain_id, nonce=nonce + i,
+            max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+            gas_limit=gas_limit, to=target, value=1 if mode == "transfer"
+            else 0, data=data).sign(secret)
+        _rpc(url, "eth_sendRawTransaction",
+             "0x" + tx.encode_canonical().hex())
+    submit_time = time.time() - t0
+
+    # wait for full inclusion (incremental scan: only NEW blocks per poll)
+    deadline = time.time() + 120
+    included = 0
+    gas_used = 0
+    scanned = start_block
+    while time.time() < deadline:
+        head = int(_rpc(url, "eth_blockNumber"), 16)
+        for n in range(scanned + 1, head + 1):
+            blk = _rpc(url, "eth_getBlockByNumber", hex(n), False)
+            included += len(blk["transactions"])
+            gas_used += int(blk["gasUsed"], 16)
+        scanned = max(scanned, head)
+        if included >= num_txs:  # the sstore deploy mines BEFORE start_block
+            break
+        time.sleep(0.3)
+    total = time.time() - t0
+    return {
+        "mode": mode,
+        "txs_submitted": num_txs,
+        "txs_included": included,
+        "submit_tps": round(num_txs / submit_time, 1),
+        "end_to_end_tps": round(included / total, 1),
+        "mgas_per_s": round(gas_used / total / 1e6, 3),
+        "wall_s": round(total, 2),
+    }
+
+
+# ---------------------------------------------------------------------------
+# CLI — open-loop when --rate/--rates given, legacy closed-loop otherwise
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="ethrex-tpu-loadgen")
+    parser.add_argument("--url", default="http://127.0.0.1:8545")
+    parser.add_argument("--key", default=hex(DEFAULT_KEY),
+                        help="funded root key (hex) used to fund the "
+                             "simulated senders")
+    # open-loop flags
+    parser.add_argument("--rate", type=float, default=0.0,
+                        help="open-loop offered rate (req/s); 0 = use "
+                             "--rates or the legacy closed-loop path")
+    parser.add_argument("--rates", default="",
+                        help="comma-separated offered rates for a sweep "
+                             "(e.g. 10,25,50)")
+    parser.add_argument("--duration", type=float, default=5.0,
+                        help="seconds per offered rate")
+    parser.add_argument("--arrivals", choices=("fixed", "poisson"),
+                        default="fixed")
+    parser.add_argument("--senders", type=int, default=8,
+                        help="simulated funded sender accounts")
+    parser.add_argument("--token-frac", type=float, default=0.25,
+                        dest="token_frac",
+                        help="fraction of requests that call the token "
+                             "template instead of a plain transfer")
+    parser.add_argument("--workers", type=int, default=16,
+                        help="max concurrent in-flight requests; a full "
+                             "pool at a send slot counts a miss")
+    parser.add_argument("--timeout", type=float, default=10.0)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--payload", choices=("tx", "ping"), default="tx",
+                        help="tx = signed transfers/token calls (needs a "
+                             "funded --key); ping = eth_blockNumber only")
+    # legacy closed-loop flags
+    parser.add_argument("--txs", type=int, default=200)
+    parser.add_argument("--mode", choices=("transfer", "sstore"),
+                        default="transfer")
+    args = parser.parse_args(argv)
+
+    rates = [float(r) for r in args.rates.split(",") if r.strip()]
+    if args.rate > 0:
+        rates.append(args.rate)
+    if rates:
+        harness = Harness(args.url, key=int(args.key, 16),
+                          senders=args.senders,
+                          token_frac=args.token_frac,
+                          workers=args.workers, timeout=args.timeout,
+                          seed=args.seed, payload=args.payload)
+        harness.setup()
+        if len(rates) == 1:
+            result = harness.run(rates[0], args.duration, args.arrivals)
+        else:
+            result = harness.sweep(rates, args.duration, args.arrivals)
+    else:
+        result = run_load(args.url, int(args.key, 16), args.txs,
+                          args.mode)
+    print(json.dumps(result, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -5,36 +5,80 @@ round-1 transport, the C++ server replaces it behind the same handlers)."""
 from __future__ import annotations
 
 import json
+import logging
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from ..utils.metrics import observe_rpc_request
-from ..utils.tracing import TRACER
+from ..utils.metrics import (METRICS, observe_rpc_queue_wait,
+                             observe_rpc_request, record_rpc_accept,
+                             record_rpc_backlog, record_rpc_bytes,
+                             record_rpc_eof, record_rpc_inflight,
+                             record_rpc_method_inflight, record_rpc_reset,
+                             record_rpc_slow_request)
+from ..utils.tracing import TRACER, trace_context
 
 from .eth import (CLIENT_NAME, CLIENT_VERSION, EthApi,
                   RpcError)  # noqa: F401 (RpcError used below)
+
+LOG = logging.getLogger("ethrex.rpc")
+
+# Requests slower than this emit one structured log line (with the trace
+# ID) and bump rpc_slow_requests_total.  Env override so operators can
+# tighten it without a restart script change.
+SLOW_REQUEST_SECONDS = float(os.environ.get("ETHREX_RPC_SLOW_SECONDS",
+                                            "1.0"))
+DEFAULT_BACKLOG = 128
 
 
 class _Httpd(ThreadingHTTPServer):
     # The socketserver default backlog of 5 lets the kernel RST
     # connections when a burst of clients connects faster than the
     # accept loop drains (the reset shows up client-side as
-    # ConnectionResetError 104, not a clean HTTP error).
-    request_queue_size = 128
+    # ConnectionResetError 104, not a clean HTTP error).  Configurable
+    # via --rpc-backlog / ETHREX_RPC_BACKLOG; saturation shows up in
+    # rpc_connections_reset_total instead of silent kernel RSTs.
+    request_queue_size = DEFAULT_BACKLOG
+
+    def __init__(self, addr, handler, backlog: int | None = None):
+        if backlog is not None:
+            # instance attribute shadows the class default; read by
+            # server_activate() -> socket.listen()
+            self.request_queue_size = int(backlog)
+        # accept timestamps keyed by connection object id: stamped on
+        # the accept-loop thread (process_request), consumed on the
+        # handler thread (finish_request) — the queue-wait measurement
+        self._accepted_at: dict[int, float] = {}
+        super().__init__(addr, handler)
+
+    def process_request(self, request, client_address):
+        self._accepted_at[id(request)] = time.monotonic()
+        record_rpc_accept()
+        super().process_request(request, client_address)
+
+    def finish_request(self, request, client_address):
+        t0 = self._accepted_at.pop(id(request), None)
+        if t0 is not None:
+            observe_rpc_queue_wait(time.monotonic() - t0)
+        super().finish_request(request, client_address)
 
 
 class RpcServer:
     def __init__(self, node, host: str = "127.0.0.1", port: int = 8545,
                  jwt_secret: bytes | None = None, engine: bool = False,
-                 admin: bool = False):
+                 admin: bool = False, backlog: int | None = None):
         self.node = node
         self.eth = EthApi(node)
         self.host = host
         self.port = port
         self.jwt_secret = jwt_secret
         self.admin_enabled = admin
+        self.backlog = backlog
         self._httpd: ThreadingHTTPServer | None = None
+        self._inflight_lock = threading.Lock()
+        self._inflight = 0
+        self._inflight_by_method: dict[str, int] = {}
         self.methods = self._build_methods()
         if engine:
             from .engine import EngineApi
@@ -151,6 +195,14 @@ class RpcServer:
             "ethrex_perf": lambda: _perf(node),
         }
 
+    def _track_inflight(self, method: str, delta: int):
+        with self._inflight_lock:
+            self._inflight += delta
+            cur = self._inflight_by_method.get(method, 0) + delta
+            self._inflight_by_method[method] = cur
+            record_rpc_inflight(self._inflight)
+            record_rpc_method_inflight(method, cur)
+
     def handle(self, request: dict):
         if "method" not in request:
             return _err(None, -32600, "invalid request")
@@ -160,19 +212,30 @@ class RpcServer:
         fn = self.methods.get(method)
         if fn is None:
             return _err(rid, -32601, f"method {method} not found")
+        self._track_inflight(method, +1)
         t0 = time.perf_counter()
-        try:
-            result = fn(*params)
-            return {"jsonrpc": "2.0", "id": rid, "result": result}
-        except RpcError as ex:
-            return _err(rid, ex.code, ex.message, ex.data)
-        except TypeError as ex:
-            return _err(rid, -32602, f"invalid params: {ex}")
-        except Exception as ex:  # noqa: BLE001 — RPC boundary
-            return _err(rid, -32603, f"internal error: {ex}")
-        finally:
-            # known methods only, so label cardinality stays bounded
-            observe_rpc_request(method, time.perf_counter() - t0)
+        # every request runs under a trace context, so nested spans
+        # correlate and the slow-request log line carries the trace ID
+        with trace_context(None) as trace_id:
+            try:
+                result = fn(*params)
+                return {"jsonrpc": "2.0", "id": rid, "result": result}
+            except RpcError as ex:
+                return _err(rid, ex.code, ex.message, ex.data)
+            except TypeError as ex:
+                return _err(rid, -32602, f"invalid params: {ex}")
+            except Exception as ex:  # noqa: BLE001 — RPC boundary
+                return _err(rid, -32603, f"internal error: {ex}")
+            finally:
+                elapsed = time.perf_counter() - t0
+                # known methods only, so label cardinality stays bounded
+                observe_rpc_request(method, elapsed)
+                self._track_inflight(method, -1)
+                if elapsed >= SLOW_REQUEST_SECONDS:
+                    record_rpc_slow_request()
+                    LOG.warning("slow rpc request method=%s "
+                                "seconds=%.3f traceId=%s",
+                                method, elapsed, trace_id)
 
     # ------------------------------------------------------------------
     def start(self):
@@ -180,6 +243,15 @@ class RpcServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_POST(self):
+                try:
+                    self._do_post()
+                except (ConnectionResetError, BrokenPipeError):
+                    # the client hung up mid-request/mid-response — the
+                    # backlog-pressure signal, never a server traceback
+                    record_rpc_reset()
+                    self.close_connection = True
+
+            def _do_post(self):
                 if server.jwt_secret is not None:
                     from .engine import jwt_verify
 
@@ -192,6 +264,11 @@ class RpcServer:
                         return
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
+                if len(body) < length:
+                    # peer closed before the full body arrived
+                    record_rpc_eof()
+                    self.close_connection = True
+                    return
                 try:
                     req = json.loads(body)
                 except json.JSONDecodeError:
@@ -202,6 +279,7 @@ class RpcServer:
                     else:
                         resp = server.handle(req)
                 data = json.dumps(resp).encode()
+                record_rpc_bytes(len(body), len(data))
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
@@ -211,8 +289,10 @@ class RpcServer:
             def log_message(self, *args):
                 pass
 
-        self._httpd = _Httpd((self.host, self.port), Handler)
+        self._httpd = _Httpd((self.host, self.port), Handler,
+                             backlog=self.backlog)
         self.port = self._httpd.server_address[1]
+        record_rpc_backlog(self._httpd.request_queue_size)
         thread = threading.Thread(target=self._httpd.serve_forever,
                                   daemon=True)
         thread.start()
@@ -535,10 +615,34 @@ def _debug_snapshot(node):
     return bundle
 
 
+def _rpc_traffic_json() -> dict:
+    """Request-lifecycle counters/gauges for ethrex_health: connection
+    churn, in-flight work, byte totals and the configured backlog —
+    read straight from the global registry."""
+    with METRICS.lock:
+        c = dict(METRICS.counters)
+        g = dict(METRICS.gauges)
+    return {
+        "accepted": int(c.get("rpc_connections_accepted_total", 0)),
+        "resets": int(c.get("rpc_connections_reset_total", 0)),
+        "eof": int(c.get("rpc_connections_eof_total", 0)),
+        "inflight": int(g.get("rpc_inflight_requests", 0)),
+        "listenBacklog": g.get("rpc_listen_backlog"),
+        "requestBytes": int(c.get("rpc_request_bytes_total", 0)),
+        "responseBytes": int(c.get("rpc_response_bytes_total", 0)),
+        "slowRequests": int(c.get("rpc_slow_requests_total", 0)),
+        "wsConnections": int(g.get("ws_connections", 0)),
+        "wsNotifications": int(c.get("ws_notifications_total", 0)),
+        "wsSendFailures": int(c.get("ws_send_failures_total", 0)),
+    }
+
+
 def _health(node):
     out = {
         "head": node.store.latest_number(),
         "mempool": len(node.mempool),
+        "mempoolFlow": node.mempool.stats_json(),
+        "rpc": _rpc_traffic_json(),
         "peers": _peer_count(node),
         "tracing": {"bufferedTraces": len(TRACER),
                     "droppedTraces": TRACER.dropped},

@@ -18,6 +18,10 @@ import socket
 import struct
 import threading
 
+from ..utils.metrics import (record_ws_accept, record_ws_connections,
+                             record_ws_notification,
+                             record_ws_send_failure)
+
 _GUID = b"258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 
 OP_TEXT = 0x1
@@ -129,6 +133,10 @@ class WsConnection:
         self.subs: dict[str, _Subscription] = {}
         self.send_lock = threading.Lock()
         self.alive = True
+        # per-connection lifecycle counters (surfaced by the fan-out
+        # tests and useful when debugging a lagging subscriber)
+        self.notifications_sent = 0
+        self.send_failures = 0
 
     def send_json(self, obj) -> bool:
         data = json.dumps(obj).encode()
@@ -141,10 +149,17 @@ class WsConnection:
             return False
 
     def notify(self, sid: str, result) -> bool:
-        return self.send_json({
+        ok = self.send_json({
             "jsonrpc": "2.0", "method": "eth_subscription",
             "params": {"subscription": sid, "result": result},
         })
+        if ok:
+            self.notifications_sent += 1
+            record_ws_notification()
+        else:
+            self.send_failures += 1
+            record_ws_send_failure()
+        return ok
 
     def handle_request(self, req: dict):
         method = req.get("method")
@@ -210,6 +225,7 @@ class WsConnection:
         finally:
             self.alive = False
             self.server.connections.discard(self)
+            record_ws_connections(len(self.server.connections))
             try:
                 self.sock.close()
             except OSError:
@@ -219,10 +235,12 @@ class WsConnection:
 class WsServer:
     """WebSocket endpoint bound to an RpcServer's method table."""
 
-    def __init__(self, rpc_server, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, rpc_server, host: str = "127.0.0.1", port: int = 0,
+                 backlog: int | None = None):
         self.rpc = rpc_server
         self.node = rpc_server.node
-        self.listener = socket.create_server((host, port))
+        self.listener = socket.create_server(
+            (host, port), backlog=backlog)
         self.host, self.port = self.listener.getsockname()[:2]
         self.connections: set[WsConnection] = set()
         self._stop = threading.Event()
@@ -316,6 +334,8 @@ class WsServer:
                 continue
             conn = WsConnection(self, sock)
             self.connections.add(conn)
+            record_ws_accept()
+            record_ws_connections(len(self.connections))
             threading.Thread(target=conn.run, daemon=True).start()
 
     def start(self):

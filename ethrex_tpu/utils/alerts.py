@@ -177,6 +177,13 @@ def p95_signal(histogram: str, window: float = 300.0):
     return sig
 
 
+def p99_signal(histogram: str, window: float = 300.0):
+    def sig(eng, node):
+        p = eng.percentiles(histogram, qs=(0.99,), window=window)
+        return None if p is None else p.get("p99")
+    return sig
+
+
 def gauge_signal(gauge: str):
     """Latest sampled value of a plain gauge (None before the first
     sample, so a node that never touched the subsystem never alerts)."""
@@ -308,6 +315,37 @@ def default_rules(node=None) -> list:
                    "the last bench_history.jsonl record; a collapsed "
                    "kernel usually means recompilation churn or a "
                    "fallen-back backend."),
+        # RPC serving tail (the item-3 front-door SLO; thresholds match
+        # the serving bench gate in docs/PERFORMANCE.md)
+        mk("rpc_request_p99:page", "page",
+           p99_signal("rpc_request_seconds", window=120.0), 2.0,
+           window=120.0, for_count=2, resolve_count=3,
+           description="JSON-RPC p99 over 2m exceeds 2s",
+           runbook="Check rpc_queue_wait_seconds (thread-pool backlog) "
+                   "vs rpc_request_seconds per method, and "
+                   "rpc_inflight_requests for a concurrency pile-up."),
+        mk("rpc_request_p99:warn", "warn",
+           p99_signal("rpc_request_seconds", window=600.0), 0.5,
+           window=600.0, for_count=3, resolve_count=3,
+           description="JSON-RPC p99 over 10m exceeds 0.5s",
+           runbook="Compare against the serving record in "
+                   "bench_history.jsonl; see ethrex_health rpc section "
+                   "for resets/EOFs under load."),
+        # mempool saturation — sustained occupancy near capacity means
+        # admissions are evicting (pool churn, dropped txs)
+        mk("mempool_saturation:page", "page",
+           gauge_signal("mempool_utilization"), 0.98,
+           window=60.0, for_count=3, resolve_count=3,
+           description="Mempool at 98%+ of capacity for 3 evals",
+           runbook="Check ethrex_health mempoolFlow topSenders for a "
+                   "spammer and mempool_evictions_by_reason for churn."),
+        mk("mempool_saturation:warn", "warn",
+           gauge_signal("mempool_utilization"), 0.8,
+           window=300.0, for_count=3, resolve_count=3,
+           description="Mempool above 80% of capacity",
+           runbook="Inclusion is falling behind admission; compare "
+                   "mempool_time_in_pool_seconds against the block "
+                   "interval."),
     ]
 
 

@@ -1,124 +1,31 @@
-"""Load generator (parity target: the reference's tooling/load_test —
-eth-transfer / ERC20-style load against a node's JSON-RPC, measuring
-inclusion throughput).
+"""Thin shim over the load harness (ethrex_tpu/perf/loadgen.py).
 
-Usage:
+The closed-loop load generator that lived here (parity target: the
+reference's tooling/load_test — eth-transfer / ERC20-style load against
+a node's JSON-RPC, measuring inclusion throughput) moved into the perf
+package, where the OPEN-loop harness now also lives.  This file keeps
+the historical entry point working:
+
     python -m ethrex_tpu.utils.load_test --url http://127.0.0.1:8545 \
         --key <hex> --txs 500 [--mode transfer|sstore]
+
+Everything public is re-exported so `from ethrex_tpu.utils.load_test
+import run_load` users (tests, scripts) see the same API as before the
+move.  New work should import `ethrex_tpu.perf.loadgen` directly — it
+adds the open-loop Harness (fixed/Poisson schedules, missed-send
+accounting, p50/p95/p99 per offered rate) this closed-loop path cannot
+measure.
 """
 
 from __future__ import annotations
 
-import argparse
-import json
-import time
-import urllib.request
-
-from ..crypto import secp256k1
-from ..primitives.transaction import TYPE_DYNAMIC_FEE, Transaction
-
-# counter contract: every call increments slot 0 (the "IO" load shape)
-SSTORE_RUNTIME = "5f546001015f5500"
-SSTORE_INITCODE = "67" + SSTORE_RUNTIME + "5f5260086018f3"
-
-
-def _rpc(url: str, method: str, *params):
-    payload = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
-                          "params": list(params)}).encode()
-    req = urllib.request.Request(
-        url, data=payload, headers={"Content-Type": "application/json"})
-    with urllib.request.urlopen(req, timeout=30) as resp:
-        out = json.loads(resp.read())
-    if "error" in out:
-        raise RuntimeError(f"{method}: {out['error']}")
-    return out["result"]
-
-
-def run_load(url: str, secret: int, num_txs: int,
-             mode: str = "transfer") -> dict:
-    sender = secp256k1.pubkey_to_address(
-        secp256k1.pubkey_from_secret(secret))
-    chain_id = int(_rpc(url, "eth_chainId"), 16)
-    nonce = int(_rpc(url, "eth_getTransactionCount",
-                     "0x" + sender.hex(), "pending"), 16)
-    target = bytes.fromhex("aa" * 20)
-    gas_limit = 21000
-    data = b""
-    if mode == "sstore":
-        deploy = Transaction(
-            tx_type=TYPE_DYNAMIC_FEE, chain_id=chain_id, nonce=nonce,
-            max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
-            gas_limit=200_000, to=b"",
-            data=bytes.fromhex(SSTORE_INITCODE)).sign(secret)
-        _rpc(url, "eth_sendRawTransaction",
-             "0x" + deploy.encode_canonical().hex())
-        receipt = None
-        deadline = time.time() + 30
-        while receipt is None and time.time() < deadline:
-            receipt = _rpc(url, "eth_getTransactionReceipt",
-                           "0x" + deploy.hash.hex())
-            time.sleep(0.2)
-        if receipt is None:
-            raise RuntimeError("deploy was not mined")
-        if receipt["status"] != "0x1":
-            raise RuntimeError("counter deploy reverted")
-        target = bytes.fromhex(receipt["contractAddress"][2:])
-        gas_limit = 100_000
-        nonce += 1
-
-    start_block = int(_rpc(url, "eth_blockNumber"), 16)
-    t0 = time.time()
-    for i in range(num_txs):
-        tx = Transaction(
-            tx_type=TYPE_DYNAMIC_FEE, chain_id=chain_id, nonce=nonce + i,
-            max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
-            gas_limit=gas_limit, to=target, value=1 if mode == "transfer"
-            else 0, data=data).sign(secret)
-        _rpc(url, "eth_sendRawTransaction",
-             "0x" + tx.encode_canonical().hex())
-    submit_time = time.time() - t0
-
-    # wait for full inclusion (incremental scan: only NEW blocks per poll)
-    deadline = time.time() + 120
-    included = 0
-    gas_used = 0
-    scanned = start_block
-    while time.time() < deadline:
-        head = int(_rpc(url, "eth_blockNumber"), 16)
-        for n in range(scanned + 1, head + 1):
-            blk = _rpc(url, "eth_getBlockByNumber", hex(n), False)
-            included += len(blk["transactions"])
-            gas_used += int(blk["gasUsed"], 16)
-        scanned = max(scanned, head)
-        if included >= num_txs:  # the sstore deploy mines BEFORE start_block
-            break
-        time.sleep(0.3)
-    total = time.time() - t0
-    return {
-        "mode": mode,
-        "txs_submitted": num_txs,
-        "txs_included": included,
-        "submit_tps": round(num_txs / submit_time, 1),
-        "end_to_end_tps": round(included / total, 1),
-        "mgas_per_s": round(gas_used / total / 1e6, 3),
-        "wall_s": round(total, 2),
-    }
-
-
-def main(argv=None):
-    parser = argparse.ArgumentParser(prog="ethrex-tpu-load-test")
-    parser.add_argument("--url", default="http://127.0.0.1:8545")
-    parser.add_argument("--key", default=hex(
-        0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8))
-    parser.add_argument("--txs", type=int, default=200)
-    parser.add_argument("--mode", choices=("transfer", "sstore"),
-                        default="transfer")
-    args = parser.parse_args(argv)
-    result = run_load(args.url, int(args.key, 16), args.txs, args.mode)
-    import sys
-
-    sys.stdout.write(json.dumps(result, indent=2) + "\n")
-
+from ..perf.loadgen import (  # noqa: F401
+    SSTORE_INITCODE,
+    SSTORE_RUNTIME,
+    _rpc,
+    main,
+    run_load,
+)
 
 if __name__ == "__main__":
     main()

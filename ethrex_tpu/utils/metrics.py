@@ -58,6 +58,8 @@ class Metrics:
         self.lock = threading.Lock()
         self.counters: dict[str, float] = {}
         self.gauges: dict[str, float] = {}
+        # labelled counter families: name -> {sorted labels tuple: value}
+        self.lcounters: dict[str, dict[tuple, float]] = {}
         # labelled gauge families: name -> {sorted labels tuple: value}
         self.lgauges: dict[str, dict[tuple, float]] = {}
         self.histograms: dict[str, _Histogram] = {}
@@ -73,6 +75,17 @@ class Metrics:
     def set(self, name: str, value: float, help_text: str = ""):
         with self.lock:
             self.gauges[name] = value
+            if help_text:
+                self.help[name] = help_text
+
+    def inc_labeled(self, name: str, labels: dict, value: float = 1.0,
+                    help_text: str = ""):
+        """Increment one series of a labelled counter family (e.g.
+        per-reason mempool rejections)."""
+        key = tuple(sorted((labels or {}).items()))
+        with self.lock:
+            fam = self.lcounters.setdefault(name, {})
+            fam[key] = fam.get(key, 0.0) + float(value)
             if help_text:
                 self.help[name] = help_text
 
@@ -121,6 +134,10 @@ class Metrics:
             return {"ts": time.time(),
                     "counters": dict(self.counters),
                     "gauges": dict(self.gauges),
+                    "labeled_counters": {
+                        name: [{"labels": dict(labels), "value": value}
+                               for labels, value in fam.items()]
+                        for name, fam in self.lcounters.items()},
                     "labeled_gauges": {
                         name: [{"labels": dict(labels), "value": value}
                                for labels, value in fam.items()]
@@ -133,6 +150,7 @@ class Metrics:
         with self.lock:
             self.counters.clear()
             self.gauges.clear()
+            self.lcounters.clear()
             self.lgauges.clear()
             self.histograms.clear()
             self.help.clear()
@@ -165,6 +183,12 @@ class Metrics:
                     lines.append(f"# HELP {name} {self.help[name]}")
                 lines.append(f"# TYPE {name} counter")
                 lines.append(f"{name} {value}")
+            for name, fam in sorted(self.lcounters.items()):
+                if name in self.help:
+                    lines.append(f"# HELP {name} {self.help[name]}")
+                lines.append(f"# TYPE {name} counter")
+                for labels, value in sorted(fam.items()):
+                    lines.append(f"{name}{{{_fmt_labels(labels)}}} {value}")
             for name, value in sorted(self.gauges.items()):
                 if name in self.help:
                     lines.append(f"# HELP {name} {self.help[name]}")
@@ -388,6 +412,132 @@ def _observe_safe(name, value, labels, help_text):
 def observe_rpc_request(method: str, seconds: float):
     _observe_safe("rpc_request_seconds", seconds, {"method": method},
                   "JSON-RPC request latency by method")
+
+
+def observe_rpc_queue_wait(seconds: float):
+    _observe_safe("rpc_queue_wait_seconds", seconds, None,
+                  "Accept-to-handler queue wait: time a connection sat "
+                  "between the accept loop and its handler thread "
+                  "picking it up (rises when the thread pool or the "
+                  "accept loop saturates)")
+
+
+def record_rpc_accept():
+    METRICS.inc("rpc_connections_accepted_total", 1,
+                "TCP connections accepted by the JSON-RPC listener")
+
+
+def record_rpc_reset():
+    METRICS.inc("rpc_connections_reset_total", 1,
+                "RPC connections that died mid-request "
+                "(ECONNRESET/EPIPE) — the backlog-pressure signal: "
+                "kernel RSTs from an overflowing listen queue land "
+                "here")
+
+
+def record_rpc_eof():
+    METRICS.inc("rpc_connections_eof_total", 1,
+                "RPC connections closed before a complete request "
+                "arrived (short body or empty read)")
+
+
+def record_rpc_bytes(request_bytes: int, response_bytes: int):
+    METRICS.inc("rpc_request_bytes_total", request_bytes,
+                "Cumulative JSON-RPC request body bytes read")
+    METRICS.inc("rpc_response_bytes_total", response_bytes,
+                "Cumulative JSON-RPC response body bytes written")
+
+
+def record_rpc_inflight(count: int):
+    METRICS.set("rpc_inflight_requests", count,
+                "JSON-RPC requests currently executing in handler "
+                "threads")
+
+
+def record_rpc_method_inflight(method: str, count: int):
+    METRICS.set_labeled("rpc_method_inflight", {"method": method}, count,
+                        help_text="Concurrent executions of one JSON-RPC "
+                                  "method right now")
+
+
+def record_rpc_backlog(size: int):
+    METRICS.set("rpc_listen_backlog", size,
+                "Configured TCP listen backlog of the JSON-RPC server "
+                "(--rpc-backlog / ETHREX_RPC_BACKLOG)")
+
+
+def record_rpc_slow_request():
+    METRICS.inc("rpc_slow_requests_total", 1,
+                "Requests slower than the slow-request threshold "
+                "(ETHREX_RPC_SLOW_SECONDS); each emits a structured "
+                "log line carrying its trace ID")
+
+
+def record_ws_connections(count: int):
+    METRICS.set("ws_connections", count,
+                "WebSocket subscription connections currently open")
+
+
+def record_ws_accept():
+    METRICS.inc("ws_connections_accepted_total", 1,
+                "WebSocket connections accepted (successful RFC 6455 "
+                "handshakes)")
+
+
+def record_ws_notification(count: int = 1):
+    METRICS.inc("ws_notifications_total", count,
+                "Subscription notification frames pushed to WebSocket "
+                "clients")
+
+
+def record_ws_send_failure():
+    METRICS.inc("ws_send_failures_total", 1,
+                "Notification pushes that failed on a dead WebSocket "
+                "(connection dropped from the fan-out set)")
+
+
+def record_mempool_admission():
+    METRICS.inc("mempool_admitted_total", 1,
+                "Transactions admitted into the mempool")
+
+
+def record_mempool_rejection(reason: str):
+    METRICS.inc("mempool_rejections_total", 1,
+                "Transactions rejected by mempool admission, any reason")
+    METRICS.inc_labeled("mempool_rejections_by_reason", {"reason": reason},
+                        1.0,
+                        help_text="Mempool admission rejections by typed "
+                                  "reason (nonce_too_low, underpriced, "
+                                  "insufficient_funds, invalid_signature, "
+                                  "pool_full, blobs_missing, privileged, "
+                                  "wrong_chain_id)")
+
+
+def record_mempool_eviction(reason: str):
+    METRICS.inc("mempool_evictions_total", 1,
+                "Transactions evicted from the mempool after admission, "
+                "any reason")
+    METRICS.inc_labeled("mempool_evictions_by_reason", {"reason": reason},
+                        1.0,
+                        help_text="Mempool evictions by reason (fifo "
+                                  "capacity, blob_pool_full, replaced, "
+                                  "invalid_at_build)")
+
+
+def record_mempool_occupancy(size: int, utilization: float):
+    METRICS.set("mempool_size", size,
+                "Transactions currently resident in the mempool")
+    METRICS.set("mempool_utilization", utilization,
+                "Mempool occupancy over capacity — the max of the "
+                "regular and blob sub-pool fill fractions (1.0 = every "
+                "new tx evicts another; the saturation alert reads "
+                "this)")
+
+
+def observe_time_in_pool(seconds: float):
+    _observe_safe("mempool_time_in_pool_seconds", seconds, None,
+                  "Admission-to-block-inclusion dwell time of mempool "
+                  "transactions (only txs that made it into a block)")
 
 
 def observe_prover_stage(stage: str, seconds: float):

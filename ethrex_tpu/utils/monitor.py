@@ -136,6 +136,58 @@ def _storage_lines(snap: dict, width: int) -> list[str]:
     ]
 
 
+def _traffic_lines(snap: dict, width: int) -> list[str]:
+    """Traffic panel: RPC request-lifecycle counters and mempool flow
+    accounting (ethrex_health `rpc` / `mempoolFlow` sections).
+    Defensive like the other panels — an older node without those
+    sections simply gets no panel."""
+    health = snap.get("health")
+    if not isinstance(health, dict):
+        return []
+    rpc = health.get("rpc")
+    flow = health.get("mempoolFlow")
+    lines: list[str] = []
+    if isinstance(rpc, dict):
+        lines.append("─" * width)
+        lines.append(" rpc traffic")
+        lines.append(
+            f"   accepted {rpc.get('accepted', '?'):<8}"
+            f" resets {rpc.get('resets', '?'):<6}"
+            f" eof {rpc.get('eof', '?'):<6}"
+            f" inflight {rpc.get('inflight', '?'):<5}"
+            f" slow {rpc.get('slowRequests', '?'):<5}"
+            f" backlog {rpc.get('listenBacklog', '—')}")
+        lines.append(
+            f"   bytes in {rpc.get('requestBytes', '?'):<12}"
+            f" out {rpc.get('responseBytes', '?'):<12}"
+            f" ws conns {rpc.get('wsConnections', '?'):<5}"
+            f" notified {rpc.get('wsNotifications', '?'):<8}"
+            f" ws fails {rpc.get('wsSendFailures', '?')}")
+    if isinstance(flow, dict):
+        lines.append("─" * width)
+        util = flow.get("utilization")
+        shown = f"{100 * util:.1f}%" if isinstance(util,
+                                                   (int, float)) else "—"
+        lines.append(
+            f" mempool flow  size {flow.get('size', '?')}"
+            f"/{flow.get('capacity', '?')}"
+            f" ({shown})  admitted {flow.get('admitted', '?')}")
+        rej = flow.get("rejections")
+        if isinstance(rej, dict) and rej:
+            lines.append("   rejected  " + "  ".join(
+                f"{k} {v}" for k, v in sorted(rej.items())))
+        ev = flow.get("evictions")
+        if isinstance(ev, dict) and ev:
+            lines.append("   evicted   " + "  ".join(
+                f"{k} {v}" for k, v in sorted(ev.items())))
+        top = flow.get("topSenders")
+        if isinstance(top, list) and top:
+            lines.append("   top senders  " + "  ".join(
+                f"{str(s.get('sender', '?'))[:12]}…({s.get('txs', '?')})"
+                for s in top[:4] if isinstance(s, dict)))
+    return lines
+
+
 def _alerts_lines(snap: dict, width: int) -> list[str]:
     """Alerts panel: firing SLO rules + most recent transitions.
     Defensive — an L1-only node answers enabled=False (no panel) and an
@@ -257,7 +309,11 @@ def render_lines(snap: dict, width: int = 100) -> list[str]:
         hl = snap["health"]
         items = hl.items() if isinstance(hl, dict) else enumerate(hl)
         for k, v in items:
+            # traffic sections render in their own panel below
+            if k in ("rpc", "mempoolFlow"):
+                continue
             lines.append(f"   {k}: {v}")
+    lines.extend(_traffic_lines(snap, width))
     lines.extend(_alerts_lines(snap, width))
     lines.extend(_perf_lines(snap, width))
     lines.extend(_latency_lines(snap, width))

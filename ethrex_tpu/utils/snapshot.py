@@ -78,6 +78,19 @@ def _perf():
             "roofline": roofline.ROOFLINE.report()}
 
 
+def _traffic(node):
+    """RPC lifecycle counters + mempool flow accounting (PERFORMANCE.md
+    traffic observability); answers even without a node for the
+    connection counters."""
+    from ..rpc.server import _rpc_traffic_json  # lazy: avoid a cycle
+
+    out = {"rpc": _rpc_traffic_json()}
+    mempool = getattr(node, "mempool", None)
+    if mempool is not None:
+        out["mempoolFlow"] = mempool.stats_json()
+    return out
+
+
 def collect(node=None, reason: str = "manual") -> dict:
     """Assemble a snapshot bundle.  Never raises; every section is
     independently guarded."""
@@ -96,6 +109,7 @@ def collect(node=None, reason: str = "manual") -> dict:
         "store": _section(lambda: _store(node)),
         "tpu": _section(jax_cache.runtime_telemetry),
         "perf": _section(_perf),
+        "traffic": _section(lambda: _traffic(node)),
     }
 
 

@@ -66,7 +66,8 @@ def _metrics_isolation():
     with METRICS.lock:
         saved = (dict(METRICS.counters), dict(METRICS.gauges),
                  copy.deepcopy(METRICS.histograms), dict(METRICS.help),
-                 copy.deepcopy(METRICS.lgauges))
+                 copy.deepcopy(METRICS.lgauges),
+                 copy.deepcopy(METRICS.lcounters))
     yield
     from ethrex_tpu.perf import profiler, roofline
     from ethrex_tpu.utils import snapshot, timeseries
@@ -85,6 +86,7 @@ def _metrics_isolation():
         METRICS.histograms = saved[2]
         METRICS.help = dict(saved[3])
         METRICS.lgauges = saved[4]
+        METRICS.lcounters = saved[5]
 
 
 @pytest.fixture(autouse=True)

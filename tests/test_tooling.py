@@ -133,8 +133,10 @@ def test_no_bare_print_in_library_modules():
 
     root = pathlib.Path(ethrex_tpu.__file__).parent
     # bench_suite is the bench.py CLI's engine: its contract is ONE JSON
-    # line on stdout per measurement, so it owns stdout like cli/repl
-    allow = {"cli.py", "repl.py", "monitor.py", "bench_suite.py"}
+    # line on stdout per measurement, so it owns stdout like cli/repl;
+    # loadgen is the load-harness CLI printing its JSON report the same way
+    allow = {"cli.py", "repl.py", "monitor.py", "bench_suite.py",
+             "loadgen.py"}
     pat = re.compile(r"(?<![A-Za-z0-9_.])print\(")
     offenders = []
     for path in sorted(root.rglob("*.py")):
@@ -228,11 +230,12 @@ def test_every_metric_helper_has_help_text():
     import ast
     import inspect
 
-    from ethrex_tpu.perf import bench_suite, profiler, roofline
+    from ethrex_tpu.blockchain import mempool
+    from ethrex_tpu.perf import bench_suite, loadgen, profiler, roofline
     from ethrex_tpu.utils import metrics
 
     offenders = []
-    for mod in (metrics, profiler, roofline, bench_suite):
+    for mod in (metrics, profiler, roofline, bench_suite, loadgen, mempool):
         tree = ast.parse(inspect.getsource(mod))
         for fn in ast.walk(tree):
             if not isinstance(fn, ast.FunctionDef):
@@ -246,9 +249,12 @@ def test_every_metric_helper_has_help_text():
                 f = call.func
                 is_metric = (
                     (isinstance(f, ast.Attribute)
-                     and f.attr in ("inc", "set", "observe", "set_labeled")
+                     and f.attr in ("inc", "set", "observe", "set_labeled",
+                                    "inc_labeled")
                      and isinstance(f.value, ast.Name)
-                     and f.value.id == "METRICS")
+                     # "registry" covers helpers writing into a run-local
+                     # Metrics() instead of the global singleton (loadgen)
+                     and f.value.id in ("METRICS", "registry"))
                     or (isinstance(f, ast.Name) and f.id == "_observe_safe"))
                 if not is_metric:
                     continue

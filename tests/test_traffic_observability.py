@@ -1,0 +1,313 @@
+"""Traffic observability: RPC request-lifecycle telemetry, mempool flow
+accounting with typed rejection reasons, and their surfaces
+(ethrex_health, flight-recorder snapshots, monitor panel, alert rules,
+the --rpc-backlog knob)."""
+
+import json
+import logging
+import urllib.request
+
+import pytest
+
+from ethrex_tpu.blockchain.mempool import (
+    BlobsMissingError,
+    InsufficientFundsError,
+    InvalidSignatureError,
+    Mempool,
+    MempoolError,
+    NonceTooLowError,
+    PrivilegedTxError,
+    UnderpricedError,
+)
+from ethrex_tpu.crypto import secp256k1
+from ethrex_tpu.node import Node
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.primitives.transaction import (TYPE_DYNAMIC_FEE,
+                                               TYPE_PRIVILEGED, Transaction)
+from ethrex_tpu.rpc.server import RpcServer
+from ethrex_tpu.utils.metrics import METRICS
+
+SECRET = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
+SENDER = secp256k1.pubkey_to_address(secp256k1.pubkey_from_secret(SECRET))
+
+GENESIS = {
+    "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+               "shanghaiTime": 0, "cancunTime": 0},
+    "alloc": {"0x" + SENDER.hex(): {"balance": hex(10**21)}},
+    "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7", "timestamp": "0x0",
+}
+
+
+def _tx(nonce, secret=SECRET, fee=10**10, value=1):
+    return Transaction(
+        tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=nonce,
+        max_priority_fee_per_gas=1, max_fee_per_gas=fee,
+        gas_limit=21_000, to=bytes([0xAA]) * 20, value=value).sign(secret)
+
+
+def _labeled(snap, name):
+    return {tuple(sorted(e["labels"].items())): e["value"]
+            for e in snap["labeled_counters"].get(name, [])}
+
+
+# ---------------------------------------------------------------------------
+# typed rejection reasons — differential against the legacy behavior
+
+def test_rejection_reasons_typed_and_counted():
+    """Every legacy rejection path must (a) raise the same-message error
+    it always raised — now as a typed subclass, still a ValueError-free
+    MempoolError — and (b) land in both the pool-local tallies and the
+    labelled registry counter under its machine-readable reason."""
+    pool = Mempool(capacity=10)
+    balance = 10**21
+    cases = []
+
+    with pytest.raises(PrivilegedTxError, match="privileged txs bypass"):
+        pool.add_transaction(
+            Transaction(tx_type=TYPE_PRIVILEGED, chain_id=1337,
+                        from_addr=SENDER, gas_limit=21_000),
+            0, balance, 7)
+    cases.append("privileged")
+
+    with pytest.raises(InvalidSignatureError, match="invalid signature"):
+        pool.add_transaction(
+            Transaction(tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=0,
+                        max_fee_per_gas=10**10, gas_limit=21_000,
+                        to=bytes(20)),   # unsigned
+            0, balance, 7)
+    cases.append("invalid_signature")
+
+    with pytest.raises(NonceTooLowError, match="nonce too low"):
+        pool.add_transaction(_tx(0), 5, balance, 7)
+    cases.append("nonce_too_low")
+
+    with pytest.raises(InsufficientFundsError, match="insufficient funds"):
+        pool.add_transaction(_tx(0), 0, 10, 7)
+    cases.append("insufficient_funds")
+
+    with pytest.raises(BlobsMissingError, match="requires blobs bundle"):
+        from ethrex_tpu.primitives.transaction import TYPE_BLOB
+
+        blob_tx = Transaction(
+            tx_type=TYPE_BLOB, chain_id=1337, nonce=0,
+            max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+            max_fee_per_blob_gas=1, gas_limit=21_000,
+            to=bytes([0xAA]) * 20).sign(SECRET)
+        pool.add_transaction(blob_tx, 0, balance, 7)
+    cases.append("blobs_missing")
+
+    pool.add_transaction(_tx(0), 0, balance, 7)
+    with pytest.raises(UnderpricedError, match="replacement underpriced"):
+        pool.add_transaction(_tx(0, fee=10**10 + 1), 0, balance, 7)
+    cases.append("underpriced")
+
+    assert pool.rejections == {r: 1 for r in cases}
+    # every typed error IS a MempoolError carrying its reason
+    for cls in (PrivilegedTxError, InvalidSignatureError, NonceTooLowError,
+                InsufficientFundsError, BlobsMissingError,
+                UnderpricedError):
+        assert issubclass(cls, MempoolError)
+        assert cls.reason in cases
+    by_reason = _labeled(METRICS.snapshot(), "mempool_rejections_by_reason")
+    for reason in cases:
+        assert by_reason[(("reason", reason),)] >= 1
+    assert METRICS.snapshot()["counters"][
+        "mempool_rejections_total"] >= len(cases)
+
+
+def test_admission_eviction_and_dwell_accounting():
+    pool = Mempool(capacity=2)
+    balance = 10**21
+    h0 = pool.add_transaction(_tx(0), 0, balance, 7)
+    pool.add_transaction(_tx(1), 0, balance, 7)
+    assert pool.admitted == 2
+    assert h0 in pool.added_at
+
+    # FIFO eviction on overflow: counted, never raised (pinned behavior)
+    pool.add_transaction(_tx(2), 0, balance, 7)
+    assert pool.evictions == {"fifo": 1}
+    assert len(pool) == 2 and h0 not in pool.by_hash
+
+    # replacement counts as its own eviction flavor
+    pool.add_transaction(_tx(2, fee=2 * 10**10), 0, balance, 7)
+    assert pool.evictions["replaced"] == 1
+
+    # inclusion observes dwell time into the histogram and is NOT an
+    # eviction
+    pool.remove_transaction(_tx(1).hash, reason="included")
+    snap = METRICS.snapshot()
+    hist = snap["histograms"]["mempool_time_in_pool_seconds"]
+    assert sum(s["counts"][-1] for s in hist["series"]) >= 1
+    assert "included" not in pool.evictions
+    assert snap["gauges"]["mempool_size"] == float(len(pool))
+
+    stats = pool.stats_json(top_k=3)
+    assert stats["admitted"] == 4
+    assert stats["evictions"] == {"fifo": 1, "replaced": 1}
+    assert stats["size"] == len(pool)
+    assert 0 < stats["utilization"] <= 1
+    assert stats["topSenders"][0]["sender"] == "0x" + SENDER.hex()
+    assert stats["topSenders"][0]["txs"] == len(pool)
+
+
+def test_wrong_chain_id_counted_at_node_boundary():
+    node = Node(Genesis.from_json(GENESIS))
+    bad = Transaction(
+        tx_type=TYPE_DYNAMIC_FEE, chain_id=2, nonce=0,
+        max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+        gas_limit=21_000, to=bytes(20), value=1).sign(SECRET)
+    from ethrex_tpu.evm.executor import InvalidTransaction
+
+    with pytest.raises(InvalidTransaction, match="wrong chain id"):
+        node.submit_transaction(bad)
+    assert node.mempool.rejections.get("wrong_chain_id") == 1
+    node.stop()
+
+
+# ---------------------------------------------------------------------------
+# RPC request-lifecycle telemetry over real TCP
+
+@pytest.fixture()
+def live_rpc():
+    node = Node(Genesis.from_json(GENESIS))
+    server = RpcServer(node, port=0, backlog=7).start()
+    url = f"http://127.0.0.1:{server.port}"
+
+    def call(method, *params):
+        payload = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                              "params": list(params)}).encode()
+        req = urllib.request.Request(
+            url, data=payload, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    yield call, node, server
+    server.stop()
+    node.stop()
+
+
+def test_request_lifecycle_metrics(live_rpc):
+    call, node, server = live_rpc
+    for _ in range(3):
+        assert call("eth_blockNumber")["result"] == "0x0"
+    snap = METRICS.snapshot()
+    # accept + queue-wait: every connection passed the accept->handler
+    # seam exactly once
+    assert snap["counters"]["rpc_connections_accepted_total"] >= 1
+    qw = snap["histograms"]["rpc_queue_wait_seconds"]
+    assert sum(s["counts"][-1] for s in qw["series"]) >= 1
+    # byte accounting in both directions
+    assert snap["counters"]["rpc_request_bytes_total"] > 0
+    assert snap["counters"]["rpc_response_bytes_total"] > 0
+    # in-flight gauges drained back to zero at rest
+    assert snap["gauges"]["rpc_inflight_requests"] == 0.0
+    for entry in snap["labeled_gauges"].get("rpc_method_inflight", []):
+        assert entry["value"] == 0.0
+    # the backlog knob is both applied and exported
+    assert server._httpd.request_queue_size == 7
+    assert snap["gauges"]["rpc_listen_backlog"] == 7.0
+
+
+def test_slow_request_logs_trace_id(live_rpc, monkeypatch, caplog):
+    call, node, server = live_rpc
+    from ethrex_tpu.rpc import server as server_mod
+
+    monkeypatch.setattr(server_mod, "SLOW_REQUEST_SECONDS", 0.0)
+    with caplog.at_level(logging.WARNING, logger="ethrex.rpc"):
+        call("eth_blockNumber")
+    slow = [r for r in caplog.records
+            if "slow rpc request" in r.getMessage()]
+    assert slow
+    msg = slow[0].getMessage()
+    assert "method=eth_blockNumber" in msg
+    assert "traceId=" in msg and "traceId=None" not in msg
+    assert METRICS.snapshot()["counters"]["rpc_slow_requests_total"] >= 1
+
+
+def test_health_exposes_traffic_sections(live_rpc):
+    call, node, server = live_rpc
+    call("eth_blockNumber")
+    health = call("ethrex_health")["result"]
+    rpc = health["rpc"]
+    for key in ("accepted", "resets", "eof", "inflight", "listenBacklog",
+                "requestBytes", "responseBytes", "slowRequests",
+                "wsConnections", "wsNotifications", "wsSendFailures"):
+        assert key in rpc, key
+    assert rpc["accepted"] >= 1
+    assert rpc["listenBacklog"] == 7
+    flow = health["mempoolFlow"]
+    for key in ("size", "capacity", "utilization", "admitted",
+                "rejections", "evictions", "topSenders"):
+        assert key in flow, key
+
+
+def test_snapshot_bundle_has_traffic_section(live_rpc):
+    call, node, server = live_rpc
+    call("eth_blockNumber")
+    from ethrex_tpu.utils import snapshot
+
+    bundle = snapshot.collect(node, reason="test")
+    traffic = bundle["traffic"]
+    assert traffic["rpc"]["accepted"] >= 1
+    assert traffic["mempoolFlow"]["size"] == 0
+    # collect() without a node still answers the rpc side
+    assert "rpc" in snapshot.collect(None)["traffic"]
+
+
+def test_monitor_renders_traffic_panel(live_rpc):
+    call, node, server = live_rpc
+    call("eth_blockNumber")
+    from ethrex_tpu.utils import monitor
+
+    health = call("ethrex_health")["result"]
+    lines = monitor._traffic_lines({"health": health}, width=100)
+    text = "\n".join(lines)
+    assert " rpc traffic" in text
+    assert "accepted" in text and "backlog 7" in text
+    assert " mempool flow" in text
+    # raw nested dicts must NOT leak into the health dump panel
+    assert "{" not in text
+
+
+# ---------------------------------------------------------------------------
+# knob plumbing + alert rules
+
+def test_cli_backlog_flag_and_env(monkeypatch):
+    from ethrex_tpu import cli
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    cli._add_node_flags(parser)
+    assert parser.parse_args([]).rpc_backlog == 128
+    assert parser.parse_args(["--rpc-backlog", "9"]).rpc_backlog == 9
+    monkeypatch.setenv("ETHREX_RPC_BACKLOG", "33")
+    parser2 = argparse.ArgumentParser()
+    cli._add_node_flags(parser2)
+    assert parser2.parse_args([]).rpc_backlog == 33
+
+
+def test_traffic_alert_rules_present_and_ordered():
+    from ethrex_tpu.utils.alerts import default_rules
+
+    rules = {r.name: r for r in default_rules()}
+    assert rules["rpc_request_p99:page"].severity == "page"
+    assert rules["rpc_request_p99:warn"].severity == "warn"
+    assert rules["mempool_saturation:page"].threshold > \
+        rules["mempool_saturation:warn"].threshold
+    for name in ("rpc_request_p99:page", "rpc_request_p99:warn",
+                 "mempool_saturation:page", "mempool_saturation:warn"):
+        assert rules[name].description and rules[name].runbook
+
+
+def test_mempool_saturation_signal_reads_occupancy_gauge():
+    """The alert signal chain end-to-end: admissions publish the
+    utilization gauge; the engine samples it; gauge_signal reads it."""
+    from ethrex_tpu.utils import timeseries
+    from ethrex_tpu.utils.alerts import gauge_signal
+
+    pool = Mempool(capacity=4)
+    pool.add_transaction(_tx(0), 0, 10**21, 7)
+    engine = timeseries.TimeSeriesEngine()
+    engine.sample_now()
+    value = gauge_signal("mempool_utilization")(engine, None)
+    assert value == pytest.approx(0.25)

@@ -135,6 +135,68 @@ def test_ws_rejects_unmasked_client_frame(ws_setup):
     assert code == 1002
 
 
+def test_ws_fanout_under_concurrent_load():
+    """Subscription fan-out under load: N concurrent subscribers over
+    real TCP all receive every newHeads push while submitter threads
+    hammer the mempool, and both the per-connection counters and the
+    global ws traffic counters account for exactly that fan-out."""
+    import threading
+
+    from ethrex_tpu.utils.metrics import METRICS
+
+    node = Node(Genesis.from_json(GENESIS))
+    rpc = RpcServer(node, port=0)
+    ws = WsServer(rpc).start()
+    n_subs, n_blocks = 6, 3
+    before = METRICS.snapshot()["counters"]
+    clients = [WsClient("127.0.0.1", ws.port) for _ in range(n_subs)]
+    try:
+        for i, client in enumerate(clients):
+            client.send({"jsonrpc": "2.0", "id": i,
+                         "method": "eth_subscribe",
+                         "params": ["newHeads"]})
+            assert client.recv()["result"].startswith("0x")
+        assert METRICS.snapshot()["gauges"]["ws_connections"] == n_subs
+
+        # concurrent load: submitter threads race block production
+        def submit(base):
+            for j in range(4):
+                try:
+                    node.submit_transaction(_transfer(base + j))
+                except Exception:
+                    pass   # nonce races are fine; load is the point
+
+        threads = [threading.Thread(target=submit, args=(k * 4,))
+                   for k in range(3)]
+        for t in threads:
+            t.start()
+        blocks = [node.produce_block() for _ in range(n_blocks)]
+        for t in threads:
+            t.join()
+
+        # every subscriber sees every head, in order
+        for client in clients:
+            hashes = [client.recv()["params"]["result"]["hash"]
+                      for _ in range(n_blocks)]
+            assert hashes == ["0x" + b.hash.hex() for b in blocks]
+        for conn in ws.connections:
+            assert conn.notifications_sent == n_blocks
+            assert conn.send_failures == 0
+    finally:
+        for client in clients:
+            client.close()
+        ws.stop()
+        node.stop()
+    after = METRICS.snapshot()["counters"]
+
+    def delta(name):
+        return after.get(name, 0) - before.get(name, 0)
+
+    assert delta("ws_connections_accepted_total") == n_subs
+    assert delta("ws_notifications_total") == n_subs * n_blocks
+    assert delta("ws_send_failures_total") == 0
+
+
 def test_ws_rejects_oversized_message(ws_setup):
     """A client-declared length beyond MAX_MESSAGE_BYTES closes with 1009
     without buffering the body."""
