@@ -8,12 +8,26 @@ import threading
 import time
 
 from ..primitives.transaction import TYPE_BLOB, Transaction
+from ..utils.faults import inject
 from ..utils.metrics import (record_mempool_admission,
                              record_mempool_eviction,
                              record_mempool_occupancy,
-                             record_mempool_rejection, observe_time_in_pool)
+                             record_mempool_rejection,
+                             record_mempool_replacement,
+                             observe_time_in_pool)
 
 MIN_REPLACEMENT_BUMP = 10  # percent
+
+# admission-control defaults (docs/OVERLOAD.md "Mempool admission"):
+# per-sender slot cap and nonce-gap limit bound what one adversarial
+# key can pin in the pool; the dynamic fee floor starts rising at
+# FEE_FLOOR_START utilization and reaches FEE_FLOOR_MAX_MULTIPLE x
+# base_fee at 100%, so `pool_full` becomes a priced signal instead of
+# an eviction scramble
+MAX_SENDER_SLOTS = 64
+MAX_NONCE_GAP = 64
+FEE_FLOOR_START = 0.85
+FEE_FLOOR_MAX_MULTIPLE = 10.0
 
 
 class MempoolError(Exception):
@@ -48,14 +62,42 @@ class UnderpricedError(MempoolError):
     reason = "underpriced"
 
 
+class ReplacementUnderpricedError(UnderpricedError):
+    """Typed replacement-by-fee rejection: same sender+nonce without the
+    >=10% effective-fee bump.  Subclasses UnderpricedError and keeps its
+    reason label and message, so the legacy rejection ledger and RPC
+    error surface stay byte-identical while callers can catch the
+    replacement case specifically."""
+
+
+class NonceGapError(MempoolError):
+    reason = "nonce_gap"
+
+
+class SenderLimitError(MempoolError):
+    reason = "sender_limit"
+
+
+class FeeBelowFloorError(MempoolError):
+    reason = "fee_below_floor"
+
+
 MAX_BLOB_MEMPOOL_SIZE = 512   # reference: mempool.rs:49
 
 
 class Mempool:
     def __init__(self, capacity: int = 10_000,
-                 blob_capacity: int = MAX_BLOB_MEMPOOL_SIZE):
+                 blob_capacity: int = MAX_BLOB_MEMPOOL_SIZE,
+                 max_sender_slots: int = MAX_SENDER_SLOTS,
+                 max_nonce_gap: int = MAX_NONCE_GAP,
+                 fee_floor_start: float = FEE_FLOOR_START,
+                 fee_floor_max_multiple: float = FEE_FLOOR_MAX_MULTIPLE):
         self.capacity = capacity
         self.blob_capacity = blob_capacity
+        self.max_sender_slots = max_sender_slots
+        self.max_nonce_gap = max_nonce_gap
+        self.fee_floor_start = fee_floor_start
+        self.fee_floor_max_multiple = fee_floor_max_multiple
         self.by_hash: dict[bytes, Transaction] = {}
         self.by_sender: dict[bytes, dict[int, Transaction]] = {}
         self.blobs_bundles: dict[bytes, object] = {}  # tx_hash -> bundle
@@ -73,6 +115,7 @@ class Mempool:
         # histogram, plus admission/rejection/eviction tallies
         self.added_at: dict[bytes, float] = {}
         self.admitted = 0
+        self.replacements = 0
         self.rejections: dict[str, int] = {}
         self.evictions: dict[str, int] = {}
 
@@ -92,11 +135,41 @@ class Mempool:
     def _publish_occupancy_locked(self) -> None:
         record_mempool_occupancy(len(self.by_hash), self._utilization())
 
+    def utilization(self) -> float:
+        """Current fill fraction (max of the regular and blob
+        sub-pools); the RPC shed-level mempool feedback reads this."""
+        with self.lock:
+            return self._utilization()
+
+    def _fee_floor_locked(self, base_fee: int) -> int:
+        regular = len(self.by_hash) - len(self.blobs_bundles)
+        util = regular / self.capacity if self.capacity else 0.0
+        if util < self.fee_floor_start:
+            return 0
+        span = (util - self.fee_floor_start) / \
+            max(1e-9, 1.0 - self.fee_floor_start)
+        mult = 1.0 + (self.fee_floor_max_multiple - 1.0) * min(1.0, span)
+        return int(max(base_fee, 1) * mult)
+
+    def fee_floor(self, base_fee: int) -> int:
+        """Dynamic admission fee floor for NEW regular slots: 0 while
+        the regular pool sits below ``fee_floor_start`` utilization,
+        then a linear ramp to ``fee_floor_max_multiple`` x base_fee at
+        100% — a full pool prices admission instead of churning its
+        FIFO eviction queue.  Replacements are exempt (they do not grow
+        the pool); so are blob txs (the blob sub-pool has its own
+        least-includable eviction rules)."""
+        with self.lock:
+            return self._fee_floor_locked(base_fee)
+
     def add_transaction(self, tx: Transaction, sender_nonce: int,
                         sender_balance: int, base_fee: int,
                         blobs_bundle=None) -> bytes:
         from ..primitives.transaction import TYPE_PRIVILEGED
 
+        # chaos seat: a slow or crashing admission path (fired OUTSIDE
+        # self.lock so an injected delay cannot serialize the pool)
+        inject("mempool.add")
         if tx.tx_type == TYPE_PRIVILEGED:
             raise self._reject(
                 PrivilegedTxError("privileged txs bypass the mempool"))
@@ -111,19 +184,46 @@ class Mempool:
             raise self._reject(
                 BlobsMissingError("blob tx requires blobs bundle"))
         with self.lock:
-            queue = self.by_sender.setdefault(sender, {})
-            existing = queue.get(tx.nonce)
+            existing_queue = self.by_sender.get(sender)
+            existing = existing_queue.get(tx.nonce) if existing_queue \
+                else None
             if existing is not None:
+                # replacement-by-fee: exempt from the sender cap, the
+                # gap limit and the fee floor — it does not grow the
+                # pool — but must clear the >=10% effective-fee bump
                 bump = existing.max_fee() * (100 + MIN_REPLACEMENT_BUMP) // 100
                 if tx.max_fee() < bump:
                     raise self._reject(
-                        UnderpricedError("replacement underpriced"))
+                        ReplacementUnderpricedError(
+                            "replacement underpriced"))
                 self.by_hash.pop(existing.hash, None)
                 self.blobs_bundles.pop(existing.hash, None)
                 self.added_at.pop(existing.hash, None)
                 self.evictions["replaced"] = \
                     self.evictions.get("replaced", 0) + 1
                 record_mempool_eviction("replaced")
+                self.replacements += 1
+                record_mempool_replacement()
+            else:
+                # NEW-slot admission rules (docs/OVERLOAD.md): bound
+                # what one key can pin, refuse unreachable nonces, and
+                # price admission when the regular pool runs hot
+                if tx.nonce - sender_nonce > self.max_nonce_gap:
+                    raise self._reject(NonceGapError(
+                        f"nonce gap {tx.nonce - sender_nonce} exceeds "
+                        f"limit {self.max_nonce_gap}"))
+                if existing_queue is not None and \
+                        len(existing_queue) >= self.max_sender_slots:
+                    raise self._reject(SenderLimitError(
+                        f"sender already holds {len(existing_queue)} "
+                        f"txs (cap {self.max_sender_slots})"))
+                if blobs_bundle is None:
+                    floor = self._fee_floor_locked(base_fee)
+                    if floor and tx.max_fee() < floor:
+                        raise self._reject(FeeBelowFloorError(
+                            f"max fee {tx.max_fee()} below dynamic "
+                            f"floor {floor}"))
+            queue = self.by_sender.setdefault(sender, {})
             queue[tx.nonce] = tx
             self.by_hash[tx.hash] = tx
             self.added_at[tx.hash] = time.monotonic()
@@ -253,6 +353,9 @@ class Mempool:
                 "blobCapacity": self.blob_capacity,
                 "utilization": round(self._utilization(), 6),
                 "admitted": self.admitted,
+                "replacements": self.replacements,
+                "senderSlotCap": self.max_sender_slots,
+                "nonceGapLimit": self.max_nonce_gap,
                 "rejections": dict(sorted(self.rejections.items())),
                 "evictions": dict(sorted(self.evictions.items())),
                 "topSenders": [{"sender": "0x" + s.hex(), "txs": n}

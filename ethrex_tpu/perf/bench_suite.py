@@ -635,15 +635,20 @@ def build_serving_record(sweep: dict, setup_s: float = 0.0,
     stages = {"setup_s": round(setup_s, 4), "sweep_s": round(sweep_s, 4)}
     return {
         "metric": "serving_rpc_p99_seconds",
+        # accepted-request p99 only: shed responses live in a separate
+        # histogram, so fast rejections cannot flatter this gate
         "value": round(lat.get("p99") or 0.0, 6),
         "unit": "s",
         "sustained_rate": sustained if sustained is not None else 0.0,
+        "shed_rate": (pick or {}).get("shedRate", 0.0),
         "arrivals": sweep.get("arrivals"),
         "rates": [{
             "offeredRate": r.get("offeredRate"),
             "achievedRate": r.get("achievedRate"),
             "errorRate": r.get("errorRate"),
             "missed": r.get("missed"),
+            "shed": r.get("shed"),
+            "shedRate": r.get("shedRate"),
             "p50": (r.get("latency") or {}).get("p50"),
             "p95": (r.get("latency") or {}).get("p95"),
             "p99": (r.get("latency") or {}).get("p99"),

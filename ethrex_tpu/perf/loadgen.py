@@ -47,6 +47,7 @@ from urllib.parse import urlparse
 from ..crypto import secp256k1
 from ..primitives.transaction import TYPE_DYNAMIC_FEE, Transaction
 from ..utils.metrics import Metrics
+from ..utils.overload import is_busy_error
 
 DEFAULT_KEY = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
 
@@ -81,6 +82,18 @@ def observe_request_latency(registry, kind: str, seconds: float):
                                "the SCHEDULED send instant to the "
                                "response, so server stalls surface as "
                                "latency, never as a reduced send rate")
+
+
+def observe_shed_latency(registry, kind: str, seconds: float):
+    """Latency of typed server-busy (shed) responses, kept in its OWN
+    histogram: the accepted-request percentiles must measure work the
+    server actually did, so shedding cannot game the serving p99
+    gate."""
+    registry.observe("loadgen_shed_seconds", seconds, {"kind": kind},
+                     help_text="Latency of typed server-busy (shed) "
+                               "responses from the scheduled send "
+                               "instant — fast sheds are the overload "
+                               "contract (docs/OVERLOAD.md)")
 
 
 def build_schedule(rate: float, duration: float, arrivals: str = "fixed",
@@ -317,7 +330,7 @@ class Harness:
         jobs: queue.Queue = queue.Queue()
         idle = threading.Semaphore(self.workers)
         lock = threading.Lock()
-        stats = {"sent": 0, "errors": 0}
+        stats = {"sent": 0, "errors": 0, "shed": 0}
         kinds: dict[str, int] = {}
 
         def worker():
@@ -329,18 +342,31 @@ class Harness:
                         return
                     target, kind, body = item
                     err = False
+                    shed = False
                     try:
                         out = conn.post(body)
-                        err = "error" in out
+                        if "error" in out:
+                            # a typed server-busy answer is graceful
+                            # shedding, not a failure — counted apart
+                            # so sweeps distinguish degradation modes
+                            if is_busy_error(out["error"]):
+                                shed = True
+                            else:
+                                err = True
                     except LoadgenError:
                         err = True
                     latency = time.monotonic() - target
-                    observe_request_latency(registry, kind, latency)
+                    if shed:
+                        observe_shed_latency(registry, kind, latency)
+                    else:
+                        observe_request_latency(registry, kind, latency)
                     with lock:
                         stats["sent"] += 1
                         kinds[kind] = kinds.get(kind, 0) + 1
                         if err:
                             stats["errors"] += 1
+                        if shed:
+                            stats["shed"] += 1
                     idle.release()
             finally:
                 conn.close()
@@ -369,20 +395,28 @@ class Harness:
             t.join(timeout=self.timeout + 5.0)
 
         snap = registry.snapshot()
-        hist = snap["histograms"].get("loadgen_request_seconds")
-        lat: dict = {"count": 0, "meanSeconds": None,
-                     "p50": None, "p95": None, "p99": None}
-        if hist is not None:
-            rows = [s["counts"] for s in hist["series"]]
-            buckets = hist["buckets"]
-            count = sum(r[-1] for r in rows)
-            total = sum(s["sum"] for s in hist["series"])
-            lat["count"] = count
-            lat["meanSeconds"] = (total / count) if count else None
-            for q in (0.50, 0.95, 0.99):
-                lat[f"p{int(q * 100)}"] = percentile_from_rows(
-                    buckets, rows, q)
+
+        def _lat(hist_name: str) -> dict:
+            hist = snap["histograms"].get(hist_name)
+            out: dict = {"count": 0, "meanSeconds": None,
+                         "p50": None, "p95": None, "p99": None}
+            if hist is not None:
+                rows = [s["counts"] for s in hist["series"]]
+                buckets = hist["buckets"]
+                count = sum(r[-1] for r in rows)
+                total = sum(s["sum"] for s in hist["series"])
+                out["count"] = count
+                out["meanSeconds"] = (total / count) if count else None
+                for q in (0.50, 0.95, 0.99):
+                    out[f"p{int(q * 100)}"] = percentile_from_rows(
+                        buckets, rows, q)
+            return out
+
+        lat = _lat("loadgen_request_seconds")
         sent = stats["sent"]
+        shed = stats["shed"]
+        # accounting identity: every scheduled slot ends up in exactly
+        # one of delivered / shed / missed (sent = delivered + shed)
         return {
             "offeredRate": rate,
             "arrivals": arrivals,
@@ -391,10 +425,14 @@ class Harness:
             "sent": sent,
             "missed": missed,
             "errors": stats["errors"],
+            "shed": shed,
+            "delivered": sent - shed,
             "achievedRate": round(sent / duration, 3) if duration else 0.0,
             "errorRate": round(stats["errors"] / sent, 6) if sent else 0.0,
+            "shedRate": round(shed / sent, 6) if sent else 0.0,
             "kinds": dict(sorted(kinds.items())),
             "latency": lat,
+            "shedLatency": _lat("loadgen_shed_seconds"),
         }
 
     def sweep(self, rates, duration: float = 5.0,
@@ -404,13 +442,14 @@ class Harness:
         """Run the schedule at each offered rate (ascending) and report
         the highest rate the server sustained: errors under
         max_error_rate and ≥ min_achieved_frac of the schedule actually
-        delivered."""
+        delivered.  A typed busy response is graceful but still NOT
+        delivered work, so shed slots count against sustainability."""
         results = [self.run(r, duration, arrivals)
                    for r in sorted(rates)]
         sustainable = None
         for rep in results:
             offered = rep["offeredRate"]
-            delivered = rep["sent"] / rep["scheduled"] \
+            delivered = rep.get("delivered", rep["sent"]) / rep["scheduled"] \
                 if rep["scheduled"] else 0.0
             if (rep["errorRate"] <= max_error_rate
                     and delivered >= min_achieved_frac):

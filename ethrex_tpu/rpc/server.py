@@ -11,12 +11,14 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..utils.faults import inject
 from ..utils.metrics import (METRICS, observe_rpc_queue_wait,
                              observe_rpc_request, record_rpc_accept,
                              record_rpc_backlog, record_rpc_bytes,
                              record_rpc_eof, record_rpc_inflight,
                              record_rpc_method_inflight, record_rpc_reset,
                              record_rpc_slow_request)
+from ..utils.overload import SERVER_BUSY_CODE, OverloadController
 from ..utils.tracing import TRACER, trace_context
 
 from .eth import (CLIENT_NAME, CLIENT_VERSION, EthApi,
@@ -30,6 +32,14 @@ LOG = logging.getLogger("ethrex.rpc")
 SLOW_REQUEST_SECONDS = float(os.environ.get("ETHREX_RPC_SLOW_SECONDS",
                                             "1.0"))
 DEFAULT_BACKLOG = 128
+
+# per-handler-thread accept-wait handoff: finish_request stamps the
+# accept->handler wait here; the FIRST request on the connection
+# consumes it (keep-alive connections serve many requests per handler
+# thread — later requests never sat in the accept queue, so charging
+# them the connection's accept wait would shed healthy persistent
+# clients)
+_TLS = threading.local()
 
 
 class _Httpd(ThreadingHTTPServer):
@@ -60,14 +70,17 @@ class _Httpd(ThreadingHTTPServer):
     def finish_request(self, request, client_address):
         t0 = self._accepted_at.pop(id(request), None)
         if t0 is not None:
-            observe_rpc_queue_wait(time.monotonic() - t0)
+            wait = time.monotonic() - t0
+            observe_rpc_queue_wait(wait)
+            _TLS.accept_wait = wait
         super().finish_request(request, client_address)
 
 
 class RpcServer:
     def __init__(self, node, host: str = "127.0.0.1", port: int = 8545,
                  jwt_secret: bytes | None = None, engine: bool = False,
-                 admin: bool = False, backlog: int | None = None):
+                 admin: bool = False, backlog: int | None = None,
+                 overload: OverloadController | None = None):
         self.node = node
         self.eth = EthApi(node)
         self.host = host
@@ -75,6 +88,14 @@ class RpcServer:
         self.jwt_secret = jwt_secret
         self.admin_enabled = admin
         self.backlog = backlog
+        # admission control (docs/OVERLOAD.md): mempool utilization
+        # feeds the shed ladder so tx submission sheds before the pool
+        # starts thrashing its eviction queues
+        self.overload = overload if overload is not None else \
+            OverloadController(mempool_probe=lambda: _mempool_util(node))
+        # expose the controller for health/snapshot surfaces that only
+        # hold the node (last-attached server wins, single-node truth)
+        node.rpc_overload = self.overload
         self._httpd: ThreadingHTTPServer | None = None
         self._inflight_lock = threading.Lock()
         self._inflight = 0
@@ -203,7 +224,7 @@ class RpcServer:
             record_rpc_inflight(self._inflight)
             record_rpc_method_inflight(method, cur)
 
-    def handle(self, request: dict):
+    def handle(self, request: dict, accepted_at: float | None = None):
         if "method" not in request:
             return _err(None, -32600, "invalid request")
         rid = request.get("id")
@@ -212,12 +233,23 @@ class RpcServer:
         fn = self.methods.get(method)
         if fn is None:
             return _err(rid, -32601, f"method {method} not found")
+        # admission control BEFORE any execution: a shed request is
+        # answered with the typed busy error and never runs, which is
+        # what keeps shed responses cheap under sustained overload
+        queue_age = None if accepted_at is None else \
+            max(0.0, time.monotonic() - accepted_at)
+        decision = self.overload.admit(method, queue_age)
+        if not decision.admitted:
+            return _err(rid, SERVER_BUSY_CODE, "server busy",
+                        decision.error_data())
         self._track_inflight(method, +1)
         t0 = time.perf_counter()
         # every request runs under a trace context, so nested spans
         # correlate and the slow-request log line carries the trace ID
         with trace_context(None) as trace_id:
             try:
+                # chaos seat: a slow or crashing handler body
+                inject("rpc.handle")
                 result = fn(*params)
                 return {"jsonrpc": "2.0", "id": rid, "result": result}
             except RpcError as ex:
@@ -227,6 +259,7 @@ class RpcServer:
             except Exception as ex:  # noqa: BLE001 — RPC boundary
                 return _err(rid, -32603, f"internal error: {ex}")
             finally:
+                self.overload.release(decision)
                 elapsed = time.perf_counter() - t0
                 # known methods only, so label cardinality stays bounded
                 observe_rpc_request(method, elapsed)
@@ -262,6 +295,15 @@ class RpcServer:
                         self.end_headers()
                         self.wfile.write(b"unauthorized")
                         return
+                # queue-age accounting: the first request on this
+                # connection carries the accept->handler wait stamped
+                # by finish_request; follow-ups on the same keep-alive
+                # connection never queued, so their age starts here
+                wait = getattr(_TLS, "accept_wait", None)
+                if wait is not None:
+                    _TLS.accept_wait = None
+                    server.overload.note_queue_wait(wait)
+                accepted_at = time.monotonic() - (wait or 0.0)
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)
                 if len(body) < length:
@@ -275,9 +317,11 @@ class RpcServer:
                     resp = _err(None, -32700, "parse error")
                 else:
                     if isinstance(req, list):
-                        resp = [server.handle(r) for r in req]
+                        resp = [server.handle(r, accepted_at=accepted_at)
+                                for r in req]
                     else:
-                        resp = server.handle(req)
+                        resp = server.handle(req,
+                                             accepted_at=accepted_at)
                 data = json.dumps(resp).encode()
                 record_rpc_bytes(len(body), len(data))
                 self.send_response(200)
@@ -631,10 +675,23 @@ def _rpc_traffic_json() -> dict:
         "requestBytes": int(c.get("rpc_request_bytes_total", 0)),
         "responseBytes": int(c.get("rpc_response_bytes_total", 0)),
         "slowRequests": int(c.get("rpc_slow_requests_total", 0)),
+        "shed": int(c.get("rpc_requests_shed_total", 0)),
+        "shedLevel": int(g.get("rpc_shed_level", 0)),
         "wsConnections": int(g.get("ws_connections", 0)),
         "wsNotifications": int(c.get("ws_notifications_total", 0)),
         "wsSendFailures": int(c.get("ws_send_failures_total", 0)),
+        "wsNotificationsDropped":
+            int(c.get("ws_notifications_dropped_total", 0)),
+        "wsSlowConsumerDisconnects":
+            int(c.get("ws_slow_consumer_disconnects_total", 0)),
     }
+
+
+def _mempool_util(node) -> float | None:
+    """Mempool fill fraction for the overload controller's shed-level
+    feedback; None (never sheds) when the node has no mempool."""
+    mempool = getattr(node, "mempool", None)
+    return mempool.utilization() if mempool is not None else None
 
 
 def _health(node):
@@ -647,6 +704,9 @@ def _health(node):
         "tracing": {"bufferedTraces": len(TRACER),
                     "droppedTraces": TRACER.dropped},
     }
+    overload = getattr(node, "rpc_overload", None)
+    if overload is not None:
+        out["rpc"]["overload"] = overload.to_json()
     alerts = getattr(node, "alerts", None)
     if alerts is not None:
         active = alerts.active()

@@ -7,6 +7,15 @@ handshake, masked client frames, text frames out, ping/pong, close.  All
 regular JSON-RPC methods route through the owning RpcServer's method
 table; eth_subscribe/eth_unsubscribe manage per-connection subscriptions
 pushed from the node's block and mempool hooks.
+
+Slow-consumer protection (docs/OVERLOAD.md): notifications are never
+sent from the fan-out loop.  Each connection owns a BOUNDED send queue
+drained by a dedicated writer thread, so one stalled subscriber cannot
+block delivery to healthy ones.  When a consumer's queue is full its
+notifications are dropped (counted), and a consumer that STAYS full
+past the slow-consumer deadline is disconnected (counted in
+ws_slow_consumer_disconnects_total) instead of holding a queue of stale
+heads forever.
 """
 
 from __future__ import annotations
@@ -14,15 +23,26 @@ from __future__ import annotations
 import base64
 import hashlib
 import json
+import os
+import queue
 import socket
 import struct
 import threading
+import time
 
 from ..utils.metrics import (record_ws_accept, record_ws_connections,
                              record_ws_notification,
-                             record_ws_send_failure)
+                             record_ws_notification_drop,
+                             record_ws_send_failure,
+                             record_ws_slow_consumer_disconnect)
 
 _GUID = b"258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+# per-connection notification queue bound + how long a consumer may
+# stay full before it is disconnected (env-tunable; docs/OVERLOAD.md)
+NOTIFY_QUEUE_SIZE = int(os.environ.get("ETHREX_WS_NOTIFY_QUEUE", "256"))
+SLOW_CONSUMER_DEADLINE = float(
+    os.environ.get("ETHREX_WS_SLOW_DEADLINE", "5.0"))
 
 OP_TEXT = 0x1
 OP_CLOSE = 0x8
@@ -137,6 +157,17 @@ class WsConnection:
         # tests and useful when debugging a lagging subscriber)
         self.notifications_sent = 0
         self.send_failures = 0
+        self.notifications_dropped = 0
+        # bounded notification queue + dedicated writer: the fan-out
+        # loop only ever enqueues (non-blocking), so a stalled consumer
+        # cannot block delivery to healthy subscribers
+        self._sendq: queue.Queue = queue.Queue(
+            maxsize=getattr(server, "notify_queue_size",
+                            NOTIFY_QUEUE_SIZE))
+        self._full_since: float | None = None
+        self._writer = threading.Thread(target=self._writer_loop,
+                                        daemon=True)
+        self._writer.start()
 
     def send_json(self, obj) -> bool:
         data = json.dumps(obj).encode()
@@ -148,18 +179,58 @@ class WsConnection:
             self.alive = False
             return False
 
-    def notify(self, sid: str, result) -> bool:
-        ok = self.send_json({
-            "jsonrpc": "2.0", "method": "eth_subscription",
-            "params": {"subscription": sid, "result": result},
-        })
-        if ok:
+    def _writer_loop(self):
+        """Drain the notification queue in order; counters tick at the
+        actual send so notifications_sent means delivered-to-socket."""
+        while True:
+            frame = self._sendq.get()
+            if frame is None:
+                return
+            try:
+                with self.send_lock:
+                    self.sock.sendall(frame)
+            except OSError:
+                self.alive = False
+                self.send_failures += 1
+                record_ws_send_failure()
+                return
             self.notifications_sent += 1
             record_ws_notification()
-        else:
-            self.send_failures += 1
-            record_ws_send_failure()
-        return ok
+
+    def notify(self, sid: str, result) -> bool:
+        frame = make_frame(OP_TEXT, json.dumps({
+            "jsonrpc": "2.0", "method": "eth_subscription",
+            "params": {"subscription": sid, "result": result},
+        }).encode())
+        try:
+            self._sendq.put_nowait(frame)
+        except queue.Full:
+            now = time.monotonic()
+            if self._full_since is None:
+                self._full_since = now
+            self.notifications_dropped += 1
+            record_ws_notification_drop()
+            deadline = getattr(self.server, "slow_consumer_deadline",
+                               SLOW_CONSUMER_DEADLINE)
+            if now - self._full_since >= deadline:
+                self._disconnect_slow()
+            return False
+        self._full_since = None
+        return True
+
+    def _disconnect_slow(self):
+        """The consumer stayed full past the deadline: close it rather
+        than serve an ever-staler backlog (docs/OVERLOAD.md)."""
+        if not self.alive:
+            return
+        self.alive = False
+        record_ws_slow_consumer_disconnect()
+        self.server.connections.discard(self)
+        record_ws_connections(len(self.server.connections))
+        try:
+            self.sock.close()
+        except OSError:
+            pass
 
     def handle_request(self, req: dict):
         method = req.get("method")
@@ -230,15 +301,25 @@ class WsConnection:
                 self.sock.close()
             except OSError:
                 pass
+            # wake the writer so the thread exits; a full queue means
+            # the writer is mid-send and will exit on the closed socket
+            try:
+                self._sendq.put_nowait(None)
+            except queue.Full:
+                pass
 
 
 class WsServer:
     """WebSocket endpoint bound to an RpcServer's method table."""
 
     def __init__(self, rpc_server, host: str = "127.0.0.1", port: int = 0,
-                 backlog: int | None = None):
+                 backlog: int | None = None,
+                 notify_queue_size: int = NOTIFY_QUEUE_SIZE,
+                 slow_consumer_deadline: float = SLOW_CONSUMER_DEADLINE):
         self.rpc = rpc_server
         self.node = rpc_server.node
+        self.notify_queue_size = notify_queue_size
+        self.slow_consumer_deadline = slow_consumer_deadline
         self.listener = socket.create_server(
             (host, port), backlog=backlog)
         self.host, self.port = self.listener.getsockname()[:2]

@@ -346,6 +346,40 @@ def default_rules(node=None) -> list:
            runbook="Inclusion is falling behind admission; compare "
                    "mempool_time_in_pool_seconds against the block "
                    "interval."),
+        # RPC load shedding — admission control actively rejecting;
+        # some shedding under a spike is the design working, sustained
+        # shedding means capacity or a stuck shed level
+        mk("rpc_shed_rate:page", "page",
+           rate_signal("rpc_requests_shed_total", window=60.0), 5.0,
+           window=60.0, for_count=2, resolve_count=3,
+           description="RPC shedding above 5 req/s over 1m",
+           runbook="Check ethrex_health rpc.overload for the shed level "
+                   "and byReason split; see docs/OVERLOAD.md for the "
+                   "level ladder and tuning knobs."),
+        mk("rpc_shed_rate:warn", "warn",
+           rate_signal("rpc_requests_shed_total", window=600.0), 0.5,
+           window=600.0, for_count=3, resolve_count=3,
+           description="RPC shedding above 0.5 req/s over 10m",
+           runbook="Sustained low-grade shedding: compare "
+                   "rpc_queue_wait_seconds against ETHREX_SHED_QUEUE_HIGH "
+                   "and check mempool utilization (level>=2 couples "
+                   "to it — docs/OVERLOAD.md)."),
+        # mempool replacement churn — high replacement-by-fee rates are
+        # a fee-bidding war or a deliberate repricing spam pattern
+        mk("mempool_replacement_churn:page", "page",
+           rate_signal("mempool_replacements_total", window=60.0), 10.0,
+           window=60.0, for_count=2, resolve_count=3,
+           description="Tx replacements above 10/s over 1m",
+           runbook="Check mempoolFlow topSenders for a single sender "
+                   "repricing in a loop; the >=10% bump rule makes this "
+                   "expensive for them (docs/OVERLOAD.md)."),
+        mk("mempool_replacement_churn:warn", "warn",
+           rate_signal("mempool_replacements_total", window=600.0), 1.0,
+           window=600.0, for_count=3, resolve_count=3,
+           description="Tx replacements above 1/s over 10m",
+           runbook="Persistent repricing churn; compare against base-fee "
+                   "movement and the dynamic fee floor in "
+                   "ethrex_health mempool stats."),
     ]
 
 

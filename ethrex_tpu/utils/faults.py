@@ -28,6 +28,13 @@ and docs/L1_SETTLEMENT_RESILIENCE.md):
                             and post-journal pre-apply (error/drop = crash
                             after the journal is durable); also fired by
                             backend.flush (see docs/STORAGE_RESILIENCE.md)
+    rpc.handle              RpcServer.handle after admission control,
+                            before the method body: delay = a slow
+                            handler (overload pressure), error/drop = a
+                            crashing handler (docs/OVERLOAD.md)
+    mempool.add             Mempool.add_transaction at entry: delay = a
+                            slow admission path, error/drop = admission
+                            crash mid-submit
 
 Fault kinds:
 
@@ -56,6 +63,8 @@ SITES = frozenset({
     "store.open",
     "store.put",
     "store.flush",
+    "rpc.handle",
+    "mempool.add",
 })
 
 KINDS = frozenset({"drop", "delay", "corrupt", "torn", "error"})

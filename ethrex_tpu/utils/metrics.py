@@ -473,6 +473,25 @@ def record_rpc_slow_request():
                 "log line carrying its trace ID")
 
 
+def record_rpc_shed(reason: str, cost_class: str):
+    METRICS.inc("rpc_requests_shed_total", 1,
+                "Requests refused by admission control with the typed "
+                "server-busy error, any reason (the shed-rate alert "
+                "reads this; docs/OVERLOAD.md)")
+    METRICS.inc_labeled("rpc_requests_shed_by_reason",
+                        {"reason": reason, "class": cost_class}, 1.0,
+                        help_text="Admission-control sheds by reason "
+                                  "(deadline, concurrency, level) and "
+                                  "cost class (read, submit, heavy)")
+
+
+def record_shed_level(level: int):
+    METRICS.set("rpc_shed_level", level,
+                "Current adaptive shed level of the RPC admission "
+                "controller (0 = admit everything, 1 = shed heavy, "
+                "2 = +submit, 3 = shed all but control)")
+
+
 def record_ws_connections(count: int):
     METRICS.set("ws_connections", count,
                 "WebSocket subscription connections currently open")
@@ -496,6 +515,20 @@ def record_ws_send_failure():
                 "(connection dropped from the fan-out set)")
 
 
+def record_ws_notification_drop():
+    METRICS.inc("ws_notifications_dropped_total", 1,
+                "Subscription notifications dropped because a "
+                "consumer's bounded send queue was full (the slow "
+                "consumer keeps its connection until the deadline)")
+
+
+def record_ws_slow_consumer_disconnect():
+    METRICS.inc("ws_slow_consumer_disconnects_total", 1,
+                "WebSocket connections force-closed because the "
+                "consumer stayed full past the slow-consumer deadline "
+                "instead of blocking fan-out for healthy subscribers")
+
+
 def record_mempool_admission():
     METRICS.inc("mempool_admitted_total", 1,
                 "Transactions admitted into the mempool")
@@ -510,7 +543,16 @@ def record_mempool_rejection(reason: str):
                                   "reason (nonce_too_low, underpriced, "
                                   "insufficient_funds, invalid_signature, "
                                   "pool_full, blobs_missing, privileged, "
-                                  "wrong_chain_id)")
+                                  "wrong_chain_id, nonce_gap, "
+                                  "sender_limit, fee_below_floor)")
+
+
+def record_mempool_replacement():
+    METRICS.inc("mempool_replacements_total", 1,
+                "Replacement-by-fee admissions (same sender+nonce with "
+                "a >=10% fee bump); the replacement-churn alert reads "
+                "this — a fee-bump war churns the pool without adding "
+                "throughput")
 
 
 def record_mempool_eviction(reason: str):

@@ -163,6 +163,12 @@ def _traffic_lines(snap: dict, width: int) -> list[str]:
             f" ws conns {rpc.get('wsConnections', '?'):<5}"
             f" notified {rpc.get('wsNotifications', '?'):<8}"
             f" ws fails {rpc.get('wsSendFailures', '?')}")
+        lines.append(
+            f"   shed {rpc.get('shed', '?'):<8}"
+            f" shed level {rpc.get('shedLevel', '?'):<4}"
+            f" ws drops {rpc.get('wsNotificationsDropped', '?'):<6}"
+            f" slow-consumer kicks "
+            f"{rpc.get('wsSlowConsumerDisconnects', '?')}")
     if isinstance(flow, dict):
         lines.append("─" * width)
         util = flow.get("utilization")

@@ -1,0 +1,365 @@
+"""Admission control / overload protection for the JSON-RPC serving path.
+
+The threaded front door (rpc/server.py) accepts everything the listen
+backlog lets through and runs every request to completion; past the
+knee of the load curve that melts p99 for *everyone* (the Tail at Scale
+argument, and DAGOR-style overload control — Zhou et al., "Overload
+Control for Scaling WeChat Microservices").  This module is the shared
+admission stage the server consults BEFORE executing a request:
+
+- **Cost classes.**  Every method maps to one of four classes:
+  ``control`` (health/alerts/admin/engine — never shed: the authenticated
+  consensus path and the operator's eyes must survive overload),
+  ``read`` (cheap state reads, the default), ``submit``
+  (eth_sendRawTransaction — work that grows the mempool), and ``heavy``
+  (debug/trace, eth_getLogs, eth_call, eth_estimateGas, eth_getProof).
+  Each class carries a concurrency limit and a queue-age deadline
+  budget.
+
+- **Shed decisions.**  ``admit()`` refuses a request when (a) it
+  already waited past its class's deadline budget (executing it would
+  spend server time on an answer the client gave up on), (b) the
+  class's concurrency limit is full, or (c) the adaptive shed level
+  says the class is switched off.  A refused request is answered with a
+  typed JSON-RPC ``server busy`` error (code ``SERVER_BUSY_CODE``)
+  carrying a machine-readable ``retryAfter`` — it is NEVER executed,
+  which is what makes shedding cheap (<10ms) while accepted work keeps
+  its latency budget.
+
+- **Adaptive shed level.**  Level 0 sheds nothing; level 1 sheds
+  ``heavy``; level 2 adds ``submit``; level 3 sheds everything but
+  ``control``.  The level is driven by the accept-to-handler queue-wait
+  signal (the existing rpc_queue_wait_seconds histogram's source),
+  mempool utilization (so tx submission sheds BEFORE the pool starts
+  thrashing its eviction queues), and sustained structural shedding
+  (deadline/concurrency refusals), with ok→shedding→recovered
+  hysteresis mirroring the alert engine's: a breach must persist
+  ``raise_hold`` seconds before the level rises, and the signal must
+  stay clear ``recover_hold`` seconds (one hysteresis window) before it
+  falls back.
+
+Tuning knobs (env, read at import): ETHREX_SHED_QUEUE_HIGH,
+ETHREX_SHED_RAISE_HOLD, ETHREX_SHED_RECOVER_HOLD,
+ETHREX_SHED_MEMPOOL_HIGH, ETHREX_SHED_RETRY_AFTER, and
+ETHREX_OVERLOAD_DISABLED=1 to turn admission control off entirely.
+See docs/OVERLOAD.md for the full contract.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import threading
+import time
+
+from .metrics import record_rpc_shed, record_shed_level
+
+LOG = logging.getLogger("ethrex.overload")
+
+# JSON-RPC application error code for "server busy" (the de-facto
+# rate-limit code used by major providers); data.retryAfter is the
+# machine-readable backoff hint.
+SERVER_BUSY_CODE = -32005
+
+QUEUE_HIGH = float(os.environ.get("ETHREX_SHED_QUEUE_HIGH", "0.25"))
+RAISE_HOLD = float(os.environ.get("ETHREX_SHED_RAISE_HOLD", "1.0"))
+RECOVER_HOLD = float(os.environ.get("ETHREX_SHED_RECOVER_HOLD", "5.0"))
+MEMPOOL_HIGH = float(os.environ.get("ETHREX_SHED_MEMPOOL_HIGH", "0.95"))
+RETRY_AFTER = float(os.environ.get("ETHREX_SHED_RETRY_AFTER", "1.0"))
+DISABLED = os.environ.get("ETHREX_OVERLOAD_DISABLED", "") == "1"
+
+# default per-class knobs: generous enough that a healthy node under
+# test-suite concurrency never sheds, tight enough that a melting node
+# stays answerable (docs/OVERLOAD.md "Defaults")
+READ_LIMIT = 128
+READ_DEADLINE = 5.0
+SUBMIT_LIMIT = 64
+SUBMIT_DEADLINE = 2.5
+HEAVY_LIMIT = 16
+HEAVY_DEADLINE = 10.0
+
+_SUBMIT_METHODS = frozenset({"eth_sendRawTransaction"})
+_HEAVY_METHODS = frozenset({
+    "eth_getLogs", "eth_call", "eth_estimateGas", "eth_getProof",
+})
+_HEAVY_PREFIXES = ("debug_",)
+_CONTROL_PREFIXES = ("engine_", "net_", "admin_", "ethrex_admin")
+_CONTROL_METHODS = frozenset({
+    "ethrex_health", "ethrex_alerts", "ethrex_debug_snapshot",
+    "web3_clientVersion",
+})
+
+
+class CostClass:
+    """One admission class: a concurrency limit (0 = unlimited), a
+    queue-age deadline budget, and the shed level at which the whole
+    class is switched off (0 = never shed)."""
+
+    __slots__ = ("name", "limit", "deadline", "shed_at")
+
+    def __init__(self, name: str, limit: int, deadline: float,
+                 shed_at: int):
+        self.name = name
+        self.limit = limit
+        self.deadline = deadline
+        self.shed_at = shed_at
+
+
+def classify(method: str) -> str:
+    """Map a JSON-RPC method name to its cost-class name."""
+    if method in _CONTROL_METHODS or \
+            method.startswith(_CONTROL_PREFIXES):
+        return "control"
+    if method in _SUBMIT_METHODS:
+        return "submit"
+    if method in _HEAVY_METHODS or method.startswith(_HEAVY_PREFIXES):
+        return "heavy"
+    return "read"
+
+
+class Decision:
+    """Outcome of one admit() call.  ``admitted`` decisions must be
+    handed back via release(); shed decisions carry the typed error
+    payload for the `server busy` response."""
+
+    __slots__ = ("admitted", "cost_class", "reason", "retry_after",
+                 "level")
+
+    def __init__(self, admitted: bool, cost_class: str,
+                 reason: str | None = None, retry_after: float = 0.0,
+                 level: int = 0):
+        self.admitted = admitted
+        self.cost_class = cost_class
+        self.reason = reason
+        self.retry_after = retry_after
+        self.level = level
+
+    def error_data(self) -> dict:
+        """The machine-readable `data` of the server-busy error
+        (docs/OVERLOAD.md "retryAfter contract")."""
+        return {
+            "reason": self.reason,
+            "class": self.cost_class,
+            "retryAfter": round(self.retry_after, 3),
+            "shedLevel": self.level,
+        }
+
+
+def is_busy_error(err) -> bool:
+    """True when a JSON-RPC error object is the typed server-busy
+    (shed) response — the classifier loadgen uses to keep graceful
+    shedding out of the generic error count."""
+    return (isinstance(err, dict)
+            and err.get("code") == SERVER_BUSY_CODE
+            and isinstance(err.get("data"), dict)
+            and "retryAfter" in err["data"])
+
+
+class OverloadController:
+    """Shared admission stage for one RPC server (thread-safe)."""
+
+    def __init__(self, *,
+                 read_limit: int = READ_LIMIT,
+                 read_deadline: float = READ_DEADLINE,
+                 submit_limit: int = SUBMIT_LIMIT,
+                 submit_deadline: float = SUBMIT_DEADLINE,
+                 heavy_limit: int = HEAVY_LIMIT,
+                 heavy_deadline: float = HEAVY_DEADLINE,
+                 queue_high: float = QUEUE_HIGH,
+                 raise_hold: float = RAISE_HOLD,
+                 recover_hold: float = RECOVER_HOLD,
+                 tick_interval: float = 0.25,
+                 signal_window: float = 5.0,
+                 shed_pressure_min: int = 3,
+                 mempool_high: float = MEMPOOL_HIGH,
+                 retry_after: float = RETRY_AFTER,
+                 mempool_probe=None,
+                 enabled: bool | None = None):
+        self.classes = {
+            "control": CostClass("control", 0, math.inf, 0),
+            "read": CostClass("read", read_limit, read_deadline, 3),
+            "submit": CostClass("submit", submit_limit,
+                                submit_deadline, 2),
+            "heavy": CostClass("heavy", heavy_limit, heavy_deadline, 1),
+        }
+        self.queue_high = queue_high
+        self.raise_hold = raise_hold
+        self.recover_hold = recover_hold
+        self.tick_interval = tick_interval
+        self.signal_window = signal_window
+        self.shed_pressure_min = shed_pressure_min
+        self.mempool_high = mempool_high
+        self.retry_after = retry_after
+        self.mempool_probe = mempool_probe
+        self.enabled = (not DISABLED) if enabled is None else enabled
+        self.level = 0
+        self.state = "ok"           # ok -> shedding -> recovered -> ok
+        self.lock = threading.Lock()
+        self._inflight = {name: 0 for name in self.classes}
+        # controller-local tallies (survive metric-registry resets, the
+        # same convention as the mempool's flow ledger)
+        self.shed_total = 0
+        self.shed_by_reason: dict[str, int] = {}
+        self.level_changes = 0
+        self._waits: list[tuple[float, float]] = []
+        self._sheds: list[float] = []      # structural-shed timestamps
+        self._last_tick = 0.0
+        self._breach_since: float | None = None
+        self._clear_since: float | None = None
+        self._level0_at: float | None = None
+
+    # -- signals -----------------------------------------------------------
+    def note_queue_wait(self, seconds: float) -> None:
+        """Feed one accept-to-handler queue wait into the shed-level
+        signal (the same measurement rpc_queue_wait_seconds records)."""
+        now = time.monotonic()
+        with self.lock:
+            self._waits.append((now, seconds))
+            self._trim_locked(now)
+
+    def _trim_locked(self, now: float) -> None:
+        horizon = now - self.signal_window
+        self._waits = [(t, w) for t, w in self._waits if t >= horizon]
+        self._sheds = [t for t in self._sheds if t >= horizon]
+
+    def _desired_level_locked(self, now: float) -> int:
+        self._trim_locked(now)
+        lvl = 0
+        waits = sorted(w for _, w in self._waits)
+        if waits:
+            # p99-ish of the recent queue waits; a single stalled accept
+            # must not flip the ladder, sustained backlog must
+            q = waits[min(len(waits) - 1,
+                          max(0, int(0.99 * len(waits))))]
+            if q >= self.queue_high:
+                lvl = 1
+            if q >= 2 * self.queue_high:
+                lvl = 2
+            if q >= 4 * self.queue_high:
+                lvl = 3
+        if self.mempool_probe is not None:
+            try:
+                util = self.mempool_probe()
+            except Exception:   # noqa: BLE001 — a probe must never shed
+                util = None
+            if util is not None and util >= self.mempool_high:
+                # the pool is about to thrash: shed submissions (level
+                # >= 2) before eviction churn eats the node
+                lvl = max(lvl, 2)
+        if len(self._sheds) >= self.shed_pressure_min:
+            # sustained structural shedding (deadline/concurrency) is
+            # itself an overload signal: switch off the heavy class
+            lvl = max(lvl, 1)
+        return lvl
+
+    def _tick_locked(self, now: float) -> None:
+        if self.tick_interval > 0 and \
+                now - self._last_tick < self.tick_interval:
+            return
+        self._last_tick = now
+        desired = self._desired_level_locked(now)
+        if desired > self.level:
+            self._clear_since = None
+            if self._breach_since is None:
+                self._breach_since = now
+            if now - self._breach_since >= self.raise_hold:
+                self._set_level_locked(desired, now)
+        elif desired < self.level:
+            self._breach_since = None
+            if self._clear_since is None:
+                self._clear_since = now
+            if now - self._clear_since >= self.recover_hold:
+                self._set_level_locked(desired, now)
+        else:
+            self._breach_since = self._clear_since = None
+            if (self.state == "recovered" and self.level == 0
+                    and self._level0_at is not None
+                    and now - self._level0_at >= self.recover_hold):
+                self.state = "ok"
+
+    def _set_level_locked(self, level: int, now: float) -> None:
+        prev = self.level
+        self.level = level
+        self.level_changes += 1
+        self._breach_since = self._clear_since = None
+        if level > 0:
+            self.state = "shedding"
+            self._level0_at = None
+        else:
+            self.state = "recovered"
+            self._level0_at = now
+        record_shed_level(level)
+        LOG.warning("shed level %d -> %d (state=%s)", prev, level,
+                    self.state)
+
+    # -- admission ---------------------------------------------------------
+    def admit(self, method: str, queue_age: float | None = None):
+        """Admission check for one request.  Returns a Decision; a
+        non-admitted decision means: answer the typed busy error NOW,
+        never execute the handler."""
+        cls = self.classes[classify(method)]
+        now = time.monotonic()
+        with self.lock:
+            self._tick_locked(now)
+            if not self.enabled or cls.name == "control":
+                self._inflight[cls.name] += 1
+                return Decision(True, cls.name)
+            if queue_age is not None and queue_age > cls.deadline:
+                # past its deadline budget: the caller has likely timed
+                # out already; executing it is pure waste
+                return self._shed_locked(cls, "deadline", now)
+            if self.level >= cls.shed_at > 0:
+                return self._shed_locked(cls, "level", now,
+                                         structural=False)
+            if cls.limit and self._inflight[cls.name] >= cls.limit:
+                return self._shed_locked(cls, "concurrency", now)
+            self._inflight[cls.name] += 1
+            return Decision(True, cls.name)
+
+    def _shed_locked(self, cls: CostClass, reason: str, now: float,
+                     structural: bool = True) -> Decision:
+        if structural:
+            # level sheds are excluded so the ladder cannot latch
+            # itself up on its own output
+            self._sheds.append(now)
+        self.shed_total += 1
+        self.shed_by_reason[reason] = \
+            self.shed_by_reason.get(reason, 0) + 1
+        retry = self.retry_after * max(1, self.level) \
+            if reason == "level" else self.retry_after
+        record_rpc_shed(reason, cls.name)
+        return Decision(False, cls.name, reason, retry, self.level)
+
+    def release(self, decision: Decision) -> None:
+        if not decision.admitted:
+            return
+        with self.lock:
+            self._inflight[decision.cost_class] -= 1
+
+    # -- introspection -----------------------------------------------------
+    def to_json(self) -> dict:
+        with self.lock:
+            return {
+                "enabled": self.enabled,
+                "level": self.level,
+                "state": self.state,
+                "levelChanges": self.level_changes,
+                "shedTotal": self.shed_total,
+                "shedByReason": dict(sorted(
+                    self.shed_by_reason.items())),
+                "classes": {
+                    name: {
+                        "limit": cls.limit,
+                        "deadlineSeconds": None
+                        if math.isinf(cls.deadline) else cls.deadline,
+                        "shedAtLevel": cls.shed_at,
+                        "inflight": self._inflight[name],
+                    } for name, cls in sorted(self.classes.items())
+                },
+                "queueHighSeconds": self.queue_high,
+                "raiseHoldSeconds": self.raise_hold,
+                "recoverHoldSeconds": self.recover_hold,
+                "mempoolHigh": self.mempool_high,
+                "retryAfterSeconds": self.retry_after,
+            }

@@ -88,6 +88,9 @@ def _traffic(node):
     mempool = getattr(node, "mempool", None)
     if mempool is not None:
         out["mempoolFlow"] = mempool.stats_json()
+    overload = getattr(node, "rpc_overload", None)
+    if overload is not None:
+        out["overload"] = overload.to_json()
     return out
 
 

@@ -1,0 +1,233 @@
+"""Overload battery: chaos coverage for the serving-path fault sites
+("rpc.handle", "mempool.add") and the 5x-overload soak proving the
+graceful-degradation contract end to end over real TCP:
+
+- the accounting identity holds exactly (scheduled = delivered + shed
+  + missed — no request is silently lost),
+- accepted requests keep their deadline budget while shedding,
+- shed responses are answered fast (never executed),
+- the shed level returns to 0 within one hysteresis window once the
+  overload stops, and
+- the run leaks no threads and no file descriptors.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from ethrex_tpu.blockchain.mempool import Mempool
+from ethrex_tpu.crypto import secp256k1
+from ethrex_tpu.node import Node
+from ethrex_tpu.perf.loadgen import Harness, RpcConn
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.primitives.transaction import TYPE_DYNAMIC_FEE, Transaction
+from ethrex_tpu.rpc.server import RpcServer
+from ethrex_tpu.utils.faults import FaultPlan, InjectedFault, injected
+from ethrex_tpu.utils.overload import (
+    SERVER_BUSY_CODE,
+    OverloadController,
+    is_busy_error,
+)
+
+SECRET = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
+SENDER = secp256k1.pubkey_to_address(secp256k1.pubkey_from_secret(SECRET))
+
+GENESIS = {
+    "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+               "shanghaiTime": 0, "cancunTime": 0},
+    "alloc": {"0x" + SENDER.hex(): {"balance": hex(10**21)}},
+    "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7", "timestamp": "0x0",
+}
+
+
+def _tx(nonce, fee=10**10):
+    return Transaction(
+        tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=nonce,
+        max_priority_fee_per_gas=1, max_fee_per_gas=fee,
+        gas_limit=21_000, to=bytes([0xAA]) * 20, value=1).sign(SECRET)
+
+
+def _req(method, rid=1):
+    return {"jsonrpc": "2.0", "id": rid, "method": method, "params": []}
+
+
+# ---------------------------------------------------------------------------
+# rpc.handle chaos: a crashing or slow handler body
+
+def test_rpc_handle_injected_error_is_contained():
+    node = Node(Genesis.from_json(GENESIS))
+    server = RpcServer(node, port=0)
+    with injected(FaultPlan(seed=1).error("rpc.handle", times=1)):
+        resp = server.handle(_req("eth_blockNumber"))
+        assert resp["error"]["code"] == -32603
+        # the budget is spent: the next request works
+        ok = server.handle(_req("eth_blockNumber"))
+    assert "result" in ok
+
+
+def test_rpc_handle_injected_drop_is_contained():
+    node = Node(Genesis.from_json(GENESIS))
+    server = RpcServer(node, port=0)
+    with injected(FaultPlan(seed=2).drop("rpc.handle", times=1)):
+        resp = server.handle(_req("eth_blockNumber"))
+    assert resp["error"]["code"] == -32603
+
+
+def test_rpc_handle_injected_delay_makes_a_slow_handler():
+    node = Node(Genesis.from_json(GENESIS))
+    server = RpcServer(node, port=0)
+    with injected(FaultPlan(seed=3).delay("rpc.handle", 0.05, times=1)):
+        t0 = time.monotonic()
+        resp = server.handle(_req("eth_blockNumber"))
+        elapsed = time.monotonic() - t0
+    assert "result" in resp
+    assert elapsed >= 0.05
+    # the seat is AFTER admission: a shed request skips the injected
+    # delay entirely (shed-early-and-cheaply)
+    ctl = server.overload
+    hold = ctl.admit("eth_blockNumber")
+    saved = ctl.classes["read"].limit
+    ctl.classes["read"].limit = 1
+    try:
+        with injected(FaultPlan(seed=4).delay("rpc.handle", 0.5)):
+            t0 = time.monotonic()
+            resp = server.handle(_req("eth_blockNumber"))
+            elapsed = time.monotonic() - t0
+    finally:
+        ctl.classes["read"].limit = saved
+        ctl.release(hold)
+    assert resp["error"]["code"] == SERVER_BUSY_CODE
+    assert elapsed < 0.25
+
+
+# ---------------------------------------------------------------------------
+# mempool.add chaos: a crashing or slow admission path
+
+def test_mempool_add_injected_error_propagates_typed():
+    pool = Mempool(capacity=10)
+    with injected(FaultPlan(seed=5).error("mempool.add", times=1)):
+        with pytest.raises(InjectedFault):
+            pool.add_transaction(_tx(0), 0, 10**21, 7)
+        # nothing was half-admitted
+        assert len(pool) == 0
+        assert pool.admitted == 0
+        pool.add_transaction(_tx(0), 0, 10**21, 7)
+    assert len(pool) == 1
+
+
+def test_mempool_add_injected_delay_outside_the_lock():
+    """The chaos seat fires before the pool lock, so a slow admission
+    cannot serialize concurrent adders behind the sleeper."""
+    pool = Mempool(capacity=10)
+    with injected(FaultPlan(seed=6).delay("mempool.add", 0.2, times=1)):
+        slow = threading.Thread(
+            target=pool.add_transaction, args=(_tx(0), 0, 10**21, 7))
+        slow.start()
+        time.sleep(0.05)          # the sleeper holds the seat, not the lock
+        t0 = time.monotonic()
+        pool.add_transaction(_tx(1), 0, 10**21, 7)
+        fast = time.monotonic() - t0
+        slow.join()
+    assert fast < 0.1
+    assert len(pool) == 2
+
+
+# ---------------------------------------------------------------------------
+# the 5x-overload soak
+
+def test_overload_soak_graceful_degradation_and_recovery():
+    baseline_threads = threading.active_count()
+    baseline_fds = len(os.listdir("/proc/self/fd"))
+
+    node = Node(Genesis.from_json(GENESIS))
+    ctl = OverloadController(
+        read_limit=1, read_deadline=0.5, queue_high=0.05,
+        raise_hold=0.1, recover_hold=0.4, tick_interval=0.05,
+        signal_window=1.0, shed_pressure_min=3, retry_after=0.25)
+    server = RpcServer(node, port=0, overload=ctl).start()
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        # every handler body takes ~20ms: a single-slot server that
+        # sustains ~50 req/s and not much more
+        with injected(FaultPlan(seed=7).delay("rpc.handle", 0.02)):
+            harness = Harness(url, payload="ping", workers=4,
+                              timeout=5.0)
+            # phase 1 — find the sustainable rate: 10 req/s (100ms
+            # spacing vs ~20ms of work) is comfortably served with
+            # zero shedding; 20 usually holds too
+            sweep = harness.sweep([10.0, 20.0], duration=1.0)
+            sustained = sweep["maxSustainableRate"]
+            assert sustained is not None and sustained >= 10.0
+            assert sweep["rates"][0]["shed"] == 0
+
+            # phase 2 — 5x the sustainable rate
+            rep = harness.run(5.0 * sustained, duration=1.5)
+            # the accounting identity holds EXACTLY: nothing vanishes
+            assert rep["scheduled"] == (rep["delivered"] + rep["shed"]
+                                        + rep["missed"])
+            assert rep["shed"] > 0
+            assert rep["delivered"] > 0
+            assert rep["errors"] == 0        # graceful: typed, not broken
+            # accepted requests keep their deadline budget even while
+            # the server sheds the excess
+            assert rep["latency"]["p99"] is not None
+            assert rep["latency"]["p99"] <= 0.5
+            # sustained structural shedding raised the adaptive level
+            assert ctl.level >= 1
+            assert ctl.state == "shedding"
+
+            # phase 3 — shed speed: refused requests are answered far
+            # under the 10ms budget because they never execute (and
+            # never reach the injected 20ms handler delay)
+            hold = ctl.admit("eth_blockNumber")
+            assert hold.admitted
+            conn = RpcConn(url, timeout=5.0)
+            try:
+                lats = []
+                for i in range(40):
+                    t0 = time.monotonic()
+                    out = conn.post(
+                        b'{"jsonrpc":"2.0","id":1,'
+                        b'"method":"eth_blockNumber","params":[]}')
+                    lats.append(time.monotonic() - t0)
+                    assert out["error"]["code"] == SERVER_BUSY_CODE
+                    assert is_busy_error(out["error"])
+                lats.sort()
+                assert lats[int(len(lats) * 0.9)] < 0.010
+            finally:
+                conn.close()
+                ctl.release(hold)
+
+        # phase 4 — recovery: once the overload stops, the level must
+        # fall back to 0 within one hysteresis window (signal_window
+        # for the sheds to age out + recover_hold to clear)
+        probe = RpcConn(url, timeout=5.0)
+        try:
+            t0 = time.monotonic()
+            budget = ctl.signal_window + ctl.recover_hold + 2.0
+            while ctl.level > 0:
+                assert time.monotonic() - t0 < budget, \
+                    f"stuck at shed level {ctl.level}"
+                probe.call("eth_blockNumber", [])
+                time.sleep(0.05)
+            assert ctl.state in ("recovered", "ok")
+        finally:
+            probe.close()
+    finally:
+        server.stop()
+
+    # phase 5 — no leaks: every worker, handler thread, and socket from
+    # the soak is gone once the harness and server are torn down
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        threads = threading.active_count()
+        fds = len(os.listdir("/proc/self/fd"))
+        if threads <= baseline_threads + 2 and fds <= baseline_fds + 8:
+            break
+        time.sleep(0.1)
+    assert threading.active_count() <= baseline_threads + 2, \
+        "soak leaked threads"
+    assert len(os.listdir("/proc/self/fd")) <= baseline_fds + 8, \
+        "soak leaked file descriptors"

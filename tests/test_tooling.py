@@ -122,6 +122,26 @@ def test_store_fault_sites_covered_by_storage_battery():
         f"store sites without storage-battery coverage: {missing}"
 
 
+def test_serving_fault_sites_covered_by_overload_battery():
+    """The serving-path sites (rpc.*, mempool.*) are the overload
+    battery's contract: each must be exercised in
+    tests/test_overload_chaos.py specifically."""
+    import os
+
+    from ethrex_tpu.utils import faults
+
+    here = os.path.dirname(__file__)
+    with open(os.path.join(here, "test_overload_chaos.py")) as f:
+        corpus = f.read()
+    serving_sites = [s for s in sorted(faults.SITES)
+                     if s.startswith(("rpc.", "mempool."))]
+    assert serving_sites, \
+        "serving fault sites missing from faults.SITES"
+    missing = [s for s in serving_sites if f'"{s}"' not in corpus]
+    assert not missing, \
+        f"serving sites without overload-battery coverage: {missing}"
+
+
 def test_no_bare_print_in_library_modules():
     """Library diagnostics go through the structured logger
     (utils/tracing.py setup_logging), never bare print().  Terminal
@@ -232,10 +252,11 @@ def test_every_metric_helper_has_help_text():
 
     from ethrex_tpu.blockchain import mempool
     from ethrex_tpu.perf import bench_suite, loadgen, profiler, roofline
-    from ethrex_tpu.utils import metrics
+    from ethrex_tpu.utils import metrics, overload
 
     offenders = []
-    for mod in (metrics, profiler, roofline, bench_suite, loadgen, mempool):
+    for mod in (metrics, profiler, roofline, bench_suite, loadgen, mempool,
+                overload):
         tree = ast.parse(inspect.getsource(mod))
         for fn in ast.walk(tree):
             if not isinstance(fn, ast.FunctionDef):
