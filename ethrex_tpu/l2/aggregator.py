@@ -1,0 +1,323 @@
+"""Recursive proof aggregation stage: N settled-ready batch proofs ->
+one aggregated proof -> one L1 verify tx (docs/AGGREGATION.md).
+
+The sequencer's per-batch path (`send_proofs`) posts one full proof per
+batch per prover type.  `ProofAggregator.step()` instead collects the
+next run of verified-but-unsettled batches from the `RollupStore`
+(committed, fully proven, above the L1's `last_verified_batch`), audits
+each proof exactly like the per-batch path, then:
+
+  * STARK-carrying proofs (the tpu backend's FORMAT_STARK output) are
+    folded cross-batch: every batch's inner STARKs feed ONE outer
+    FriVerifyAir recursion proof via `stark.aggregate.aggregate_groups`,
+    and the per-batch payloads ship with their FRI Merkle path data
+    stripped — the dominant share of proof bytes.  The aggregate is
+    re-verified host-side (`verify_aggregated`) before submission,
+    mirroring how `send_proofs` audits before `verify_batches`.
+  * proofs with no STARK body (the exec backend) degrade to an output
+    bundle: the same one-payload, one-tx settlement shape without a
+    recursion proof.
+
+Settlement goes through `L1Client.verify_batches_aggregated`, which
+binds every batch's committed output (state root + messages root) just
+like the per-batch entry point but charges ONE L1 tx for the range —
+the N->1 cost amortization ROADMAP item 4 names.  Per-batch settlement
+stays available as the fallback: the sequencer only defers to this
+stage when the pending run reaches `min_batches`.
+
+Crash safety: `step()` drops an `aggregation_inflight` marker in the
+rollup store's meta table before touching the L1 and clears it after
+the local verified flags land.  Recovery needs no replay logic — the
+range always starts at `l1.last_verified_batch() + 1` and the L1
+rejects non-contiguous verification, so double-settling is structurally
+impossible; startup reconciliation (`Sequencer._reconcile_with_l1`)
+adopts verified flags the crash window lost, and the marker is just
+observability for how the crash resolved.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+
+from ..prover import protocol
+from ..utils import faults, tracing
+from .l1_client import L1Client
+from .rollup_store import RollupStore
+
+log = logging.getLogger("ethrex_tpu.l2.aggregator")
+
+INFLIGHT_META_KEY = "aggregation_inflight"
+
+
+class AggregatorError(ValueError):
+    pass
+
+
+def slim_entry(proof: dict) -> dict:
+    """The outputs-only settlement entry of one batch proof: everything
+    `verify_batches_aggregated` binds (the committed ProgramOutput) and
+    nothing it does not.  Used for proofs with no STARK body and by the
+    aligned path, whose full proofs were already verified off-chain."""
+    return {"backend": proof.get("backend"),
+            "format": proof.get("format"),
+            "output": proof["output"], "proof": None}
+
+
+def bundle_payload(entries: list[dict], first: int, last: int) -> dict:
+    """A degenerate (recursion-free) aggregate payload: one settlement
+    object covering `first..last` out of outputs-only entries."""
+    return {"format": "aggregate", "first": first, "last": last,
+            "proofs": entries, "outer": None}
+
+
+class ProofAggregator:
+    """Collects, recursively aggregates, and settles batch proof runs.
+
+    Drive with `step()` (the sequencer's `aggregate_proofs` actor does);
+    every call settles at most one contiguous range.  Thread-safe with
+    respect to its own stats; the rollup/L1 stores carry their own
+    locks."""
+
+    def __init__(self, rollup: RollupStore, l1: L1Client,
+                 coordinator=None,
+                 needed_types: list[str] | None = None,
+                 commit_hash: str = protocol.PROTOCOL_VERSION,
+                 min_batches: int = 2, max_batches: int = 16,
+                 params=None, outer_params=None,
+                 audit_aggregate: bool = True):
+        self.rollup = rollup
+        self.l1 = l1
+        self.coordinator = coordinator
+        self.needed = list(needed_types or [protocol.PROVER_TPU])
+        self.commit_hash = commit_hash
+        self.min_batches = max(1, min_batches)
+        self.max_batches = max(self.min_batches, max_batches)
+        self.params = params
+        self.outer_params = outer_params
+        self.audit_aggregate = audit_aggregate
+        self.lock = threading.RLock()
+        self.aggregations_total = 0
+        self.batches_aggregated_total = 0
+        self.last_range: tuple[int, int] | None = None
+        self.last_error: str | None = None
+        self.recovered: str | None = None
+        self._recover_inflight()
+
+    # ------------------------------------------------------------------
+    def _recover_inflight(self):
+        """Classify a crash-mid-aggregation marker left by a previous
+        run.  Either way the marker is cleared and normal stepping
+        resumes — the L1-anchored range start makes redo/skip automatic;
+        this only records WHICH side of the L1 call the crash fell on."""
+        marker = self.rollup.get_meta(INFLIGHT_META_KEY)
+        if not marker:
+            return
+        try:
+            settled = self.l1.last_verified_batch() >= int(marker["last"])
+        except Exception:  # noqa: BLE001 — L1 unreachable: leave marker
+            return
+        self.recovered = "settled-before-crash" if settled \
+            else "lost-before-settlement"
+        log.warning("recovered aggregation marker for batches %s..%s: %s",
+                    marker.get("first"), marker.get("last"),
+                    self.recovered)
+        self.rollup.set_meta(INFLIGHT_META_KEY, None)
+
+    def _slot_type(self, n: int, t: str) -> str:
+        """Quarantine substitution, same rule as send_proofs."""
+        if self.coordinator is None:
+            return t
+        eff = self.coordinator.effective_needed_types(n, [t])
+        return eff[0] if eff else t
+
+    # ------------------------------------------------------------------
+    def _collect(self) -> tuple[int, int] | None:
+        """The next contiguous committed + fully-proven run above the
+        L1's verified tip, capped at max_batches."""
+        first = self.l1.last_verified_batch() + 1
+        last = first - 1
+        while last - first + 1 < self.max_batches:
+            batch = self.rollup.get_batch(last + 1)
+            if batch is None or not batch.committed:
+                break
+            types = [self._slot_type(last + 1, t) for t in self.needed]
+            if not self.rollup.batch_fully_proven(last + 1, types):
+                break
+            last += 1
+        if last - first + 1 < self.min_batches:
+            return None
+        return first, last
+
+    def _audit(self, first: int, last: int) -> bool:
+        """Per-proof audit, identical in depth to send_proofs' check:
+        coverage anti-downgrade + full verify (witness replay when the
+        backend supports it).  Invalid proofs are deleted so the fleet
+        re-proves them."""
+        from ..guest.execution import ProgramInput
+        from ..prover.backend import get_backend
+
+        ok_all = True
+        for t in self.needed:
+            for n in range(first, last + 1):
+                st = self._slot_type(n, t)
+                backend = get_backend(st)
+                proof = self.rollup.get_proof(n, st)
+                batch = self.rollup.get_batch(n)
+                ok = proof is not None
+                if ok and batch is not None and not backend.check_coverage(
+                        proof, batch.vm_mode):
+                    ok = False
+                if ok:
+                    stored = self.rollup.get_prover_input(
+                        n, self.commit_hash)
+                    if hasattr(backend, "verify_with_input") \
+                            and stored is not None:
+                        ok = backend.verify_with_input(
+                            proof, ProgramInput.from_json(stored))
+                    else:
+                        ok = backend.verify(proof)
+                if not ok:
+                    self.rollup.delete_proof(n, st)
+                    self.last_error = f"invalid {st} proof for batch {n}"
+                    log.warning("aggregation audit failed: %s",
+                                self.last_error)
+                    ok_all = False
+        return ok_all
+
+    # ------------------------------------------------------------------
+    def _build_payload(self, t: str, first: int, last: int) -> dict:
+        """One aggregate payload for prover type t over first..last."""
+        from ..prover.backend import get_backend
+        from ..stark import aggregate as agg_mod
+
+        entries: list[tuple[str, dict]] = []
+        for n in range(first, last + 1):
+            st = self._slot_type(n, t)
+            proof = self.rollup.get_proof(n, st)
+            if proof is None:
+                raise AggregatorError(f"no {st} proof for batch {n}")
+            entries.append((st, proof))
+        if not any(isinstance(p.get("proof"), dict) for _, p in entries):
+            # exec fleet (or any proof-less backend): outputs bundle
+            return bundle_payload([slim_entry(p) for _, p in entries],
+                                  first, last)
+        groups: list[tuple[list, list]] = []
+        for st, p in entries:
+            if not isinstance(p.get("proof"), dict):
+                groups.append(([], []))
+                continue
+            backend = get_backend(st)
+            if not hasattr(backend, "stark_components"):
+                raise AggregatorError(
+                    f"backend {st} carries STARK proofs but exposes no "
+                    f"components for recursion")
+            groups.append(backend.stark_components(p))
+        params = self.params if self.params is not None \
+            else _default_params()
+        agg, slices = agg_mod.aggregate_groups(groups, params,
+                                               self.outer_params)
+        if self.audit_aggregate:
+            flat_airs = [a for airs, _ in groups for a in airs]
+            agg_mod.verify_aggregated(flat_airs, agg, params,
+                                      self.outer_params)
+        out_entries = []
+        for (st, p), (start, stop) in zip(entries, slices):
+            if isinstance(p.get("proof"), dict):
+                out_entries.append(
+                    _reassemble(p, agg.inners[start:stop]))
+            else:
+                out_entries.append(slim_entry(p))
+        return {"format": "aggregate", "first": first, "last": last,
+                "proofs": out_entries, "outer": agg.outer,
+                "max_depth": agg.max_depth,
+                "seg_periods": agg.seg_periods}
+
+    # ------------------------------------------------------------------
+    def step(self) -> tuple[int, int] | None:
+        """Aggregate and settle the next pending run; returns the settled
+        (first, last) range or None when there is nothing (yet) to do."""
+        from ..utils.metrics import record_aggregation, \
+            record_verified_batch
+
+        work = self._collect()
+        if work is None:
+            return None
+        first, last = work
+        if not self._audit(first, last):
+            return None
+        with tracing.span("aggregate.prove", first=first, last=last):
+            # two-leg fault site: before = recursion work lost mid-build,
+            # after = proof built but the settlement leg lost
+            faults.inject("aggregate.prove")
+            payloads = {t: self._build_payload(t, first, last)
+                        for t in self.needed}
+            faults.inject("aggregate.prove")
+        wire = {t: json.dumps(p, separators=(",", ":")).encode()
+                for t, p in payloads.items()}
+        # the marker brackets the settlement call: a crash inside this
+        # window is classified (settled vs lost) on the next startup
+        self.rollup.set_meta(INFLIGHT_META_KEY,
+                             {"first": first, "last": last})
+        self.l1.verify_batches_aggregated(first, last, wire)
+        count = last - first + 1
+        for n in range(first, last + 1):
+            trace = self.coordinator.batch_traces.get(n) \
+                if self.coordinator is not None else None
+            with tracing.trace_context(trace):
+                with tracing.span("proof.settle_aggregated", batch=n):
+                    self.rollup.set_verified(n)
+        self.rollup.set_meta(INFLIGHT_META_KEY, None)
+        with self.lock:
+            self.aggregations_total += 1
+            self.batches_aggregated_total += count
+            self.last_range = (first, last)
+            self.last_error = None
+        record_aggregation(count, last)
+        record_verified_batch(last)
+        log.info("aggregated batches %d..%d into one settlement "
+                 "(%d proofs -> 1 L1 tx)", first, last, count)
+        return first, last
+
+    # ------------------------------------------------------------------
+    def stats_json(self) -> dict:
+        """Health-endpoint view (ethrex_health l2.aggregation)."""
+        with self.lock:
+            return {
+                "aggregations": self.aggregations_total,
+                "batchesAggregated": self.batches_aggregated_total,
+                "lastRange": list(self.last_range)
+                if self.last_range else None,
+                "minBatches": self.min_batches,
+                "maxBatches": self.max_batches,
+                "lastError": self.last_error,
+                "recoveredInflight": self.recovered,
+                "inflight": self.rollup.get_meta(INFLIGHT_META_KEY),
+            }
+
+
+def _default_params():
+    from ..stark.prover import StarkParams
+
+    return StarkParams()
+
+
+def _reassemble(proof: dict, inners: list[dict]) -> dict:
+    """Substitute path-stripped inner proofs back into a tpu batch
+    proof's dict layout (inner order matches TpuBackend._reconstruct:
+    state, binding, vm?, tok?, bytecode...)."""
+    out = dict(proof)
+    out["state_proof"] = inners[0]
+    out["proof"] = inners[1]
+    cursor = 2
+    if proof.get("vm") is not None and "vm_proof" in proof:
+        out["vm_proof"] = inners[cursor]
+        cursor += 1
+    if "tok_proof" in proof:
+        out["tok_proof"] = inners[cursor]
+        cursor += 1
+    if "bc_proofs" in proof:
+        out["bc_proofs"] = inners[cursor:cursor
+                                  + len(proof["bc_proofs"])]
+    return out

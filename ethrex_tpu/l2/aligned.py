@@ -14,6 +14,7 @@ deterministically).
 
 from __future__ import annotations
 
+import json as _json
 import threading
 import time
 
@@ -96,12 +97,15 @@ class L1ProofVerifier:
 
     def __init__(self, rollup, l1, aligned: AlignedLayer,
                  needed_prover_types: list[str],
-                 resubmit_timeout: float = 30.0):
+                 resubmit_timeout: float = 30.0,
+                 aggregate: bool = False, min_aggregate: int = 2):
         self.rollup = rollup
         self.l1 = l1
         self.aligned = aligned
         self.needed = list(needed_prover_types)
         self.resubmit_timeout = resubmit_timeout
+        self.aggregate = aggregate
+        self.min_aggregate = max(1, min_aggregate)
         self.inflight: dict | None = None
 
     def _collect(self):
@@ -148,11 +152,26 @@ class L1ProofVerifier:
         state = self.aligned.status(sid)
         if state == AlignedLayer.INCLUDED:
             first, last = self.inflight["first"], self.inflight["last"]
-            wire = {
-                t: [get_backend(t).to_proof_bytes(p) for p in plist]
-                for t, plist in self.inflight["proofs"].items()
-            }
-            self.l1.verify_batches(first, last, wire)
+            if self.aggregate and last - first + 1 >= self.min_aggregate:
+                # the aligned layer already verified every full proof at
+                # submit time, so settlement only needs the committed
+                # outputs: one outputs-bundle payload per type, one L1 tx
+                # for the whole range (docs/AGGREGATION.md)
+                from . import aggregator as agg_mod
+
+                wire = {
+                    t: _json.dumps(agg_mod.bundle_payload(
+                        [agg_mod.slim_entry(p) for p in plist],
+                        first, last), separators=(",", ":")).encode()
+                    for t, plist in self.inflight["proofs"].items()
+                }
+                self.l1.verify_batches_aggregated(first, last, wire)
+            else:
+                wire = {
+                    t: [get_backend(t).to_proof_bytes(p) for p in plist]
+                    for t, plist in self.inflight["proofs"].items()
+                }
+                self.l1.verify_batches(first, last, wire)
             for n in range(first, last + 1):
                 self.rollup.set_verified(n)
             self.inflight = None

@@ -63,6 +63,15 @@ class L1Client:
                        proofs: dict[str, bytes]) -> bytes:
         raise NotImplementedError
 
+    def verify_batches_aggregated(self, first: int, last: int,
+                                  aggregates: dict[str, bytes]) -> bytes:
+        """Settle a contiguous batch range with ONE aggregated proof per
+        prover type instead of one full proof per batch (the recursion
+        path, docs/AGGREGATION.md): `aggregates` maps prover type to a
+        single wire payload that still binds every batch's committed
+        output, so L1 calldata amortizes N batches into one tx."""
+        raise NotImplementedError
+
     def last_committed_batch(self) -> int:
         raise NotImplementedError
 
@@ -120,6 +129,11 @@ class InMemoryL1(L1Client):
         self.lock = threading.RLock()
         self.block_number = 0
         self.reorgs_total = 0
+        # aggregated-settlement accounting (observability only — not part
+        # of the reorg snapshot state): how many verify txs were
+        # aggregated and how many per-batch proofs they amortized away
+        self.aggregated_settlements = 0
+        self.proofs_settled_aggregated = 0
         self._history: list[tuple[int, dict]] = [(0, self._snapshot())]
 
     # ---- L1 block model ----
@@ -283,6 +297,71 @@ class InMemoryL1(L1Client):
             return keccak256(b"verify" + first.to_bytes(8, "big")
                              + last.to_bytes(8, "big"))
 
+    def verify_batches_aggregated(self, first, last, aggregates) -> bytes:
+        """aggregates: {prover_type: payload_bytes} — ONE wire payload per
+        type for the whole range.  The payload carries a per-batch
+        "proofs" list whose entries each commit a ProgramOutput; every
+        entry must bind its batch's stored state root and messages root
+        exactly like the per-batch path, and a STARK-backed payload must
+        carry exactly one "outer" recursion proof for the range (the
+        sequencer-side aggregator fully verified it before submitting,
+        mirroring how send_proofs audits before verify_batches)."""
+        import json as _json
+
+        from ..guest.execution import ProgramOutput
+
+        with self.lock:
+            if first != self.verified_up_to + 1:
+                raise L1Error("verification must be contiguous")
+            if last > len(self.commitments):
+                raise L1Error("cannot verify uncommitted batches")
+            count = last - first + 1
+            for t in self.needed:
+                raw = aggregates.get(t)
+                if not raw:
+                    raise L1Error(f"missing {t} aggregate")
+                try:
+                    obj = _json.loads(raw)
+                    if obj.get("format") != "aggregate":
+                        raise ValueError("not an aggregate payload")
+                    batch_proofs = obj["proofs"]
+                except (ValueError, KeyError, TypeError):
+                    raise L1Error(f"unparseable {t} aggregate")
+                if not isinstance(batch_proofs, list) \
+                        or len(batch_proofs) != count:
+                    raise L1Error(
+                        f"{t} aggregate does not cover batches "
+                        f"{first}..{last}")
+                if any(isinstance(p, dict) and p.get("proof") is not None
+                       for p in batch_proofs) \
+                        and not isinstance(obj.get("outer"), dict):
+                    raise L1Error(
+                        f"{t} aggregate carries STARK inners but no "
+                        f"outer recursion proof")
+                for offset, entry in enumerate(batch_proofs):
+                    number = first + offset
+                    try:
+                        out = ProgramOutput.decode(
+                            bytes.fromhex(entry["output"][2:]))
+                    except (ValueError, KeyError, TypeError):
+                        raise L1Error(
+                            f"unparseable {t} aggregate entry for "
+                            f"batch {number}")
+                    state_root, _ = self.commitments[number]
+                    if out.final_state_root != state_root:
+                        raise L1Error(
+                            f"proof state root mismatch for batch {number}")
+                    if out.messages_root != self.message_roots[number]:
+                        raise L1Error(
+                            f"proof messages root mismatch for batch "
+                            f"{number}")
+            self.verified_up_to = last
+            self.aggregated_settlements += 1
+            self.proofs_settled_aggregated += count
+            self._mine()
+            return keccak256(b"verify-agg" + first.to_bytes(8, "big")
+                             + last.to_bytes(8, "big"))
+
     def last_committed_batch(self) -> int:
         return len(self.commitments)
 
@@ -425,6 +504,12 @@ class PersistentInMemoryL1(InMemoryL1):
 
     def verify_batches(self, *a, **kw):
         out = super().verify_batches(*a, **kw)
+        with self.lock:
+            self._save()
+        return out
+
+    def verify_batches_aggregated(self, *a, **kw):
+        out = super().verify_batches_aggregated(*a, **kw)
         with self.lock:
             self._save()
         return out

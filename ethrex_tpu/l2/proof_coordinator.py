@@ -25,7 +25,29 @@ no-op storage), extended with the resilience layer:
   * a bounded lease lifetime — heartbeats extend a lease only up to
     `max_lease_lifetime` past first assignment, so a prover whose prove
     call hangs (rather than crashes) is still eventually reassigned and
-    counted as a failure instead of pinning the batch forever.
+    counted as a failure instead of pinning the batch forever;
+
+and, on top of the lease substrate, a **fleet scheduler**
+(docs/AGGREGATION.md) replacing the original FCFS scan:
+
+  * per-prover throughput tracking — provers may volunteer a stable
+    `prover_id` on the wire; the coordinator keeps an EWMA of each
+    prover's proving wall-clock and its live-lease count;
+  * batch-size-aware placement — the fastest provers are steered toward
+    the heaviest unleased batches and the slowest toward the lightest
+    (with no stats the scan degrades to the FCFS order, and
+    `scheduler_policy="fcfs"` pins the original behavior outright);
+  * speculative hedged re-assignment — once every candidate batch is
+    leased, a requester can be granted a *hedge lease* on a straggler
+    whose elapsed time exceeds a p99-derived deadline ("The Tail at
+    Scale", Dean & Barroso, CACM 2013).  First result wins: the hedge
+    carries its own token, either holder's valid submit settles the
+    batch, and the loser's later submit is deduplicated into a no-op
+    SUBMIT_ACK without touching lease or quarantine state;
+  * work stealing — an idle prover may likewise be granted a hedge on a
+    batch held by a prover sitting on a deep backlog of live leases
+    (Blumofe & Leiserson's steal-from-the-loaded rule, run as a race
+    rather than a revocation so the existing token safety applies).
 """
 
 from __future__ import annotations
@@ -46,6 +68,10 @@ log = logging.getLogger("ethrex_tpu.l2.proof_coordinator")
 ASSIGNMENT_TIMEOUT = 600.0  # default lease, like the reference's 10 minutes
 QUARANTINE_THRESHOLD = 3    # failed assignments before exec fallback
 LEASE_LIFETIME_FACTOR = 6   # max heartbeat-extended lifetime, in leases
+HEDGE_MIN_SAMPLES = 8       # completed proofs before p99 hedging arms
+HEDGE_FACTOR = 1.5          # hedge once elapsed > p99 * factor
+STEAL_THRESHOLD = 4         # live leases that mark a prover "overloaded"
+EWMA_ALPHA = 0.3            # per-prover proving-time smoothing
 
 
 class ProofCoordinator:
@@ -58,7 +84,14 @@ class ProofCoordinator:
                  quarantine_threshold: int = QUARANTINE_THRESHOLD,
                  fallback_type: str = protocol.PROVER_EXEC,
                  verify_submissions: bool = True,
-                 max_lease_lifetime: float | None = None):
+                 max_lease_lifetime: float | None = None,
+                 scheduler_policy: str = "fleet",
+                 hedge_min_samples: int = HEDGE_MIN_SAMPLES,
+                 hedge_factor: float = HEDGE_FACTOR,
+                 steal_threshold: int = STEAL_THRESHOLD):
+        if scheduler_policy not in ("fleet", "fcfs"):
+            raise ValueError(
+                f"unknown scheduler policy {scheduler_policy!r}")
         self.rollup = rollup_store
         self.needed_types = needed_types or [protocol.PROVER_TPU]
         self.commit_hash = commit_hash
@@ -93,6 +126,26 @@ class ProofCoordinator:
         self.rejected_submits_total = 0
         self.unsolicited_submits_total = 0
         self.stale_submits_total = 0
+        # -- fleet scheduler state -------------------------------------
+        self.scheduler_policy = scheduler_policy
+        self.hedge_min_samples = max(1, hedge_min_samples)
+        self.hedge_factor = hedge_factor
+        self.steal_threshold = max(1, steal_threshold)
+        # (batch, prover_type) -> hedge lease racing the primary holder:
+        # {token, assigned_at, expires, prover_id, reason}; its token is
+        # accepted by Heartbeat/ProofSubmit exactly like the primary's
+        self.hedges: dict[tuple[int, str], dict] = {}
+        # (batch, prover_type) -> prover_id of the primary holder (None
+        # for provers that do not volunteer an identity)
+        self.lease_holders: dict[tuple[int, str], str | None] = {}
+        # prover_id -> {completed, ewma, last_seen}; fed by assigns and
+        # successful submits that carry a prover_id
+        self.prover_stats: dict[str, dict] = {}
+        # recent completed proving wall-clocks, the p99 hedging source
+        self.durations: collections.deque = collections.deque(maxlen=256)
+        self.hedged_assignments_total = 0
+        self.duplicate_submits_total = 0
+        self.queue_depth = 0
         self.lock = threading.RLock()
         self.host = host
         self.port = port
@@ -167,19 +220,107 @@ class ProofCoordinator:
         return list(dict.fromkeys(types))
 
     # ------------------------------------------------------------------
-    def next_batch_to_assign(self, prover_type: str) -> int | None:
-        """Lowest batch with a stored prover input, no proof of this type,
-        and no live lease (reference: next_batch_to_assign:149-215).
-        Expired leases are counted as failed assignments — enough of them
-        quarantines the batch onto the fallback backend."""
-        if prover_type not in self._allowed_types():
+    # fleet scheduler
+    # ------------------------------------------------------------------
+    def _batch_weight(self, num: int) -> int:
+        """Rough batch size for placement: block/tx counts out of the
+        stored prover input.  Opaque inputs weigh 1, which collapses the
+        size-aware pick back to the FCFS order."""
+        inp = self.rollup.get_prover_input(num, self.commit_hash)
+        if not isinstance(inp, dict):
+            return 1
+        blocks = inp.get("blocks")
+        if not isinstance(blocks, list):
+            return 1
+        weight = 0
+        for b in blocks:
+            weight += 1
+            if isinstance(b, dict):
+                txs = b.get("transactions")
+                if isinstance(txs, list):
+                    weight += len(txs)
+        return max(1, weight)
+
+    def _hedge_deadline(self) -> float | None:
+        """p99 of recent proving wall-clocks times `hedge_factor`; None
+        until `hedge_min_samples` proofs have completed (hedging stays
+        disarmed while the fleet has no latency signal).  Caller holds
+        self.lock."""
+        if len(self.durations) < self.hedge_min_samples:
             return None
+        ordered = sorted(self.durations)
+        p99 = ordered[min(len(ordered) - 1,
+                          int(0.99 * (len(ordered) - 1) + 0.5))]
+        return p99 * self.hedge_factor
+
+    def _live_leases_held(self, prover_id: str, now: float) -> int:
+        """Caller holds self.lock."""
+        return sum(1 for key, deadline in self.assignments.items()
+                   if deadline > now
+                   and self.lease_holders.get(key) == prover_id)
+
+    def _pick_unleased(self, unleased: list[int],
+                       prover_id: str | None) -> int:
+        """Batch-size-aware placement: relative to the rest of the
+        fleet's EWMA proving times, a fastest prover takes the heaviest
+        waiting batch and a slowest takes the lightest; everyone else —
+        and every prover without stats — takes the oldest (FCFS)."""
+        if self.scheduler_policy != "fleet" or prover_id is None \
+                or len(unleased) == 1:
+            return unleased[0]
+        st = self.prover_stats.get(prover_id)
+        ewma = st.get("ewma") if st else None
+        others = [s["ewma"] for pid, s in self.prover_stats.items()
+                  if pid != prover_id and s.get("ewma") is not None]
+        if ewma is None or not others:
+            return unleased[0]
+        weights = {num: self._batch_weight(num) for num in unleased}
+        if len(set(weights.values())) == 1:
+            return unleased[0]
+        if ewma <= min(others):
+            # ties break toward the oldest batch, keeping settlement
+            # (which walks batches in order) fed
+            return max(unleased, key=lambda n: (weights[n], -n))
+        if ewma >= max(others):
+            return min(unleased, key=lambda n: (weights[n], n))
+        return unleased[0]
+
+    def next_batch_to_assign(self, prover_type: str,
+                             prover_id: str | None = None) -> int | None:
+        """Back-compat wrapper over `assign` (the original FCFS scan's
+        signature); callers that need the granted lease token — a hedge
+        grant carries its own — use `assign` directly."""
+        return self.assign(prover_type, prover_id)[0]
+
+    def assign(self, prover_type: str, prover_id: str | None = None
+               ) -> tuple[int | None, str | None]:
+        """One scheduling decision: returns (batch, lease_token) or
+        (None, None).
+
+        Scans batches with a stored prover input and no proof of this
+        type (reference: next_batch_to_assign:149-215).  Expired leases
+        are counted as failed assignments — enough of them quarantines
+        the batch onto the fallback backend.  Unleased work is placed
+        size-aware under the fleet policy (FCFS under `fcfs`); when
+        everything is leased, the fleet policy may grant a *hedge* on a
+        straggler past the p99-derived deadline or steal from an
+        overloaded holder — a second lease racing the first, dedup'd at
+        submit time."""
+        faults.inject("coordinator.schedule")
+        if prover_type not in self._allowed_types():
+            return None, None
         now = self._now()
         with self.lock:
+            if prover_id is not None:
+                self.prover_stats.setdefault(
+                    prover_id, {"completed": 0, "ewma": None,
+                                "last_seen": now})["last_seen"] = now
             candidates = sorted({
                 num for (num, ver) in self.rollup.prover_inputs
                 if ver == self.commit_hash
             })
+            unleased: list[int] = []
+            leased: list[int] = []
             for num in candidates:
                 if num in self.quarantined:
                     # quarantined batches go only to the fallback backend
@@ -193,6 +334,7 @@ class ProofCoordinator:
                 deadline = self.assignments.get(key)
                 if deadline is not None:
                     if deadline > now:
+                        leased.append(num)
                         continue  # live lease elsewhere
                     # lease expired: the holder crashed or stalled
                     self._clear_lease(key)
@@ -200,19 +342,91 @@ class ProofCoordinator:
                     if num in self.quarantined and \
                             prover_type != self.fallback_type:
                         continue  # this expiry tipped it into quarantine
-                self.assignments[(num, prover_type)] = \
-                    now + self.lease_timeout
-                self.assigned_at[(num, prover_type)] = now
-                self.lease_tokens[(num, prover_type)] = \
-                    secrets.token_hex(16)
-                return num
-        return None
+                unleased.append(num)
+            self.queue_depth = len(unleased)
+            if unleased:
+                num = self._pick_unleased(unleased, prover_id)
+                token = self._grant(num, prover_type, prover_id, now)
+                self.queue_depth -= 1   # the grant is no longer waiting
+                self._report_queue_depth()
+                return num, token
+            granted = self._maybe_hedge(leased, prover_type, prover_id,
+                                        now)
+            self._report_queue_depth()
+            return granted
+
+    def _grant(self, num: int, prover_type: str, prover_id: str | None,
+               now: float) -> str:
+        """Issue the primary lease. Caller holds self.lock."""
+        key = (num, prover_type)
+        token = secrets.token_hex(16)
+        self.assignments[key] = now + self.lease_timeout
+        self.assigned_at[key] = now
+        self.lease_tokens[key] = token
+        self.lease_holders[key] = prover_id
+        return token
+
+    def _maybe_hedge(self, leased: list[int], prover_type: str,
+                     prover_id: str | None, now: float
+                     ) -> tuple[int | None, str | None]:
+        """Every candidate batch is leased: under the fleet policy, grant
+        a hedge lease on a straggler past the p99 deadline, or steal from
+        a holder with a deep live backlog when this requester is idle.
+        Caller holds self.lock."""
+        from ..utils.metrics import record_hedged_assignment
+
+        if self.scheduler_policy != "fleet":
+            return None, None
+        deadline = self._hedge_deadline()
+        requester_idle = (prover_id is not None
+                          and self._live_leases_held(prover_id, now) == 0)
+        for num in leased:
+            key = (num, prover_type)
+            hedge = self.hedges.get(key)
+            if hedge is not None:
+                if hedge["expires"] > now:
+                    continue  # one hedge at a time per batch
+                self.hedges.pop(key, None)  # hedge holder crashed too
+            if prover_id is not None \
+                    and self.lease_holders.get(key) == prover_id:
+                continue  # never hedge a prover against itself
+            reason = None
+            if deadline is not None \
+                    and now - self.assigned_at.get(key, now) > deadline:
+                reason = "straggler"
+            elif requester_idle:
+                holder = self.lease_holders.get(key)
+                if holder is not None and holder != prover_id \
+                        and self._live_leases_held(holder, now) \
+                        >= self.steal_threshold:
+                    reason = "steal"
+            if reason is None:
+                continue
+            token = secrets.token_hex(16)
+            self.hedges[key] = {
+                "token": token, "assigned_at": now,
+                "expires": now + self.lease_timeout,
+                "prover_id": prover_id, "reason": reason,
+            }
+            self.hedged_assignments_total += 1
+            record_hedged_assignment()
+            self._note_event("hedge", num, prover_type, reason)
+            log.info("hedged batch %d/%s to %s (%s): first result wins",
+                     num, prover_type, prover_id or "<anon>", reason)
+            return num, token
+        return None, None
+
+    def _report_queue_depth(self):
+        from ..utils.metrics import record_scheduler_queue_depth
+
+        record_scheduler_queue_depth(self.queue_depth)
 
     def _clear_lease(self, key: tuple[int, str]) -> float | None:
         """Drop a lease and its token; returns the first-assignment time
         (None if it was never live). Caller holds self.lock."""
         self.assignments.pop(key, None)
         self.lease_tokens.pop(key, None)
+        self.lease_holders.pop(key, None)
         return self.assigned_at.pop(key, None)
 
     def trace_for_batch(self, batch: int) -> str:
@@ -261,6 +475,19 @@ class ProofCoordinator:
                     ok = True
                 # else: lifetime spent; the lease lapses at its current
                 # deadline, expiry reassigns and counts the failure
+            else:
+                # a hedge holder extends its own lease with its own
+                # token, under the same hard-lifetime clamp
+                hedge = self.hedges.get(key)
+                if (hedge is not None and hedge["expires"] > now
+                        and token is not None
+                        and token == hedge["token"]):
+                    hard = hedge["assigned_at"] + self.max_lease_lifetime
+                    if now < hard:
+                        hedge["expires"] = \
+                            min(now + self.lease_timeout, hard)
+                        self.heartbeats_total += 1
+                        ok = True
         if ok:
             record_heartbeat()
         return {"type": protocol.HEARTBEAT_ACK, "batch_id": batch, "ok": ok}
@@ -279,11 +506,22 @@ class ProofCoordinator:
             return {"type": protocol.ERROR, "message": "bad submit"}
         key = (batch, prover_type)
         with self.lock:
-            if self.rollup.get_proof(batch, prover_type) is not None:
-                # duplicate submit -> no-op ACK (reference parity: the
-                # store keeps the first proof; the prover moves on)
-                return {"type": protocol.SUBMIT_ACK, "batch_id": batch}
-            if key not in self.assignments:
+            duplicate = self.rollup.get_proof(batch, prover_type) \
+                is not None
+            if duplicate:
+                self.duplicate_submits_total += 1
+                self._note_event("duplicate-submit", batch, prover_type)
+        if duplicate:
+            # duplicate submit -> no-op ACK (reference parity: the store
+            # keeps the first proof; the prover moves on).  This is also
+            # the losing leg of a hedged assignment — first result wins,
+            # and the loser's work is acknowledged without touching
+            # lease, failure, or quarantine state.
+            faults.inject("submit.duplicate", proof)
+            return {"type": protocol.SUBMIT_ACK, "batch_id": batch}
+        with self.lock:
+            hedge = self.hedges.get(key)
+            if key not in self.assignments and hedge is None:
                 # unsolicited: never assigned (or already settled and
                 # cleaned up) — do not let an arbitrary connection write
                 # into the proof store
@@ -291,10 +529,14 @@ class ProofCoordinator:
                 return {"type": protocol.ERROR,
                         "message": f"no assignment for batch {batch}"}
             # the wire protocol carries no prover identity — the lease
-            # token is what distinguishes the granted holder from a stale
-            # evicted prover or an arbitrary third party
-            holds_lease = (token is not None
-                           and token == self.lease_tokens.get(key))
+            # token is what distinguishes the granted holder (primary or
+            # hedge) from a stale evicted prover or an arbitrary third
+            # party
+            holds_primary = (token is not None
+                             and token == self.lease_tokens.get(key))
+            holds_hedge = (token is not None and hedge is not None
+                           and token == hedge["token"])
+            holds_lease = holds_primary or holds_hedge
         if self.verify_submissions:
             from ..prover.backend import get_backend
 
@@ -307,13 +549,24 @@ class ProofCoordinator:
                     # re-check under the lock: verification ran outside
                     # it, and the lease may have expired and been
                     # re-granted to a new holder in the meantime
-                    holds_lease = (token is not None and
-                                   token == self.lease_tokens.get(key))
-                    if holds_lease:
+                    hedge = self.hedges.get(key)
+                    holds_primary = (token is not None and
+                                     token == self.lease_tokens.get(key))
+                    holds_hedge = (token is not None and hedge is not None
+                                   and token == hedge["token"])
+                    holds_lease = holds_primary or holds_hedge
+                    if holds_primary:
                         self._clear_lease(key)
                         self.rejected_submits_total += 1
                         self._record_failure(batch, prover_type,
                                              "invalid proof")
+                    elif holds_hedge:
+                        # the hedge loses its lease, but the primary is
+                        # still proving: no failure against the batch
+                        self.hedges.pop(key, None)
+                        self.rejected_submits_total += 1
+                        self._note_event("hedge-rejected", batch,
+                                         prover_type, "invalid proof")
                     else:
                         # an invalid proof from a non-holder must not
                         # evict the live holder's lease or burn the
@@ -348,14 +601,34 @@ class ProofCoordinator:
                 self.rollup.store_proof(batch, prover_type, proof)
         with self.lock:
             started = self._clear_lease(key)
-            self._note_event("proof-stored", batch, prover_type)
+            hedge = self.hedges.pop(key, None)
+            if holds_hedge and hedge is not None:
+                # the hedge won the race: its own start time is the
+                # proving clock, not the straggler's
+                started = hedge["assigned_at"]
+            self._note_event("proof-stored", batch, prover_type,
+                             "hedge won" if holds_hedge else None)
         if started is not None and holds_lease:
             # proving-time metric (reference: set_batch_proving_time,
             # proof_coordinator.rs:286-296) — only meaningful when the
             # submitter is the prover the clock was started for
             from ..utils.metrics import record_batch
 
-            record_batch(batch, self._now() - started)
+            duration = self._now() - started
+            record_batch(batch, duration)
+            prover_id = msg.get("prover_id")
+            with self.lock:
+                # feed the fleet scheduler: the p99 hedging deadline and
+                # this prover's EWMA placement signal
+                self.durations.append(duration)
+                if prover_id is not None:
+                    st = self.prover_stats.setdefault(
+                        prover_id, {"completed": 0, "ewma": None,
+                                    "last_seen": self._now()})
+                    st["completed"] += 1
+                    st["ewma"] = duration if st["ewma"] is None else \
+                        EWMA_ALPHA * duration \
+                        + (1.0 - EWMA_ALPHA) * st["ewma"]
         return {"type": protocol.SUBMIT_ACK, "batch_id": batch}
 
     def handle_request(self, msg: dict) -> dict:
@@ -377,7 +650,8 @@ class ProofCoordinator:
             prover_type = msg.get("prover_type")
             if prover_type not in self._allowed_types():
                 return {"type": protocol.TYPE_NOT_NEEDED}
-            batch = self.next_batch_to_assign(prover_type)
+            batch, token = self.assign(prover_type,
+                                       msg.get("prover_id"))
             if batch is None:
                 return {"type": protocol.TYPE_NOT_NEEDED}
             trace_id = self.trace_for_batch(batch)
@@ -392,7 +666,7 @@ class ProofCoordinator:
                 self._note_event("assign", batch, prover_type)
             return {"type": protocol.INPUT_RESPONSE, "batch_id": batch,
                     "input": program_input, "format": self.proof_format,
-                    "lease_token": self.lease_token(batch, prover_type),
+                    "lease_token": token,
                     "trace_id": trace_id, "span_id": assign_span}
         if mtype == protocol.HEARTBEAT:
             return self._handle_heartbeat(msg)
@@ -418,7 +692,32 @@ class ProofCoordinator:
                              for (num, ptype), count
                              in sorted(self.failures.items())},
                 "recentEvents": list(self.events),
+                "scheduler": self._scheduler_stats_locked(),
             }
+
+    def _scheduler_stats_locked(self) -> dict:
+        """Caller holds self.lock."""
+        now = self._now()
+        deadline = self._hedge_deadline()
+        return {
+            "policy": self.scheduler_policy,
+            "queueDepth": self.queue_depth,
+            "hedgedAssignments": self.hedged_assignments_total,
+            "duplicateSubmits": self.duplicate_submits_total,
+            "hedgeDeadlineSeconds": deadline,
+            "liveHedges": [
+                {"batch": num, "proverType": ptype,
+                 "reason": h.get("reason"),
+                 "proverId": h.get("prover_id")}
+                for (num, ptype), h in sorted(self.hedges.items())
+                if h["expires"] > now],
+            "provers": {
+                pid: {"completed": st["completed"],
+                      "ewmaSeconds": st["ewma"],
+                      "liveLeases": self._live_leases_held(pid, now),
+                      "idleSeconds": max(0.0, now - st["last_seen"])}
+                for pid, st in sorted(self.prover_stats.items())},
+        }
 
     # ------------------------------------------------------------------
     def start(self):

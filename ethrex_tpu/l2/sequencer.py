@@ -68,6 +68,19 @@ class SequencerConfig:
     prover_lease_timeout: float = 600.0
     prover_max_lease_lifetime: float | None = None
     prover_quarantine_threshold: int = 3
+    # fleet scheduling (docs/AGGREGATION.md): "fleet" = size-aware
+    # placement + p99 hedging + work stealing; "fcfs" pins the original
+    # first-come-first-served scan
+    scheduler_policy: str = "fleet"
+    # recursive proof aggregation (docs/AGGREGATION.md): when enabled,
+    # pending runs of >= aggregation_min_batches settle as ONE
+    # aggregated proof per prover type (send_proofs defers to the
+    # aggregate_proofs actor for those runs and stays the per-batch
+    # fallback for everything shorter)
+    aggregation_enabled: bool = False
+    aggregation_interval: float = 2.0
+    aggregation_min_batches: int = 2
+    aggregation_max_batches: int = 16
 
 
 @dataclasses.dataclass
@@ -126,7 +139,7 @@ class Sequencer:
     # admin pause/resume surface validates against them (keeping the RPC
     # and the loop keyed to one registry instead of magic strings)
     ACTOR_NAMES = ("produce_block", "commit_next_batch", "send_proofs",
-                   "watch_l1", "update_state")
+                   "aggregate_proofs", "watch_l1", "update_state")
 
     def __init__(self, node: Node, l1: L1Client,
                  config: SequencerConfig | None = None,
@@ -140,7 +153,8 @@ class Sequencer:
             commit_hash=self.cfg.commit_hash,
             lease_timeout=self.cfg.prover_lease_timeout,
             quarantine_threshold=self.cfg.prover_quarantine_threshold,
-            max_lease_lifetime=self.cfg.prover_max_lease_lifetime)
+            max_lease_lifetime=self.cfg.prover_max_lease_lifetime,
+            scheduler_policy=self.cfg.scheduler_policy)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         # checkpoint resume (reference: l1_committer.rs:389 per-batch
@@ -195,6 +209,17 @@ class Sequencer:
         # accepted settlement the local store never recorded, and refuse
         # to run at all on a local/L1 divergence
         self._reconcile_with_l1()
+        # the recursive-aggregation stage (docs/AGGREGATION.md) —
+        # constructed after reconciliation so a crash-mid-aggregation
+        # marker is classified against the L1's recovered verified tip
+        from .aggregator import ProofAggregator
+
+        self.aggregator = ProofAggregator(
+            self.rollup, self.l1, coordinator=self.coordinator,
+            needed_types=list(self.cfg.needed_prover_types),
+            commit_hash=self.cfg.commit_hash,
+            min_batches=self.cfg.aggregation_min_batches,
+            max_batches=self.cfg.aggregation_max_batches)
 
     def _regenerate_chain(self):
         """Re-import committed-batch blocks the chain store lost (crash
@@ -671,6 +696,15 @@ class Sequencer:
             last += 1
         if last < first:
             return None
+        if self.cfg.aggregation_enabled \
+                and last - first + 1 >= self.cfg.aggregation_min_batches:
+            # long enough for the recursion stage: defer to the
+            # aggregate_proofs actor (N proofs -> one L1 tx); runs
+            # shorter than aggregation_min_batches still settle here
+            # per-batch, which also keeps settlement moving if the
+            # aggregator keeps failing (its audit deletes bad proofs,
+            # shrinking the run below the threshold)
+            return None
         proofs = {}
         for t in needed:
             from ..prover.backend import get_backend
@@ -732,6 +766,17 @@ class Sequencer:
 
         record_verified_batch(last)
         return (first, last)
+
+    # ------------------------------------------------------------------
+    # ProofAggregator actor (docs/AGGREGATION.md)
+    # ------------------------------------------------------------------
+    def aggregate_proofs(self) -> tuple[int, int] | None:
+        """Settle the next pending run as one aggregated proof; a no-op
+        until aggregation is enabled and the run reaches
+        aggregation_min_batches (send_proofs remains the fallback)."""
+        if not self.cfg.aggregation_enabled:
+            return None
+        return self.aggregator.step()
 
     # ------------------------------------------------------------------
     # StateUpdater (reference: state_updater.rs)
@@ -885,6 +930,7 @@ class Sequencer:
             "produce_block": self.cfg.block_time,
             "commit_next_batch": self.cfg.commit_interval,
             "send_proofs": self.cfg.proof_send_interval,
+            "aggregate_proofs": self.cfg.aggregation_interval,
             "watch_l1": self.cfg.watcher_interval,
             "update_state": self.cfg.watcher_interval,
         }

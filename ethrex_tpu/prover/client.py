@@ -20,6 +20,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import random
+import secrets
 import socket
 import threading
 import time
@@ -91,9 +92,15 @@ class ProverClient:
                  backoff_max: float = 30.0,
                  breaker_threshold: int = 5,
                  breaker_cooldown: float = 10.0,
-                 rng_seed: int | None = None):
+                 rng_seed: int | None = None,
+                 prover_id: str | None = None):
         self.backend = (get_backend(backend) if isinstance(backend, str)
                         else backend)
+        # advisory fleet identity: lets the coordinator's scheduler
+        # attribute throughput to this prover across polls (the lease
+        # token, not this, remains the authority over lease state)
+        self.prover_id = prover_id if prover_id is not None else \
+            f"{self.backend.prover_type}-{secrets.token_hex(4)}"
         self.endpoints = endpoints
         self.commit_hash = commit_hash
         self.poll_interval = poll_interval
@@ -186,6 +193,7 @@ class ProverClient:
                 "type": protocol.INPUT_REQUEST,
                 "commit_hash": self.commit_hash,
                 "prover_type": self.backend.prover_type,
+                "prover_id": self.prover_id,
             })
             resp = protocol.recv_msg(sock)
         rtype = resp.get("type")
@@ -232,6 +240,7 @@ class ProverClient:
                         "prover_type": self.backend.prover_type,
                         "proof": proof,
                         "lease_token": lease_token,
+                        "prover_id": self.prover_id,
                         "trace_id": trace_id,
                         "span_id": sub.span_id if sub else None,
                     })

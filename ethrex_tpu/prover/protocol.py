@@ -12,16 +12,22 @@ import socket
 from ..utils import faults
 
 # message types (the reference's ProofData variants). The wire carries no
-# prover identity, so InputResponse issues a per-assignment lease_token;
-# Heartbeat and ProofSubmit must echo it — lease mutations only ever act
-# on behalf of the prover the lease was granted to.
-INPUT_REQUEST = "InputRequest"          # {commit_hash, prover_type}
+# AUTHENTICATED prover identity, so InputResponse issues a per-assignment
+# lease_token; Heartbeat and ProofSubmit must echo it — lease mutations
+# only ever act on behalf of the prover the lease was granted to.  A
+# prover MAY volunteer a stable `prover_id` string on InputRequest and
+# ProofSubmit: it is advisory only (never a capability — the token stays
+# the sole authority), feeding the coordinator's fleet scheduler with
+# per-prover throughput stats for size-aware placement, work stealing,
+# and hedged re-assignment (docs/AGGREGATION.md).
+INPUT_REQUEST = "InputRequest"          # {commit_hash, prover_type
+#                                          [, prover_id]}
 INPUT_RESPONSE = "InputResponse"        # {batch_id, input, format,
 #                                          lease_token}
 VERSION_MISMATCH = "VersionMismatch"    # {expected}
 TYPE_NOT_NEEDED = "ProverTypeNotNeeded"
 PROOF_SUBMIT = "ProofSubmit"            # {batch_id, prover_type, proof,
-#                                          lease_token}
+#                                          lease_token [, prover_id]}
 SUBMIT_ACK = "ProofSubmitACK"           # {batch_id}
 ERROR = "Error"                         # {message}
 # lease keep-alive: a prover mid-way through a long TPU proof extends its

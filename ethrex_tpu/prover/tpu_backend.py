@@ -579,9 +579,12 @@ class TpuBackend(ProverBackend):
 
     # -- verification -------------------------------------------------------
 
-    def _check(self, proof: dict):
-        """Shared verification core; returns the parsed raw log + claimed
-        output bytes, or raises."""
+    def _reconstruct(self, proof: dict):
+        """Rebuild the AIRs and collect the inner STARKs of one batch
+        proof, enforcing every public-input binding against the claimed
+        log along the way (no STARK verification happens here).  Returns
+        (airs, proofs, blocks_log, encoded); shared by `_check` and by
+        `stark_components` (the cross-batch aggregation path)."""
         if proof.get("backend") != self.prover_type:
             raise ValueError("wrong backend tag")
         encoded = bytes.fromhex(proof["output"][2:])
@@ -669,6 +672,23 @@ class TpuBackend(ProverBackend):
             proofs.append(tok_proof)
         airs.extend(bc_airs)
         proofs.extend(bc_proofs)
+        return airs, proofs, blocks_log, encoded
+
+    def stark_components(self, proof: dict):
+        """The (airs, inner STARK proofs) of a FORMAT_STARK batch proof,
+        FRI paths intact, publics validated against the claimed log —
+        the raw material l2/aggregator.py feeds into
+        stark.aggregate.aggregate_groups for cross-batch recursion."""
+        if proof.get("aggregate") is not None:
+            raise ValueError("proof is already aggregated: its inner FRI "
+                             "paths are gone and cannot be re-aggregated")
+        airs, proofs, _, _ = self._reconstruct(proof)
+        return airs, proofs
+
+    def _check(self, proof: dict):
+        """Shared verification core; returns the parsed raw log + claimed
+        output bytes, or raises."""
+        airs, proofs, blocks_log, encoded = self._reconstruct(proof)
 
         agg_info = proof.get("aggregate")
         if agg_info is not None:

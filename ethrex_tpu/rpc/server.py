@@ -765,8 +765,14 @@ def _health(node):
             "stopAtBatch": seq.stop_at_batch,
             "fatal": list(seq.fatal) if seq.fatal else None,
             # prover pipeline resilience: lease/reassignment counters and
-            # the poison-batch quarantine (docs/PROVER_RESILIENCE.md)
+            # the poison-batch quarantine (docs/PROVER_RESILIENCE.md);
+            # the fleet scheduler state rides inside under "scheduler"
             "prover": seq.coordinator.stats_json(),
+            # recursive aggregation pipeline state (docs/AGGREGATION.md)
+            "aggregation": {
+                "enabled": seq.cfg.aggregation_enabled,
+                **seq.aggregator.stats_json(),
+            },
             # L1 settlement resilience: reorg/recommit/adoption counters
             # and the recommit backlog (docs/L1_SETTLEMENT_RESILIENCE.md)
             "l1": {

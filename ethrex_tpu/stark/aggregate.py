@@ -228,6 +228,29 @@ def aggregate(airs: list[Air], proofs: list[dict],
         max_depth=max_depth, seg_periods=air_out.seg_periods)
 
 
+def aggregate_groups(groups: list[tuple[list[Air], list[dict]]],
+                     params: StarkParams = StarkParams(),
+                     outer_params: StarkParams | None = None
+                     ) -> tuple[AggregateProof, list[tuple[int, int]]]:
+    """Cross-batch recursion entry (l2/aggregator.py): each group is one
+    batch's (airs, proofs); every group's FRI query work lands in the SAME
+    outer STARK.  Returns (agg, slices) where slices[i] = (start, stop)
+    into agg.inners for group i, so the caller can reassemble per-batch
+    payloads from the flattened, path-stripped inners."""
+    airs: list[Air] = []
+    proofs: list[dict] = []
+    slices: list[tuple[int, int]] = []
+    for g_airs, g_proofs in groups:
+        if len(g_airs) != len(g_proofs):
+            raise AggregationError("air/proof count mismatch in group")
+        start = len(proofs)
+        airs.extend(g_airs)
+        proofs.extend(g_proofs)
+        slices.append((start, len(proofs)))
+    agg = aggregate(airs, proofs, params, outer_params)
+    return agg, slices
+
+
 def verify_aggregated(airs: list[Air], agg: AggregateProof,
                       params: StarkParams = StarkParams(),
                       outer_params: StarkParams | None = None) -> bool:

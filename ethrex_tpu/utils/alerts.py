@@ -199,6 +199,20 @@ def settlement_lag_signal(eng, node):
     return latest - verified
 
 
+def aggregation_lag_signal(eng, node):
+    """Batches past the last aggregated settlement.  None until the first
+    aggregation lands (`ethrex_l2_last_aggregated_batch` is only sampled
+    by the aggregation path), so nodes running per-batch settlement —
+    or no L2 at all — never alert."""
+    aggregated = eng.gauge("ethrex_l2_last_aggregated_batch")
+    if aggregated is None:
+        return None
+    latest = eng.gauge("ethrex_l2_latest_batch")
+    if latest is None:
+        return None
+    return latest - aggregated
+
+
 def actor_stall_signal(eng, node):
     """Seconds since the least-recently-successful sequencer actor made
     progress (no-progress watchdog; every healthy actor iteration —
@@ -277,6 +291,25 @@ def default_rules(node=None) -> list:
            description="5+ committed batches await L1 verification",
            runbook="Settlement is falling behind proving; check "
                    "send_proofs actor latency."),
+        # aggregation lag (gauge-derived like settlement lag, but
+        # anchored to the last AGGREGATED settlement: only armed once an
+        # aggregation has landed, so per-batch-settling nodes stay quiet)
+        mk("aggregation_lag:page", "page",
+           aggregation_lag_signal, 48.0,
+           window=60.0, for_count=3, resolve_count=3,
+           description="48+ batches produced past the last aggregated "
+                       "settlement",
+           runbook="The aggregator stalled or its proofs are being "
+                   "rejected; check l2.aggregation.lastError in "
+                   "ethrex_health and docs/AGGREGATION.md."),
+        mk("aggregation_lag:warn", "warn",
+           aggregation_lag_signal, 16.0,
+           window=600.0, for_count=5, resolve_count=3,
+           description="16+ batches produced past the last aggregated "
+                       "settlement",
+           runbook="Aggregation is falling behind proving; check the "
+                   "aggregate_proofs actor latency and whether the run "
+                   "keeps failing its pre-settlement audit."),
         # sequencer actor stall — no-progress watchdog
         mk("sequencer_stall:page", "page",
            actor_stall_signal, 120.0,

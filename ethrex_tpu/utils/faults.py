@@ -35,6 +35,21 @@ and docs/L1_SETTLEMENT_RESILIENCE.md):
     mempool.add             Mempool.add_transaction at entry: delay = a
                             slow admission path, error/drop = admission
                             crash mid-submit
+    coordinator.schedule    ProofCoordinator.assign at entry: delay = a
+                            slow scheduling decision, error/drop =
+                            scheduler crash (the connection drops, the
+                            prover backs off and retries; no lease is
+                            granted)
+    aggregate.prove         ProofAggregator around the recursion proof;
+                            fires on BOTH legs — before the aggregate
+                            build (work lost) and after it returns
+                            (proof built, settlement leg lost; pair with
+                            after=1 to target this leg)
+    submit.duplicate        ProofCoordinator on a PROOF_SUBMIT for a
+                            batch that already has a stored proof (the
+                            losing leg of a hedged assignment): delay = a
+                            slow duplicate ack, error/drop = crash while
+                            no-op-acking the loser
 
 Fault kinds:
 
@@ -65,6 +80,9 @@ SITES = frozenset({
     "store.flush",
     "rpc.handle",
     "mempool.add",
+    "coordinator.schedule",
+    "aggregate.prove",
+    "submit.duplicate",
 })
 
 KINDS = frozenset({"drop", "delay", "corrupt", "torn", "error"})

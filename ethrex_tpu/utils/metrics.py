@@ -270,6 +270,34 @@ def record_submit_rejected():
                 "failure)")
 
 
+def record_hedged_assignment():
+    METRICS.inc("prover_hedged_assignments_total", 1,
+                "Speculative (hedged) re-assignments of straggler "
+                "batches past the p99-derived deadline, plus "
+                "work-stealing grants; first result wins, the loser's "
+                "submit is a deduplicated no-op")
+
+
+def record_scheduler_queue_depth(depth: int):
+    METRICS.set("scheduler_queue_depth", depth,
+                "Provable batches awaiting an assignment at the last "
+                "scheduling decision (unleased work the fleet has not "
+                "picked up yet)")
+
+
+def record_aggregation(count: int, last_batch: int):
+    METRICS.inc("proofs_aggregated_total", count,
+                "Per-batch proofs folded into aggregated settlement "
+                "proofs (the N of every N-to-1 recursion step)")
+    METRICS.set("aggregation_ratio", count,
+                "Batch proofs covered by the most recent aggregated "
+                "settlement (the amortization factor N of that L1 tx)")
+    METRICS.set("ethrex_l2_last_aggregated_batch", last_batch,
+                "Highest L2 batch settled through the aggregation "
+                "pipeline (the aggregation-lag alert reads latest_batch "
+                "minus this on nodes that aggregate)")
+
+
 def record_l1_reorg():
     METRICS.inc("l1_reorgs_total", 1,
                 "L1 reorgs detected through a settlement regression "

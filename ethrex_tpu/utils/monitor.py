@@ -194,6 +194,63 @@ def _traffic_lines(snap: dict, width: int) -> list[str]:
     return lines
 
 
+def _aggregation_lines(snap: dict, width: int) -> list[str]:
+    """Aggregation + fleet-scheduler panel: settlement amortization state
+    (ethrex_health `l2.aggregation`) and the coordinator's scheduler
+    policy counters (`l2.prover.scheduler`).  Defensive like the other
+    panels — an L1-only or older node simply has no panel."""
+    health = snap.get("health")
+    l2 = health.get("l2") if isinstance(health, dict) else None
+    if not isinstance(l2, dict):
+        return []
+    agg = l2.get("aggregation")
+    prover = l2.get("prover")
+    sched = prover.get("scheduler") if isinstance(prover, dict) else None
+    lines: list[str] = []
+    if isinstance(agg, dict):
+        lines.append("─" * width)
+        rng = agg.get("lastRange")
+        shown = f"{rng[0]}..{rng[1]}" if isinstance(rng, list) \
+            and len(rng) == 2 else "—"
+        lines.append(
+            f" aggregation  {'on' if agg.get('enabled') else 'off'}"
+            f"  settled {agg.get('aggregations', '?')} runs"
+            f" / {agg.get('batchesAggregated', '?')} batches"
+            f"  last {shown}"
+            f"  window {agg.get('minBatches', '?')}"
+            f"–{agg.get('maxBatches', '?')}")
+        if agg.get("lastError"):
+            lines.append(f"   last error: {agg['lastError']}")
+        if agg.get("recoveredInflight"):
+            lines.append(f"   recovered inflight: "
+                         f"{agg['recoveredInflight']}")
+    if isinstance(sched, dict):
+        if not lines:
+            lines.append("─" * width)
+        deadline = sched.get("hedgeDeadlineSeconds")
+        dshown = f"{deadline:.2f}s" if isinstance(deadline,
+                                                  (int, float)) else "—"
+        lines.append(
+            f" scheduler  {sched.get('policy', '?')}"
+            f"  queue {sched.get('queueDepth', '?')}"
+            f"  hedged {sched.get('hedgedAssignments', '?')}"
+            f"  dup submits {sched.get('duplicateSubmits', '?')}"
+            f"  live hedges {sched.get('liveHedges', '?')}"
+            f"  deadline {dshown}")
+        provers = sched.get("provers")
+        if isinstance(provers, dict) and provers:
+            for pid, st in sorted(provers.items())[:4]:
+                if not isinstance(st, dict):
+                    continue
+                ewma = st.get("ewmaSeconds")
+                eshown = f"{ewma:.2f}s" if isinstance(ewma,
+                                                      (int, float)) else "—"
+                lines.append(f"   {str(pid)[:24]:<24}"
+                             f" done {st.get('completed', '?'):<5}"
+                             f" ewma {eshown}")
+    return lines
+
+
 def _alerts_lines(snap: dict, width: int) -> list[str]:
     """Alerts panel: firing SLO rules + most recent transitions.
     Defensive — an L1-only node answers enabled=False (no panel) and an
@@ -320,6 +377,7 @@ def render_lines(snap: dict, width: int = 100) -> list[str]:
                 continue
             lines.append(f"   {k}: {v}")
     lines.extend(_traffic_lines(snap, width))
+    lines.extend(_aggregation_lines(snap, width))
     lines.extend(_alerts_lines(snap, width))
     lines.extend(_perf_lines(snap, width))
     lines.extend(_latency_lines(snap, width))

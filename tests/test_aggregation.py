@@ -1,0 +1,413 @@
+"""Aggregation pipeline integration (docs/AGGREGATION.md): sequencer ->
+batches -> TCP provers -> ProofAggregator -> ONE aggregated settlement on
+the in-memory L1, plus startup reconciliation after a crash
+mid-aggregation, the L1's aggregate-payload validation, and the slow
+differential check that `verify_aggregated` accepts exactly the proof
+sets the per-proof verifier accepts."""
+
+import json
+import time
+
+import pytest
+
+from ethrex_tpu.crypto import secp256k1
+from ethrex_tpu.l2.aggregator import (INFLIGHT_META_KEY, ProofAggregator,
+                                      bundle_payload, slim_entry)
+from ethrex_tpu.l2.l1_client import InMemoryL1, L1Error
+from ethrex_tpu.l2.sequencer import Sequencer, SequencerConfig
+from ethrex_tpu.node import Node
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.primitives.transaction import TYPE_DYNAMIC_FEE, Transaction
+from ethrex_tpu.prover import protocol
+from ethrex_tpu.prover.client import ProverClient
+from ethrex_tpu.utils.metrics import METRICS
+
+SECRET = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
+SENDER = secp256k1.pubkey_to_address(secp256k1.pubkey_from_secret(SECRET))
+OTHER = bytes.fromhex("aa" * 20)
+EXEC = protocol.PROVER_EXEC
+
+GENESIS = {
+    "config": {"chainId": 65536999, "terminalTotalDifficulty": 0,
+               "shanghaiTime": 0, "cancunTime": 0},
+    "alloc": {"0x" + SENDER.hex(): {"balance": hex(10**21)}},
+    "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7", "timestamp": "0x0",
+}
+
+
+def _transfer(nonce, value=100):
+    return Transaction(
+        tx_type=TYPE_DYNAMIC_FEE, chain_id=65536999, nonce=nonce,
+        max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+        gas_limit=21000, to=OTHER, value=value,
+    ).sign(SECRET)
+
+
+def _cfg(**kw):
+    kw.setdefault("needed_prover_types", (EXEC,))
+    kw.setdefault("aggregation_enabled", True)
+    kw.setdefault("aggregation_min_batches", 2)
+    return SequencerConfig(**kw)
+
+
+def _pipeline(batches, **cfg_kw):
+    """Node + sequencer (+ live TCP coordinator) with `batches` committed
+    batches, each one block with one transfer."""
+    node = Node(Genesis.from_json(GENESIS))
+    l1 = InMemoryL1([EXEC])
+    seq = Sequencer(node, l1, _cfg(**cfg_kw))
+    seq.coordinator.start()
+    for i in range(batches):
+        node.submit_transaction(_transfer(i))
+        seq.produce_block()
+        assert seq.commit_next_batch() is not None
+    return node, l1, seq
+
+
+def _prove_all(seq, batches, deadline_s=15.0):
+    """Prove every committed batch over the real TCP wire."""
+    client = ProverClient(EXEC, [("127.0.0.1", seq.coordinator.port)],
+                          heartbeat_interval=0, backoff_base=0.01,
+                          rng_seed=0)
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        client.poll_once()
+        if all(seq.rollup.get_proof(n, EXEC) is not None
+               for n in range(1, batches + 1)):
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"batches 1..{batches} never fully proven")
+
+
+# ===========================================================================
+# e2e: one aggregated settlement for the whole run
+# ===========================================================================
+
+def test_e2e_four_batches_settle_as_one_aggregated_proof():
+    """The issue's acceptance drill: the sequencer produces 4 batches,
+    provers prove them over real TCP, and the aggregator settles them as
+    ONE aggregated proof on the in-memory L1 — with the per-batch path
+    standing down and the whole state visible via metrics + health."""
+    node, l1, seq = _pipeline(batches=4, aggregation_max_batches=8)
+    try:
+        _prove_all(seq, 4)
+        # the per-batch path defers runs long enough to aggregate
+        assert seq.send_proofs() is None
+        assert l1.last_verified_batch() == 0
+        # ... and the aggregation actor settles the run in one L1 tx
+        assert seq.aggregate_proofs() == (1, 4)
+        assert l1.last_verified_batch() == 4
+        assert l1.aggregated_settlements == 1
+        assert l1.proofs_settled_aggregated == 4
+        for n in range(1, 5):
+            assert seq.rollup.get_batch(n).verified
+        # nothing left: both paths are idle now
+        assert seq.aggregate_proofs() is None
+        assert seq.send_proofs() is None
+        # metrics surface the amortization
+        assert METRICS.counters["proofs_aggregated_total"] >= 4
+        assert METRICS.gauges["aggregation_ratio"] == 4
+        assert METRICS.gauges["ethrex_l2_last_aggregated_batch"] == 4
+        rendered = METRICS.render()
+        assert "proofs_aggregated_total" in rendered
+        assert "scheduler_queue_depth" in rendered
+        # health endpoint carries the aggregation + scheduler sections
+        from ethrex_tpu.rpc.server import RpcServer
+
+        node.sequencer = seq
+        h = RpcServer(node).handle({
+            "jsonrpc": "2.0", "id": 1,
+            "method": "ethrex_health", "params": []})
+        agg = h["result"]["l2"]["aggregation"]
+        assert agg["enabled"] is True
+        assert agg["aggregations"] == 1
+        assert agg["batchesAggregated"] == 4
+        assert agg["lastRange"] == [1, 4]
+        assert agg["inflight"] is None
+        sched = h["result"]["l2"]["prover"]["scheduler"]
+        assert sched["policy"] == "fleet"
+        # the monitor panel renders both sections
+        from ethrex_tpu.utils.monitor import _aggregation_lines
+
+        lines = _aggregation_lines({"health": h["result"]}, width=100)
+        joined = "\n".join(lines)
+        assert "aggregation" in joined and "last 1..4" in joined
+        assert "scheduler" in joined and "fleet" in joined
+    finally:
+        seq.stop()
+
+
+def test_short_run_falls_back_to_per_batch_settlement():
+    """Below aggregation_min_batches the per-batch path still settles —
+    aggregation is an amortization, not a liveness dependency."""
+    node, l1, seq = _pipeline(batches=1, aggregation_min_batches=4)
+    try:
+        _prove_all(seq, 1)
+        assert seq.aggregate_proofs() is None    # run too short
+        assert seq.send_proofs() == (1, 1)       # fallback settles
+        assert l1.last_verified_batch() == 1
+        assert l1.aggregated_settlements == 0
+    finally:
+        seq.stop()
+
+
+def test_aggregation_disabled_keeps_per_batch_path():
+    """With the flag off the actor is a no-op and send_proofs behaves
+    exactly as before, whatever the run length."""
+    node, l1, seq = _pipeline(batches=2, aggregation_enabled=False)
+    try:
+        _prove_all(seq, 2)
+        assert seq.aggregate_proofs() is None
+        assert seq.send_proofs() == (1, 2)
+        assert l1.last_verified_batch() == 2
+        assert l1.aggregated_settlements == 0
+    finally:
+        seq.stop()
+
+
+def test_timer_driven_aggregation_over_tcp():
+    """Live actor loops + a live prover: batches flow through production,
+    commit, TCP proving, and the aggregate_proofs timer settles them in
+    aggregated runs (the per-batch timer is parked far out)."""
+    node = Node(Genesis.from_json(GENESIS))
+    l1 = InMemoryL1([EXEC])
+    seq = Sequencer(node, l1, _cfg(
+        block_time=0.05, commit_interval=0.05, proof_send_interval=30.0,
+        watcher_interval=0.1, aggregation_interval=0.1,
+        aggregation_min_batches=2, aggregation_max_batches=8)).start()
+    prover = ProverClient(EXEC, [("127.0.0.1", seq.coordinator.port)],
+                          poll_interval=0.05).start()
+    try:
+        deadline = time.time() + 30
+        nonce = 0
+        while time.time() < deadline and l1.last_verified_batch() < 4:
+            if nonce < 8:
+                node.submit_transaction(_transfer(nonce))
+                nonce += 1
+            time.sleep(0.1)
+        assert l1.last_verified_batch() >= 4
+        # everything that settled settled AGGREGATED (send_proofs never
+        # ticked): at least one run, covering every verified batch
+        assert l1.aggregated_settlements >= 1
+        assert l1.proofs_settled_aggregated == l1.last_verified_batch()
+    finally:
+        prover.stop()
+        seq.stop()
+        node.stop()
+
+
+# ===========================================================================
+# crash mid-aggregation: startup reconciliation, no double-settling
+# ===========================================================================
+
+def test_restart_after_crash_post_settlement_adopts_and_never_resettles():
+    """Crash AFTER the L1 accepted the aggregate but BEFORE the local
+    verified flags landed: restart classifies the marker as
+    settled-before-crash, reconciliation adopts the flags, and nothing is
+    settled twice (the L1 contiguity rule would reject it anyway)."""
+    node, l1, seq = _pipeline(batches=2)
+    _prove_all(seq, 2)
+    agg = seq.aggregator
+    payload = agg._build_payload(EXEC, 1, 2)
+    wire = {EXEC: json.dumps(payload, separators=(",", ":")).encode()}
+    seq.rollup.set_meta(INFLIGHT_META_KEY, {"first": 1, "last": 2})
+    l1.verify_batches_aggregated(1, 2, wire)
+    seq.stop()                    # "crash": verified flags never set
+    assert not seq.rollup.get_batch(1).verified
+
+    seq2 = Sequencer(node, l1, _cfg(), rollup=seq.rollup)
+    try:
+        assert seq2.aggregator.recovered == "settled-before-crash"
+        assert seq2.rollup.get_meta(INFLIGHT_META_KEY) is None
+        # reconciliation adopted the flags the crash window lost
+        assert seq2.rollup.get_batch(1).verified
+        assert seq2.rollup.get_batch(2).verified
+        # nothing pending, nothing double-settled
+        assert seq2.aggregate_proofs() is None
+        assert l1.aggregated_settlements == 1
+        assert l1.last_verified_batch() == 2
+        assert seq2.aggregator.stats_json()["recoveredInflight"] == \
+            "settled-before-crash"
+    finally:
+        seq2.stop()
+
+
+def test_restart_after_crash_pre_settlement_reaggregates():
+    """Crash AFTER the marker was written but BEFORE the L1 call went
+    out: restart classifies it as lost-before-settlement and the next
+    step simply re-aggregates — the range is L1-anchored, so the retry
+    covers exactly the unsettled run."""
+    node, l1, seq = _pipeline(batches=2)
+    _prove_all(seq, 2)
+    seq.rollup.set_meta(INFLIGHT_META_KEY, {"first": 1, "last": 2})
+    seq.stop()                    # "crash" before verify_batches_aggregated
+
+    seq2 = Sequencer(node, l1, _cfg(), rollup=seq.rollup)
+    try:
+        assert seq2.aggregator.recovered == "lost-before-settlement"
+        assert seq2.rollup.get_meta(INFLIGHT_META_KEY) is None
+        assert seq2.aggregate_proofs() == (1, 2)
+        assert l1.last_verified_batch() == 2
+        assert l1.aggregated_settlements == 1
+    finally:
+        seq2.stop()
+
+
+# ===========================================================================
+# L1-side aggregate validation
+# ===========================================================================
+
+def test_l1_rejects_malformed_or_tampered_aggregates():
+    node, l1, seq = _pipeline(batches=2)
+    try:
+        _prove_all(seq, 2)
+        payload = seq.aggregator._build_payload(EXEC, 1, 2)
+
+        def wire(p):
+            return {EXEC: json.dumps(p, separators=(",", ":")).encode()}
+
+        # settlement must stay contiguous from the verified tip
+        with pytest.raises(L1Error, match="contiguous"):
+            l1.verify_batches_aggregated(2, 2, wire(payload))
+        # the payload must cover the whole claimed range
+        short = dict(payload, proofs=payload["proofs"][:1])
+        with pytest.raises(L1Error, match="does not cover"):
+            l1.verify_batches_aggregated(1, 2, wire(short))
+        # STARK-carrying entries demand an outer recursion proof
+        starky = dict(payload, proofs=[
+            dict(payload["proofs"][0], proof={"fake": True}),
+            payload["proofs"][1]])
+        with pytest.raises(L1Error, match="outer recursion proof"):
+            l1.verify_batches_aggregated(1, 2, wire(starky))
+        # a tampered output no longer binds the committed state root
+        # (byte 32 = first byte of final_state_root)
+        out = bytearray.fromhex(payload["proofs"][0]["output"][2:])
+        out[32] ^= 1
+        bad = dict(payload, proofs=[
+            dict(payload["proofs"][0], output="0x" + out.hex()),
+            payload["proofs"][1]])
+        with pytest.raises(L1Error, match="state root mismatch"):
+            l1.verify_batches_aggregated(1, 2, wire(bad))
+        # garbage is unparseable, not a crash
+        with pytest.raises(L1Error, match="unparseable"):
+            l1.verify_batches_aggregated(1, 2, {EXEC: b"not json"})
+        # nothing above moved the tip; the honest payload settles
+        assert l1.last_verified_batch() == 0
+        l1.verify_batches_aggregated(1, 2, wire(payload))
+        assert l1.last_verified_batch() == 2
+    finally:
+        seq.stop()
+
+
+def test_aligned_path_settles_aggregated():
+    """The aligned L1ProofVerifier's aggregate option: once the aligned
+    layer reports inclusion, the whole range settles through ONE
+    verify_batches_aggregated call built from outputs-only entries."""
+    from ethrex_tpu.l2.aligned import AlignedLayer, L1ProofVerifier
+
+    node, l1, seq = _pipeline(batches=3, aggregation_enabled=False)
+    try:
+        _prove_all(seq, 3)
+        verifier = L1ProofVerifier(
+            seq.rollup, l1, AlignedLayer(latency_polls=1), [EXEC],
+            aggregate=True, min_aggregate=2)
+        assert verifier.step() == "submitted"
+        assert verifier.step() == "verified"
+        assert l1.last_verified_batch() == 3
+        assert l1.aggregated_settlements == 1
+        assert l1.proofs_settled_aggregated == 3
+        for n in range(1, 4):
+            assert seq.rollup.get_batch(n).verified
+    finally:
+        seq.stop()
+
+
+def test_audit_deletes_invalid_proof_and_blocks_aggregation():
+    """The aggregator audits like send_proofs: a proof that stops
+    verifying is deleted (the fleet re-proves it) and the run does not
+    settle until the store is clean again."""
+    node, l1, seq = _pipeline(batches=2)
+    try:
+        _prove_all(seq, 2)
+        good = seq.rollup.get_proof(2, EXEC)
+        # a structurally broken proof (truncated output) fails the
+        # backend's verify, exactly like send_proofs' audit would see it
+        seq.rollup.delete_proof(2, EXEC)
+        seq.rollup.store_proof(
+            2, EXEC, dict(good, output=good["output"][:22]))
+        assert seq.aggregate_proofs() is None
+        assert seq.rollup.get_proof(2, EXEC) is None   # deleted for re-prove
+        assert seq.aggregator.stats_json()["lastError"] is not None
+        assert l1.aggregated_settlements == 0
+        # the fleet re-proves; the next tick settles the clean run
+        _prove_all(seq, 2)
+        assert seq.aggregate_proofs() == (1, 2)
+        assert l1.last_verified_batch() == 2
+    finally:
+        seq.stop()
+
+
+def test_bundle_payload_helpers():
+    entry = slim_entry({"backend": EXEC, "format": "exec-output",
+                        "output": "0x" + "00" * 176,
+                        "proof": {"big": "stark"}, "extra": "dropped"})
+    assert entry == {"backend": EXEC, "format": "exec-output",
+                     "output": "0x" + "00" * 176, "proof": None}
+    p = bundle_payload([entry, entry], 3, 4)
+    assert p["format"] == "aggregate" and p["outer"] is None
+    assert (p["first"], p["last"]) == (3, 4) and len(p["proofs"]) == 2
+
+
+# ===========================================================================
+# differential: aggregate verification == per-proof verification (slow)
+# ===========================================================================
+
+@pytest.mark.slow
+def test_differential_verify_aggregated_vs_per_proof():
+    """`verify_aggregated` accepts exactly the proof sets the per-proof
+    verifier accepts: honest sets pass both; a set with one tampered
+    proof fails both (at aggregation build time or at aggregate
+    verification, matching where the per-proof verifier fails)."""
+    import copy
+
+    from ethrex_tpu.stark import aggregate as agg_mod
+    from ethrex_tpu.stark import verifier as stark_verifier
+    from ethrex_tpu.stark.prover import StarkParams
+    from tests.test_aggregate import _fib_air_and_proofs
+
+    airs, proofs, params = _fib_air_and_proofs(2)
+    outer_params = StarkParams(log_blowup=3, num_queries=8,
+                               log_final_size=4)
+    # the per-proof verifier accepts every inner proof
+    for air, proof in zip(airs, proofs):
+        assert stark_verifier.verify(air, proof, params)
+    # ... and so does the aggregate built over per-batch groups
+    groups = [([airs[0]], [proofs[0]]), ([airs[1]], [proofs[1]])]
+    agg, slices = agg_mod.aggregate_groups(groups, params, outer_params)
+    assert slices == [(0, 1), (1, 2)]
+    assert agg_mod.verify_aggregated(airs, agg, params, outer_params)
+
+    # a tampered FRI opening: per-proof verification rejects it, and the
+    # same proof set cannot even be aggregated (the host-side fold
+    # replay catches what the Merkle check would have)
+    bad_proofs = copy.deepcopy(proofs)
+    opening = bad_proofs[0]["fri"]["queries"][0][0]
+    vals = [list(v) for v in opening["values"]]
+    vals[0][0] = (int(vals[0][0]) + 1) % (2**31 - 2**27 + 1)
+    opening["values"] = [tuple(v) for v in vals]
+    with pytest.raises(Exception):
+        stark_verifier.verify(airs[0], bad_proofs[0], params)
+    with pytest.raises(Exception):
+        agg_mod.aggregate(airs, bad_proofs, params, outer_params)
+
+    # a post-hoc tamper of the aggregate's inner proof: the per-proof
+    # verifier rejects the tampered inner, and verify_aggregated rejects
+    # the aggregate carrying it (digest binding)
+    bad_agg = copy.deepcopy(agg)
+    tampered = bad_agg.inners[0]
+    tampered["pub_inputs"] = list(tampered["pub_inputs"])
+    tampered["pub_inputs"][0] = int(tampered["pub_inputs"][0]) + 1
+    with pytest.raises(Exception):
+        stark_verifier.verify(airs[0], tampered, params)
+    with pytest.raises(Exception):
+        agg_mod.verify_aggregated(airs, bad_agg, params, outer_params)

@@ -18,6 +18,7 @@ from ethrex_tpu.prover.client import ProverClient
 from ethrex_tpu.rpc.server import RpcServer
 from ethrex_tpu.utils import faults, snapshot, timeseries
 from ethrex_tpu.utils.alerts import (AlertEngine, AlertRule, actor_stall_signal,
+                                     aggregation_lag_signal,
                                      build_default_engine, default_rules,
                                      rate_signal, settlement_lag_signal)
 from ethrex_tpu.utils.faults import FaultPlan
@@ -316,6 +317,20 @@ def test_settlement_lag_signal():
     assert settlement_lag_signal(eng, None) == 2.0
 
 
+def test_aggregation_lag_signal():
+    m = Metrics()
+    eng = TimeSeriesEngine(m)
+    assert aggregation_lag_signal(eng, None) is None    # cold start
+    m.set("ethrex_l2_latest_batch", 30)
+    eng.sample_now(now=0.0)
+    # per-batch-settling nodes never sample the aggregated gauge and
+    # must stay silent, however far settlement itself lags
+    assert aggregation_lag_signal(eng, None) is None
+    m.set("ethrex_l2_last_aggregated_batch", 24)
+    eng.sample_now(now=1.0)
+    assert aggregation_lag_signal(eng, None) == 6.0
+
+
 def test_actor_stall_signal():
     from types import SimpleNamespace as NS
 
@@ -341,7 +356,7 @@ def test_default_rules_pair_page_and_warn():
     names = {r.name for r in rules}
     for slo in ("batch_proving_p95", "prover_reassignment_rate",
                 "store_corruption_rate", "l1_settlement_lag",
-                "sequencer_stall"):
+                "aggregation_lag", "sequencer_stall"):
         assert f"{slo}:page" in names and f"{slo}:warn" in names
     assert "sequencer_loop_p95:warn" in names
     for r in rules:

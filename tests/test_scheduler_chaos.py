@@ -1,0 +1,389 @@
+"""Fault-injection battery for the fleet scheduler and the aggregation
+pipeline (docs/AGGREGATION.md): scheduler crashes mid-decision
+("coordinator.schedule"), aggregation crashes on either side of the
+recursion build ("aggregate.prove"), and the losing leg of a hedged
+assignment ("submit.duplicate") — plus the hedging/steal unit drills
+(straggler re-assigned past the p99 deadline, first result wins, the
+original's duplicate submit no-op-acked without burning quarantine
+budget) and the FCFS policy flag.
+
+Select alone with `-m chaos`; the whole battery is in the fast tier.
+"""
+
+import time
+
+import pytest
+
+from ethrex_tpu.crypto import secp256k1
+from ethrex_tpu.l2.aggregator import INFLIGHT_META_KEY, ProofAggregator
+from ethrex_tpu.l2.l1_client import InMemoryL1
+from ethrex_tpu.l2.proof_coordinator import ProofCoordinator
+from ethrex_tpu.l2.rollup_store import RollupStore
+from ethrex_tpu.l2.sequencer import Sequencer, SequencerConfig
+from ethrex_tpu.node import Node
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.primitives.transaction import TYPE_DYNAMIC_FEE, Transaction
+from ethrex_tpu.prover import protocol
+from ethrex_tpu.prover.client import ProverClient
+from ethrex_tpu.utils import faults
+from ethrex_tpu.utils.faults import FaultPlan, InjectedFault
+
+pytestmark = pytest.mark.chaos
+
+SECRET = 0x45A915E4D060149EB4365960E6A7A45F334393093061116B197E3240065FF2D8
+SENDER = secp256k1.pubkey_to_address(secp256k1.pubkey_from_secret(SECRET))
+OTHER = bytes.fromhex("aa" * 20)
+EXEC = protocol.PROVER_EXEC
+
+GENESIS = {
+    "config": {"chainId": 65536999, "terminalTotalDifficulty": 0,
+               "shanghaiTime": 0, "cancunTime": 0},
+    "alloc": {"0x" + SENDER.hex(): {"balance": hex(10**21)}},
+    "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7", "timestamp": "0x0",
+}
+
+
+def _transfer(nonce, value=100):
+    return Transaction(
+        tx_type=TYPE_DYNAMIC_FEE, chain_id=65536999, nonce=nonce,
+        max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+        gas_limit=21000, to=OTHER, value=value,
+    ).sign(SECRET)
+
+
+def _mini_l2(batches=1, **cfg_kw):
+    """Real Node + sequencer + live TCP coordinator with `batches`
+    committed batches ready for provers to pull."""
+    node = Node(Genesis.from_json(GENESIS))
+    l1 = InMemoryL1([EXEC])
+    seq = Sequencer(node, l1, SequencerConfig(
+        needed_prover_types=(EXEC,), **cfg_kw))
+    seq.coordinator.start()
+    for i in range(batches):
+        node.submit_transaction(_transfer(i))
+        seq.produce_block()
+        assert seq.commit_next_batch() is not None
+    return node, l1, seq
+
+
+def _endpoints(seq):
+    return [("127.0.0.1", seq.coordinator.port)]
+
+
+def _prove_all(seq, batches, deadline_s=10.0):
+    client = ProverClient(EXEC, _endpoints(seq), heartbeat_interval=0,
+                          backoff_base=0.01, rng_seed=0)
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        client.poll_once()
+        if all(seq.rollup.get_proof(n, EXEC) is not None
+               for n in range(1, batches + 1)):
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"batches 1..{batches} never fully proven")
+
+
+# ===========================================================================
+# coordinator.schedule — scheduler crash / slow decision
+# ===========================================================================
+
+def test_schedule_crash_drops_connection_then_recovers():
+    """A scheduler crash inside assign() drops the prover's connection
+    before any lease is granted; the prover backs off, retries, and the
+    batch is proven — no lease or failure accounting is left behind."""
+    node, l1, seq = _mini_l2()
+    co = seq.coordinator
+    try:
+        plan = faults.install(
+            FaultPlan(seed=21).error("coordinator.schedule", times=1))
+        client = ProverClient(EXEC, _endpoints(seq),
+                              heartbeat_interval=0, backoff_base=0.01,
+                              rng_seed=5)
+        assert client.poll_once() == 0       # scheduler crashed mid-decide
+        assert co.assignments == {}          # no lease was granted
+        assert co.failures == {}             # and no failure was charged
+        assert plan.log == [("coordinator.schedule", "error")]
+        time.sleep(0.03)                     # clear the client backoff
+        _prove_all(seq, 1)
+        assert seq.send_proofs() == (1, 1)
+        assert l1.last_verified_batch() == 1
+    finally:
+        faults.clear()
+        seq.stop()
+
+
+def test_schedule_delay_slow_decision_still_grants():
+    """A slow scheduling decision (delay rule) stalls the poll but the
+    grant still lands with a usable lease token."""
+    node, l1, seq = _mini_l2()
+    try:
+        faults.install(
+            FaultPlan(seed=22).delay("coordinator.schedule", 0.2, times=1))
+        client = ProverClient(EXEC, _endpoints(seq),
+                              heartbeat_interval=0, rng_seed=6)
+        t0 = time.time()
+        assert client.poll_once() == 1
+        assert time.time() - t0 >= 0.2
+        assert seq.rollup.get_proof(1, EXEC) is not None
+    finally:
+        faults.clear()
+        seq.stop()
+
+
+# ===========================================================================
+# aggregate.prove — crash on either leg of the recursion build
+# ===========================================================================
+
+def _proven_run(batches=2):
+    node, l1, seq = _mini_l2(batches=batches)
+    _prove_all(seq, batches)
+    agg = ProofAggregator(seq.rollup, l1, coordinator=seq.coordinator,
+                          needed_types=[EXEC], min_batches=2)
+    return node, l1, seq, agg
+
+
+def test_aggregate_crash_before_build_then_recovers():
+    """A crash BEFORE the aggregate is built loses only work: nothing
+    reached the L1, no inflight marker is left, and the retry settles the
+    whole run as one aggregated proof."""
+    node, l1, seq, agg = _proven_run()
+    try:
+        faults.install(
+            FaultPlan(seed=23).error("aggregate.prove", times=1))
+        with pytest.raises(InjectedFault):
+            agg.step()
+        assert l1.last_verified_batch() == 0
+        assert seq.rollup.get_meta(INFLIGHT_META_KEY) is None
+        faults.clear()
+        assert agg.step() == (1, 2)
+        assert l1.last_verified_batch() == 2
+        assert l1.aggregated_settlements == 1
+        assert seq.rollup.get_batch(1).verified
+        assert seq.rollup.get_batch(2).verified
+    finally:
+        faults.clear()
+        seq.stop()
+
+
+def test_aggregate_crash_after_build_before_settlement():
+    """after=1 targets the second leg: the aggregate was built but the
+    settlement never went out — the L1 is untouched, no marker is stuck,
+    and the retry re-builds and settles (the range is L1-anchored, so
+    double-settling is structurally impossible)."""
+    node, l1, seq, agg = _proven_run()
+    try:
+        plan = faults.install(
+            FaultPlan(seed=24).error("aggregate.prove", times=1, after=1))
+        with pytest.raises(InjectedFault):
+            agg.step()
+        assert plan.log == [("aggregate.prove", "error")]
+        assert l1.last_verified_batch() == 0
+        assert l1.aggregated_settlements == 0
+        assert seq.rollup.get_meta(INFLIGHT_META_KEY) is None
+        faults.clear()
+        assert agg.step() == (1, 2)
+        assert l1.last_verified_batch() == 2
+        # one settlement for the whole run, not one per batch
+        assert l1.aggregated_settlements == 1
+        assert l1.proofs_settled_aggregated == 2
+    finally:
+        faults.clear()
+        seq.stop()
+
+
+# ===========================================================================
+# hedged re-assignment drills (fake clock) + submit.duplicate
+# ===========================================================================
+
+def _bare_coordinator(batches=1, **kw):
+    store = RollupStore()
+    for n in range(1, batches + 1):
+        store.store_prover_input(n, protocol.PROTOCOL_VERSION, {"stub": n})
+    kw.setdefault("needed_types", [EXEC])
+    kw.setdefault("verify_submissions", False)
+    return store, ProofCoordinator(store, **kw)
+
+
+def _submit(co, batch, token, prover_id=None):
+    msg = {"type": protocol.PROOF_SUBMIT, "batch_id": batch,
+           "prover_type": EXEC, "lease_token": token,
+           "proof": {"backend": EXEC, "output": "0x" + "00" * 176}}
+    if prover_id is not None:
+        msg["prover_id"] = prover_id
+    return co.handle_request(msg)
+
+
+def test_hedged_straggler_first_result_wins_duplicate_noop(monkeypatch):
+    """The chaos drill from the issue: a straggler holds the primary
+    lease past the p99-derived deadline; an idle prover is granted a
+    hedge with its OWN token; the hedge wins the race; the straggler's
+    late submit hits the duplicate path — no-op SUBMIT_ACK, no lease or
+    quarantine mutation — even when a "submit.duplicate" fault kills the
+    ack mid-flight first."""
+    store, co = _bare_coordinator(hedge_min_samples=4, hedge_factor=1.5)
+    t = [0.0]
+    monkeypatch.setattr(co, "_now", lambda: t[0])
+    co.durations.extend([1.0, 1.0, 1.0, 1.0])    # p99=1s -> deadline 1.5s
+
+    batch, tok_slow = co.assign(EXEC, "slow-prover")
+    assert (batch, tok_slow is not None) == (1, True)
+    # inside the deadline: no hedge yet (the lease itself is live)
+    t[0] = 1.0
+    assert co.assign(EXEC, "fast-prover") == (None, None)
+    # past p99 * factor: the idle prover gets a hedge with its own token
+    t[0] = 2.0
+    hbatch, tok_fast = co.assign(EXEC, "fast-prover")
+    assert hbatch == 1 and tok_fast not in (None, tok_slow)
+    assert co.hedged_assignments_total == 1
+    assert co.hedges[(1, EXEC)]["reason"] == "straggler"
+    # one hedge at a time per batch; never hedge the holder against itself
+    assert co.assign(EXEC, "third-prover") == (None, None)
+    assert co.assign(EXEC, "slow-prover") == (None, None)
+    # the hedge holder can feed its own lease with its own token
+    hb = co.handle_request({"type": protocol.HEARTBEAT, "batch_id": 1,
+                            "prover_type": EXEC, "lease_token": tok_fast})
+    assert hb["ok"] is True
+    # ... and the primary's token still feeds the primary lease
+    hb = co.handle_request({"type": protocol.HEARTBEAT, "batch_id": 1,
+                            "prover_type": EXEC, "lease_token": tok_slow})
+    assert hb["ok"] is True
+
+    # first result wins: the hedge submits first
+    t[0] = 3.0
+    r = _submit(co, 1, tok_fast, prover_id="fast-prover")
+    assert r["type"] == protocol.SUBMIT_ACK
+    assert store.get_proof(1, EXEC) is not None
+    assert co.hedges == {} and (1, EXEC) not in co.assignments
+    # the winner's proving clock started at the HEDGE grant (t=2 -> t=3)
+    assert co.prover_stats["fast-prover"]["completed"] == 1
+    assert abs(co.prover_stats["fast-prover"]["ewma"] - 1.0) < 1e-9
+    assert any(e["event"] == "proof-stored"
+               and e.get("detail") == "hedge won" for e in co.events)
+
+    # the straggler finally finishes; its submit is a duplicate.  A
+    # fault that kills the no-op ack drops the connection but mutates
+    # nothing; the plain retry is acknowledged.
+    faults.install(FaultPlan(seed=31).error("submit.duplicate", times=1))
+    try:
+        with pytest.raises(InjectedFault):
+            _submit(co, 1, tok_slow, prover_id="slow-prover")
+    finally:
+        faults.clear()
+    r = _submit(co, 1, tok_slow, prover_id="slow-prover")
+    assert r["type"] == protocol.SUBMIT_ACK
+    assert co.duplicate_submits_total == 2       # both attempts counted
+    # the loser burned NO failure/quarantine budget and lost no lease
+    assert co.failures == {}
+    assert co.quarantined == set()
+    assert co.rejected_submits_total == 0
+    assert co.stale_submits_total == 0
+    # the stored proof is still the winner's (first write wins)
+    assert store.get_proof(1, EXEC) is not None
+
+
+def test_hedge_rejected_submit_burns_no_quarantine_budget(monkeypatch):
+    """An INVALID proof from the hedge holder costs the hedge its lease
+    but charges nothing against the batch: the primary keeps proving and
+    the quarantine budget is untouched."""
+    store, co = _bare_coordinator(verify_submissions=True,
+                                  hedge_min_samples=2, hedge_factor=1.0,
+                                  quarantine_threshold=2)
+    t = [0.0]
+    monkeypatch.setattr(co, "_now", lambda: t[0])
+    co.durations.extend([0.5, 0.5])
+    batch, tok_p = co.assign(EXEC, "primary")
+    assert batch == 1
+    t[0] = 1.0
+    hbatch, tok_h = co.assign(EXEC, "hedger")
+    assert hbatch == 1
+    r = co.handle_request({"type": protocol.PROOF_SUBMIT, "batch_id": 1,
+                           "prover_type": EXEC, "lease_token": tok_h,
+                           "proof": {"backend": "__corrupt__"}})
+    assert r["type"] == protocol.ERROR and "invalid proof" in r["message"]
+    assert co.hedges == {}                       # hedge lease revoked
+    assert (1, EXEC) in co.assignments           # primary lease intact
+    assert co.failures == {} and co.quarantined == set()
+    assert co.rejected_submits_total == 1
+
+
+def test_work_steal_from_overloaded_prover(monkeypatch):
+    """An idle prover steals (hedges) a batch from a holder sitting on
+    steal_threshold live leases, without waiting for the p99 deadline."""
+    store, co = _bare_coordinator(batches=2, steal_threshold=2)
+    t = [0.0]
+    monkeypatch.setattr(co, "_now", lambda: t[0])
+    assert co.assign(EXEC, "busy")[0] == 1
+    assert co.assign(EXEC, "busy")[0] == 2
+    # no duration samples -> the straggler path is disarmed; only the
+    # steal rule can hedge, and only for an idle requester
+    batch, token = co.assign(EXEC, "idle")
+    assert batch in (1, 2) and token is not None
+    assert co.hedges[(batch, EXEC)]["reason"] == "steal"
+    # the overloaded holder itself cannot steal its own work back
+    assert co.assign(EXEC, "busy") == (None, None)
+
+
+def test_size_aware_placement_fast_gets_heavy(monkeypatch):
+    """Fleet placement: with EWMA stats on both sides, the fastest prover
+    is steered to the heaviest waiting batch and the slowest to the
+    lightest."""
+    store = RollupStore()
+    light = {"blocks": [{"transactions": []}]}              # weight 1
+    heavy = {"blocks": [{"transactions": [{}] * 9}] * 2}    # weight 20
+    store.store_prover_input(1, protocol.PROTOCOL_VERSION, light)
+    store.store_prover_input(2, protocol.PROTOCOL_VERSION, heavy)
+    co = ProofCoordinator(store, needed_types=[EXEC],
+                          verify_submissions=False)
+    co.prover_stats["fast"] = {"completed": 3, "ewma": 1.0, "last_seen": 0}
+    co.prover_stats["slow"] = {"completed": 3, "ewma": 9.0, "last_seen": 0}
+    assert co.assign(EXEC, "fast")[0] == 2     # heaviest first
+    assert co.assign(EXEC, "slow")[0] == 1
+    assert co.queue_depth in (0, 1)            # depth sampled pre-grant
+
+
+def test_fcfs_policy_flag_pins_original_behavior(monkeypatch):
+    """scheduler_policy="fcfs" keeps the original scan: oldest batch
+    first regardless of stats, and NO hedging even past the deadline."""
+    store = RollupStore()
+    light = {"blocks": [{"transactions": []}]}
+    heavy = {"blocks": [{"transactions": [{}] * 9}] * 2}
+    store.store_prover_input(1, protocol.PROTOCOL_VERSION, light)
+    store.store_prover_input(2, protocol.PROTOCOL_VERSION, heavy)
+    co = ProofCoordinator(store, needed_types=[EXEC],
+                          verify_submissions=False,
+                          scheduler_policy="fcfs",
+                          hedge_min_samples=2, hedge_factor=1.0)
+    t = [0.0]
+    monkeypatch.setattr(co, "_now", lambda: t[0])
+    co.prover_stats["fast"] = {"completed": 3, "ewma": 1.0, "last_seen": 0}
+    co.prover_stats["slow"] = {"completed": 3, "ewma": 9.0, "last_seen": 0}
+    co.durations.extend([0.1, 0.1])
+    # FCFS: the fast prover still gets the OLDEST batch, not the heaviest
+    assert co.assign(EXEC, "fast")[0] == 1
+    assert co.assign(EXEC, "slow")[0] == 2
+    # way past any deadline: still no hedge under fcfs
+    t[0] = 100.0
+    t[0] = min(100.0, co.lease_timeout - 1)    # keep both leases live
+    assert co.assign(EXEC, "idle") == (None, None)
+    assert co.hedged_assignments_total == 0
+    # and an unknown policy is rejected outright
+    with pytest.raises(ValueError):
+        ProofCoordinator(store, scheduler_policy="lifo")
+
+
+def test_scheduler_state_in_stats_json(monkeypatch):
+    """The coordinator's health payload carries the scheduler section the
+    monitor panel and ethrex_health render."""
+    store, co = _bare_coordinator(batches=2)
+    t = [0.0]
+    monkeypatch.setattr(co, "_now", lambda: t[0])
+    co.durations.extend([1.0] * co.hedge_min_samples)
+    assert co.assign(EXEC, "p1")[0] == 1
+    sched = co.stats_json()["scheduler"]
+    assert sched["policy"] == "fleet"
+    assert sched["hedgedAssignments"] == 0
+    assert sched["duplicateSubmits"] == 0
+    assert sched["queueDepth"] == 1            # batch 2 still waiting
+    assert sched["hedgeDeadlineSeconds"] == pytest.approx(
+        co.hedge_factor * 1.0)
+    assert sched["provers"]["p1"]["liveLeases"] == 1

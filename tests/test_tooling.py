@@ -142,6 +142,28 @@ def test_serving_fault_sites_covered_by_overload_battery():
         f"serving sites without overload-battery coverage: {missing}"
 
 
+def test_scheduler_fault_sites_covered_by_scheduler_battery():
+    """The scheduling/aggregation sites are the scheduler battery's
+    contract: each must be exercised in tests/test_scheduler_chaos.py
+    specifically (coordinator.store_proof predates the fleet scheduler
+    and stays with the prover battery)."""
+    import os
+
+    from ethrex_tpu.utils import faults
+
+    here = os.path.dirname(__file__)
+    with open(os.path.join(here, "test_scheduler_chaos.py")) as f:
+        corpus = f.read()
+    sched_sites = ["coordinator.schedule", "aggregate.prove",
+                   "submit.duplicate"]
+    missing = [s for s in sched_sites if s not in faults.SITES]
+    assert not missing, \
+        f"scheduler fault sites missing from faults.SITES: {missing}"
+    missing = [s for s in sched_sites if f'"{s}"' not in corpus]
+    assert not missing, \
+        f"scheduler sites without scheduler-battery coverage: {missing}"
+
+
 def test_no_bare_print_in_library_modules():
     """Library diagnostics go through the structured logger
     (utils/tracing.py setup_logging), never bare print().  Terminal
