@@ -85,7 +85,9 @@ def build_prove_step(log_n: int, width: int, log_blowup: int = 2,
         return buf[0]
 
     def step(trace_cols, zeta, gamma, betas):
-        trace_cols = shard(trace_cols, (axis, None))
+        # trace_cols arrives column-sharded from the pjit boundary
+        # (in_shardings below); intermediates keep with_sharding_constraint
+        # where XLA needs a nudge (the LDE->hash transpose, fold chain)
         # 1. column-parallel LDE (NTT along rows, local per column)
         lde_cols = ntt.coset_lde(trace_cols, log_blowup, shift=shift)
         lde_rows = shard(lde_cols.T, (axis, None))  # transpose => all-to-all
@@ -133,7 +135,20 @@ def build_prove_step(log_n: int, width: int, log_blowup: int = 2,
         jnp.stack([ext.to_device(tuple(int(x) for x in rng.integers(0, bb.P, 4)))
                    for _ in range(L)]),
     )
-    return jax.jit(step), example_args
+    if mesh is None:
+        return jax.jit(step), example_args
+    # explicit pjit boundary: trace columns partitioned over the shard
+    # axis, challenges replicated (same sharding_for policy as the
+    # stark/prover.py phase programs).  Example args are placed to match
+    # so the AOT-compiled executable accepts them without resharding.
+    # NO donate_argnums here: the bench reuses example_args across runs,
+    # and donation would invalidate the trace buffer after the first call.
+    repl = mesh_lib.replicated(mesh)
+    in_sh = (mesh_lib.sharding_for(mesh, (width, n), (axis, None)),
+             repl, repl, repl)
+    example_args = tuple(jax.device_put(a, s)
+                         for a, s in zip(example_args, in_sh))
+    return jax.jit(step, in_shardings=in_sh), example_args
 
 
 def compile_prove_step(log_n: int, width: int, log_blowup: int = 2,

@@ -5,6 +5,18 @@ reference's NCCL/MPI-style backends map to XLA collectives over ICI/DCN):
 pick a mesh, annotate shardings, let XLA insert collectives.  Single axis
 "shard" for round 1 (FRI/LDE row sharding + column sharding for the NTT);
 later rounds add a second axis for prover-fleet batch parallelism.
+
+Two sharding entry points live here so every mesh consumer applies the
+SAME partitioning policy:
+
+- `sharding_for(mesh, shape, spec)` — the pjit boundary form: a
+  NamedSharding where any AXIS entry whose dimension does not divide
+  evenly across the mesh is dropped (replicated).  stark/prover.py's
+  phase programs and parallel/core.py's fused step both build their
+  `in_shardings`/`out_shardings` through it.
+- `split_mesh(mesh, n_jobs)` — disjoint contiguous sub-meshes for
+  embarrassingly parallel proving (one STARK per slice); the slice
+  policy is documented on the function and locked by tests.
 """
 
 from __future__ import annotations
@@ -39,3 +51,56 @@ def col_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def shape_label(mesh: Mesh | None) -> str:
+    """Stable label for a mesh's device layout ("none", "4", "2x4") —
+    used to key retrace telemetry by mesh shape."""
+    if mesh is None:
+        return "none"
+    return "x".join(str(int(s)) for s in mesh.devices.shape)
+
+
+def sharding_for(mesh: Mesh, shape: tuple, spec: tuple) -> NamedSharding:
+    """NamedSharding for an array of `shape` under `spec` (a tuple of
+    AXIS / None per dimension), with the partition-or-replicate policy
+    shared by every mesh consumer: an AXIS entry is kept only when that
+    dimension splits evenly across the mesh (dim >= ndev and
+    dim % ndev == 0), otherwise the dimension is replicated.  Dropping
+    the annotation never changes results — all prover arithmetic is
+    exact u32 work — it only changes layout, so small or ragged
+    dimensions stay whole instead of forcing padded collectives."""
+    ndev = int(mesh.devices.size)
+    dims = []
+    for d, s in zip(shape, spec):
+        keep = s == AXIS and ndev > 1 and d >= ndev and d % ndev == 0
+        dims.append(AXIS if keep else None)
+    return NamedSharding(mesh, P(*dims))
+
+
+def split_mesh(mesh: Mesh, n_jobs: int) -> list[Mesh]:
+    """Split a 1-axis mesh into disjoint contiguous sub-meshes for
+    `n_jobs` independent proofs.
+
+    Policy (locked by tests/test_mesh_sharding.py):
+    - number of slices = min(n_jobs, n_devices) — never more slices
+      than devices, never more than jobs;
+    - every device is used: sizes differ by at most one, with the
+      earlier slices taking the extra device (8 devices / 3 jobs ->
+      3+3+2);
+    - jobs beyond the slice count are assigned round-robin by the
+      caller, proven serially within their slice;
+    - 1 device or 1 job -> [mesh] unchanged (the serial fallback).
+    """
+    devs = list(mesh.devices.flat)
+    k = max(1, min(int(n_jobs), len(devs)))
+    if k == 1:
+        return [mesh]
+    base, extra = divmod(len(devs), k)
+    out = []
+    start = 0
+    for i in range(k):
+        size = base + (1 if i < extra else 0)
+        out.append(Mesh(devs[start:start + size], (AXIS,)))
+        start += size
+    return out

@@ -137,7 +137,9 @@ def _span_observer(name, stage, seconds):
     span is visible the day it ships."""
     if stage in _STARK_STAGES:
         PROFILER.record("stark", stage, seconds)
-    elif stage in _BACKEND_STAGES:
+    elif stage in _BACKEND_STAGES or stage.startswith("vm_circuits/"):
+        # per-slice vm_circuits/<air> spans (parallel mesh proving)
+        # attribute to the prover component alongside the aggregate
         PROFILER.record("prover", stage, seconds)
     else:
         PROFILER.record("other", stage, seconds)

@@ -104,13 +104,19 @@ class RooflineRegistry:
             if len(self._kernels) >= self.MAX_KEYS:
                 return None
             cell = self._kernels[key] = {
-                "flops": None, "bytes": None,
+                "flops": None, "bytes": None, "devices": 1,
                 "wallCount": 0, "wallTotal": 0.0, "wallLast": None,
                 "wallMin": None,
             }
         return cell
 
-    def record_cost(self, air: str, kernel: str, cost) -> None:
+    def record_cost(self, air: str, kernel: str, cost,
+                    devices: int = 1) -> None:
+        """`devices`: mesh size the executable was compiled for — the
+        report carries it so a sharded kernel's static FLOPs are read
+        against the right number of chips (utilization stays relative
+        to the single-chip peak estimate, documented in
+        docs/PERFORMANCE.md)."""
         parsed = _parse_cost(cost)
         with self._lock:
             cell = self._cell(air, kernel)
@@ -120,6 +126,7 @@ class RooflineRegistry:
                 cell["flops"] = parsed["flops"]
             if parsed["bytes"] is not None:
                 cell["bytes"] = parsed["bytes"]
+            cell["devices"] = max(1, int(devices))
 
     def record_wall(self, air: str, kernel: str, seconds: float) -> None:
         sec = float(seconds)
@@ -152,6 +159,7 @@ class RooflineRegistry:
             achieved = flops / last if flops and last else None
             kernels.append({
                 "air": air, "kernel": kernel,
+                "devices": c.get("devices", 1),
                 "flops": flops, "bytes": nbytes,
                 "intensityFlopsPerByte":
                     round(flops / nbytes, 3) if flops and nbytes else None,
@@ -183,11 +191,12 @@ class RooflineRegistry:
 ROOFLINE = RooflineRegistry()
 
 
-def record_cost(air: str, kernel: str, cost) -> None:
+def record_cost(air: str, kernel: str, cost, devices: int = 1) -> None:
     """Never-raise hook: fold one compiled program's cost_analysis()
-    output (any shape, including None) into the registry."""
+    output (any shape, including None) into the registry; `devices` is
+    the mesh size the executable was compiled for (1 = unsharded)."""
     try:
-        ROOFLINE.record_cost(air, kernel, cost)
+        ROOFLINE.record_cost(air, kernel, cost, devices=devices)
     except Exception:
         pass
 

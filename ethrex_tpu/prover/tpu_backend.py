@@ -391,6 +391,99 @@ def expected_vm_mode(program_input: ProgramInput) -> str:
                                   output.initial_state_root)
 
 
+def _run_proof_jobs(jobs: list, mesh) -> dict:
+    """Run independent STARK proving jobs, concurrently when the mesh
+    has devices to split.
+
+    `jobs` is a list of ``(name, group, builder)``; ``builder(job_mesh)``
+    generates its trace and returns a proof dict.  With no mesh or a
+    1-device mesh jobs run serially on the caller's thread, VM-circuit
+    jobs wrapped in the pre-existing ``vm_circuits`` stage span with one
+    ``vm_circuits/<air>`` child span each.  Otherwise the mesh is split
+    into min(len(jobs), n_devices) disjoint contiguous slices
+    (parallel/mesh.py split policy) and one worker thread per slice runs
+    its round-robin share of jobs serially, re-entering the caller's
+    trace so per-job spans land in the same trace tree; the aggregate
+    ``vm_circuits`` wall (first VM start to last VM finish, overlap
+    collapsed) is fed to prover_stage_seconds directly.  Proofs are
+    bit-identical to the serial path — slicing only changes placement.
+    Returns results keyed by job name; a worker exception propagates.
+    """
+    import time as _time
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ..parallel import mesh as mesh_lib
+    from ..utils import metrics as metrics_mod
+
+    ndev = 1 if mesh is None else int(mesh.devices.size)
+    try:
+        metrics_mod.record_mesh_devices(ndev)
+    except Exception:
+        pass
+
+    def _run_one(name, group, build, job_mesh):
+        stage = name if group == "vm_circuits" else group
+        with tracing.span(f"prove.{name}", stage=stage):
+            return build(job_mesh)
+
+    results: dict = {}
+    vm_jobs = [j for j in jobs if j[1] == "vm_circuits"]
+    if ndev == 1 or len(jobs) == 1:
+        try:
+            metrics_mod.record_vm_parallelism(1)
+        except Exception:
+            pass
+        for name, group, build in jobs:
+            if group != "vm_circuits":
+                results[name] = _run_one(name, group, build, mesh)
+        if vm_jobs:
+            with tracing.span("prove.vm_proofs", stage="vm_circuits"):
+                for name, group, build in vm_jobs:
+                    results[name] = _run_one(name, group, build, mesh)
+        return results
+
+    slices = mesh_lib.split_mesh(mesh, len(jobs))
+    assigned: list[list] = [[] for _ in slices]
+    vm_slices = set()
+    for i, job in enumerate(jobs):
+        assigned[i % len(slices)].append(job)
+        if job[1] == "vm_circuits":
+            vm_slices.add(i % len(slices))
+    try:
+        metrics_mod.record_vm_parallelism(max(1, len(vm_slices)))
+    except Exception:
+        pass
+
+    cur = tracing.current()
+    tid, pid = cur if cur else (None, None)
+    timings: dict = {}
+
+    def _worker(slice_mesh, slice_jobs):
+        # re-enter the prove's trace on this thread so every job span
+        # (and its stark child spans) joins the same subtree
+        with tracing.trace_context(tid, pid):
+            for name, group, build in slice_jobs:
+                t0 = _time.perf_counter()
+                results[name] = _run_one(name, group, build, slice_mesh)
+                timings[name] = (t0, _time.perf_counter())
+
+    with ThreadPoolExecutor(max_workers=len(slices)) as pool:
+        futs = [pool.submit(_worker, s, a)
+                for s, a in zip(slices, assigned) if a]
+        for f in futs:
+            f.result()
+
+    vm_times = [timings[name] for name, group, _ in jobs
+                if group == "vm_circuits" and name in timings]
+    if vm_times:
+        wall = max(t1 for _, t1 in vm_times) - min(t0 for t0, _ in vm_times)
+        try:
+            metrics_mod.observe_prover_stage("vm_circuits", wall)
+        except Exception:
+            pass
+    return results
+
+
 class TpuBackend(ProverBackend):
     prover_type = protocol.PROVER_TPU
 
@@ -453,19 +546,30 @@ class TpuBackend(ProverBackend):
             except tl_mod.NotTransferBatch:
                 pass
 
-        with tracing.span("prove.state_proof", stage="state_proof"):
-            entries = access_log.flatten_entries(blocks_log)
-            records, r_pre, r_post, depth = \
-                access_log.build_access_records(entries)
-            S = _schedule_for(depth)
-            air = sua.StateUpdateAir(depth, seg_periods=S)
+        # -- independent STARK jobs: state_proof + the VM-mode circuits.
+        # Each job is (name, stage, builder) where builder(mesh) generates
+        # its trace and proves on the mesh slice it is handed.  With a
+        # multi-device mesh the jobs run CONCURRENTLY on disjoint
+        # sub-meshes (parallel/mesh.py split_mesh policy: min(jobs,
+        # devices) contiguous slices, every device used, extra jobs
+        # round-robined and proven serially within their slice); with no
+        # mesh or 1 device they run serially on the main thread.  Proofs
+        # are bit-identical either way — sharding and slicing only move
+        # layout, never values.
+        entries = access_log.flatten_entries(blocks_log)
+        records, r_pre, r_post, depth = \
+            access_log.build_access_records(entries)
+        S = _schedule_for(depth)
+        air = sua.StateUpdateAir(depth, seg_periods=S)
+        pub = sua.state_update_public_inputs(records, r_pre, r_post, S)
+
+        def _state_job(job_mesh):
             trace = sua.generate_state_update_trace(records, r_pre,
                                                     depth, S)
-            pub = sua.state_update_public_inputs(records, r_pre,
-                                                 r_post, S)
-            state_proof = stark_prover.prove(air, trace, pub, PARAMS,
-                                             mesh=self.mesh)
-        digest = pub[16:24]
+            return stark_prover.prove(air, trace, pub, PARAMS,
+                                      mesh=job_mesh)
+
+        jobs = [("state_proof", "state_proof", _state_job)]
 
         vm_pub = None
         vm_proof = None
@@ -477,33 +581,55 @@ class TpuBackend(ProverBackend):
         bc_proofs: list = []
         bc_airs: list = []
         if vm_batch is not None:
-            with tracing.span("prove.vm_proofs", stage="vm_circuits"):
-                vm_air = ta.TransferAir()
-                vm_trace = ta.generate_transfer_trace(vm_batch.segs)
-                vm_pub = ta.transfer_public_inputs(vm_batch.segs)
-                vm_proof = stark_prover.prove(vm_air, vm_trace, vm_pub,
-                                              PARAMS, mesh=self.mesh)
-                if vm_batch.tok_segs:
-                    tok_air = tka.TokenAir()
-                    tok_trace = tka.generate_token_trace(
-                        vm_batch.tok_segs)
-                    tok_pub = tka.token_public_inputs(vm_batch.tok_segs)
-                    tok_proof = stark_prover.prove(tok_air, tok_trace,
-                                                   tok_pub, PARAMS,
-                                                   mesh=self.mesh)
-                if vm_batch.bc_calls:
-                    from ..models import bytecode_air as bca
+            vm_air = ta.TransferAir()
+            vm_pub = ta.transfer_public_inputs(vm_batch.segs)
 
-                    for call in vm_batch.bc_calls:
-                        air_bc = bca.BytecodeAir()
-                        bc_trace = bca.generate_bytecode_trace(
-                            call.steps, call.snaps)
-                        pub_bc = bca.bytecode_public_inputs(call.steps)
-                        bc_airs.append(air_bc)
-                        bc_pubs.append(pub_bc)
-                        bc_proofs.append(stark_prover.prove(
-                            air_bc, bc_trace, pub_bc, PARAMS,
-                            mesh=self.mesh))
+            def _transfer_job(job_mesh):
+                trace = ta.generate_transfer_trace(vm_batch.segs)
+                return stark_prover.prove(vm_air, trace, vm_pub,
+                                          PARAMS, mesh=job_mesh)
+
+            jobs.append(("vm_circuits/TransferAir", "vm_circuits",
+                         _transfer_job))
+            if vm_batch.tok_segs:
+                tok_air = tka.TokenAir()
+                tok_pub = tka.token_public_inputs(vm_batch.tok_segs)
+
+                def _token_job(job_mesh):
+                    trace = tka.generate_token_trace(vm_batch.tok_segs)
+                    return stark_prover.prove(tok_air, trace, tok_pub,
+                                              PARAMS, mesh=job_mesh)
+
+                jobs.append(("vm_circuits/TokenAir", "vm_circuits",
+                             _token_job))
+            if vm_batch.bc_calls:
+                from ..models import bytecode_air as bca
+
+                for idx, call in enumerate(vm_batch.bc_calls):
+                    air_bc = bca.BytecodeAir()
+                    pub_bc = bca.bytecode_public_inputs(call.steps)
+                    bc_airs.append(air_bc)
+                    bc_pubs.append(pub_bc)
+
+                    def _bc_job(job_mesh, _air=air_bc, _call=call,
+                                _pub=pub_bc):
+                        trace = bca.generate_bytecode_trace(
+                            _call.steps, _call.snaps)
+                        return stark_prover.prove(_air, trace, _pub,
+                                                  PARAMS, mesh=job_mesh)
+
+                    jobs.append((f"vm_circuits/BytecodeAir{idx}",
+                                 "vm_circuits", _bc_job))
+
+        results = _run_proof_jobs(jobs, self.mesh)
+        state_proof = results["state_proof"]
+        if vm_batch is not None:
+            vm_proof = results["vm_circuits/TransferAir"]
+            if vm_batch.tok_segs:
+                tok_proof = results["vm_circuits/TokenAir"]
+            bc_proofs = [results[f"vm_circuits/BytecodeAir{i}"]
+                         for i in range(len(bc_airs))]
+        digest = pub[16:24]
 
         with tracing.span("prove.binding", stage="binding"):
             limbs = binding_limbs(encoded, r_pre, r_post, digest, vm_pub,
@@ -548,7 +674,8 @@ class TpuBackend(ProverBackend):
             airs.extend(bc_airs)
             proofs.extend(bc_proofs)
             with tracing.span("prove.aggregate", stage="aggregate"):
-                agg = agg_mod.aggregate(airs, proofs, PARAMS)
+                agg = agg_mod.aggregate(airs, proofs, PARAMS,
+                                        mesh=self.mesh)
             proof["state_proof"], proof["proof"] = agg.inners[:2]
             cursor = 2
             if vm_batch is not None:

@@ -642,6 +642,11 @@ def _perf(node):
                          "prover_trace_cells_per_sec",
                          "proofs_per_hour")
         }
+        out["mesh"] = {
+            "devices": gauges.get("prover_mesh_devices"),
+            "vmCircuitsParallel":
+                gauges.get("prover_vm_circuits_parallel"),
+        }
     except Exception as exc:  # noqa: BLE001 — telemetry endpoint
         out["throughput"] = {"error": f"{type(exc).__name__}: {exc}"}
     return out

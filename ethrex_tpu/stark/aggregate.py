@@ -203,9 +203,13 @@ class AggregateProof:
 
 def aggregate(airs: list[Air], proofs: list[dict],
               params: StarkParams = StarkParams(),
-              outer_params: StarkParams | None = None) -> AggregateProof:
+              outer_params: StarkParams | None = None,
+              mesh=None) -> AggregateProof:
     """Prove the aggregate: one FriVerifyAir STARK covering every FRI
-    query opening of every inner proof."""
+    query opening of every inner proof.  `mesh` (a jax Mesh or None)
+    shards the outer recursion proof the same way as any inner prove —
+    by this point the inner slices have been joined, so the whole mesh
+    is available to the single outer STARK."""
     if not proofs:
         raise AggregationError("nothing to aggregate")
     items = []
@@ -222,7 +226,7 @@ def aggregate(airs: list[Air], proofs: list[dict],
     digest = fva.transcript_digest([it["msg"] for it in items],
                                    air_out.seg_periods)
     outer = stark_prover.prove(air_out, trace, digest,
-                               outer_params or params)
+                               outer_params or params, mesh=mesh)
     return AggregateProof(
         inners=[_strip_paths(p) for p in proofs], outer=outer,
         max_depth=max_depth, seg_periods=air_out.seg_periods)
@@ -230,7 +234,8 @@ def aggregate(airs: list[Air], proofs: list[dict],
 
 def aggregate_groups(groups: list[tuple[list[Air], list[dict]]],
                      params: StarkParams = StarkParams(),
-                     outer_params: StarkParams | None = None
+                     outer_params: StarkParams | None = None,
+                     mesh=None
                      ) -> tuple[AggregateProof, list[tuple[int, int]]]:
     """Cross-batch recursion entry (l2/aggregator.py): each group is one
     batch's (airs, proofs); every group's FRI query work lands in the SAME
@@ -247,7 +252,7 @@ def aggregate_groups(groups: list[tuple[list[Air], list[dict]]],
         airs.extend(g_airs)
         proofs.extend(g_proofs)
         slices.append((start, len(proofs)))
-    agg = aggregate(airs, proofs, params, outer_params)
+    agg = aggregate(airs, proofs, params, outer_params, mesh=mesh)
     return agg, slices
 
 

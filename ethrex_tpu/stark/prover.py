@@ -36,7 +36,7 @@ from ..ops import merkle
 from ..ops import ntt
 from ..ops.challenger import Challenger
 from ..utils import tracing
-from ..utils.metrics import record_kernel_build
+from ..utils.metrics import record_kernel_build, record_phase_compile
 from .air import Air, DeviceOps
 
 
@@ -72,46 +72,93 @@ _PHASE_CACHE: dict = {}
 
 
 def _mesh_key(mesh):
+    """Cache identity of a mesh: the exact device set, axis names AND
+    layout shape.  A compiled (or pjit-sharded) program is bound to its
+    devices, so two meshes are interchangeable only when all three
+    match; None (no mesh) is its own key.  Keying on this — not object
+    identity — means switching mesh <-> no-mesh, resizing the mesh, or
+    proving on a different sub-slice can never be served a stale
+    program, while re-building an identical Mesh object stays a hit."""
     if mesh is None:
         return None
-    return (tuple(d.id for d in mesh.devices.flat), mesh.axis_names)
+    return (tuple(int(d.id) for d in mesh.devices.flat),
+            tuple(mesh.axis_names), tuple(mesh.devices.shape))
 
 
-def _phases(air: Air, log_n: int, lb: int, shift: int, mesh=None):
+def clear_phase_cache() -> None:
+    """Drop every cached phase program (tests / simulated restarts)."""
+    _PHASE_CACHE.clear()
+
+
+class PhasePrograms:
+    """The four compiled phase programs plus the input-placement plan.
+
+    `put_cols` / `put_small` commit leaf inputs to the shardings the
+    programs were compiled against (identity on the single-device
+    path); intermediates already carry matched shardings because each
+    program's out_shardings equal the next program's in_shardings."""
+
+    __slots__ = ("commit", "quotient", "open", "deep", "plan")
+
+    def __init__(self, programs, plan):
+        self.commit, self.quotient, self.open, self.deep = programs
+        self.plan = plan
+
+    def put_cols(self, x):
+        if self.plan is None:
+            return x
+        return jax.device_put(x, self.plan.cols)
+
+    def put_small(self, x):
+        if self.plan is None:
+            return x
+        return jax.device_put(x, self.plan.repl)
+
+
+def _phases(air: Air, log_n: int, lb: int, shift: int,
+            mesh=None) -> PhasePrograms:
     """Phase programs, cached by *structural* AIR identity.
 
     Keyed on (type, width, degree, pub-count) rather than object identity so
     `prove(MixerAir(16), ...)` in a loop reuses compiled programs.  AIRs with
     extra structure-affecting parameters must reflect them in `cache_key()`.
+    The mesh participates in the key via `_mesh_key` (device set + layout).
 
-    On the single-device path the programs are AOT-compiled (lower +
-    compile against ShapeDtypeStructs) so the XLA cost model is captured
-    for roofline accounting; `record_kernel_build` therefore now times
-    trace + staging + backend compile for a cache miss.
+    Programs are AOT-compiled (lower + compile against ShapeDtypeStructs)
+    on BOTH the single-device and mesh paths, so the XLA cost model is
+    captured for roofline accounting either way; `record_kernel_build`
+    therefore times trace + staging + backend compile for a cache miss,
+    labelled with the mesh shape.
     """
     key = (air.cache_key(), log_n, lb, shift, _mesh_key(mesh))
     cached = _PHASE_CACHE.get(key)
     if cached is not None:
         return cached
     t0 = time.perf_counter()
-    built = _aot_phases(air, log_n, lb,
-                        _build_phases(air, log_n, lb, shift, mesh), mesh)
+    bodies, plan = _build_phases(air, log_n, lb, shift, mesh)
+    built = PhasePrograms(
+        _aot_phases(air, log_n, lb, bodies, plan, mesh), plan)
     _PHASE_CACHE[key] = built
     # retrace telemetry: every miss here is a fresh set of phase programs
-    record_kernel_build(type(air).__name__, time.perf_counter() - t0)
+    from ..parallel import mesh as mesh_lib
+
+    record_kernel_build(type(air).__name__, time.perf_counter() - t0,
+                        mesh=mesh_lib.shape_label(mesh))
     return built
 
 
 _KERNELS = ("commit", "quotient", "open", "deep")
 
 
-def _record_phase_cost(air_name: str, kernel: str, compiled) -> None:
+def _record_phase_cost(air_name: str, kernel: str, compiled,
+                       devices: int = 1) -> None:
     # roofline hooks are telemetry: a failing cost_analysis (None on some
     # backends, shape drift across jaxlib versions) can never fail a prove
     try:
         from ..perf import roofline
 
-        roofline.record_cost(air_name, kernel, compiled.cost_analysis())
+        roofline.record_cost(air_name, kernel, compiled.cost_analysis(),
+                             devices=devices)
     except Exception:
         pass
 
@@ -135,19 +182,52 @@ def _record_prove_throughput(cells: int, seconds: float) -> None:
         pass
 
 
-def _aot_phases(air: Air, log_n: int, lb: int, phases, mesh):
+def _jit_programs(bodies, plan):
+    """Wrap the phase bodies as (lazily) jitted programs.
+
+    Single-device (`plan is None`): plain jit, exactly the legacy path.
+    Mesh: pjit-style jit with explicit in/out shardings matched between
+    pipeline stages and the big consumed buffers donated (lde_cols into
+    quotient, chunks into open, q_lde into deep — each is dead after
+    its consuming phase; cols and lde_rows are reused by later stages
+    and the host query openings, so they are never donated)."""
+    if plan is None:
+        return tuple(jax.jit(b) for b in bodies)
+    return tuple(
+        jax.jit(body,
+                in_shardings=plan.in_shardings[kernel],
+                out_shardings=plan.out_shardings[kernel],
+                donate_argnums=plan.donate[kernel])
+        for kernel, body in zip(_KERNELS, bodies))
+
+
+def _shard_map_program(body, mesh):
+    """Fully-replicated shard_map fallback for a phase that does not
+    partition cleanly: every device redundantly runs the whole phase
+    (in_specs/out_specs all P()), so outputs are replicated and
+    bit-identical — correctness is preserved at the cost of the
+    parallel win for that one kernel."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=P(),
+                             out_specs=P(), check_rep=False))
+
+
+def _aot_phases(air: Air, log_n: int, lb: int, bodies, plan, mesh):
     """AOT-compile the four phase programs against their (statically
     known) argument shapes and register each executable's XLA cost
-    analysis with the roofline registry.
+    analysis with the roofline registry — mesh and single-device paths
+    alike, so sharded programs get the same roofline cost records.
 
-    Single-device path only: with a mesh the lazily-jitted programs are
-    kept (an AOT executable pins input placement, and the sharded path
-    is exercised against virtual device counts in tests).  Any lowering
-    or compile failure falls back to the jitted callable for that phase
-    — the prove still runs, the kernel just has no static cost entry.
-    ETHREX_PERF_NO_AOT=1 forces the fallback (drills, A/B timing)."""
-    if mesh is not None or os.environ.get("ETHREX_PERF_NO_AOT") == "1":
-        return phases
+    Fallback ladder per kernel: pjit with explicit shardings -> (mesh
+    only) fully-replicated shard_map -> the lazily-jitted callable.
+    The prove always runs; a kernel only loses its static cost entry
+    when every AOT attempt fails.  ETHREX_PERF_NO_AOT=1 forces the lazy
+    fallback (drills, A/B timing)."""
+    lazy = _jit_programs(bodies, plan)
+    if os.environ.get("ETHREX_PERF_NO_AOT") == "1":
+        return lazy
     n = 1 << log_n
     w = air.width
     B = 1 << lb
@@ -165,32 +245,110 @@ def _aot_phases(air: Air, log_n: int, lb: int, phases, mesh):
                      S((w, 4), u32), S((B, 4), u32), e, e, e),
         }
     except Exception:
-        return phases
+        return lazy
     air_name = type(air).__name__
+    devices = 1 if mesh is None else int(mesh.devices.size)
+    from ..parallel import mesh as mesh_lib
+
+    mesh_label = mesh_lib.shape_label(mesh)
     out = []
-    for kernel, fn in zip(_KERNELS, phases):
+    for kernel, body, fn in zip(_KERNELS, bodies, lazy):
+        compiled = None
+        t_c = time.perf_counter()
         try:
             compiled = fn.lower(*specs[kernel]).compile()
-            _record_phase_cost(air_name, kernel, compiled)
-            out.append(compiled)
         except Exception:
+            if mesh is not None:
+                try:
+                    compiled = _shard_map_program(body, mesh).lower(
+                        *specs[kernel]).compile()
+                except Exception:
+                    compiled = None
+        if compiled is None:
             out.append(fn)
+            continue
+        # per-program compile wall: the cold-start baseline each warmup
+        # pays per phase program (bench measure_config4 reports these)
+        record_phase_compile(air_name, kernel,
+                             time.perf_counter() - t_c, mesh=mesh_label)
+        _record_phase_cost(air_name, kernel, compiled, devices)
+        out.append(compiled)
     return tuple(out)
 
 
+class _MeshPlan:
+    """Per-kernel pjit shardings + donation, and leaf-input placements.
+
+    in_shardings/out_shardings are keyed by kernel name and MATCHED
+    between pipeline stages: commit's lde_cols out == quotient's in,
+    commit's lde_rows out == deep's in, quotient's chunks out == open's
+    in, quotient's q_lde out == deep's in — so no phase boundary ever
+    forces a resharding collective."""
+
+    __slots__ = ("in_shardings", "out_shardings", "donate", "cols",
+                 "repl", "devices")
+
+    def __init__(self, mesh, log_n: int, lb: int, w: int, nb: int):
+        from ..parallel import mesh as mesh_lib
+
+        A = mesh_lib.AXIS
+        n = 1 << log_n
+        B = 1 << lb
+        N = n << lb
+
+        def sh(shape, *spec):
+            return mesh_lib.sharding_for(mesh, shape, spec)
+
+        self.devices = int(mesh.devices.size)
+        self.cols = sh((w, n), A, None)
+        self.repl = mesh_lib.replicated(mesh)
+        e = self.repl                       # small (4,) transcript values
+        cols = self.cols                    # (w, n) trace columns
+        lde_cols = sh((w, N), A, None)      # column-parallel NTT layout
+        lde_rows = sh((N, w), A, None)      # row-parallel Merkle/DEEP
+        chunks = sh((B, n, 4), A, None, None)
+        q_lde = sh((B, 4, N), None, None, A)
+        q_rows = sh((N, B * 4), A, None)
+        # Merkle levels: (N >> k, 8) rows; sharding_for replicates the
+        # small tail levels automatically (dim < ndev)
+        levels_t = tuple(sh((N >> k, 8), A, None)
+                         for k in range((N.bit_length() - 1) + 1))
+        self.in_shardings = {
+            "commit": (cols,),
+            "quotient": (lde_cols, e, e),
+            "open": (cols, chunks, e, e),
+            "deep": (lde_rows, q_lde, e, e, e, e, e, e),
+        }
+        self.out_shardings = {
+            "commit": (lde_cols, lde_rows, levels_t),
+            "quotient": (chunks, q_lde, q_rows, levels_t),
+            "open": (e, e, e),
+            "deep": sh((N, 4), A, None),
+        }
+        # donate only buffers dead after their consuming phase: cols is
+        # reused by open, lde_rows/q_rows by the host query openings
+        self.donate = {"commit": (), "quotient": (0,), "open": (1,),
+                       "deep": (1,)}
+
+
 def _build_phases(air: Air, log_n: int, lb: int, shift: int, mesh=None):
-    """Build the jitted phase programs for a given AIR and trace shape.
+    """Build the four phase BODIES for a given AIR and trace shape, plus
+    the mesh partition plan (None on the single-device path); returns
+    (bodies, plan).
 
     Boundary structure (rows/cols) must not depend on public-input *values*
     (values are traced inputs; structure is baked into the program).
 
-    With `mesh`, every phase annotates its large intermediates with
-    sharding constraints over the mesh's "shard" axis (column-parallel
-    NTT, row-parallel Merkle/DEEP — the same layout as the fused demo
-    core, parallel/core.py) and XLA inserts the ICI collectives.  This is
-    the PRODUCTION prover's multi-chip path (SURVEY.md §5 "shard the
-    STARK trace across the slice"); the host transcript and query
-    openings are unchanged.
+    With `mesh`, the bodies stay annotation-free: partitioning is
+    expressed ONCE at each pjit boundary via the plan's matched
+    in/out shardings (trace columns and LDE rows over the mesh's
+    "shard" axis — the same layout as the fused demo core,
+    parallel/core.py — small commitments replicated) and GSPMD
+    propagates through the program interior, inserting the ICI
+    collectives.  This is the PRODUCTION prover's multi-chip path
+    (SURVEY.md §5 "shard the STARK trace across the slice"); the host
+    transcript and query openings are unchanged and proofs are
+    bit-identical to single-device runs (all arithmetic is exact u32).
     """
     n = 1 << log_n
     w = air.width
@@ -236,51 +394,23 @@ def _build_phases(air: Air, log_n: int, lb: int, shift: int, mesh=None):
     ))))
     pts_m_np = bb.to_mont_host(_domain_points(log_N, shift))
 
-    if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        from ..parallel import mesh as mesh_lib
-
-        axis = mesh_lib.AXIS
-        ndev = len(mesh.devices.flat)
-
-        def shard(x, spec):
-            # stop constraining once the sharded dim is below the mesh
-            dim = x.shape[list(spec).index(axis)] if axis in spec else None
-            if dim is not None and dim < ndev:
-                return x
-            return jax.lax.with_sharding_constraint(
-                x, NamedSharding(mesh, P(*spec)))
-    else:
-        axis = "shard"
-
-        def shard(x, spec):
-            return x
-
-    def row_shard(d):
-        return shard(d, (axis, None))
-
-    @jax.jit
     def phase_commit(cols):
-        lde_cols = shard(ntt.coset_lde(shard(cols, (axis, None)), lb,
-                                       shift=shift), (axis, None))
-        lde_rows = shard(lde_cols.T, (axis, None))  # transpose: all-to-all
-        levels = merkle.build_levels_with(lde_rows, row_shard)
+        lde_cols = ntt.coset_lde(cols, lb, shift=shift)
+        lde_rows = lde_cols.T               # transpose: all-to-all
+        levels = merkle.build_levels_with(lde_rows)
         return lde_cols, lde_rows, levels
 
-    @jax.jit
     def phase_quotient(lde_cols, alpha, bound_vals):
         dev = DeviceOps()
         rolled = jnp.roll(lde_cols, -B, axis=1)
         local = [lde_cols[j] for j in range(w)]
         nxt = [rolled[j] for j in range(w)]
         periodic = [jnp.asarray(p) for p in periodic_np]
-        cons = shard(jnp.stack(air.constraints(local, nxt, periodic, dev)),
-                     (None, axis))                                 # (K, N)
+        cons = jnp.stack(air.constraints(local, nxt, periodic, dev))  # (K, N)
         apow = ext.ext_powers(alpha, K + nb)                      # (K+nb, 4)
         # random-linear-combination of constraint columns: an MXU matmul
         # (N, K) @ (K, 4) instead of materializing a (K, N, 4) product
-        acc = bb.mod_matmul(shard(cons.T, (axis, None)), apow[:K])  # (N, 4)
+        acc = bb.mod_matmul(cons.T, apow[:K])                      # (N, 4)
         inv_stack = jnp.asarray(inv_stack_np)
         inv_xn1 = jnp.tile(inv_stack[:B], N // B)
         xm = jnp.asarray(bb.to_mont_host(x_minus_glast))
@@ -292,27 +422,22 @@ def _build_phases(air: Air, log_n: int, lb: int, shift: int, mesh=None):
             q_acc = ext.add(q_acc, bb.mont_mul(
                 bb.mont_mul(diff, inv_x)[:, None], apow[K + j][None, :]
             ))
-        q_acc = shard(q_acc, (axis, None))
         qc = ntt.coset_intt(q_acc.T, shift=shift).T                # (N, 4)
         chunks = jnp.stack([qc[i * n:(i + 1) * n] for i in range(B)])
         q_lde = ntt.coset_evals_from_coeffs(
             jnp.moveaxis(chunks, -1, 1), N, shift=shift
         )                                                          # (B, 4, N)
-        q_lde = shard(q_lde, (None, None, axis))
-        q_rows = shard(jnp.moveaxis(q_lde, -1, 0).reshape(N, B * 4),
-                       (axis, None))
-        levels = merkle.build_levels_with(q_rows, row_shard)
+        q_rows = jnp.moveaxis(q_lde, -1, 0).reshape(N, B * 4)
+        levels = merkle.build_levels_with(q_rows)
         return chunks, q_lde, q_rows, levels
 
-    @jax.jit
     def phase_open(cols, chunks, zeta, zeta_g):
-        tcoeffs = ntt.intt(shard(cols, (axis, None)))
+        tcoeffs = ntt.intt(cols)
         t_z = ext.eval_base_poly_at_ext(tcoeffs, zeta)
         t_zg = ext.eval_base_poly_at_ext(tcoeffs, zeta_g)
         q_z = ext.eval_ext_poly_at_ext(chunks, zeta)
         return t_z, t_zg, q_z
 
-    @jax.jit
     def phase_deep(lde_rows, q_lde, t_z, t_zg, q_z, zeta, zeta_g, gamma):
         # sum_w gamma^w*(T_w(x) - T_w(z)) = (lde_rows @ gamma-powers) minus
         # a per-z constant: the contraction over columns runs as a base-
@@ -320,8 +445,7 @@ def _build_phases(air: Air, log_n: int, lb: int, shift: int, mesh=None):
         # minimal-polynomial inverse — same restructure as the fused
         # prove step (parallel/core.py), avoiding (N, w, 4) ext tensors.
         pts_m = jnp.asarray(pts_m_np)
-        lde_rows = shard(lde_rows, (axis, None))
-        inv_xz = shard(ext.inv_x_minus_zeta(pts_m, zeta), (axis, None))
+        inv_xz = ext.inv_x_minus_zeta(pts_m, zeta)
         inv_xzg = ext.inv_x_minus_zeta(pts_m, zeta_g)
         gpow = ext.ext_powers(gamma, 2 * w + B)
         s1 = ext.sub(bb.mod_matmul(lde_rows, gpow[:w]),
@@ -331,10 +455,12 @@ def _build_phases(air: Air, log_n: int, lb: int, shift: int, mesh=None):
         q_ext = jnp.moveaxis(q_lde, 1, -1)                         # (B, N, 4)
         d3 = ext.sub(q_ext, q_z[:, None])
         s3 = bb.sum_mod(ext.mul(d3, gpow[2 * w:, None]), axis=0)
-        return shard(ext.add(ext.mul(ext.add(s1, s3), inv_xz),
-                             ext.mul(s2, inv_xzg)), (axis, None))
+        return ext.add(ext.mul(ext.add(s1, s3), inv_xz),
+                       ext.mul(s2, inv_xzg))
 
-    return phase_commit, phase_quotient, phase_open, phase_deep
+    bodies = (phase_commit, phase_quotient, phase_open, phase_deep)
+    plan = None if mesh is None else _MeshPlan(mesh, log_n, lb, w, nb)
+    return bodies, plan
 
 
 # AIRs at least this wide produce XLA programs whose AOT serialization
@@ -378,8 +504,9 @@ def _prove(air: Air, trace: np.ndarray, pub_inputs: list[int],
     N = n << lb
     shift = params.shift % bb.P
     g_n = bb.root_of_unity(log_n)
-    p_commit, p_quotient, p_open, p_deep = _phases(air, log_n, lb, shift,
-                                                   mesh)
+    progs = _phases(air, log_n, lb, shift, mesh)
+    p_commit, p_quotient, p_open, p_deep = (
+        progs.commit, progs.quotient, progs.open, progs.deep)
     air_name = type(air).__name__
     t_prove0 = time.perf_counter()
 
@@ -395,7 +522,12 @@ def _prove(air: Air, trace: np.ndarray, pub_inputs: list[int],
     # ---- 1. trace commitment --------------------------------------------
     with tracing.span("prove.trace_lde", stage="trace_lde",
                       width=w, n=n):
-        cols = bb.to_mont(jnp.asarray(trace.T.astype(np.uint32)))   # (w, n)
+        # leaf inputs are committed to the shardings the programs were
+        # compiled against (no-op on the single-device path); every
+        # intermediate already flows stage-to-stage with matched
+        # out_shardings == in_shardings
+        cols = progs.put_cols(
+            bb.to_mont(jnp.asarray(trace.T.astype(np.uint32))))     # (w, n)
         t_k = time.perf_counter()
         lde_cols, lde_rows, levels_t = p_commit(cols)
         jax.block_until_ready((lde_cols, lde_rows))
@@ -411,12 +543,12 @@ def _prove(air: Air, trace: np.ndarray, pub_inputs: list[int],
     # ---- 2. constraint quotient -----------------------------------------
     with tracing.span("prove.quotient", stage="quotient"):
         bounds = air.boundaries(pub_inputs, n)
-        bound_vals = bb.to_mont(jnp.asarray(
+        bound_vals = progs.put_small(bb.to_mont(jnp.asarray(
             np.array([v % bb.P for (_, _, v) in bounds],
-                     dtype=np.uint32)))
+                     dtype=np.uint32))))
         t_k = time.perf_counter()
         chunks, q_lde, q_rows, levels_q = p_quotient(
-            lde_cols, ext.to_device(alpha), bound_vals)
+            lde_cols, progs.put_small(ext.to_device(alpha)), bound_vals)
         jax.block_until_ready(levels_q)
         _record_phase_wall(air_name, "quotient", time.perf_counter() - t_k)
         q_root = levels_q[-1][0]
@@ -428,7 +560,8 @@ def _prove(air: Air, trace: np.ndarray, pub_inputs: list[int],
         zeta_g = ext.h_mul(zeta, ext.h_from_base(g_n))
         t_k = time.perf_counter()
         t_z_dev, t_zg_dev, q_z_dev = p_open(
-            cols, chunks, ext.to_device(zeta), ext.to_device(zeta_g))
+            cols, chunks, progs.put_small(ext.to_device(zeta)),
+            progs.put_small(ext.to_device(zeta_g)))
         t_at_z = [tuple(int(x) for x in row) for row in _canon(t_z_dev)]
         t_at_zg = [tuple(int(x) for x in row)
                    for row in _canon(t_zg_dev)]
@@ -443,8 +576,9 @@ def _prove(air: Air, trace: np.ndarray, pub_inputs: list[int],
     with tracing.span("prove.fri_fold", stage="fri_fold"):
         t_k = time.perf_counter()
         F = p_deep(lde_rows, q_lde, t_z_dev, t_zg_dev, q_z_dev,
-                   ext.to_device(zeta), ext.to_device(zeta_g),
-                   ext.to_device(gamma))
+                   progs.put_small(ext.to_device(zeta)),
+                   progs.put_small(ext.to_device(zeta_g)),
+                   progs.put_small(ext.to_device(gamma)))
         jax.block_until_ready(F)
         _record_phase_wall(air_name, "deep", time.perf_counter() - t_k)
         fparams = fri.FriParams(

@@ -369,13 +369,38 @@ def record_verified_batch(batch_number: int):
                 "alert reads latest_batch minus this)")
 
 
-def record_kernel_build(air: str, seconds: float):
-    METRICS.inc("prover_kernel_retraces_total", 1,
-                "STARK phase-program builds (jit retraces): cache misses "
-                "in the in-process phase cache")
-    _observe_safe("prover_kernel_build_seconds", seconds, {"air": air},
+def record_kernel_build(air: str, seconds: float, mesh: str = "none"):
+    # labelled by mesh shape ("none", "4", "2x4") so mesh<->no-mesh
+    # switches and sub-slice churn show up as distinct retrace series
+    METRICS.inc_labeled("prover_kernel_retraces_total", {"mesh": mesh}, 1,
+                        help_text="STARK phase-program builds (jit "
+                        "retraces) by mesh shape: cache misses in the "
+                        "in-process phase cache")
+    _observe_safe("prover_kernel_build_seconds", seconds,
+                  {"air": air, "mesh": mesh},
                   "Wall-clock to build+stage the jitted STARK phase "
-                  "programs for one AIR shape")
+                  "programs for one AIR shape (AOT compile included)")
+
+
+def record_phase_compile(air: str, kernel: str, seconds: float,
+                         mesh: str = "none"):
+    _observe_safe("prover_phase_compile_seconds", seconds,
+                  {"air": air, "kernel": kernel, "mesh": mesh},
+                  "Per-phase-program AOT compile wall (lower+compile) "
+                  "by AIR, kernel and mesh shape — the cold-start "
+                  "baseline each warmup pays per program")
+
+
+def record_mesh_devices(n: int):
+    METRICS.set("prover_mesh_devices", float(n),
+                help_text="Devices in the prover backend's JAX mesh "
+                "(1 = unsharded single-device proving)")
+
+
+def record_vm_parallelism(n: int):
+    METRICS.set("prover_vm_circuits_parallel", float(n),
+                help_text="Concurrent mesh slices used for the last "
+                "batch's VM-circuit STARK proofs (1 = serial)")
 
 
 def record_jax_compile(seconds: float):

@@ -299,6 +299,14 @@ def _perf_lines(snap: dict, width: int) -> list[str]:
             f"   import {fmt(tp.get('l1_import_mgas_per_sec')):>8} Mgas/s"
             f"   prover {fmt(tp.get('prover_trace_cells_per_sec')):>10}"
             f" cells/s   proofs/h {fmt(tp.get('proofs_per_hour')):>8}")
+    msh = perf.get("mesh")
+    if isinstance(msh, dict):
+        ndev = msh.get("devices")
+        if isinstance(ndev, (int, float)) and ndev > 1:
+            par = msh.get("vmCircuitsParallel")
+            par_s = f"{par:.0f}" if isinstance(par, (int, float)) else "—"
+            lines.append(f"   mesh   {ndev:>8.0f} devices"
+                         f"   vm-circuit slices {par_s:>8}")
     prof = perf.get("profiler")
     comps = prof.get("components") if isinstance(prof, dict) else None
     if isinstance(comps, dict) and comps:

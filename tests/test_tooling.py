@@ -345,6 +345,48 @@ def test_every_bench_config_emits_stages():
         f"bench configs without a stages breakdown: {offenders}"
 
 
+def test_stark_partition_specs_reference_mesh_axis():
+    """Every PartitionSpec built under stark/ must name the mesh axis
+    through parallel.mesh.AXIS (or be fully replicated) — a
+    string-literal axis name silently diverges from the shared
+    partitioning policy the moment the mesh axis is renamed."""
+    import ast
+    import pathlib
+
+    import ethrex_tpu
+
+    stark_dir = pathlib.Path(ethrex_tpu.__file__).parent / "stark"
+    offenders = []
+    for path in sorted(stark_dir.rglob("*.py")):
+        tree = ast.parse(path.read_text())
+        aliases = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module \
+                    and "sharding" in node.module:
+                for a in node.names:
+                    if a.name == "PartitionSpec":
+                        aliases.add(a.asname or a.name)
+        if not aliases:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            if name not in aliases:
+                continue
+            args = list(node.args) + [k.value for k in node.keywords]
+            for arg in args:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Constant) \
+                            and isinstance(sub.value, str):
+                        offenders.append(f"{path.name}:{node.lineno}")
+    assert not offenders, (
+        "string-literal axis names in stark/ PartitionSpec calls "
+        f"(use parallel.mesh.AXIS): {sorted(set(offenders))}")
+
+
 def test_bench_check_regression_exit_codes(capsys):
     """The CI regression gate: ok and missing-baseline pass (0), a
     throughput drop past the threshold fails (2), a broken current
