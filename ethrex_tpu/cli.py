@@ -151,6 +151,32 @@ def _add_node_flags(parser: argparse.ArgumentParser):
                         help="thread-pool size for batched sender "
                         "recovery (native secp256k1 engine); 0 = "
                         "min(8, cpu_count)")
+    parser.add_argument("--executable-cache-dir",
+                        dest="executable_cache_dir",
+                        default=_env("EXEC_CACHE_DIR"),
+                        help="on-disk serialized-executable cache for AOT "
+                        "prover kernels (utils/exec_cache): a restarted "
+                        "prover hydrates compiled programs from here in "
+                        "deserialize time instead of recompiling — ship "
+                        "it in a deploy image to kill cold-start "
+                        "(docs/PERFORMANCE.md); default: a "
+                        "host-fingerprinted /tmp directory")
+
+
+def _enable_compile_caches(args):
+    """Production startup wiring for the two compile caches: the XLA
+    persistent compilation cache (utils/jax_cache, HLO-level) and the
+    serialized-executable store (utils/exec_cache, whole-program level —
+    the prover cold-start killer).  Never fatal: a node that cannot set
+    up caching still serves."""
+    try:
+        from .utils import exec_cache, jax_cache
+
+        if getattr(args, "executable_cache_dir", None):
+            exec_cache.set_cache_dir(args.executable_cache_dir)
+        jax_cache.enable_persistent_cache()
+    except Exception as e:  # noqa: BLE001 — caching is an optimization
+        print(f"compile-cache setup skipped: {e}", file=sys.stderr)
 
 
 def _load_genesis(args) -> Genesis | None:
@@ -295,6 +321,7 @@ def _parse_enode(url: str):
 
 
 def run_node(args) -> int:
+    _enable_compile_caches(args)
     if args.kzg_setup:
         from .crypto import kzg
 
@@ -448,6 +475,7 @@ def run_l2(args) -> int:
     from .l2.rollup_store import PersistentRollupStore, RollupStore
     from .l2.sequencer import Sequencer, SequencerConfig
 
+    _enable_compile_caches(args)
     genesis = _load_genesis(args)
     if genesis is None:
         print("either --dev or --network <genesis.json> is required",

@@ -47,7 +47,16 @@ and, on top of the lease substrate, a **fleet scheduler**
   * work stealing — an idle prover may likewise be granted a hedge on a
     batch held by a prover sitting on a deep backlog of live leases
     (Blumofe & Leiserson's steal-from-the-loaded rule, run as a race
-    rather than a revocation so the existing token safety applies).
+    rather than a revocation so the existing token safety applies);
+  * warm-aware handoff — provers may report an advisory `warm` flag on
+    InputRequest (their AOT kernels hydrated from the on-disk executable
+    cache, docs/PERFORMANCE.md "Cold start").  A cold prover is asked to
+    sit out a bounded number of polls while recently-seen warm provers
+    can absorb the queue, so the first post-restart batches land on
+    provers that prove at steady-state wall; and a batch assigned to a
+    cold prover is excluded from the duration samples and that prover's
+    EWMA, so one compile-inclusive first proof cannot poison the
+    placement and hedging signals.
 """
 
 from __future__ import annotations
@@ -72,6 +81,8 @@ HEDGE_MIN_SAMPLES = 8       # completed proofs before p99 hedging arms
 HEDGE_FACTOR = 1.5          # hedge once elapsed > p99 * factor
 STEAL_THRESHOLD = 4         # live leases that mark a prover "overloaded"
 EWMA_ALPHA = 0.3            # per-prover proving-time smoothing
+WARM_PEER_WINDOW = 60.0     # a warm prover seen this recently can absorb
+COLD_DEFERRAL_CAP = 3       # polls a cold prover sits out before it's fed
 
 
 class ProofCoordinator:
@@ -138,9 +149,15 @@ class ProofCoordinator:
         # (batch, prover_type) -> prover_id of the primary holder (None
         # for provers that do not volunteer an identity)
         self.lease_holders: dict[tuple[int, str], str | None] = {}
-        # prover_id -> {completed, ewma, last_seen}; fed by assigns and
-        # successful submits that carry a prover_id
+        # prover_id -> {completed, ewma, last_seen, warm, cold_deferrals};
+        # fed by assigns and successful submits that carry a prover_id
         self.prover_stats: dict[str, dict] = {}
+        # (batch, prover_type) -> the holder's warm flag at grant time
+        # (None for provers that did not report one); a cold-assigned
+        # batch's proving wall includes compile time, so _handle_submit
+        # keeps it out of the durations deque and the holder's EWMA
+        self.lease_warm: dict[tuple[int, str], bool | None] = {}
+        self.cold_deferrals_total = 0
         # recent completed proving wall-clocks, the p99 hedging source
         self.durations: collections.deque = collections.deque(maxlen=256)
         self.hedged_assignments_total = 0
@@ -292,7 +309,8 @@ class ProofCoordinator:
         grant carries its own — use `assign` directly."""
         return self.assign(prover_type, prover_id)[0]
 
-    def assign(self, prover_type: str, prover_id: str | None = None
+    def assign(self, prover_type: str, prover_id: str | None = None,
+               warm: bool | None = None
                ) -> tuple[int | None, str | None]:
         """One scheduling decision: returns (batch, lease_token) or
         (None, None).
@@ -301,20 +319,28 @@ class ProofCoordinator:
         type (reference: next_batch_to_assign:149-215).  Expired leases
         are counted as failed assignments — enough of them quarantines
         the batch onto the fallback backend.  Unleased work is placed
-        size-aware under the fleet policy (FCFS under `fcfs`); when
-        everything is leased, the fleet policy may grant a *hedge* on a
-        straggler past the p99-derived deadline or steal from an
-        overloaded holder — a second lease racing the first, dedup'd at
-        submit time."""
+        size-aware under the fleet policy (FCFS under `fcfs`); a
+        requester that reports itself cold (`warm=False`) may first be
+        deferred while recently-seen warm provers can absorb the queue
+        (bounded by COLD_DEFERRAL_CAP so a warm-less fleet never
+        starves); when everything is leased, the fleet policy may grant
+        a *hedge* on a straggler past the p99-derived deadline or steal
+        from an overloaded holder — a second lease racing the first,
+        dedup'd at submit time."""
         faults.inject("coordinator.schedule")
         if prover_type not in self._allowed_types():
             return None, None
         now = self._now()
         with self.lock:
             if prover_id is not None:
-                self.prover_stats.setdefault(
+                st = self.prover_stats.setdefault(
                     prover_id, {"completed": 0, "ewma": None,
-                                "last_seen": now})["last_seen"] = now
+                                "last_seen": now})
+                st["last_seen"] = now
+                if warm is not None:
+                    st["warm"] = warm
+                    if warm:
+                        st["cold_deferrals"] = 0
             candidates = sorted({
                 num for (num, ver) in self.rollup.prover_inputs
                 if ver == self.commit_hash
@@ -345,18 +371,58 @@ class ProofCoordinator:
                 unleased.append(num)
             self.queue_depth = len(unleased)
             if unleased:
+                if self._defer_cold(prover_id, warm, len(unleased), now):
+                    self._report_queue_depth()
+                    return None, None
                 num = self._pick_unleased(unleased, prover_id)
-                token = self._grant(num, prover_type, prover_id, now)
+                token = self._grant(num, prover_type, prover_id, now,
+                                    warm)
                 self.queue_depth -= 1   # the grant is no longer waiting
                 self._report_queue_depth()
                 return num, token
             granted = self._maybe_hedge(leased, prover_type, prover_id,
-                                        now)
+                                        now, warm)
             self._report_queue_depth()
             return granted
 
+    def _defer_cold(self, prover_id: str | None, warm: bool | None,
+                    queue_len: int, now: float) -> bool:
+        """Warm-aware handoff: should this requester sit out the poll?
+        Only a prover that EXPLICITLY reports warm=False is deferred
+        (warm=None — an older client — is never penalized), only while
+        enough recently-seen warm peers exist to absorb the whole queue,
+        and only COLD_DEFERRAL_CAP times in a row — so the first batches
+        after a restart land on provers that prove at steady-state wall,
+        without ever starving a fleet that has no warm capacity.  The
+        deferred prover keeps polling (and hydrating in the background);
+        its next InputRequest is a fresh decision.  Caller holds
+        self.lock."""
+        from ..utils.metrics import record_cold_deferral
+
+        if self.scheduler_policy != "fleet" or warm is not False \
+                or prover_id is None:
+            return False
+        st = self.prover_stats.get(prover_id)
+        deferrals = st.get("cold_deferrals", 0) if st else 0
+        if deferrals >= COLD_DEFERRAL_CAP:
+            return False
+        warm_peers = sum(
+            1 for pid, s in self.prover_stats.items()
+            if pid != prover_id and s.get("warm")
+            and now - s.get("last_seen", 0.0) <= WARM_PEER_WINDOW)
+        if warm_peers == 0 or queue_len > warm_peers:
+            return False    # not enough warm capacity; feed the cold one
+        if st is not None:
+            st["cold_deferrals"] = deferrals + 1
+        self.cold_deferrals_total += 1
+        record_cold_deferral()
+        log.info("deferring cold prover %s (%d/%d): %d warm peer(s) can "
+                 "absorb the %d-batch queue", prover_id, deferrals + 1,
+                 COLD_DEFERRAL_CAP, warm_peers, queue_len)
+        return True
+
     def _grant(self, num: int, prover_type: str, prover_id: str | None,
-               now: float) -> str:
+               now: float, warm: bool | None = None) -> str:
         """Issue the primary lease. Caller holds self.lock."""
         key = (num, prover_type)
         token = secrets.token_hex(16)
@@ -364,10 +430,12 @@ class ProofCoordinator:
         self.assigned_at[key] = now
         self.lease_tokens[key] = token
         self.lease_holders[key] = prover_id
+        self.lease_warm[key] = warm
         return token
 
     def _maybe_hedge(self, leased: list[int], prover_type: str,
-                     prover_id: str | None, now: float
+                     prover_id: str | None, now: float,
+                     warm: bool | None = None
                      ) -> tuple[int | None, str | None]:
         """Every candidate batch is leased: under the fleet policy, grant
         a hedge lease on a straggler past the p99 deadline, or steal from
@@ -407,6 +475,7 @@ class ProofCoordinator:
                 "token": token, "assigned_at": now,
                 "expires": now + self.lease_timeout,
                 "prover_id": prover_id, "reason": reason,
+                "warm": warm,
             }
             self.hedged_assignments_total += 1
             record_hedged_assignment()
@@ -427,6 +496,7 @@ class ProofCoordinator:
         self.assignments.pop(key, None)
         self.lease_tokens.pop(key, None)
         self.lease_holders.pop(key, None)
+        self.lease_warm.pop(key, None)
         return self.assigned_at.pop(key, None)
 
     def trace_for_batch(self, batch: int) -> str:
@@ -600,12 +670,14 @@ class ProofCoordinator:
                 proof = faults.inject("coordinator.store_proof", proof)
                 self.rollup.store_proof(batch, prover_type, proof)
         with self.lock:
+            warm_at_grant = self.lease_warm.get(key)
             started = self._clear_lease(key)
             hedge = self.hedges.pop(key, None)
             if holds_hedge and hedge is not None:
                 # the hedge won the race: its own start time is the
                 # proving clock, not the straggler's
                 started = hedge["assigned_at"]
+                warm_at_grant = hedge.get("warm")
             self._note_event("proof-stored", batch, prover_type,
                              "hedge won" if holds_hedge else None)
         if started is not None and holds_lease:
@@ -619,16 +691,22 @@ class ProofCoordinator:
             prover_id = msg.get("prover_id")
             with self.lock:
                 # feed the fleet scheduler: the p99 hedging deadline and
-                # this prover's EWMA placement signal
-                self.durations.append(duration)
+                # this prover's EWMA placement signal.  A batch granted
+                # to a prover that reported itself cold is excluded from
+                # both — its wall includes AOT compile time, and one
+                # such sample would poison the EWMA placement and the
+                # p99 hedge deadline for dozens of proofs after
+                if warm_at_grant is not False:
+                    self.durations.append(duration)
                 if prover_id is not None:
                     st = self.prover_stats.setdefault(
                         prover_id, {"completed": 0, "ewma": None,
                                     "last_seen": self._now()})
                     st["completed"] += 1
-                    st["ewma"] = duration if st["ewma"] is None else \
-                        EWMA_ALPHA * duration \
-                        + (1.0 - EWMA_ALPHA) * st["ewma"]
+                    if warm_at_grant is not False:
+                        st["ewma"] = duration if st["ewma"] is None else \
+                            EWMA_ALPHA * duration \
+                            + (1.0 - EWMA_ALPHA) * st["ewma"]
         return {"type": protocol.SUBMIT_ACK, "batch_id": batch}
 
     def handle_request(self, msg: dict) -> dict:
@@ -650,8 +728,10 @@ class ProofCoordinator:
             prover_type = msg.get("prover_type")
             if prover_type not in self._allowed_types():
                 return {"type": protocol.TYPE_NOT_NEEDED}
-            batch, token = self.assign(prover_type,
-                                       msg.get("prover_id"))
+            warm = msg.get("warm")
+            batch, token = self.assign(
+                prover_type, msg.get("prover_id"),
+                warm=warm if isinstance(warm, bool) else None)
             if batch is None:
                 return {"type": protocol.TYPE_NOT_NEEDED}
             trace_id = self.trace_for_batch(batch)
@@ -704,6 +784,7 @@ class ProofCoordinator:
             "queueDepth": self.queue_depth,
             "hedgedAssignments": self.hedged_assignments_total,
             "duplicateSubmits": self.duplicate_submits_total,
+            "coldDeferrals": self.cold_deferrals_total,
             "hedgeDeadlineSeconds": deadline,
             "liveHedges": [
                 {"batch": num, "proverType": ptype,
@@ -715,7 +796,9 @@ class ProofCoordinator:
                 pid: {"completed": st["completed"],
                       "ewmaSeconds": st["ewma"],
                       "liveLeases": self._live_leases_held(pid, now),
-                      "idleSeconds": max(0.0, now - st["last_seen"])}
+                      "idleSeconds": max(0.0, now - st["last_seen"]),
+                      "warm": st.get("warm"),
+                      "coldDeferrals": st.get("cold_deferrals", 0)}
                 for pid, st in sorted(self.prover_stats.items())},
         }
 

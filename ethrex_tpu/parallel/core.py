@@ -161,13 +161,31 @@ def compile_prove_step(log_n: int, width: int, log_blowup: int = 2,
     lowering/compiling ahead of time is unavailable (the jitted callable
     is returned in that case, so callers always get something runnable).
     The bench core microbench uses this to pair measured cells/s with
-    the kernel's static FLOPs."""
+    the kernel's static FLOPs.
+
+    The fused step participates in the on-disk executable cache
+    (utils/exec_cache): a prior process's compile hydrates in
+    deserialize time, which is what the --measure-warmup bench drill
+    measures cold-vs-hydrated."""
+    from ..utils import exec_cache
+
     fn, example_args = build_prove_step(log_n, width, log_blowup,
                                         log_final_size, mesh)
+    parts = {"kind": "core_step", "log_n": log_n, "width": width,
+             "log_blowup": log_blowup, "log_final_size": log_final_size,
+             "mesh": exec_cache.mesh_fingerprint(mesh)}
+    compiled = exec_cache.load(parts)
+    if compiled is not None:
+        try:
+            cost = compiled.cost_analysis()
+        except Exception:
+            cost = None
+        return compiled, example_args, cost
     try:
         specs = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
                       for a in example_args)
         compiled = fn.lower(*specs).compile()
+        exec_cache.store(parts, compiled)
         return compiled, example_args, compiled.cost_analysis()
     except Exception:
         return fn, example_args, None

@@ -28,6 +28,14 @@ sweep via perf/loadgen — client-observed p50/p95/p99 + error rate at
 each offered rate over real TCP against a live in-process node, gated
 on p99 and sustained rate).
 
+Cold start: --measure-warmup runs the cold-vs-hydrated warmup drill —
+two child processes share one fresh executable-cache dir (via
+ETHREX_EXEC_CACHE_DIR), the first compiling and serializing the AOT
+executable, the second hydrating it — and appends a gateable
+`stark_core_warmup_hydrated_s` record (lower is better) carrying both
+warmup walls (`warmup_s`).  --measure-warmup-child is the per-process
+entry point.
+
 Mesh scaling: --measure-scaling sweeps the prove-core cells/s at
 1/2/4/8 simulated host devices (one forced-CPU child per count via
 XLA_FLAGS=--xla_force_host_platform_device_count; list overridable
@@ -218,11 +226,15 @@ def measure() -> None:
     pi = ProgramInput(blocks=[block], witness=witness, config=node.config)
 
     backend = TpuBackend()
-    # one warm-up prove compiles every XLA program (persistent-cached)
+    # one warm-up prove compiles (or hydrates from the on-disk
+    # executable cache) every XLA program before the timed section;
+    # warmup_s + the cache hit/miss split record which one happened
+    t_w0 = time.perf_counter()
     warm = backend.prove(pi, "stark")
+    warmup_wall = time.perf_counter() - t_w0
     assert warm.get("vm", {}).get("mode") == "transfer"
 
-    from ethrex_tpu.utils import tracing
+    from ethrex_tpu.utils import exec_cache, tracing
 
     t0 = time.perf_counter()
     with tracing.span("bench.prove") as bench_span:
@@ -238,6 +250,7 @@ def measure() -> None:
         stages = {k: round(v, 4) for k, v in sorted(
             tracing.TRACER.stage_breakdown(bench_span.trace_id).items())}
 
+    cache_stats = exec_cache.runtime_stats()
     gas_per_sec = gas / wall
     print(json.dumps({
         "metric": "transfer_batch_prove_wall_s",
@@ -248,6 +261,9 @@ def measure() -> None:
         "num_txs": NUM_TXS,
         "gas_per_sec": round(gas_per_sec, 1),
         "proofs_per_hour_chip": round(3600.0 / wall, 2),
+        "warmup_s": round(warmup_wall, 3),
+        "executable_cache": {k: cache_stats.get(k) for k in
+                             ("hits", "misses", "errors", "stores")},
         "stages": stages,
         "config": "BASELINE-1 (10-transfer block, vm mode, 3 STARKs)",
     }))
@@ -648,6 +664,87 @@ def measure_core() -> None:
         out["utilization_vs_peak"] = round(achieved / peak, 6) \
             if peak else None
     print(json.dumps(out))
+
+
+def measure_warmup_child() -> None:
+    """One warmup sample for the cold-start drill: compile (or hydrate)
+    the core microbench config and run it once.  The parent
+    --measure-warmup spawns this twice against one executable-cache dir
+    — first cold (populating it), then hydrated — and the
+    executable_cache hit/miss split proves which path each child took."""
+    _guard_backend()
+    import jax
+
+    from ethrex_tpu.parallel.core import compile_prove_step
+    from ethrex_tpu.utils import exec_cache
+
+    t0 = time.perf_counter()
+    fn, args, _cost = compile_prove_step(log_n=15, width=64, log_blowup=2,
+                                         log_final_size=5, mesh=None)
+    jax.block_until_ready(fn(*args))
+    warmup = time.perf_counter() - t0
+    stats = exec_cache.runtime_stats()
+    print(json.dumps({
+        "metric": "stark_core_warmup_s",
+        "value": round(warmup, 4),
+        "unit": "s",
+        "backend": jax.default_backend(),
+        "stages": {"compile_and_warmup": round(warmup, 4)},
+        "executable_cache": {k: stats.get(k) for k in
+                             ("hits", "misses", "errors", "stores")},
+    }))
+
+
+def measure_warmup() -> None:
+    """Cold-vs-hydrated warmup drill (ROADMAP item 2's yardstick): two
+    child processes share one FRESH executable-cache dir — child A pays
+    the full AOT compile and serializes it, child B must hydrate.  Emits
+    and appends ONE record whose gateable value is the HYDRATED warmup
+    (lower is better; the same-backend history gate keeps the cold-start
+    win locked in) with the cold wall and the speedup alongside."""
+    import tempfile
+
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory(
+            prefix="ethrex_tpu_warmup_drill_") as cache_dir:
+        # the XLA persistent cache must be fresh too: an XLA-cache-hit
+        # compile serializes without its jit symbols, so the cold
+        # child's store would be rejected at validation and the drill
+        # would measure hit-vs-hit instead of cold-vs-hydrated
+        env = {"ETHREX_EXEC_CACHE_DIR": cache_dir,
+               "ETHREX_JAX_CACHE_DIR": os.path.join(cache_dir, "xla")}
+        cold = _attempt("--measure-warmup-child",
+                        min(EXTRA_TIMEOUT, 1500), env=env) \
+            or {"_err": "no output"}
+        hydrated = _attempt("--measure-warmup-child",
+                            min(EXTRA_TIMEOUT, 1500), env=env) \
+            or {"_err": "no output"}
+    cold_s = cold.get("value")
+    hyd_s = hydrated.get("value")
+    ok = (isinstance(cold_s, (int, float)) and cold_s > 0
+          and isinstance(hyd_s, (int, float)) and hyd_s > 0)
+    record = {
+        "metric": "stark_core_warmup_hydrated_s",
+        "value": round(float(hyd_s), 4) if ok else 0.0,
+        "unit": "s",
+        "backend": (hydrated.get("backend") or cold.get("backend")
+                    or "unknown"),
+        "warmup_s": {"cold": cold_s, "hydrated": hyd_s},
+        "stages": {"warmup_cold_s": cold_s, "warmup_hydrated_s": hyd_s,
+                   "drill_s": round(time.perf_counter() - t0, 4)},
+        "executable_cache": {"cold": cold.get("executable_cache"),
+                             "hydrated": hydrated.get("executable_cache")},
+        "config": "cold-vs-hydrated warmup drill (core microbench "
+                  "config, two children sharing one fresh "
+                  "executable-cache dir)",
+    }
+    if ok:
+        record["speedup_x"] = round(float(cold_s) / float(hyd_s), 2)
+    else:
+        record["error"] = (cold.get("_err") or hydrated.get("_err")
+                           or "child produced no warmup value")
+    append_history(record)
+    print(json.dumps(record))
 
 
 def measure_scaling_one() -> None:
@@ -1236,6 +1333,11 @@ def check_regression_suite(threshold: float = REGRESSION_THRESHOLD) -> int:
                              threshold=threshold, lower_is_better=True),
         check_history_metric("settled_proofs_per_l1_tx",
                              threshold=threshold),
+        # cold-start gate (fed by --measure-warmup records): the
+        # hydrated second-process warmup must stay collapsed — growth
+        # here means the executable cache stopped hydrating
+        check_history_metric("stark_core_warmup_hydrated_s",
+                             threshold=threshold, lower_is_better=True),
     ]
     if 2 in codes:
         return 2
@@ -1370,6 +1472,10 @@ def cli(argv: list[str] | None = None) -> None:
         measure_config4()
     elif "--measure-5" in argv:
         measure_config5()
+    elif "--measure-warmup-child" in argv:
+        measure_warmup_child()
+    elif "--measure-warmup" in argv:
+        measure_warmup()
     elif "--measure" in argv:
         measure()
     elif "--check-regression" in argv:

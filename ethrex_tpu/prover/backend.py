@@ -15,6 +15,13 @@ class ProverBackend:
         """Run the guest program natively (no proof)."""
         return execution_program(program_input)
 
+    def prewarm(self) -> int:
+        """Hydrate whatever compiled artifacts this backend can restore
+        from the on-disk executable cache (utils/exec_cache) before its
+        first assignment; returns how many kernel groups came back.
+        Backends with no AOT-compiled programs have nothing to restore."""
+        return 0
+
     def prove(self, program_input: ProgramInput, proof_format: str) -> dict:
         raise NotImplementedError
 

@@ -12,7 +12,13 @@ for real fleets:
     TPU proof extends its coordinator lease instead of being reassigned;
   * submit over a fresh connection — the socket that carried the input
     request can die during a multi-minute proof without losing the
-    finished proof.
+    finished proof;
+  * background pre-warm before the first InputRequest — the backend's
+    AOT kernels are hydrated from the on-disk executable cache
+    (utils/exec_cache) while the client starts polling, and every
+    InputRequest carries an advisory `warm` flag so the coordinator's
+    fleet scheduler can route the first post-restart batches to
+    already-hydrated provers (docs/PERFORMANCE.md "Cold start").
 """
 
 from __future__ import annotations
@@ -93,7 +99,8 @@ class ProverClient:
                  breaker_threshold: int = 5,
                  breaker_cooldown: float = 10.0,
                  rng_seed: int | None = None,
-                 prover_id: str | None = None):
+                 prover_id: str | None = None,
+                 prewarm: bool = True):
         self.backend = (get_backend(backend) if isinstance(backend, str)
                         else backend)
         # advisory fleet identity: lets the coordinator's scheduler
@@ -116,6 +123,40 @@ class ProverClient:
         #                               transport; never trips the breaker)
         self.endpoint_states: dict[tuple[str, int], EndpointState] = {
             ep: EndpointState() for ep in endpoints}
+        # pre-warm: hydrate the backend's AOT executables from the
+        # on-disk cache in the background, so the first assignment can
+        # run at steady-state wall; `warm` rides every InputRequest
+        # (advisory, like prover_id) so the fleet scheduler can prefer
+        # hydrated provers for the first batches after a restart
+        self.hydrated_groups = 0
+        self._prewarm_done = threading.Event()
+        if prewarm:
+            threading.Thread(target=self._prewarm_worker,
+                             daemon=True).start()
+        else:
+            self._prewarm_done.set()
+
+    def _prewarm_worker(self):
+        try:
+            hook = getattr(self.backend, "prewarm", None)
+            if callable(hook):
+                self.hydrated_groups = int(hook() or 0)
+        except Exception:  # noqa: BLE001 — a failed prewarm is just cold
+            log.exception("prover prewarm failed; starting cold")
+        finally:
+            self._prewarm_done.set()
+            if self.hydrated_groups:
+                log.info("prover %s prewarmed: %d kernel group(s) "
+                         "hydrated from the executable cache",
+                         self.prover_id, self.hydrated_groups)
+
+    @property
+    def warm(self) -> bool:
+        """Whether this prover's next proof should run at steady-state
+        wall: the prewarm pass finished AND it either hydrated compiled
+        kernels from disk or has already proven in this process."""
+        return self._prewarm_done.is_set() and (
+            self.hydrated_groups > 0 or bool(self.proved))
 
     # ------------------------------------------------------------------
     # breaker / backoff
@@ -194,6 +235,7 @@ class ProverClient:
                 "commit_hash": self.commit_hash,
                 "prover_type": self.backend.prover_type,
                 "prover_id": self.prover_id,
+                "warm": self.warm,
             })
             resp = protocol.recv_msg(sock)
         rtype = resp.get("type")

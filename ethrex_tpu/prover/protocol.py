@@ -19,9 +19,16 @@ from ..utils import faults
 # ProofSubmit: it is advisory only (never a capability — the token stays
 # the sole authority), feeding the coordinator's fleet scheduler with
 # per-prover throughput stats for size-aware placement, work stealing,
-# and hedged re-assignment (docs/AGGREGATION.md).
+# and hedged re-assignment (docs/AGGREGATION.md).  InputRequest MAY also
+# carry a boolean `warm`: whether this prover's AOT kernels are already
+# hydrated (from the on-disk executable cache, utils/exec_cache) so its
+# next proof runs at steady-state wall rather than paying a cold
+# compile.  Like prover_id it is advisory — the scheduler uses it only
+# to prefer warm provers for the first batches after a restart and to
+# keep a cold prover's compile-inclusive first wall out of its EWMA; a
+# lying prover gains nothing but a worse placement.
 INPUT_REQUEST = "InputRequest"          # {commit_hash, prover_type
-#                                          [, prover_id]}
+#                                          [, prover_id] [, warm]}
 INPUT_RESPONSE = "InputResponse"        # {batch_id, input, format,
 #                                          lease_token}
 VERSION_MISMATCH = "VersionMismatch"    # {expected}

@@ -649,6 +649,12 @@ def _perf(node):
         }
     except Exception as exc:  # noqa: BLE001 — telemetry endpoint
         out["throughput"] = {"error": f"{type(exc).__name__}: {exc}"}
+    try:
+        from ..utils import exec_cache
+        out["executableCache"] = exec_cache.runtime_stats()
+    except Exception as exc:  # noqa: BLE001 — telemetry endpoint
+        out["executableCache"] = {
+            "error": f"{type(exc).__name__}: {exc}"}
     return out
 
 
@@ -749,6 +755,14 @@ def _health(node):
             # or the pure-Python fallback (docs/PERFORMANCE.md)
             "nativeSecp256k1": native_secp256k1.available(),
         }
+        from ..utils import exec_cache
+
+        cache = exec_cache.runtime_stats()
+        # cold-start posture: are AOT kernels hydrating from disk or
+        # being recompiled? (docs/PERFORMANCE.md "Cold start")
+        out["perf"]["executableCache"] = {
+            k: cache.get(k)
+            for k in ("hits", "misses", "errors", "entries", "enabled")}
     except Exception:  # noqa: BLE001 — health must answer regardless
         pass
     seq = getattr(node, "sequencer", None)

@@ -21,8 +21,10 @@ docs/SOUNDNESS.md.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
+import threading
 import time
 
 import numpy as np
@@ -137,7 +139,7 @@ def _phases(air: Air, log_n: int, lb: int, shift: int,
     t0 = time.perf_counter()
     bodies, plan = _build_phases(air, log_n, lb, shift, mesh)
     built = PhasePrograms(
-        _aot_phases(air, log_n, lb, bodies, plan, mesh), plan)
+        _aot_phases(air, log_n, lb, shift, bodies, plan, mesh), plan)
     _PHASE_CACHE[key] = built
     # retrace telemetry: every miss here is a fresh set of phase programs
     from ..parallel import mesh as mesh_lib
@@ -214,11 +216,33 @@ def _shard_map_program(body, mesh):
                              out_specs=P(), check_rep=False))
 
 
-def _aot_phases(air: Air, log_n: int, lb: int, bodies, plan, mesh):
+def _exec_cache_parts(air: Air, log_n: int, lb: int, shift: int,
+                      mesh, kernel: str) -> dict:
+    """On-disk executable-cache identity of one phase program.  Carries
+    everything hydrate_phase_cache needs to rebuild the in-process
+    cache entry without the AIR object (width/nb for the mesh plan,
+    air_name for telemetry) on top of the _PHASE_CACHE key parts."""
+    n = 1 << log_n
+    return {"kind": "phase", "air": air.cache_key(),
+            "air_name": type(air).__name__, "width": air.width,
+            "nb": len(air.boundaries([0] * air.num_pub_inputs, n)),
+            "log_n": log_n, "log_blowup": lb, "shift": shift,
+            "mesh": _mesh_key(mesh), "kernel": kernel}
+
+
+def _aot_phases(air: Air, log_n: int, lb: int, shift: int, bodies, plan,
+                mesh):
     """AOT-compile the four phase programs against their (statically
     known) argument shapes and register each executable's XLA cost
     analysis with the roofline registry — mesh and single-device paths
     alike, so sharded programs get the same roofline cost records.
+
+    Each kernel asks the on-disk executable cache first
+    (utils/exec_cache): a hit hydrates the serialized executable in
+    milliseconds instead of recompiling, and a fresh compile is
+    serialized back so the NEXT process restart hydrates.  Wide AIRs
+    (>= _PERSISTENT_CACHE_MAX_WIDTH) skip the disk path entirely, same
+    as the XLA persistent cache.
 
     Fallback ladder per kernel: pjit with explicit shardings -> (mesh
     only) fully-replicated shard_map -> the lazily-jitted callable.
@@ -246,34 +270,106 @@ def _aot_phases(air: Air, log_n: int, lb: int, bodies, plan, mesh):
         }
     except Exception:
         return lazy
+    from ..utils import exec_cache
+
     air_name = type(air).__name__
     devices = 1 if mesh is None else int(mesh.devices.size)
+    use_disk = w < _PERSISTENT_CACHE_MAX_WIDTH
     from ..parallel import mesh as mesh_lib
 
     mesh_label = mesh_lib.shape_label(mesh)
     out = []
     for kernel, body, fn in zip(_KERNELS, bodies, lazy):
-        compiled = None
+        parts = _exec_cache_parts(air, log_n, lb, shift, mesh, kernel)
         t_c = time.perf_counter()
-        try:
-            compiled = fn.lower(*specs[kernel]).compile()
-        except Exception:
-            if mesh is not None:
-                try:
-                    compiled = _shard_map_program(body, mesh).lower(
-                        *specs[kernel]).compile()
-                except Exception:
+        compiled = exec_cache.load(parts) if use_disk else None
+        source = "deserialized"
+        if compiled is None:
+            source = "compiled"
+            try:
+                compiled = fn.lower(*specs[kernel]).compile()
+            except Exception:
+                if mesh is not None:
+                    try:
+                        compiled = _shard_map_program(body, mesh).lower(
+                            *specs[kernel]).compile()
+                    except Exception:
+                        compiled = None
+                else:
                     compiled = None
+            if compiled is not None and use_disk:
+                exec_cache.store(parts, compiled)
         if compiled is None:
             out.append(fn)
             continue
-        # per-program compile wall: the cold-start baseline each warmup
-        # pays per phase program (bench measure_config4 reports these)
+        # per-program build wall: the cold-start baseline each warmup
+        # pays per phase program (bench measure_config4 reports these);
+        # source tells hydration apart from a fresh compile
         record_phase_compile(air_name, kernel,
-                             time.perf_counter() - t_c, mesh=mesh_label)
+                             time.perf_counter() - t_c, mesh=mesh_label,
+                             source=source)
         _record_phase_cost(air_name, kernel, compiled, devices)
         out.append(compiled)
     return tuple(out)
+
+
+def hydrate_phase_cache(mesh=None) -> int:
+    """Pre-warm the in-process phase cache from the on-disk executable
+    cache: every complete four-kernel phase group recorded for this
+    environment and mesh layout is deserialized and installed into
+    _PHASE_CACHE, so the first prove of those shapes runs at
+    steady-state wall.  Never compiles — an empty or foreign cache is a
+    no-op — and never raises.  Returns the number of phase-program sets
+    hydrated (the ProverClient warm flag flips once this returns)."""
+    from ..utils import exec_cache
+
+    if not exec_cache.enabled():
+        return 0
+    try:
+        entries = exec_cache.scan("phase")
+    except Exception:
+        return 0
+    mesh_key = _mesh_key(mesh)
+    groups: dict = {}
+    for parts in entries:
+        try:
+            if parts.get("mesh") != mesh_key:
+                continue
+            gkey = (parts["air"], parts["log_n"], parts["log_blowup"],
+                    parts["shift"], parts["mesh"])
+            groups.setdefault(gkey, {})[parts["kernel"]] = parts
+        except Exception:
+            continue
+    from ..parallel import mesh as mesh_lib
+
+    mesh_label = mesh_lib.shape_label(mesh)
+    hydrated = 0
+    for gkey, kernels in groups.items():
+        if gkey in _PHASE_CACHE or set(kernels) != set(_KERNELS):
+            continue
+        try:
+            p0 = kernels["commit"]
+            programs = []
+            ok = True
+            for kernel in _KERNELS:
+                t_c = time.perf_counter()
+                compiled = exec_cache.load(kernels[kernel])
+                if compiled is None:
+                    ok = False
+                    break
+                record_phase_compile(p0["air_name"], kernel,
+                                     time.perf_counter() - t_c,
+                                     mesh=mesh_label, source="deserialized")
+                programs.append(compiled)
+            if not ok:
+                continue
+            plan = None if mesh is None else _MeshPlan(
+                mesh, p0["log_n"], p0["log_blowup"], p0["width"], p0["nb"])
+            _PHASE_CACHE[gkey] = PhasePrograms(tuple(programs), plan)
+            hydrated += 1
+        except Exception:
+            continue
+    return hydrated
 
 
 class _MeshPlan:
@@ -465,9 +561,44 @@ def _build_phases(air: Air, log_n: int, lb: int, shift: int, mesh=None):
 
 # AIRs at least this wide produce XLA programs whose AOT serialization
 # has segfaulted inside jaxlib's persistent-cache write (seen with the
-# 278-column transfer AIR); exclude them from the on-disk cache — the
-# in-process _PHASE_CACHE still amortizes compiles within a run.
+# 278-column transfer AIR); exclude them from BOTH on-disk caches (the
+# XLA persistent cache and utils/exec_cache) — the in-process
+# _PHASE_CACHE still amortizes compiles within a run.
 _PERSISTENT_CACHE_MAX_WIDTH = 200
+
+# jax_enable_compilation_cache is process-global, so the wide-AIR
+# disable window must be refcounted: TpuBackend proves VM-circuit jobs
+# on concurrent threads, and two overlapping wide proves with a bare
+# save/restore would clobber each other's "previous" value (the second
+# entrant saves False and restores False forever).  First entrant saves
+# and disables, last exiter restores; exceptions restore via finally.
+_WIDE_TOGGLE_LOCK = threading.Lock()
+_WIDE_TOGGLE_DEPTH = 0
+_WIDE_TOGGLE_PREV = None
+
+
+@contextlib.contextmanager
+def _compilation_cache_disabled():
+    """Scoped, concurrency-safe disable of the XLA persistent
+    compilation cache.  A narrow prove that happens to compile inside
+    the window merely skips the persistent-cache write for that compile
+    — benign; the segfaulting wide-AIR write is what must never run."""
+    global _WIDE_TOGGLE_DEPTH, _WIDE_TOGGLE_PREV
+    import jax
+
+    with _WIDE_TOGGLE_LOCK:
+        if _WIDE_TOGGLE_DEPTH == 0:
+            _WIDE_TOGGLE_PREV = jax.config.jax_enable_compilation_cache
+            jax.config.update("jax_enable_compilation_cache", False)
+        _WIDE_TOGGLE_DEPTH += 1
+    try:
+        yield
+    finally:
+        with _WIDE_TOGGLE_LOCK:
+            _WIDE_TOGGLE_DEPTH -= 1
+            if _WIDE_TOGGLE_DEPTH == 0:
+                jax.config.update("jax_enable_compilation_cache",
+                                  _WIDE_TOGGLE_PREV)
 
 
 def prove(air: Air, trace: np.ndarray, pub_inputs: list[int],
@@ -476,14 +607,8 @@ def prove(air: Air, trace: np.ndarray, pub_inputs: list[int],
     device phase sharded across the mesh — the production multi-chip
     path; proofs are bit-identical to single-device runs."""
     if air.width >= _PERSISTENT_CACHE_MAX_WIDTH:
-        import jax
-
-        prev = jax.config.jax_enable_compilation_cache
-        jax.config.update("jax_enable_compilation_cache", False)
-        try:
+        with _compilation_cache_disabled():
             return _prove(air, trace, pub_inputs, params, mesh)
-        finally:
-            jax.config.update("jax_enable_compilation_cache", prev)
     return _prove(air, trace, pub_inputs, params, mesh)
 
 

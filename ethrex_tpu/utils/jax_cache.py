@@ -18,16 +18,26 @@ an error in the prover path.
 from __future__ import annotations
 
 import hashlib
+import os
 import platform
 import threading
 
 _LOCK = threading.Lock()
 _MONITORING_INSTALLED = False
+_DEFAULT_PREFIX = "/tmp/ethrex_tpu_jax_cache"
 STATS = {"compiles": 0, "compile_seconds": 0.0,
          "cache_hits": 0, "cache_misses": 0}
 
 
-def cache_dir(prefix: str = "/tmp/ethrex_tpu_jax_cache") -> str:
+def cache_dir(prefix: str = _DEFAULT_PREFIX) -> str:
+    """Host-fingerprinted cache directory.  The XLA compile cache's /tmp
+    default is overridable via ETHREX_JAX_CACHE_DIR (used verbatim, no
+    fingerprint suffix — the operator owns its scoping); callers with
+    their own prefix (the executable store, utils/exec_cache) keep it."""
+    if prefix == _DEFAULT_PREFIX:
+        env = os.environ.get("ETHREX_JAX_CACHE_DIR")
+        if env:
+            return env
     try:
         with open("/proc/cpuinfo") as f:
             cpu = [ln for ln in f if ln.startswith("flags")][0]

@@ -278,6 +278,13 @@ def record_hedged_assignment():
                 "submit is a deduplicated no-op")
 
 
+def record_cold_deferral():
+    METRICS.inc("prover_cold_deferrals_total", 1,
+                "Assignments withheld from provers that reported "
+                "themselves cold (AOT kernels not yet hydrated) while "
+                "recently-seen warm provers could absorb the queue")
+
+
 def record_scheduler_queue_depth(depth: int):
     METRICS.set("scheduler_queue_depth", depth,
                 "Provable batches awaiting an assignment at the last "
@@ -383,12 +390,14 @@ def record_kernel_build(air: str, seconds: float, mesh: str = "none"):
 
 
 def record_phase_compile(air: str, kernel: str, seconds: float,
-                         mesh: str = "none"):
+                         mesh: str = "none", source: str = "compiled"):
     _observe_safe("prover_phase_compile_seconds", seconds,
-                  {"air": air, "kernel": kernel, "mesh": mesh},
-                  "Per-phase-program AOT compile wall (lower+compile) "
-                  "by AIR, kernel and mesh shape — the cold-start "
-                  "baseline each warmup pays per program")
+                  {"air": air, "kernel": kernel, "mesh": mesh,
+                   "source": source},
+                  "Per-phase-program build wall by AIR, kernel, mesh "
+                  "shape and source (compiled = fresh AOT lower+compile "
+                  "— the cold-start baseline; deserialized = hydrated "
+                  "from the on-disk executable cache)")
 
 
 def record_mesh_devices(n: int):

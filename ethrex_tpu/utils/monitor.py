@@ -307,6 +307,16 @@ def _perf_lines(snap: dict, width: int) -> list[str]:
             par_s = f"{par:.0f}" if isinstance(par, (int, float)) else "—"
             lines.append(f"   mesh   {ndev:>8.0f} devices"
                          f"   vm-circuit slices {par_s:>8}")
+    cache = perf.get("executableCache")
+    if isinstance(cache, dict) and "error" not in cache:
+        def cnt(key):
+            v = cache.get(key)
+            return f"{v:.0f}" if isinstance(v, (int, float)) else "—"
+        state = "on" if cache.get("enabled") else "off"
+        lines.append(f"   exec cache [{state}]  hits {cnt('hits'):>6}"
+                     f"  misses {cnt('misses'):>6}"
+                     f"  errors {cnt('errors'):>4}"
+                     f"  entries {cnt('entries'):>5}")
     prof = perf.get("profiler")
     comps = prof.get("components") if isinstance(prof, dict) else None
     if isinstance(comps, dict) and comps:

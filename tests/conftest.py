@@ -28,6 +28,12 @@ jax.config.update("jax_platforms", "cpu")
 from ethrex_tpu.utils.jax_cache import enable_persistent_cache  # noqa: E402
 
 enable_persistent_cache()
+# the serialized-executable store only pays off ACROSS processes (the
+# in-process phase cache already amortizes within one pytest run), so
+# inside the suite its serialize + round-trip validation per fresh
+# compile is pure overhead — off by default; exec-cache tests opt back
+# in through their own env fixtures.
+os.environ.setdefault("ETHREX_EXEC_CACHE_OFF", "1")
 
 
 # ---------------------------------------------------------------------------

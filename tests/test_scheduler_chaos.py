@@ -387,3 +387,164 @@ def test_scheduler_state_in_stats_json(monkeypatch):
     assert sched["hedgeDeadlineSeconds"] == pytest.approx(
         co.hedge_factor * 1.0)
     assert sched["provers"]["p1"]["liveLeases"] == 1
+
+
+# ===========================================================================
+# warm-aware handoff — cold-start routing after a fleet restart
+# ===========================================================================
+
+def test_cold_prover_deferred_while_warm_peer_absorbs(monkeypatch):
+    """Restart scenario: a warm peer is known and can absorb the queue,
+    so a prover that explicitly reports warm=False sits out the poll and
+    the batch lands on the warm prover instead."""
+    store, co = _bare_coordinator(batches=2)
+    t = [0.0]
+    monkeypatch.setattr(co, "_now", lambda: t[0])
+    assert co.assign(EXEC, "warm-p", warm=True)[0] is not None
+    # one batch left, one recently-seen warm peer: the cold prover waits
+    assert co.assign(EXEC, "cold-p", warm=False) == (None, None)
+    assert co.cold_deferrals_total == 1
+    sched = co.stats_json()["scheduler"]
+    assert sched["coldDeferrals"] == 1
+    assert sched["provers"]["warm-p"]["warm"] is True
+    assert sched["provers"]["cold-p"]["warm"] is False
+    assert sched["provers"]["cold-p"]["coldDeferrals"] == 1
+    # the warm prover comes back for the deferred batch
+    assert co.assign(EXEC, "warm-p", warm=True)[0] is not None
+    # empty queue afterwards: the cold prover's (None, None) is not a
+    # deferral, so the counter does not creep
+    assert co.assign(EXEC, "cold-p", warm=False) == (None, None)
+    assert co.cold_deferrals_total == 1
+
+
+def test_cold_deferral_cap_prevents_starvation(monkeypatch):
+    """A fleet whose warm capacity never shows up must not starve the
+    cold prover: after COLD_DEFERRAL_CAP consecutive sit-outs it is fed,
+    and reporting warm=True resets the budget."""
+    from ethrex_tpu.l2.proof_coordinator import COLD_DEFERRAL_CAP
+
+    store, co = _bare_coordinator(batches=2)
+    t = [0.0]
+    monkeypatch.setattr(co, "_now", lambda: t[0])
+    assert co.assign(EXEC, "warm-p", warm=True)[0] is not None
+    for _ in range(COLD_DEFERRAL_CAP):
+        assert co.assign(EXEC, "cold-p", warm=False) == (None, None)
+    batch, token = co.assign(EXEC, "cold-p", warm=False)
+    assert batch is not None and token is not None   # cap reached: fed
+    assert co.cold_deferrals_total == COLD_DEFERRAL_CAP
+    # hydration finished: the warm report clears the deferral budget
+    co.assign(EXEC, "cold-p", warm=True)
+    st = co.prover_stats["cold-p"]
+    assert st["cold_deferrals"] == 0 and st["warm"] is True
+
+
+def test_legacy_client_without_warm_flag_never_deferred(monkeypatch):
+    store, co = _bare_coordinator(batches=2)
+    t = [0.0]
+    monkeypatch.setattr(co, "_now", lambda: t[0])
+    assert co.assign(EXEC, "warm-p", warm=True)[0] is not None
+    # an older client sends no warm flag at all: fed immediately
+    batch, token = co.assign(EXEC, "legacy-p")
+    assert batch is not None and token is not None
+    assert co.cold_deferrals_total == 0
+
+
+def test_stale_warm_peer_does_not_defer_cold(monkeypatch):
+    """A warm peer last seen outside WARM_PEER_WINDOW is not live warm
+    capacity — the cold prover gets the batch."""
+    from ethrex_tpu.l2.proof_coordinator import WARM_PEER_WINDOW
+
+    store, co = _bare_coordinator(batches=2)
+    t = [0.0]
+    monkeypatch.setattr(co, "_now", lambda: t[0])
+    assert co.assign(EXEC, "warm-p", warm=True)[0] is not None
+    t[0] = WARM_PEER_WINDOW + 1.0
+    batch, token = co.assign(EXEC, "cold-p", warm=False)
+    assert batch is not None and token is not None
+    assert co.cold_deferrals_total == 0
+
+
+def test_fcfs_policy_never_defers_cold(monkeypatch):
+    store, co = _bare_coordinator(batches=2, scheduler_policy="fcfs")
+    t = [0.0]
+    monkeypatch.setattr(co, "_now", lambda: t[0])
+    assert co.assign(EXEC, "warm-p", warm=True)[0] == 1
+    batch, token = co.assign(EXEC, "cold-p", warm=False)
+    assert batch == 2 and token is not None
+    assert co.cold_deferrals_total == 0
+
+
+def test_cold_granted_wall_excluded_from_ewma_and_durations(monkeypatch):
+    """The compile-inclusive first wall of a cold-granted batch must not
+    poison the EWMA placement signal or the p99 hedge-deadline window;
+    the first warm proof is the first EWMA sample."""
+    store, co = _bare_coordinator(batches=2)
+    t = [0.0]
+    monkeypatch.setattr(co, "_now", lambda: t[0])
+    # no warm peers around: the cold prover is fed immediately
+    batch, token = co.assign(EXEC, "restarted", warm=False)
+    assert batch == 1 and token is not None
+    t[0] = 300.0                        # compile-inclusive first wall
+    r = _submit(co, batch, token, "restarted")
+    assert r["type"] == protocol.SUBMIT_ACK
+    st = co.prover_stats["restarted"]
+    assert st["completed"] == 1
+    assert st["ewma"] is None           # 300 s never entered the EWMA
+    assert list(co.durations) == []     # nor the hedge-deadline window
+    # hydrated now: the steady-state wall is the first placement sample
+    batch2, tok2 = co.assign(EXEC, "restarted", warm=True)
+    assert batch2 == 2
+    t[0] = 308.0
+    assert _submit(co, batch2, tok2, "restarted")["type"] == \
+        protocol.SUBMIT_ACK
+    st = co.prover_stats["restarted"]
+    assert st["completed"] == 2
+    assert st["ewma"] == pytest.approx(8.0)
+    assert list(co.durations) == [pytest.approx(8.0)]
+
+
+def test_warm_flag_parsed_from_input_request_wire(monkeypatch):
+    """The warm flag rides the INPUT_REQUEST wire message; a deferred
+    cold prover sees TYPE_NOT_NEEDED, and a non-bool warm value from a
+    hostile client is ignored rather than crashed on."""
+    store, co = _bare_coordinator(batches=2)
+    t = [0.0]
+    monkeypatch.setattr(co, "_now", lambda: t[0])
+    assert co.assign(EXEC, "warm-p", warm=True)[0] is not None
+    r = co.handle_request({"type": protocol.INPUT_REQUEST,
+                           "commit_hash": protocol.PROTOCOL_VERSION,
+                           "prover_type": EXEC, "prover_id": "cold-p",
+                           "warm": False})
+    assert r["type"] == protocol.TYPE_NOT_NEEDED
+    assert co.cold_deferrals_total == 1
+    r = co.handle_request({"type": protocol.INPUT_REQUEST,
+                           "commit_hash": protocol.PROTOCOL_VERSION,
+                           "prover_type": EXEC, "prover_id": "odd-p",
+                           "warm": "yes"})
+    assert r["type"] == protocol.INPUT_RESPONSE
+    assert co.cold_deferrals_total == 1
+
+
+def test_client_reports_warm_after_first_proof():
+    """End-to-end over the real wire: the exec backend hydrates nothing,
+    so the client's first InputRequest is cold (its wall excluded from
+    the durations window) and every request after its first proof
+    reports warm."""
+    node, l1, seq = _mini_l2(batches=2)
+    co = seq.coordinator
+    try:
+        client = ProverClient(EXEC, _endpoints(seq), heartbeat_interval=0,
+                              backoff_base=0.01, rng_seed=9)
+        assert client._prewarm_done.wait(5.0)
+        assert client.warm is False
+        deadline = time.time() + 10.0
+        while time.time() < deadline and len(client.proved) < 2:
+            client.poll_once()
+            time.sleep(0.01)
+        assert len(client.proved) == 2
+        assert client.warm is True
+        assert co.prover_stats[client.prover_id]["warm"] is True
+        # the cold-granted first batch stayed out of the durations window
+        assert len(co.durations) == 1
+    finally:
+        seq.stop()

@@ -274,11 +274,11 @@ def test_every_metric_helper_has_help_text():
 
     from ethrex_tpu.blockchain import mempool
     from ethrex_tpu.perf import bench_suite, loadgen, profiler, roofline
-    from ethrex_tpu.utils import metrics, overload
+    from ethrex_tpu.utils import exec_cache, metrics, overload
 
     offenders = []
     for mod in (metrics, profiler, roofline, bench_suite, loadgen, mempool,
-                overload):
+                overload, exec_cache):
         tree = ast.parse(inspect.getsource(mod))
         for fn in ast.walk(tree):
             if not isinstance(fn, ast.FunctionDef):
@@ -343,6 +343,33 @@ def test_every_bench_config_emits_stages():
             offenders.append(fn.name)
     assert not offenders, \
         f"bench configs without a stages breakdown: {offenders}"
+
+
+def test_every_env_knob_is_documented():
+    """Every ETHREX_* environment variable the code reads must appear in
+    docs/*.md — an undocumented knob is one an operator cannot discover.
+    A new env var lands with its documentation or not at all."""
+    import pathlib
+    import re
+
+    import ethrex_tpu
+
+    pkg = pathlib.Path(ethrex_tpu.__file__).parent
+    repo = pkg.parent
+    pat = re.compile(r"ETHREX_[A-Z0-9_]+")
+    used = set()
+    for path in sorted(pkg.rglob("*.py")) + [repo / "bench.py"]:
+        if "__pycache__" in path.parts:
+            continue
+        used.update(pat.findall(path.read_text()))
+    # cli.py builds names as f"ETHREX_{name}"; the prefix alone is not a knob
+    used.discard("ETHREX_")
+    documented = set()
+    for path in sorted((repo / "docs").glob("*.md")):
+        documented.update(pat.findall(path.read_text()))
+    missing = sorted(used - documented)
+    assert not missing, \
+        f"env vars read by code but absent from docs/*.md: {missing}"
 
 
 def test_stark_partition_specs_reference_mesh_axis():
