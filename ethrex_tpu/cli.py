@@ -79,6 +79,19 @@ def _add_node_flags(parser: argparse.ArgumentParser):
                         help="TCP listen backlog for the RPC listeners "
                              "(HTTP, Engine API, WebSocket); saturation "
                              "shows up as rpc_connections_reset_total")
+    parser.add_argument("--rpc-executor-workers",
+                        dest="rpc_executor_workers", type=int,
+                        default=_env_int("RPC_EXECUTOR_WORKERS", 0),
+                        help="handler threads behind the asyncio RPC "
+                             "front door (0 = ETHREX_RPC_EXECUTOR_WORKERS "
+                             "env or built-in default); the event loop "
+                             "never blocks, handlers run here")
+    parser.add_argument("--rpc-max-batch", dest="rpc_max_batch", type=int,
+                        default=_env_int("RPC_MAX_BATCH", 0),
+                        help="largest JSON-RPC batch array accepted "
+                             "(0 = ETHREX_RPC_MAX_BATCH env or built-in "
+                             "default); larger arrays get a typed -32600 "
+                             "error, never a dropped connection")
     parser.add_argument("--block-time", dest="block_time", type=float,
                         default=_env_float("BLOCK_TIME", 1.0),
                         help="dev block production interval (s)")
@@ -336,8 +349,12 @@ def run_node(args) -> int:
     coinbase = bytes.fromhex(args.coinbase.removeprefix("0x"))
     store = _open_store(args.datadir)
     node = Node(genesis, coinbase=coinbase, store=store)
+    rpc_tuning = {
+        "executor_workers": args.rpc_executor_workers or None,
+        "max_batch": args.rpc_max_batch or None,
+    }
     server = RpcServer(node, args.http_addr, args.http_port,
-                       backlog=args.rpc_backlog).start()
+                       backlog=args.rpc_backlog, **rpc_tuning).start()
     print(f"genesis hash: 0x{node.genesis_header.hash.hex()}")
     print(f"JSON-RPC listening on http://{args.http_addr}:{server.port}")
     authrpc = None
@@ -356,7 +373,7 @@ def run_node(args) -> int:
                   f"{jwt_secret.hex()}")
         authrpc = RpcServer(node, args.authrpc_addr, args.authrpc_port,
                             jwt_secret=jwt_secret, engine=True,
-                            backlog=args.rpc_backlog).start()
+                            backlog=args.rpc_backlog, **rpc_tuning).start()
         print(f"Engine API listening on http://{args.authrpc_addr}:"
               f"{authrpc.port}")
     ws = None
@@ -524,8 +541,11 @@ def run_l2(args) -> int:
     seq = Sequencer(node, l1, cfg, rollup=rollup)
     node.sequencer = seq
 
-    server = RpcServer(node, args.http_addr, args.http_port,
-                       backlog=getattr(args, "rpc_backlog", None)).start()
+    server = RpcServer(
+        node, args.http_addr, args.http_port,
+        backlog=getattr(args, "rpc_backlog", None),
+        executor_workers=getattr(args, "rpc_executor_workers", 0) or None,
+        max_batch=getattr(args, "rpc_max_batch", 0) or None).start()
     print(f"genesis hash: 0x{node.genesis_header.hash.hex()}")
     print(f"L2 JSON-RPC listening on http://{args.http_addr}:{server.port}")
     latest = rollup.latest_batch_number()

@@ -552,29 +552,38 @@ def measure_mgas() -> None:
     from ethrex_tpu.primitives.transaction import Transaction
     from ethrex_tpu.storage.store import Store
 
+    from ethrex_tpu.blockchain.mempool import MAX_SENDER_SLOTS
+
     num_blocks = int(os.environ.get("BENCH_MGAS_BLOCKS", "20"))
     txs_per_block = int(os.environ.get("BENCH_MGAS_TXS", "400"))
-    secret = 0xA11CE
-    sender = secp256k1.pubkey_to_address(
-        secp256k1.pubkey_from_secret(secret))
+    # enough senders that no one holds more than the mempool's per-sender
+    # slot cap while a block's worth of txs queues (the cap is overload
+    # protection on the serving path; the untimed chain build here must
+    # live within it, not bypass it)
+    n_senders = -(-txs_per_block // MAX_SENDER_SLOTS)
+    secrets = [0xA11CE + i for i in range(n_senders)]
+    senders = [secp256k1.pubkey_to_address(
+        secp256k1.pubkey_from_secret(s)) for s in secrets]
     genesis = {
         "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
                    "shanghaiTime": 0, "cancunTime": 0},
-        "alloc": {"0x" + sender.hex(): {"balance": hex(10**24)}},
+        "alloc": {"0x" + a.hex(): {"balance": hex(10**24)}
+                  for a in senders},
         "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7",
         "timestamp": "0x0",
     }
     node = Node(Genesis.from_json(genesis))
-    nonce = 0
+    nonces = [0] * n_senders
     blocks = []
     for _ in range(num_blocks):
         for i in range(txs_per_block):
+            s = i % n_senders
             node.submit_transaction(Transaction(
-                tx_type=2, chain_id=1337, nonce=nonce,
+                tx_type=2, chain_id=1337, nonce=nonces[s],
                 max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
                 gas_limit=21_000, to=bytes([0x50 + i % 64]) * 20,
-                value=1 + i).sign(secret))
-            nonce += 1
+                value=1 + i).sign(secrets[s]))
+            nonces[s] += 1
         blocks.append(node.produce_block())
     gas = sum(b.header.gas_used for b in blocks)
     # RLP round-trip so the import is COLD, like a real sync: the chain
@@ -828,23 +837,43 @@ def measure_scaling() -> None:
 
 
 def build_serving_record(sweep: dict, setup_s: float = 0.0,
-                         sweep_s: float = 0.0) -> dict:
+                         sweep_s: float = 0.0,
+                         batch: dict | None = None,
+                         reference_rate: float | None = None) -> dict:
     """Pure record builder for the serving sweep (unit-testable without
     a live node).  Headline value is the client-observed p99 at the
     highest sustainable offered rate (lower is better); the sustained
     rate itself rides along as a sub-config so the history gate can
-    also hold the throughput direction."""
+    also hold the throughput direction.
+
+    When `reference_rate` is set and the sweep sustains beyond it, the
+    headline p99 is taken at the gentlest sustained rate >= the
+    reference instead: tail latency is only comparable across history
+    at equal offered load, so a server that newly sustains 10x the old
+    ceiling must not see its p99 gate judged at the new ceiling while
+    the baseline was judged at the old one.  The throughput direction
+    is held by the serving_sustained_tps sub-config either way.
+
+    `batch`, when provided, is the JSON-RPC batch-array stage summary
+    (offered rate, per-array p99 and the server-side
+    rpc_batch_requests_total delta) and rides along unchanged."""
     reports = sweep.get("rates") or []
     sustained = sweep.get("maxSustainableRate")
     pick = None
     for rep in reports:
         if sustained is not None and rep.get("offeredRate") == sustained:
             pick = rep
+    if (pick is not None and reference_rate is not None
+            and sustained is not None and sustained > reference_rate):
+        at_ref = [r for r in reports
+                  if reference_rate <= r.get("offeredRate", 0) <= sustained]
+        if at_ref:
+            pick = min(at_ref, key=lambda r: r.get("offeredRate", 0))
     if pick is None and reports:
         pick = reports[0]   # nothing sustained: report the gentlest rate
     lat = (pick or {}).get("latency") or {}
     stages = {"setup_s": round(setup_s, 4), "sweep_s": round(sweep_s, 4)}
-    return {
+    record = {
         "metric": "serving_rpc_p99_seconds",
         # accepted-request p99 only: shed responses live in a separate
         # histogram, so fast rejections cannot flatter this gate
@@ -874,6 +903,9 @@ def build_serving_record(sweep: dict, setup_s: float = 0.0,
         "config": "open-loop JSON-RPC serving sweep (loadgen Harness, "
                   "real TCP, tx mix, producer thread)",
     }
+    if batch is not None:
+        record["batch"] = batch
+    return record
 
 
 def measure_serving() -> None:
@@ -891,11 +923,19 @@ def measure_serving() -> None:
     from ethrex_tpu.primitives.genesis import Genesis
     from ethrex_tpu.rpc.server import RpcServer
 
+    # the asyncio front door sustains hundreds-to-thousands of req/s on
+    # one core, so the default sweep probes the new regime (the old
+    # thread-per-connection server toppled past ~30)
     rates = [float(r) for r in os.environ.get(
-        "BENCH_SERVING_RATES", "10,25").split(",") if r.strip()]
+        "BENCH_SERVING_RATES", "30,100,300,1000").split(",") if r.strip()]
     duration = float(os.environ.get("BENCH_SERVING_DURATION", "3.0"))
     arrivals = os.environ.get("BENCH_SERVING_ARRIVALS", "poisson")
-    senders = int(os.environ.get("BENCH_SERVING_SENDERS", "8"))
+    senders = int(os.environ.get("BENCH_SERVING_SENDERS", "16"))
+    batch_rate = float(os.environ.get("BENCH_SERVING_BATCH_RATE", "100"))
+    batch_size = int(os.environ.get("BENCH_SERVING_BATCH_SIZE", "8"))
+    # the p99 history gate holds at this offered rate (the old serving
+    # ceiling) so tail latency is compared at equal load across records
+    reference = float(os.environ.get("BENCH_SERVING_REFERENCE_RATE", "30"))
 
     root = secp256k1.pubkey_to_address(
         secp256k1.pubkey_from_secret(loadgen.DEFAULT_KEY))
@@ -930,15 +970,44 @@ def measure_serving() -> None:
         t1 = time.perf_counter()
         sweep = harness.sweep(rates, duration=duration, arrivals=arrivals)
         sweep_s = time.perf_counter() - t1
+        # batch-array stage: one scheduled slot = one JSON-RPC array of
+        # `batch_size` reads, dispatched concurrently server-side.  The
+        # server and bench share a process, so the METRICS counter
+        # delta proves the batch path (not per-request fallback) served
+        # the arrays.
+        from ethrex_tpu.utils.metrics import METRICS
+        t2 = time.perf_counter()
+        before = METRICS.snapshot()["counters"]
+        batch_rep = loadgen.Harness(
+            f"http://127.0.0.1:{server.port}", payload="batch",
+            batch_size=batch_size).run(batch_rate, duration, arrivals)
+        after = METRICS.snapshot()["counters"]
+        batch_s = time.perf_counter() - t2
+        batch = {
+            "offeredRate": batch_rep["offeredRate"],
+            "achievedRate": batch_rep["achievedRate"],
+            "batchSize": batch_size,
+            "errorRate": batch_rep["errorRate"],
+            "shedRate": batch_rep.get("shedRate", 0.0),
+            "p99": (batch_rep.get("latency") or {}).get("p99"),
+            "rpc_batch_requests_total": (
+                after.get("rpc_batch_requests_total", 0.0)
+                - before.get("rpc_batch_requests_total", 0.0)),
+            "rpc_batch_entries_total": (
+                after.get("rpc_batch_entries_total", 0.0)
+                - before.get("rpc_batch_entries_total", 0.0)),
+        }
     finally:
         stop.set()
         thread.join(timeout=5)
         server.stop()
         node.stop()
-    record = build_serving_record(sweep, setup_s, sweep_s)
+    record = build_serving_record(sweep, setup_s, sweep_s, batch=batch,
+                                  reference_rate=reference)
     # every measure_* names its stage breakdown inline (tooling lint)
     record.update({"stages": {"setup_s": round(setup_s, 4),
-                              "sweep_s": round(sweep_s, 4)}})
+                              "sweep_s": round(sweep_s, 4),
+                              "batch_s": round(batch_s, 4)}})
     append_history(record)
     print(json.dumps(record))
 

@@ -34,12 +34,11 @@ inclusion-throughput measurement unchanged.
 from __future__ import annotations
 
 import argparse
+import asyncio
 import hashlib
 import http.client
 import json
-import queue
 import random
-import threading
 import time
 import urllib.request
 from urllib.parse import urlparse
@@ -211,6 +210,105 @@ def _body(method: str, params: list, rid: int = 1) -> bytes:
                        "params": params}).encode()
 
 
+class _AsyncConn:
+    """One persistent keep-alive JSON-RPC connection on the client
+    event loop, with a single reconnect retry (mirroring RpcConn.post)
+    so a server-side idle close does not read as a request error.
+    Handles HTTP/1.0 close-per-response servers by reconnecting."""
+
+    __slots__ = ("host", "port", "path", "timeout", "reader", "writer")
+
+    def __init__(self, host: str, port: int, path: str, timeout: float):
+        self.host = host
+        self.port = port
+        self.path = path
+        self.timeout = timeout
+        self.reader: asyncio.StreamReader | None = None
+        self.writer: asyncio.StreamWriter | None = None
+
+    def close(self):
+        if self.writer is not None:
+            try:
+                self.writer.close()
+            except Exception:  # noqa: BLE001 — teardown
+                pass
+            self.reader = self.writer = None
+
+    async def connect(self):
+        self.reader, self.writer = await asyncio.open_connection(
+            self.host, self.port)
+
+    async def _roundtrip(self, body: bytes) -> bytes:
+        if self.writer is None:
+            await self.connect()
+        self.writer.write(
+            b"POST %s HTTP/1.1\r\n"
+            b"Host: %s\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: %d\r\n\r\n"
+            % (self.path.encode(), self.host.encode(), len(body)) + body)
+        await self.writer.drain()
+        head = await self.reader.readuntil(b"\r\n\r\n")
+        status_line, _, header_block = head.partition(b"\r\n")
+        parts = status_line.split(None, 2)
+        status = int(parts[1])
+        headers: dict[bytes, bytes] = {}
+        for line in header_block.split(b"\r\n"):
+            if b":" in line:
+                k, v = line.split(b":", 1)
+                headers[k.strip().lower()] = v.strip()
+        data = await self.reader.readexactly(
+            int(headers.get(b"content-length", b"0")))
+        connection = headers.get(b"connection", b"").lower()
+        if b"close" in connection or (parts[0] == b"HTTP/1.0"
+                                      and b"keep-alive" not in connection):
+            self.close()
+        if status != 200:
+            raise LoadgenError(f"HTTP {status}")
+        return data
+
+    async def post(self, body: bytes):
+        data = None
+        for attempt in (0, 1):
+            try:
+                data = await asyncio.wait_for(self._roundtrip(body),
+                                              self.timeout)
+                break
+            except (OSError, ConnectionError, ValueError, IndexError,
+                    asyncio.IncompleteReadError,
+                    asyncio.TimeoutError) as exc:
+                self.close()
+                if attempt:
+                    raise LoadgenError(f"transport: {exc}") from exc
+        try:
+            return json.loads(data)
+        except (json.JSONDecodeError, TypeError) as exc:
+            raise LoadgenError(f"bad response: {exc}") from exc
+
+
+def _classify(out) -> tuple[bool, bool]:
+    """(err, shed) from a decoded response.  A typed server-busy answer
+    is graceful shedding, not a failure — counted apart so sweeps
+    distinguish degradation modes.  A batch response counts as shed
+    only when EVERY entry was shed (partial service delivered work);
+    any non-busy error entry makes the whole request an error."""
+    if isinstance(out, list):
+        if not out:
+            return True, False
+        errors = [e["error"] for e in out
+                  if isinstance(e, dict) and "error" in e]
+        if any(not is_busy_error(e) for e in errors):
+            return True, False
+        if errors and len(errors) == len(out):
+            return False, True
+        return False, False
+    if isinstance(out, dict) and "error" in out:
+        if is_busy_error(out["error"]):
+            return False, True
+        return True, False
+    return False, False
+
+
 class Harness:
     """Open-loop load harness against one JSON-RPC endpoint.
 
@@ -218,12 +316,21 @@ class Harness:
     accounts (mix of transfers and token-template calls; requires
     setup() against a funded root key).  payload="ping" sends
     eth_blockNumber — serving-layer load with no chain setup, which is
-    what the open-loop unit tests and read-path sweeps use."""
+    what the open-loop unit tests and read-path sweeps use.
+    payload="batch" sends JSON-RPC arrays of `batch_size`
+    eth_blockNumber calls, exercising the server's concurrent batch
+    dispatch; one array is one scheduled send slot.
+
+    Send/receive runs on an asyncio client loop over `workers`
+    persistent connections, so the generator outruns the server: the
+    open-loop guarantees (scheduled-send latency base, missed-slot
+    accounting) are unchanged — a slot with no free connection is a
+    MISS, never deferred."""
 
     def __init__(self, url: str, key: int = DEFAULT_KEY, senders: int = 8,
-                 token_frac: float = 0.25, workers: int = 16,
+                 token_frac: float = 0.25, workers: int = 64,
                  timeout: float = 10.0, seed: int = 0,
-                 payload: str = "tx"):
+                 payload: str = "tx", batch_size: int = 8):
         self.url = url
         self.key = key
         self.token_frac = token_frac
@@ -231,6 +338,7 @@ class Harness:
         self.timeout = timeout
         self.seed = seed
         self.payload = payload
+        self.batch_size = max(1, int(batch_size))
         self.secrets = derive_secrets(senders, seed) if payload == "tx" \
             else []
         self.addresses = [secp256k1.pubkey_to_address(
@@ -289,6 +397,13 @@ class Harness:
     def _build_requests(self, n: int) -> list[tuple[str, bytes]]:
         """Pre-sign/pre-encode every request body before the clock
         starts, so signing cost cannot eat into send slots."""
+        if self.payload == "batch":
+            size = self.batch_size
+            return [("batch", json.dumps(
+                [{"jsonrpc": "2.0", "id": i * size + j,
+                  "method": "eth_blockNumber", "params": []}
+                 for j in range(size)]).encode())
+                    for i in range(n)]
         if self.payload != "tx":
             return [("ping", _body("eth_blockNumber", [], i))
                     for i in range(n)]
@@ -327,72 +442,11 @@ class Harness:
         schedule = build_schedule(rate, duration, arrivals, self.seed)
         requests = self._build_requests(len(schedule))
         registry = Metrics()
-        jobs: queue.Queue = queue.Queue()
-        idle = threading.Semaphore(self.workers)
-        lock = threading.Lock()
-        stats = {"sent": 0, "errors": 0, "shed": 0}
+        stats = {"sent": 0, "errors": 0, "shed": 0, "missed": 0}
         kinds: dict[str, int] = {}
-
-        def worker():
-            conn = RpcConn(self.url, timeout=self.timeout)
-            try:
-                while True:
-                    item = jobs.get()
-                    if item is None:
-                        return
-                    target, kind, body = item
-                    err = False
-                    shed = False
-                    try:
-                        out = conn.post(body)
-                        if "error" in out:
-                            # a typed server-busy answer is graceful
-                            # shedding, not a failure — counted apart
-                            # so sweeps distinguish degradation modes
-                            if is_busy_error(out["error"]):
-                                shed = True
-                            else:
-                                err = True
-                    except LoadgenError:
-                        err = True
-                    latency = time.monotonic() - target
-                    if shed:
-                        observe_shed_latency(registry, kind, latency)
-                    else:
-                        observe_request_latency(registry, kind, latency)
-                    with lock:
-                        stats["sent"] += 1
-                        kinds[kind] = kinds.get(kind, 0) + 1
-                        if err:
-                            stats["errors"] += 1
-                        if shed:
-                            stats["shed"] += 1
-                    idle.release()
-            finally:
-                conn.close()
-
-        threads = [threading.Thread(target=worker, daemon=True)
-                   for _ in range(self.workers)]
-        for t in threads:
-            t.start()
-        missed = 0
-        start = time.monotonic() + 0.02
-        for offset, (kind, body) in zip(schedule, requests):
-            target = start + offset
-            delay = target - time.monotonic()
-            if delay > 0:
-                time.sleep(delay)
-            # open-loop contract: a slot with no free worker is counted
-            # and DROPPED — deferring it would serialize sends behind
-            # server latency, which is exactly coordinated omission
-            if not idle.acquire(blocking=False):
-                missed += 1
-                continue
-            jobs.put((target, kind, body))
-        for _ in threads:
-            jobs.put(None)
-        for t in threads:
-            t.join(timeout=self.timeout + 5.0)
+        asyncio.run(self._run_async(schedule, requests, registry,
+                                    stats, kinds))
+        missed = stats["missed"]
 
         snap = registry.snapshot()
 
@@ -434,6 +488,67 @@ class Harness:
             "latency": lat,
             "shedLatency": _lat("loadgen_shed_seconds"),
         }
+
+    async def _run_async(self, schedule, requests, registry, stats,
+                         kinds):
+        """The open loop on an asyncio client: `workers` persistent
+        connections in a free pool, one task per send slot."""
+        u = urlparse(self.url)
+        host = u.hostname or "127.0.0.1"
+        port = u.port or 80
+        path = u.path or "/"
+        conns = [_AsyncConn(host, port, path, self.timeout)
+                 for _ in range(self.workers)]
+        # pre-connect OUTSIDE the measured schedule so handshake cost
+        # cannot eat send slots (failures fall back to lazy reconnect)
+        await asyncio.gather(*(c.connect() for c in conns),
+                             return_exceptions=True)
+        free = list(conns)
+        inflight: set = set()
+
+        async def one(conn, target, kind, body):
+            err = shed = False
+            try:
+                out = await conn.post(body)
+                err, shed = _classify(out)
+            except LoadgenError:
+                err = True
+            except Exception:  # noqa: BLE001 — a client bug must not
+                err = True     # break the accounting identity
+            latency = time.monotonic() - target
+            if shed:
+                observe_shed_latency(registry, kind, latency)
+            else:
+                observe_request_latency(registry, kind, latency)
+            stats["sent"] += 1
+            kinds[kind] = kinds.get(kind, 0) + 1
+            if err:
+                stats["errors"] += 1
+            if shed:
+                stats["shed"] += 1
+            free.append(conn)
+
+        start = time.monotonic() + 0.02
+        for offset, (kind, body) in zip(schedule, requests):
+            target = start + offset
+            delay = target - time.monotonic()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            # open-loop contract: a slot with no free connection is
+            # counted and DROPPED — deferring it would serialize sends
+            # behind server latency, which is exactly coordinated
+            # omission
+            if not free:
+                stats["missed"] += 1
+                continue
+            conn = free.pop()
+            task = asyncio.ensure_future(one(conn, target, kind, body))
+            inflight.add(task)
+            task.add_done_callback(inflight.discard)
+        if inflight:
+            await asyncio.wait(inflight, timeout=self.timeout + 5.0)
+        for conn in conns:
+            conn.close()
 
     def sweep(self, rates, duration: float = 5.0,
               arrivals: str = "fixed",
@@ -579,14 +694,22 @@ def main(argv=None):
                         dest="token_frac",
                         help="fraction of requests that call the token "
                              "template instead of a plain transfer")
-    parser.add_argument("--workers", type=int, default=16,
-                        help="max concurrent in-flight requests; a full "
-                             "pool at a send slot counts a miss")
+    parser.add_argument("--workers", type=int, default=64,
+                        help="persistent connections = max concurrent "
+                             "in-flight requests; a full pool at a send "
+                             "slot counts a miss")
     parser.add_argument("--timeout", type=float, default=10.0)
     parser.add_argument("--seed", type=int, default=0)
-    parser.add_argument("--payload", choices=("tx", "ping"), default="tx",
+    parser.add_argument("--payload", choices=("tx", "ping", "batch"),
+                        default="tx",
                         help="tx = signed transfers/token calls (needs a "
-                             "funded --key); ping = eth_blockNumber only")
+                             "funded --key); ping = eth_blockNumber "
+                             "only; batch = JSON-RPC arrays of "
+                             "--batch-size eth_blockNumber calls")
+    parser.add_argument("--batch-size", type=int, default=8,
+                        dest="batch_size",
+                        help="entries per JSON-RPC batch array when "
+                             "--payload batch")
     # legacy closed-loop flags
     parser.add_argument("--txs", type=int, default=200)
     parser.add_argument("--mode", choices=("transfer", "sstore"),
@@ -601,7 +724,8 @@ def main(argv=None):
                           senders=args.senders,
                           token_frac=args.token_frac,
                           workers=args.workers, timeout=args.timeout,
-                          seed=args.seed, payload=args.payload)
+                          seed=args.seed, payload=args.payload,
+                          batch_size=args.batch_size)
         harness.setup()
         if len(rates) == 1:
             result = harness.run(rates[0], args.duration, args.arrivals)

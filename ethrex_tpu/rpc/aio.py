@@ -1,0 +1,90 @@
+"""One asyncio event loop on a daemon thread.
+
+The node is thread-structured (producer thread, sequencer actors,
+prover clients); the serving front door is event-driven (SEDA's
+argument — Welsh et al., "SEDA: An Architecture for Well-Conditioned,
+Scalable Internet Services", SOSP 2001; PAPERS.md): one loop multiplexes
+thousands of connections, and blocking work crosses into a bounded
+executor pool instead of a thread per connection.  This helper is the
+bridge between the two worlds: it owns exactly one loop, runs it on a
+daemon thread, and lets synchronous code submit coroutines and shut the
+loop down deterministically (the leak checks in the overload soak count
+threads and fds after stop()).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+
+class LoopThread:
+    """An asyncio event loop running on a dedicated daemon thread.
+
+    start() blocks until the loop is spinning; call() submits a
+    coroutine from any thread and waits for its result; stop() cancels
+    outstanding tasks, halts the loop, joins the thread and closes the
+    loop so no selector fd outlives the server.
+    """
+
+    def __init__(self, name: str = "aio-loop"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+        self._started = threading.Event()
+        self._stopped = False
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self._started.set()
+        try:
+            self.loop.run_forever()
+        finally:
+            try:
+                self.loop.close()
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass
+
+    def start(self) -> "LoopThread":
+        self._thread.start()
+        self._started.wait()
+        return self
+
+    def running(self) -> bool:
+        return self._thread.is_alive() and not self._stopped
+
+    def call(self, coro, timeout: float | None = 30.0):
+        """Run `coro` on the loop from any thread; returns its result
+        (or raises its exception) within `timeout` seconds."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        try:
+            return fut.result(timeout)
+        except BaseException:
+            fut.cancel()
+            raise
+
+    def stop(self, timeout: float = 5.0):
+        """Cancel every outstanding task, stop and close the loop."""
+        if self._stopped or not self._thread.is_alive():
+            self._stopped = True
+            return
+        self._stopped = True
+
+        async def _cancel_all():
+            tasks = [t for t in asyncio.all_tasks()
+                     if t is not asyncio.current_task()]
+            for task in tasks:
+                task.cancel()
+            if tasks:
+                await asyncio.gather(*tasks, return_exceptions=True)
+
+        try:
+            asyncio.run_coroutine_threadsafe(
+                _cancel_all(), self.loop).result(timeout)
+        except Exception:  # noqa: BLE001 — a wedged task must not block
+            pass           # shutdown; loop.close() below reclaims the fd
+        try:
+            self.loop.call_soon_threadsafe(self.loop.stop)
+        except RuntimeError:
+            pass
+        self._thread.join(timeout)

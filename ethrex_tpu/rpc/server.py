@@ -1,26 +1,49 @@
 """JSON-RPC HTTP server + method routing (parity target: the reference's
-crates/networking/rpc/rpc.rs start_api; threaded stdlib HTTP server is the
-round-1 transport, the C++ server replaces it behind the same handlers)."""
+crates/networking/rpc/rpc.rs start_api).
+
+Transport: one asyncio event loop (rpc/aio.LoopThread) accepts
+connections, parses pipelined keep-alive HTTP/1.1, and dispatches
+JSON-RPC — single requests and spec batch arrays — onto a BOUNDED
+thread-pool executor.  The stage split follows SEDA (Welsh et al.,
+"SEDA: An Architecture for Well-Conditioned, Scalable Internet
+Services", SOSP 2001; PAPERS.md): the loop stage only parses, admits
+and writes; the executor stage runs the blocking store/EVM handler
+bodies.  Admission control (utils/overload.py) runs ON THE LOOP before
+a request may take an executor slot, so a shed under saturation costs
+microseconds — the executor can be pinned full of heavy work and the
+typed busy answer still goes out inside the <10ms shed budget
+(docs/OVERLOAD.md).
+
+Responses on one connection are written strictly in request order by a
+per-connection writer coroutine draining an ordered queue of response
+tasks, so HTTP/1.1 pipelining is safe while handlers complete out of
+order on the executor.  Batch arrays are dispatched concurrently
+(asyncio.gather), reassembled in order, capped (ETHREX_RPC_MAX_BATCH)
+and counted (rpc_batch_requests_total)."""
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 import os
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from concurrent.futures import ThreadPoolExecutor
 
 from ..utils.faults import inject
 from ..utils.metrics import (METRICS, observe_rpc_queue_wait,
                              observe_rpc_request, record_rpc_accept,
-                             record_rpc_backlog, record_rpc_bytes,
-                             record_rpc_eof, record_rpc_inflight,
+                             record_rpc_backlog, record_rpc_batch,
+                             record_rpc_bytes, record_rpc_eof,
+                             record_rpc_executor_workers,
+                             record_rpc_inflight,
                              record_rpc_method_inflight, record_rpc_reset,
                              record_rpc_slow_request)
 from ..utils.overload import SERVER_BUSY_CODE, OverloadController
 from ..utils.tracing import TRACER, trace_context
 
+from .aio import LoopThread
 from .eth import (CLIENT_NAME, CLIENT_VERSION, EthApi,
                   RpcError)  # noqa: F401 (RpcError used below)
 
@@ -33,54 +56,238 @@ SLOW_REQUEST_SECONDS = float(os.environ.get("ETHREX_RPC_SLOW_SECONDS",
                                             "1.0"))
 DEFAULT_BACKLOG = 128
 
-# per-handler-thread accept-wait handoff: finish_request stamps the
-# accept->handler wait here; the FIRST request on the connection
-# consumes it (keep-alive connections serve many requests per handler
-# thread — later requests never sat in the accept queue, so charging
-# them the connection's accept wait would shed healthy persistent
-# clients)
-_TLS = threading.local()
+# Execution-stage pool bound: blocking handler bodies (store reads, EVM
+# calls, signature recovery) run here so they never stall the event
+# loop.  Admission control caps per-class concurrency separately and
+# FIRST, on the loop — the executor bound is the hard backstop.
+EXECUTOR_WORKERS = int(os.environ.get("ETHREX_RPC_EXECUTOR_WORKERS",
+                                      "16"))
+# JSON-RPC batch array cap: one array must not amplify into unbounded
+# concurrent dispatch.  Oversized (or empty) batches are answered with
+# a typed -32600, never a closed connection.
+MAX_BATCH = int(os.environ.get("ETHREX_RPC_MAX_BATCH", "64"))
+# Single request body cap: a larger Content-Length is drained (framing
+# stays in sync) and answered with a typed -32600 on a live connection.
+MAX_BODY_BYTES = int(os.environ.get("ETHREX_RPC_MAX_BODY",
+                                    str(8 * 1024 * 1024)))
+# StreamReader buffer limit — bounds readuntil() header scans.
+_READER_LIMIT = 256 * 1024
+
+_REASONS = {200: b"OK", 400: b"Bad Request", 401: b"Unauthorized",
+            405: b"Method Not Allowed",
+            431: b"Request Header Fields Too Large"}
 
 
-class _Httpd(ThreadingHTTPServer):
-    # The socketserver default backlog of 5 lets the kernel RST
-    # connections when a burst of clients connects faster than the
-    # accept loop drains (the reset shows up client-side as
-    # ConnectionResetError 104, not a clean HTTP error).  Configurable
-    # via --rpc-backlog / ETHREX_RPC_BACKLOG; saturation shows up in
-    # rpc_connections_reset_total instead of silent kernel RSTs.
-    request_queue_size = DEFAULT_BACKLOG
+def _http_response(status: int, body: bytes,
+                   ctype: bytes = b"application/json") -> bytes:
+    return (b"HTTP/1.1 %d %s\r\n"
+            b"Content-Type: %s\r\n"
+            b"Content-Length: %d\r\n"
+            b"\r\n" % (status, _REASONS.get(status, b""), ctype,
+                       len(body))) + body
 
-    def __init__(self, addr, handler, backlog: int | None = None):
-        if backlog is not None:
-            # instance attribute shadows the class default; read by
-            # server_activate() -> socket.listen()
-            self.request_queue_size = int(backlog)
-        # accept timestamps keyed by connection object id: stamped on
-        # the accept-loop thread (process_request), consumed on the
-        # handler thread (finish_request) — the queue-wait measurement
-        self._accepted_at: dict[int, float] = {}
-        super().__init__(addr, handler)
 
-    def process_request(self, request, client_address):
-        self._accepted_at[id(request)] = time.monotonic()
-        record_rpc_accept()
-        super().process_request(request, client_address)
+class _Admitted:
+    """An admitted request: everything _execute() needs, produced by
+    _admit() on the event loop (or on the caller's thread for the
+    direct handle() path) BEFORE any executor slot is taken."""
 
-    def finish_request(self, request, client_address):
-        t0 = self._accepted_at.pop(id(request), None)
-        if t0 is not None:
-            wait = time.monotonic() - t0
-            observe_rpc_queue_wait(wait)
-            _TLS.accept_wait = wait
-        super().finish_request(request, client_address)
+    __slots__ = ("rid", "method", "params", "fn", "decision")
+
+    def __init__(self, rid, method, params, fn, decision):
+        self.rid = rid
+        self.method = method
+        self.params = params
+        self.fn = fn
+        self.decision = decision
+
+
+class _ListenerShim:
+    """Compatibility handle kept at `server._httpd`: the pre-asyncio
+    transport exposed the stdlib ThreadingHTTPServer there, and
+    operational surfaces use `.request_queue_size` for the configured
+    listen backlog and `.shutdown()` to stop the server."""
+
+    def __init__(self, server: "RpcServer", request_queue_size: int):
+        self._server = server
+        self.request_queue_size = request_queue_size
+
+    def shutdown(self):
+        self._server.stop()
+
+    def server_close(self):
+        pass
+
+
+class _HttpConn:
+    """One keep-alive HTTP connection on the event loop.
+
+    The reader coroutine parses pipelined requests and creates one
+    response task per request; the writer coroutine drains an ORDERED
+    queue of those tasks, so responses go out in request order no
+    matter how the handlers interleave on the executor."""
+
+    __slots__ = ("server", "reader", "writer", "queue", "accepted_at",
+                 "reader_task", "writer_task")
+
+    def __init__(self, server: "RpcServer", reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter):
+        self.server = server
+        self.reader = reader
+        self.writer = writer
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.accepted_at = time.monotonic()
+        self.reader_task: asyncio.Task | None = None
+        self.writer_task: asyncio.Task | None = None
+
+    # -- reader --------------------------------------------------------
+    async def read_loop(self):
+        # queue-wait signal: accept (connection_made) → first read
+        # attempt.  In an event-driven server the accept backlog shows
+        # up as loop-scheduling delay, so this is the asyncio analog of
+        # the old accept-thread→handler-thread handoff wait.  Client
+        # idle time on a pre-opened keep-alive connection is NOT queue
+        # wait — charging it would spike the shed ladder on healthy
+        # persistent clients (connection pools open sockets early).
+        wait = time.monotonic() - self.accepted_at
+        observe_rpc_queue_wait(wait)
+        self.server.overload.note_queue_wait(wait)
+        while True:
+            try:
+                head = await self.reader.readuntil(b"\r\n\r\n")
+            except asyncio.IncompleteReadError as exc:
+                if exc.partial:
+                    # peer closed mid-headers; a clean EOF between
+                    # requests is just the client hanging up
+                    record_rpc_eof()
+                return
+            except (asyncio.LimitOverrunError, ValueError):
+                self.queue.put_nowait(_http_response(
+                    431, b"header block too large", b"text/plain"))
+                return
+            except (ConnectionError, OSError):
+                record_rpc_reset()
+                return
+            request_line, _, header_block = head.partition(b"\r\n")
+            parts = request_line.split()
+            if len(parts) < 2:
+                self.queue.put_nowait(_http_response(
+                    400, b"bad request line", b"text/plain"))
+                return
+            headers: dict[str, str] = {}
+            for line in header_block.split(b"\r\n"):
+                if b":" in line:
+                    key, value = line.split(b":", 1)
+                    headers[key.strip().lower().decode("latin-1")] = \
+                        value.strip().decode("latin-1")
+            close_after = "close" in headers.get("connection", "").lower()
+            try:
+                length = int(headers.get("content-length", "0"))
+            except ValueError:
+                self.queue.put_nowait(_http_response(
+                    400, b"bad content-length", b"text/plain"))
+                return
+            if parts[0].upper() != b"POST":
+                if not await self._discard(length):
+                    return
+                self.queue.put_nowait(_http_response(
+                    405, b"POST only", b"text/plain"))
+                if close_after:
+                    return
+                continue
+            if length > MAX_BODY_BYTES:
+                if not await self._discard(length):
+                    return
+                self.queue.put_nowait(_http_response(200, json.dumps(
+                    _err(None, -32600,
+                         "request body too large")).encode()))
+                if close_after:
+                    return
+                continue
+            try:
+                body = await self.reader.readexactly(length)
+            except asyncio.IncompleteReadError:
+                record_rpc_eof()
+                return
+            except (ConnectionError, OSError):
+                record_rpc_reset()
+                return
+            server = self.server
+            if server.jwt_secret is not None and not server._authorized(
+                    headers.get("authorization", "")):
+                self.queue.put_nowait(_http_response(
+                    401, b"unauthorized", b"text/plain"))
+                if close_after:
+                    return
+                continue
+            # each request's queue age starts at parse time: deadline
+            # shedding should see loop-dispatch delay (the gap between
+            # this stamp and _admit running), never client idle time
+            # on a keep-alive connection
+            task = asyncio.ensure_future(
+                server._respond(body, time.monotonic()))
+            server._pending.add(task)
+            task.add_done_callback(server._pending.discard)
+            self.queue.put_nowait(task)
+            if close_after:
+                return
+
+    async def _discard(self, length: int) -> bool:
+        """Drain `length` body bytes without buffering them."""
+        try:
+            while length > 0:
+                chunk = await self.reader.read(min(length, 65536))
+                if not chunk:
+                    record_rpc_eof()
+                    return False
+                length -= len(chunk)
+        except (ConnectionError, OSError):
+            record_rpc_reset()
+            return False
+        return True
+
+    # -- writer --------------------------------------------------------
+    async def write_loop(self):
+        try:
+            while True:
+                item = await self.queue.get()
+                if item is None:
+                    return
+                if isinstance(item, bytes):
+                    payload = item
+                else:
+                    payload = _http_response(200, await item)
+                self.writer.write(payload)
+                await self.writer.drain()
+        except (ConnectionError, OSError):
+            # the client hung up mid-response — backlog-pressure
+            # signal, never a server traceback
+            record_rpc_reset()
+        except asyncio.CancelledError:
+            pass
+        finally:
+            self.server._conns.discard(self)
+            try:
+                self.writer.close()
+            except Exception:  # noqa: BLE001 — transport teardown
+                pass
+
+    def abort(self):
+        try:
+            transport = self.writer.transport
+            if transport is not None:
+                transport.abort()
+        except Exception:  # noqa: BLE001 — already closed
+            pass
 
 
 class RpcServer:
     def __init__(self, node, host: str = "127.0.0.1", port: int = 8545,
                  jwt_secret: bytes | None = None, engine: bool = False,
                  admin: bool = False, backlog: int | None = None,
-                 overload: OverloadController | None = None):
+                 overload: OverloadController | None = None,
+                 executor_workers: int | None = None,
+                 max_batch: int | None = None):
         self.node = node
         self.eth = EthApi(node)
         self.host = host
@@ -88,6 +295,10 @@ class RpcServer:
         self.jwt_secret = jwt_secret
         self.admin_enabled = admin
         self.backlog = backlog
+        self.executor_workers = int(executor_workers) \
+            if executor_workers is not None else EXECUTOR_WORKERS
+        self.max_batch = int(max_batch) if max_batch is not None \
+            else MAX_BATCH
         # admission control (docs/OVERLOAD.md): mempool utilization
         # feeds the shed ladder so tx submission sheds before the pool
         # starts thrashing its eviction queues
@@ -96,7 +307,13 @@ class RpcServer:
         # expose the controller for health/snapshot surfaces that only
         # hold the node (last-attached server wins, single-node truth)
         node.rpc_overload = self.overload
-        self._httpd: ThreadingHTTPServer | None = None
+        self._httpd: _ListenerShim | None = None
+        self._loop_thread: LoopThread | None = None
+        self._aio_server: asyncio.AbstractServer | None = None
+        self._conns: set[_HttpConn] = set()
+        self._pending: set[asyncio.Future] = set()
+        self._executor: ThreadPoolExecutor | None = None
+        self._exec_lock = threading.Lock()
         self._inflight_lock = threading.Lock()
         self._inflight = 0
         self._inflight_by_method: dict[str, int] = {}
@@ -224,8 +441,13 @@ class RpcServer:
             record_rpc_inflight(self._inflight)
             record_rpc_method_inflight(method, cur)
 
-    def handle(self, request: dict, accepted_at: float | None = None):
-        if "method" not in request:
+    def _admit(self, request, accepted_at: float | None = None):
+        """Admission stage: cheap and non-blocking, so the async
+        transport runs it ON THE EVENT LOOP before a request may take
+        an executor slot.  Returns a finished error response for
+        invalid/unknown/shed requests, or an _Admitted carrying the
+        overload decision — which _execute() MUST release."""
+        if not isinstance(request, dict) or "method" not in request:
             return _err(None, -32600, "invalid request")
         rid = request.get("id")
         method = request["method"]
@@ -234,14 +456,22 @@ class RpcServer:
         if fn is None:
             return _err(rid, -32601, f"method {method} not found")
         # admission control BEFORE any execution: a shed request is
-        # answered with the typed busy error and never runs, which is
-        # what keeps shed responses cheap under sustained overload
+        # answered with the typed busy error and never runs (and never
+        # queues behind the executor), which is what keeps shed
+        # responses cheap under sustained overload
         queue_age = None if accepted_at is None else \
             max(0.0, time.monotonic() - accepted_at)
         decision = self.overload.admit(method, queue_age)
         if not decision.admitted:
             return _err(rid, SERVER_BUSY_CODE, "server busy",
                         decision.error_data())
+        return _Admitted(rid, method, params, fn, decision)
+
+    def _execute(self, adm: _Admitted) -> dict:
+        """Execution stage: the (possibly blocking) handler body.  The
+        async transport runs it on the executor pool; direct handle()
+        callers run it on their own thread."""
+        rid, method = adm.rid, adm.method
         self._track_inflight(method, +1)
         t0 = time.perf_counter()
         # every request runs under a trace context, so nested spans
@@ -250,7 +480,7 @@ class RpcServer:
             try:
                 # chaos seat: a slow or crashing handler body
                 inject("rpc.handle")
-                result = fn(*params)
+                result = adm.fn(*adm.params)
                 return {"jsonrpc": "2.0", "id": rid, "result": result}
             except RpcError as ex:
                 return _err(rid, ex.code, ex.message, ex.data)
@@ -259,7 +489,7 @@ class RpcServer:
             except Exception as ex:  # noqa: BLE001 — RPC boundary
                 return _err(rid, -32603, f"internal error: {ex}")
             finally:
-                self.overload.release(decision)
+                self.overload.release(adm.decision)
                 elapsed = time.perf_counter() - t0
                 # known methods only, so label cardinality stays bounded
                 observe_rpc_request(method, elapsed)
@@ -270,82 +500,162 @@ class RpcServer:
                                 "seconds=%.3f traceId=%s",
                                 method, elapsed, trace_id)
 
+    def handle(self, request: dict, accepted_at: float | None = None):
+        """Synchronous admit+execute — the websocket dispatch path and
+        direct callers (tests, tools) that bring their own thread."""
+        adm = self._admit(request, accepted_at)
+        if isinstance(adm, _Admitted):
+            return self._execute(adm)
+        return adm
+
+    # -- async dispatch ------------------------------------------------
+    def _get_executor(self) -> ThreadPoolExecutor:
+        """Lazily build the bounded execution pool (shared with the
+        websocket server's dispatch path)."""
+        ex = self._executor
+        if ex is None:
+            with self._exec_lock:
+                ex = self._executor
+                if ex is None:
+                    ex = ThreadPoolExecutor(
+                        max_workers=self.executor_workers,
+                        thread_name_prefix="rpc-exec")
+                    record_rpc_executor_workers(self.executor_workers)
+                    self._executor = ex
+        return ex
+
+    def _authorized(self, auth_header: str) -> bool:
+        from .engine import jwt_verify
+
+        token = auth_header.removeprefix("Bearer ").strip()
+        return bool(token) and jwt_verify(self.jwt_secret, token)
+
+    async def _respond(self, raw: bytes,
+                       accepted_at: float | None) -> bytes:
+        """One HTTP body → one response body (single or batch)."""
+        try:
+            try:
+                req = json.loads(raw)
+            except json.JSONDecodeError:
+                resp = _err(None, -32700, "parse error")
+            else:
+                if isinstance(req, list):
+                    resp = await self._handle_batch(req, accepted_at)
+                else:
+                    resp = await self._handle_async(req, accepted_at)
+            data = json.dumps(resp).encode()
+        except asyncio.CancelledError:
+            raise
+        except Exception as ex:  # noqa: BLE001 — transport boundary
+            data = json.dumps(_err(None, -32603,
+                                   f"internal error: {ex}")).encode()
+        record_rpc_bytes(len(raw), len(data))
+        return data
+
+    async def _handle_async(self, request,
+                            accepted_at: float | None = None):
+        adm = self._admit(request, accepted_at)
+        if not isinstance(adm, _Admitted):
+            return adm
+        loop = asyncio.get_running_loop()
+        try:
+            return await loop.run_in_executor(
+                self._get_executor(), self._execute, adm)
+        except RuntimeError:
+            # executor already shutting down: _execute never ran, so
+            # the admission slot is still held — release it here
+            self.overload.release(adm.decision)
+            return _err(adm.rid, -32603, "server shutting down")
+
+    async def _handle_batch(self, reqs: list,
+                            accepted_at: float | None = None):
+        """JSON-RPC batch array: concurrent dispatch, in-order
+        reassembly; malformed entries get per-entry errors, size
+        violations a typed whole-batch error — never a closed
+        connection."""
+        n = len(reqs)
+        if n == 0:
+            return _err(None, -32600, "empty batch")
+        if n > self.max_batch:
+            return _err(None, -32600,
+                        f"batch too large: {n} > {self.max_batch}")
+        record_rpc_batch(n)
+        return list(await asyncio.gather(
+            *(self._handle_async(r, accepted_at) for r in reqs)))
+
     # ------------------------------------------------------------------
     def start(self):
-        server = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def do_POST(self):
-                try:
-                    self._do_post()
-                except (ConnectionResetError, BrokenPipeError):
-                    # the client hung up mid-request/mid-response — the
-                    # backlog-pressure signal, never a server traceback
-                    record_rpc_reset()
-                    self.close_connection = True
-
-            def _do_post(self):
-                if server.jwt_secret is not None:
-                    from .engine import jwt_verify
-
-                    auth = self.headers.get("Authorization", "")
-                    token = auth.removeprefix("Bearer ").strip()
-                    if not token or not jwt_verify(server.jwt_secret, token):
-                        self.send_response(401)
-                        self.end_headers()
-                        self.wfile.write(b"unauthorized")
-                        return
-                # queue-age accounting: the first request on this
-                # connection carries the accept->handler wait stamped
-                # by finish_request; follow-ups on the same keep-alive
-                # connection never queued, so their age starts here
-                wait = getattr(_TLS, "accept_wait", None)
-                if wait is not None:
-                    _TLS.accept_wait = None
-                    server.overload.note_queue_wait(wait)
-                accepted_at = time.monotonic() - (wait or 0.0)
-                length = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(length)
-                if len(body) < length:
-                    # peer closed before the full body arrived
-                    record_rpc_eof()
-                    self.close_connection = True
-                    return
-                try:
-                    req = json.loads(body)
-                except json.JSONDecodeError:
-                    resp = _err(None, -32700, "parse error")
-                else:
-                    if isinstance(req, list):
-                        resp = [server.handle(r, accepted_at=accepted_at)
-                                for r in req]
-                    else:
-                        resp = server.handle(req,
-                                             accepted_at=accepted_at)
-                data = json.dumps(resp).encode()
-                record_rpc_bytes(len(body), len(data))
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
-            def log_message(self, *args):
-                pass
-
-        self._httpd = _Httpd((self.host, self.port), Handler,
-                             backlog=self.backlog)
-        self.port = self._httpd.server_address[1]
-        record_rpc_backlog(self._httpd.request_queue_size)
-        thread = threading.Thread(target=self._httpd.serve_forever,
-                                  daemon=True)
-        thread.start()
+        backlog = int(self.backlog) if self.backlog is not None \
+            else DEFAULT_BACKLOG
+        self._loop_thread = LoopThread(name="rpc-http-loop").start()
+        self._aio_server = self._loop_thread.call(self._open(backlog))
+        self.port = self._aio_server.sockets[0].getsockname()[1]
+        self._httpd = _ListenerShim(self, backlog)
+        record_rpc_backlog(backlog)
         return self
 
-    def stop(self):
-        if self._httpd:
-            self._httpd.shutdown()
-            self._httpd.server_close()
+    async def _open(self, backlog: int):
+        return await asyncio.start_server(
+            self._serve, self.host, self.port, backlog=backlog,
+            limit=_READER_LIMIT)
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter):
+        conn = _HttpConn(self, reader, writer)
+        self._conns.add(conn)
+        record_rpc_accept()
+        conn.reader_task = asyncio.current_task()
+        conn.writer_task = asyncio.ensure_future(conn.write_loop())
+        try:
+            await conn.read_loop()
+        except asyncio.CancelledError:
+            pass  # draining: stop reading; the writer flushes + closes
+        except Exception:  # noqa: BLE001 — one bad conn, not a crash
+            LOG.debug("connection reader failed", exc_info=True)
+        finally:
+            conn.queue.put_nowait(None)
+
+    async def _shutdown_async(self, drain: float | None):
+        srv = self._aio_server
+        if srv is not None:
+            srv.close()
+            await srv.wait_closed()
+        conns = list(self._conns)
+        for conn in conns:
+            task = conn.reader_task
+            if task is not None and not task.done():
+                task.cancel()
+        writers = [c.writer_task for c in conns
+                   if c.writer_task is not None
+                   and not c.writer_task.done()]
+        if writers:
+            # graceful drain: cancelled readers enqueue the sentinel,
+            # so each writer exits once in-flight responses are flushed
+            _, stuck = await asyncio.wait(
+                writers, timeout=drain if drain is not None else 0.25)
+            for task in stuck:
+                task.cancel()
+        for conn in conns:
+            conn.abort()
+
+    def stop(self, drain: float | None = None):
+        """Stop accepting, drain in-flight requests for up to `drain`
+        seconds (the shutdown manager passes its remaining budget),
+        then close every connection, the executor pool and the loop."""
+        lt = self._loop_thread
+        if lt is not None:
+            self._loop_thread = None
+            try:
+                lt.call(self._shutdown_async(drain),
+                        timeout=(drain or 0.0) + 5.0)
+            except Exception:  # noqa: BLE001 — hard-stop below reclaims
+                pass
+            lt.stop()
+            self._aio_server = None
+        ex = self._executor
+        if ex is not None:
+            self._executor = None
+            ex.shutdown(wait=True)
 
 
 def _peer_count(node) -> int:

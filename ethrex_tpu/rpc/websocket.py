@@ -3,23 +3,33 @@
 The reference serves subscriptions over websockets
 (crates/networking/rpc subscription_manager; newHeads / logs /
 newPendingTransactions).  This is a dependency-free RFC 6455 server:
-handshake, masked client frames, text frames out, ping/pong, close.  All
-regular JSON-RPC methods route through the owning RpcServer's method
-table; eth_subscribe/eth_unsubscribe manage per-connection subscriptions
+handshake, masked client frames, text frames out, ping/pong, close.
+Framing lives in a sans-IO generator (`_parse_message`) driven by two
+interchangeable IO layers — the blocking `read_frame` (kept for test
+clients and tooling) and the asyncio reader used by the server.  All
+regular JSON-RPC methods route through the owning RpcServer's executor
+pool; eth_subscribe/eth_unsubscribe manage per-connection subscriptions
 pushed from the node's block and mempool hooks.
+
+Like the HTTP front door, the server side is a single event loop
+(rpc/aio.LoopThread; SEDA — Welsh et al., SOSP 2001; PAPERS.md): one
+reader task and one writer task per connection instead of two threads.
 
 Slow-consumer protection (docs/OVERLOAD.md): notifications are never
 sent from the fan-out loop.  Each connection owns a BOUNDED send queue
-drained by a dedicated writer thread, so one stalled subscriber cannot
-block delivery to healthy ones.  When a consumer's queue is full its
+drained by its writer task, so one stalled subscriber cannot block
+delivery to healthy ones.  When a consumer's queue is full its
 notifications are dropped (counted), and a consumer that STAYS full
 past the slow-consumer deadline is disconnected (counted in
 ws_slow_consumer_disconnects_total) instead of holding a queue of stale
-heads forever.
+heads forever.  A `WsConnection` built without a loop (direct
+construction over a raw socket, as the overload tests do) falls back to
+a writer thread with identical queue/drop/deadline semantics.
 """
 
 from __future__ import annotations
 
+import asyncio
 import base64
 import hashlib
 import json
@@ -67,6 +77,59 @@ def _accept_key(key: str) -> str:
     return base64.b64encode(digest).decode()
 
 
+# -- sans-IO framing ---------------------------------------------------------
+
+
+def _parse_message(require_mask: bool = False):
+    """Sans-IO RFC 6455 message parser (one generator per message).
+
+    Yields ("need", n) to request exactly n bytes from the driver, and
+    ("control", op, data) when a control frame interleaves a fragmented
+    data message — the driver sends back True when it consumed the
+    control frame (ping/pong) or False to abandon the message (close).
+    Returns (opcode, payload) of the completed message via
+    StopIteration.value.  Both the blocking `read_frame` and the async
+    reader drive this same generator, so the two transports cannot
+    drift on framing rules."""
+    payload = b""
+    opcode = None
+    while True:
+        h0, h1 = (yield ("need", 2))
+        fin = h0 & 0x80
+        op = h0 & 0x0F
+        masked = h1 & 0x80
+        length = h1 & 0x7F
+        if length == 126:
+            (length,) = struct.unpack(">H", (yield ("need", 2)))
+        elif length == 127:
+            (length,) = struct.unpack(">Q", (yield ("need", 8)))
+        if require_mask and not masked:
+            # RFC 6455 §5.1: a server MUST fail the connection on
+            # unmasked client frames.
+            raise ProtocolError(1002, "unmasked client frame")
+        if length + len(payload) > MAX_MESSAGE_BYTES:
+            raise ProtocolError(1009, "message too big")
+        mask = (yield ("need", 4)) if masked else b"\x00" * 4
+        data = bytearray((yield ("need", length)) if length else b"")
+        if masked:
+            for i in range(len(data)):
+                data[i] ^= mask[i % 4]
+        if op & 0x8:
+            # control frame: never fragmented (§5.5), must not interrupt
+            # the reassembly buffer of an in-flight data message
+            if not fin or length > 125:
+                raise ProtocolError(1002, "bad control frame")
+            consumed = yield ("control", op, bytes(data))
+            if consumed:
+                continue
+            return op, bytes(data)
+        if op != 0:
+            opcode = op
+        payload += bytes(data)
+        if fin:
+            return opcode, payload
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = b""
     while len(buf) < n:
@@ -79,52 +142,48 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def read_frame(sock: socket.socket, require_mask: bool = False,
                on_control=None) -> tuple[int, bytes]:
-    """Returns (opcode, payload) of one (possibly fragmented) message.
+    """Blocking driver for `_parse_message` (test clients, tooling).
 
-    Servers pass require_mask=True: RFC 6455 §5.1 requires client→server
-    frames to be masked and the connection failed otherwise.
-
-    Control frames may be interleaved between fragments of a data message
-    (RFC 6455 §5.4); `on_control(op, data) -> bool` handles them inline
-    (True = consumed, keep reading).  Unconsumed control frames are
-    returned directly — mid-fragment that abandons the partial data
-    message, which only happens for CLOSE."""
-    payload = b""
-    opcode = None
+    Returns (opcode, payload) of one (possibly fragmented) message.
+    `on_control(op, data) -> bool` handles interleaved control frames
+    inline (True = consumed, keep reading); unconsumed control frames
+    are returned directly — which only happens for CLOSE."""
+    gen = _parse_message(require_mask)
+    event = gen.send(None)
     while True:
-        h0, h1 = _recv_exact(sock, 2)
-        fin = h0 & 0x80
-        op = h0 & 0x0F
-        masked = h1 & 0x80
-        length = h1 & 0x7F
-        if length == 126:
-            (length,) = struct.unpack(">H", _recv_exact(sock, 2))
-        elif length == 127:
-            (length,) = struct.unpack(">Q", _recv_exact(sock, 8))
-        if require_mask and not masked:
-            # RFC 6455 §5.1: a server MUST fail the connection on
-            # unmasked client frames.
-            raise ProtocolError(1002, "unmasked client frame")
-        if length + len(payload) > MAX_MESSAGE_BYTES:
-            raise ProtocolError(1009, "message too big")
-        mask = _recv_exact(sock, 4) if masked else b"\x00" * 4
-        data = bytearray(_recv_exact(sock, length))
-        if masked:
-            for i in range(len(data)):
-                data[i] ^= mask[i % 4]
-        if op & 0x8:
-            # control frame: never fragmented (§5.5), must not interrupt
-            # the reassembly buffer of an in-flight data message
-            if not fin or length > 125:
-                raise ProtocolError(1002, "bad control frame")
-            if on_control is not None and on_control(op, bytes(data)):
-                continue
-            return op, bytes(data)
-        if op != 0:
-            opcode = op
-        payload += bytes(data)
-        if fin:
-            return opcode, payload
+        if event[0] == "need":
+            reply = _recv_exact(sock, event[1]) if event[1] else b""
+        else:
+            reply = bool(on_control is not None
+                         and on_control(event[1], event[2]))
+        try:
+            event = gen.send(reply)
+        except StopIteration as stop:
+            return stop.value
+
+
+async def read_frame_async(reader: asyncio.StreamReader,
+                           require_mask: bool = False,
+                           on_control=None) -> tuple[int, bytes]:
+    """Async driver for `_parse_message`; `on_control` is awaited (it
+    may write a pong)."""
+    gen = _parse_message(require_mask)
+    event = gen.send(None)
+    while True:
+        if event[0] == "need":
+            try:
+                reply = await reader.readexactly(event[1]) \
+                    if event[1] else b""
+            except asyncio.IncompleteReadError:
+                raise ConnectionError("peer closed") from None
+        else:
+            reply = False
+            if on_control is not None:
+                reply = bool(await on_control(event[1], event[2]))
+        try:
+            event = gen.send(reply)
+        except StopIteration as stop:
+            return stop.value
 
 
 def make_frame(opcode: int, payload: bytes) -> bytes:
@@ -139,6 +198,28 @@ def make_frame(opcode: int, payload: bytes) -> bytes:
     return header + payload
 
 
+def _parse_handshake(data: bytes) -> str | None:
+    """Extract the Sec-WebSocket-Key from an upgrade request, or None
+    when the request is not a websocket upgrade."""
+    headers = {}
+    for line in data.split(b"\r\n")[1:]:
+        if b":" in line:
+            k, v = line.split(b":", 1)
+            headers[k.strip().lower().decode()] = v.strip().decode()
+    key = headers.get("sec-websocket-key")
+    if not key or "websocket" not in headers.get("upgrade", "").lower():
+        return None
+    return key
+
+
+def _handshake_response(key: str) -> bytes:
+    return (b"HTTP/1.1 101 Switching Protocols\r\n"
+            b"Upgrade: websocket\r\n"
+            b"Connection: Upgrade\r\n"
+            b"Sec-WebSocket-Accept: " + _accept_key(key).encode()
+            + b"\r\n\r\n")
+
+
 class _Subscription:
     def __init__(self, sid: str, kind: str, params: dict | None):
         self.sid = sid
@@ -147,9 +228,13 @@ class _Subscription:
 
 
 class WsConnection:
-    def __init__(self, server: "WsServer", sock: socket.socket):
+    def __init__(self, server: "WsServer", sock: socket.socket,
+                 reader: asyncio.StreamReader | None = None,
+                 writer: asyncio.StreamWriter | None = None):
         self.server = server
         self.sock = sock
+        self.reader = reader
+        self.writer = writer
         self.subs: dict[str, _Subscription] = {}
         self.send_lock = threading.Lock()
         self.alive = True
@@ -158,18 +243,27 @@ class WsConnection:
         self.notifications_sent = 0
         self.send_failures = 0
         self.notifications_dropped = 0
-        # bounded notification queue + dedicated writer: the fan-out
-        # loop only ever enqueues (non-blocking), so a stalled consumer
-        # cannot block delivery to healthy subscribers
+        # bounded notification queue drained by ONE writer (task on the
+        # server loop, or a fallback thread when constructed standalone
+        # over a raw socket): the fan-out loop only ever enqueues
+        # (non-blocking), so a stalled consumer cannot block delivery
+        # to healthy subscribers
         self._sendq: queue.Queue = queue.Queue(
             maxsize=getattr(server, "notify_queue_size",
                             NOTIFY_QUEUE_SIZE))
         self._full_since: float | None = None
-        self._writer = threading.Thread(target=self._writer_loop,
-                                        daemon=True)
-        self._writer.start()
+        self._loop = getattr(server, "loop", None) \
+            if writer is not None else None
+        self._wake: asyncio.Event | None = None
+        self._writer_task: asyncio.Task | None = None
+        if self._loop is None:
+            self._writer = threading.Thread(target=self._writer_loop,
+                                            daemon=True)
+            self._writer.start()
 
+    # -- send paths ---------------------------------------------------------
     def send_json(self, obj) -> bool:
+        """Blocking send (standalone/thread mode only)."""
         data = json.dumps(obj).encode()
         try:
             with self.send_lock:
@@ -180,8 +274,9 @@ class WsConnection:
             return False
 
     def _writer_loop(self):
-        """Drain the notification queue in order; counters tick at the
-        actual send so notifications_sent means delivered-to-socket."""
+        """Thread fallback: drain the notification queue in order;
+        counters tick at the actual send so notifications_sent means
+        delivered-to-socket."""
         while True:
             frame = self._sendq.get()
             if frame is None:
@@ -196,6 +291,41 @@ class WsConnection:
                 return
             self.notifications_sent += 1
             record_ws_notification()
+
+    async def _writer_loop_async(self):
+        """Event-loop writer task: same queue, same counters; woken by
+        call_soon_threadsafe from producer threads."""
+        try:
+            while True:
+                try:
+                    frame = self._sendq.get_nowait()
+                except queue.Empty:
+                    if not self.alive:
+                        return
+                    self._wake.clear()
+                    await self._wake.wait()
+                    continue
+                if frame is None:
+                    return
+                self.writer.write(frame)
+                await self.writer.drain()
+                self.notifications_sent += 1
+                record_ws_notification()
+        except (ConnectionError, OSError):
+            self.alive = False
+            self.send_failures += 1
+            record_ws_send_failure()
+        except asyncio.CancelledError:
+            pass
+
+    def _wake_writer(self):
+        loop = self._loop
+        if loop is None or self._wake is None:
+            return
+        try:
+            loop.call_soon_threadsafe(self._wake.set)
+        except RuntimeError:
+            pass  # loop already closed (server stopping)
 
     def notify(self, sid: str, result) -> bool:
         frame = make_frame(OP_TEXT, json.dumps({
@@ -216,6 +346,7 @@ class WsConnection:
                 self._disconnect_slow()
             return False
         self._full_since = None
+        self._wake_writer()
         return True
 
     def _disconnect_slow(self):
@@ -227,11 +358,31 @@ class WsConnection:
         record_ws_slow_consumer_disconnect()
         self.server.connections.discard(self)
         record_ws_connections(len(self.server.connections))
+        loop = self._loop
+        if loop is not None:
+            try:
+                loop.call_soon_threadsafe(self._abort)
+            except RuntimeError:
+                pass
         try:
             self.sock.close()
         except OSError:
             pass
 
+    def _abort(self):
+        """Tear the transport down from the loop thread."""
+        self.alive = False
+        if self._wake is not None:
+            self._wake.set()
+        if self.writer is not None:
+            try:
+                transport = self.writer.transport
+                if transport is not None:
+                    transport.abort()
+            except Exception:  # noqa: BLE001 — already closed
+                pass
+
+    # -- dispatch -----------------------------------------------------------
     def handle_request(self, req: dict):
         method = req.get("method")
         rid = req.get("id")
@@ -253,6 +404,94 @@ class WsConnection:
             return {"jsonrpc": "2.0", "id": rid, "result": found}
         return self.server.rpc.handle(req)
 
+    async def _handle_request_async(self, req):
+        """Route one request: subscription management runs inline on
+        the loop (it only touches this connection's dict); everything
+        else crosses into the RpcServer's bounded executor so a slow
+        handler never stalls the websocket loop."""
+        if not isinstance(req, dict):
+            return {"jsonrpc": "2.0", "id": None,
+                    "error": {"code": -32600,
+                              "message": "invalid request"}}
+        if req.get("method") in ("eth_subscribe", "eth_unsubscribe"):
+            return self.handle_request(req)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self.server.rpc._get_executor(), self.server.rpc.handle, req)
+
+    async def _send_json_async(self, obj) -> None:
+        self.writer.write(make_frame(OP_TEXT, json.dumps(obj).encode()))
+        await self.writer.drain()
+
+    async def _on_control_async(self, op: int, data: bytes) -> bool:
+        if op == OP_PING:
+            self.writer.write(make_frame(OP_PONG, data))
+            await self.writer.drain()
+            return True
+        return op == OP_PONG  # CLOSE: surface to the reader loop
+
+    async def run_async(self):
+        """Reader task: one per connection on the server loop."""
+        self._wake = asyncio.Event()
+        self._writer_task = asyncio.ensure_future(
+            self._writer_loop_async())
+        try:
+            while self.alive:
+                opcode, payload = await read_frame_async(
+                    self.reader, require_mask=True,
+                    on_control=self._on_control_async)
+                if opcode == OP_CLOSE:
+                    self.writer.write(make_frame(OP_CLOSE, b""))
+                    await self.writer.drain()
+                    break
+                if opcode != OP_TEXT:
+                    continue
+                try:
+                    req = json.loads(payload)
+                except json.JSONDecodeError:
+                    await self._send_json_async(
+                        {"jsonrpc": "2.0", "id": None,
+                         "error": {"code": -32700,
+                                   "message": "parse error"}})
+                    continue
+                if isinstance(req, list):
+                    await self._send_json_async(list(await asyncio.gather(
+                        *(self._handle_request_async(r) for r in req))))
+                else:
+                    await self._send_json_async(
+                        await self._handle_request_async(req))
+        except ProtocolError as exc:
+            try:
+                self.writer.write(make_frame(
+                    OP_CLOSE, struct.pack(">H", exc.close_code)))
+                await self.writer.drain()
+            except (ConnectionError, OSError):
+                pass
+        except (ConnectionError, OSError):
+            pass
+        except asyncio.CancelledError:
+            pass
+        finally:
+            self.alive = False
+            self.server.connections.discard(self)
+            record_ws_connections(len(self.server.connections))
+            # wake the writer task so it exits, then tear down
+            try:
+                self._sendq.put_nowait(None)
+            except queue.Full:
+                pass
+            self._wake.set()
+            try:
+                await asyncio.wait_for(self._writer_task, timeout=1.0)
+            except (asyncio.TimeoutError, asyncio.CancelledError,
+                    Exception):  # noqa: B014 — teardown best-effort
+                self._writer_task.cancel()
+            try:
+                self.writer.close()
+            except Exception:  # noqa: BLE001 — transport teardown
+                pass
+
+    # -- legacy blocking reader (standalone/thread mode) --------------------
     def _on_control(self, op: int, data: bytes) -> bool:
         if op == OP_PING:
             with self.send_lock:
@@ -320,11 +559,15 @@ class WsServer:
         self.node = rpc_server.node
         self.notify_queue_size = notify_queue_size
         self.slow_consumer_deadline = slow_consumer_deadline
+        # bind eagerly so the port is known before start()
         self.listener = socket.create_server(
             (host, port), backlog=backlog)
         self.host, self.port = self.listener.getsockname()[:2]
         self.connections: set[WsConnection] = set()
         self._stop = threading.Event()
+        self.loop: asyncio.AbstractEventLoop | None = None
+        self._loop_thread = None
+        self._aio_server: asyncio.AbstractServer | None = None
         # push hooks
         self.node.block_listeners.append(self._on_block)
         self.node.mempool.on_add.append(self._on_pending_tx)
@@ -375,56 +618,68 @@ class WsServer:
                 if sub.kind == "newPendingTransactions":
                     conn.notify(sub.sid, "0x" + tx_hash.hex())
 
-    # -- accept loop -------------------------------------------------------
-    def _handshake(self, sock: socket.socket) -> bool:
-        data = b""
-        while b"\r\n\r\n" not in data:
-            chunk = sock.recv(4096)
-            if not chunk:
-                return False
-            data += chunk
-        headers = {}
-        for line in data.split(b"\r\n")[1:]:
-            if b":" in line:
-                k, v = line.split(b":", 1)
-                headers[k.strip().lower().decode()] = v.strip().decode()
-        key = headers.get("sec-websocket-key")
-        if not key or "websocket" not in \
-                headers.get("upgrade", "").lower():
-            sock.sendall(b"HTTP/1.1 400 Bad Request\r\n\r\n")
-            return False
-        sock.sendall(
-            b"HTTP/1.1 101 Switching Protocols\r\n"
-            b"Upgrade: websocket\r\n"
-            b"Connection: Upgrade\r\n"
-            b"Sec-WebSocket-Accept: " + _accept_key(key).encode()
-            + b"\r\n\r\n")
-        return True
-
-    def _accept_loop(self):
-        while not self._stop.is_set():
-            try:
-                sock, _ = self.listener.accept()
-            except OSError:
-                break
-            try:
-                if not self._handshake(sock):
-                    sock.close()
-                    continue
-            except OSError:
-                continue
-            conn = WsConnection(self, sock)
-            self.connections.add(conn)
-            record_ws_accept()
-            record_ws_connections(len(self.connections))
-            threading.Thread(target=conn.run, daemon=True).start()
+    # -- accept path -------------------------------------------------------
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter):
+        try:
+            data = await reader.readuntil(b"\r\n\r\n")
+        except (asyncio.IncompleteReadError, asyncio.LimitOverrunError,
+                ConnectionError, OSError):
+            writer.close()
+            return
+        key = _parse_handshake(data)
+        try:
+            if key is None:
+                writer.write(b"HTTP/1.1 400 Bad Request\r\n\r\n")
+                await writer.drain()
+                writer.close()
+                return
+            writer.write(_handshake_response(key))
+            await writer.drain()
+        except (ConnectionError, OSError):
+            writer.close()
+            return
+        raw = writer.get_extra_info("socket")
+        conn = WsConnection(self, raw, reader=reader, writer=writer)
+        self.connections.add(conn)
+        record_ws_accept()
+        record_ws_connections(len(self.connections))
+        await conn.run_async()
 
     def start(self):
-        threading.Thread(target=self._accept_loop, daemon=True).start()
+        from .aio import LoopThread
+
+        self._loop_thread = LoopThread(name="ws-loop").start()
+        self.loop = self._loop_thread.loop
+        self.listener.setblocking(False)
+
+        async def _open():
+            return await asyncio.start_server(self._serve,
+                                              sock=self.listener)
+
+        self._aio_server = self._loop_thread.call(_open())
         return self
 
     def stop(self):
         self._stop.set()
+        lt = self._loop_thread
+        if lt is not None:
+            self._loop_thread = None
+
+            async def _close():
+                if self._aio_server is not None:
+                    self._aio_server.close()
+                    await self._aio_server.wait_closed()
+                for conn in list(self.connections):
+                    conn._abort()
+
+            try:
+                lt.call(_close(), timeout=5.0)
+            except Exception:  # noqa: BLE001 — hard-stop below reclaims
+                pass
+            lt.stop()
+            self.loop = None
+            self._aio_server = None
         try:
             self.listener.close()
         except OSError:

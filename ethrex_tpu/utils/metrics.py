@@ -535,6 +535,23 @@ def record_rpc_slow_request():
                 "log line carrying its trace ID")
 
 
+def record_rpc_batch(entries: int):
+    METRICS.inc("rpc_batch_requests_total", 1,
+                "JSON-RPC batch arrays received (entries dispatched "
+                "concurrently on the event loop, responses reassembled "
+                "in order; capped by ETHREX_RPC_MAX_BATCH)")
+    METRICS.inc("rpc_batch_entries_total", entries,
+                "Individual requests carried inside JSON-RPC batch "
+                "arrays (each still admitted and measured on its own)")
+
+
+def record_rpc_executor_workers(count: int):
+    METRICS.set("rpc_executor_workers", count,
+                "Bound of the RPC execution-stage thread pool "
+                "(ETHREX_RPC_EXECUTOR_WORKERS): blocking handler "
+                "bodies run here so they never stall the event loop")
+
+
 def record_rpc_shed(reason: str, cost_class: str):
     METRICS.inc("rpc_requests_shed_total", 1,
                 "Requests refused by admission control with the typed "

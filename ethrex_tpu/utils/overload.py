@@ -1,11 +1,15 @@
 """Admission control / overload protection for the JSON-RPC serving path.
 
-The threaded front door (rpc/server.py) accepts everything the listen
-backlog lets through and runs every request to completion; past the
-knee of the load curve that melts p99 for *everyone* (the Tail at Scale
-argument, and DAGOR-style overload control — Zhou et al., "Overload
-Control for Scaling WeChat Microservices").  This module is the shared
-admission stage the server consults BEFORE executing a request:
+A front door that accepts everything the listen backlog lets through
+and runs every request to completion melts p99 for *everyone* past the
+knee of the load curve (the Tail at Scale argument, and DAGOR-style
+overload control — Zhou et al., "Overload Control for Scaling WeChat
+Microservices").  This module is the shared admission stage the server
+consults BEFORE executing a request.  The asyncio front door
+(rpc/server.py) runs it as on-loop middleware: ``admit()`` is cheap and
+non-blocking, so the event loop decides inline — per batch entry — and
+only admitted requests ever cross to the handler executor
+(docs/OVERLOAD.md "Async admission middleware"):
 
 - **Cost classes.**  Every method maps to one of four classes:
   ``control`` (health/alerts/admin/engine — never shed: the authenticated

@@ -123,7 +123,18 @@ def build_node_shutdown(node=None, servers=(), sequencer=None,
     for server in servers:
         if server is None:
             continue
-        manager.register("rpc", lambda t, s=server: s.stop())
+
+        def _stop_server(t, s=server):
+            # the asyncio front door accepts a drain budget: in-flight
+            # responses get a slice of the remaining deadline to land
+            # before connections are aborted.  Servers without a drain
+            # parameter (metrics, ws) just stop.
+            try:
+                s.stop(drain=min(max(t, 0.0), 5.0))
+            except TypeError:
+                s.stop()
+
+        manager.register("rpc", _stop_server)
     for client in prover_clients:
         if client is None:
             continue

@@ -1,0 +1,251 @@
+"""Asyncio front door: HTTP/1.1 pipelining, JSON-RPC batch arrays,
+mid-batch admission control, and the coordinated shutdown drain.
+
+These are raw-socket tests on purpose: the pipelining and keep-alive
+guarantees live below any HTTP client library, and a typed error that
+arrives on a CLOSED connection is indistinguishable from a crash to a
+real caller."""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from ethrex_tpu.node import Node
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.rpc.server import RpcServer
+from ethrex_tpu.utils.overload import OverloadController, SERVER_BUSY_CODE
+
+GENESIS = {
+    "config": {"chainId": 1337, "terminalTotalDifficulty": 0,
+               "shanghaiTime": 0, "cancunTime": 0},
+    "alloc": {},
+    "gasLimit": hex(30_000_000), "baseFeePerGas": "0x7", "timestamp": "0x0",
+}
+
+
+# ---------------------------------------------------------------------------
+# raw HTTP/1.1 helpers
+
+
+def _request_bytes(body: bytes) -> bytes:
+    return (b"POST / HTTP/1.1\r\nHost: t\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n"
+            + body)
+
+
+def _read_response(f):
+    """One HTTP response off a socket file; returns the decoded JSON."""
+    status = f.readline()
+    assert status.startswith(b"HTTP/1.1"), status
+    length = None
+    while True:
+        line = f.readline()
+        if line in (b"\r\n", b"\n", b""):
+            break
+        key, _, value = line.partition(b":")
+        if key.strip().lower() == b"content-length":
+            length = int(value.strip())
+    assert length is not None
+    return json.loads(f.read(length))
+
+
+def _rpc_body(method: str, rid, params=None) -> dict:
+    return {"jsonrpc": "2.0", "id": rid, "method": method,
+            "params": params or []}
+
+
+@pytest.fixture(scope="module")
+def rpc():
+    node = Node(Genesis.from_json(GENESIS))
+    server = RpcServer(node, port=0, max_batch=4)
+    server.start()
+    yield server
+    server.stop()
+    node.stop(timeout=1.0)
+
+
+@pytest.fixture()
+def conn(rpc):
+    sock = socket.create_connection(("127.0.0.1", rpc.port), timeout=10)
+    f = sock.makefile("rb")
+    yield sock, f
+    f.close()
+    sock.close()
+
+
+# ---------------------------------------------------------------------------
+# pipelined keep-alive
+
+
+def test_pipelined_requests_answered_in_order(conn):
+    """Two requests written back-to-back BEFORE any response is read:
+    the server must answer both, in request order, on one connection."""
+    sock, f = conn
+    first = json.dumps(_rpc_body("eth_blockNumber", 1)).encode()
+    second = json.dumps(_rpc_body("eth_chainId", 2)).encode()
+    sock.sendall(_request_bytes(first) + _request_bytes(second))
+    out1 = _read_response(f)
+    out2 = _read_response(f)
+    assert out1["id"] == 1 and "result" in out1
+    assert out2["id"] == 2 and out2["result"] == hex(1337)
+
+
+def test_keepalive_many_requests_one_connection(conn):
+    sock, f = conn
+    for i in range(20):
+        body = json.dumps(_rpc_body("eth_blockNumber", i)).encode()
+        sock.sendall(_request_bytes(body))
+        assert _read_response(f)["id"] == i
+
+
+def test_connection_close_header_honored(rpc):
+    sock = socket.create_connection(("127.0.0.1", rpc.port), timeout=10)
+    body = json.dumps(_rpc_body("eth_blockNumber", 1)).encode()
+    sock.sendall(
+        b"POST / HTTP/1.1\r\nHost: t\r\nConnection: close\r\n"
+        b"Content-Type: application/json\r\n"
+        b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n" + body)
+    f = sock.makefile("rb")
+    assert _read_response(f)["id"] == 1
+    assert f.read() == b""   # server closed after the response
+    sock.close()
+
+
+# ---------------------------------------------------------------------------
+# batch arrays: typed errors, never a dropped connection
+
+
+def test_batch_dispatched_and_reassembled_in_order(conn):
+    sock, f = conn
+    batch = [_rpc_body("eth_chainId", i) for i in range(4)]
+    sock.sendall(_request_bytes(json.dumps(batch).encode()))
+    out = _read_response(f)
+    assert [e["id"] for e in out] == [0, 1, 2, 3]
+    assert all(e["result"] == hex(1337) for e in out)
+
+
+def test_malformed_json_typed_error_keeps_connection(conn):
+    sock, f = conn
+    sock.sendall(_request_bytes(b"{not json"))
+    out = _read_response(f)
+    assert out["error"]["code"] == -32700
+    # the connection survived: a well-formed follow-up still answers
+    sock.sendall(_request_bytes(
+        json.dumps(_rpc_body("eth_blockNumber", 7)).encode()))
+    assert _read_response(f)["id"] == 7
+
+
+def test_empty_batch_typed_error_keeps_connection(conn):
+    sock, f = conn
+    sock.sendall(_request_bytes(b"[]"))
+    out = _read_response(f)
+    assert out["error"]["code"] == -32600
+    assert "empty" in out["error"]["message"]
+    sock.sendall(_request_bytes(
+        json.dumps(_rpc_body("eth_blockNumber", 8)).encode()))
+    assert _read_response(f)["id"] == 8
+
+
+def test_oversized_batch_typed_error_keeps_connection(rpc, conn):
+    sock, f = conn
+    batch = [_rpc_body("eth_blockNumber", i)
+             for i in range(rpc.max_batch + 1)]
+    sock.sendall(_request_bytes(json.dumps(batch).encode()))
+    out = _read_response(f)
+    assert out["error"]["code"] == -32600
+    assert "batch too large" in out["error"]["message"]
+    sock.sendall(_request_bytes(
+        json.dumps(_rpc_body("eth_blockNumber", 9)).encode()))
+    assert _read_response(f)["id"] == 9
+
+
+def test_batch_invalid_entries_get_per_entry_errors(conn):
+    sock, f = conn
+    batch = [_rpc_body("eth_chainId", 0), "bogus",
+             {"id": 2, "params": []}]
+    sock.sendall(_request_bytes(json.dumps(batch).encode()))
+    out = _read_response(f)
+    assert out[0]["result"] == hex(1337)
+    assert out[1]["error"]["code"] == -32600
+    assert out[2]["error"]["code"] == -32600
+
+
+# ---------------------------------------------------------------------------
+# mid-batch shed: admission is per entry, not per array
+
+
+def test_mid_batch_shed_answers_every_entry():
+    """With the read class pinned to one slot and a slow handler holding
+    it, the remaining batch entries shed with the typed busy error while
+    the admitted entry still completes — one array, mixed outcomes, and
+    the connection stays open."""
+    node = Node(Genesis.from_json(GENESIS))
+    server = RpcServer(node, port=0, max_batch=8)
+    server.overload = OverloadController(read_limit=1, tick_interval=0.0)
+    server.methods["test_slowRead"] = (
+        lambda: time.sleep(0.4) or "0xslow")
+    server.start()
+    try:
+        sock = socket.create_connection(("127.0.0.1", server.port),
+                                        timeout=10)
+        f = sock.makefile("rb")
+        batch = [_rpc_body("test_slowRead", 0),
+                 _rpc_body("eth_blockNumber", 1),
+                 _rpc_body("eth_blockNumber", 2)]
+        sock.sendall(_request_bytes(json.dumps(batch).encode()))
+        out = _read_response(f)
+        assert [e["id"] for e in out] == [0, 1, 2]
+        assert out[0]["result"] == "0xslow"
+        for entry in out[1:]:
+            assert entry["error"]["code"] == SERVER_BUSY_CODE
+            assert entry["error"]["data"]["retryAfter"] > 0
+        # shed entries never killed the connection
+        sock.sendall(_request_bytes(
+            json.dumps(_rpc_body("eth_blockNumber", 3)).encode()))
+        assert _read_response(f)["id"] == 3
+        f.close()
+        sock.close()
+    finally:
+        server.stop()
+        node.stop(timeout=1.0)
+
+
+# ---------------------------------------------------------------------------
+# graceful shutdown: in-flight responses drain before the port dies
+
+
+def test_shutdown_drains_inflight_request():
+    from ethrex_tpu.utils.shutdown import build_node_shutdown
+
+    node = Node(Genesis.from_json(GENESIS))
+    server = RpcServer(node, port=0)
+    server.methods["test_slowRead"] = (
+        lambda: time.sleep(0.5) or "0xdrained")
+    server.start()
+    manager = build_node_shutdown(node=node, servers=(server,),
+                                  deadline=10.0)
+    result: dict = {}
+
+    def call():
+        sock = socket.create_connection(("127.0.0.1", server.port),
+                                        timeout=10)
+        sock.sendall(_request_bytes(json.dumps(
+            _rpc_body("test_slowRead", 1)).encode()))
+        result["out"] = _read_response(sock.makefile("rb"))
+        sock.close()
+
+    thread = threading.Thread(target=call)
+    thread.start()
+    time.sleep(0.15)          # let the slow handler reach the executor
+    summary = manager.run()   # rpc step passes the drain budget through
+    thread.join(timeout=5)
+    assert result["out"]["result"] == "0xdrained"
+    rpc_steps = [s for s in summary["steps"] if s["phase"] == "rpc"]
+    assert rpc_steps and all(s["ok"] for s in rpc_steps)
+    # the listener is really gone
+    with pytest.raises(OSError):
+        socket.create_connection(("127.0.0.1", server.port), timeout=1)

@@ -372,6 +372,63 @@ def test_every_env_knob_is_documented():
         f"env vars read by code but absent from docs/*.md: {missing}"
 
 
+def test_async_front_door_never_blocks_the_loop():
+    """rpc/server.py is event-loop code: every blocking primitive
+    (time.sleep, socket recv/accept/sendall, socket file objects) must
+    live behind the executor boundary (handlers run in _execute on the
+    pool), never in the module itself — one blocking call on the loop
+    stalls every connection at once."""
+    import pathlib
+    import re
+
+    import ethrex_tpu
+
+    src = (pathlib.Path(ethrex_tpu.__file__).parent / "rpc"
+           / "server.py").read_text()
+    banned = [r"time\.sleep\(", r"\.recv\(", r"\.accept\(",
+              r"\.sendall\(", r"\.makefile\("]
+    offenders = []
+    for pat in banned:
+        for m in re.finditer(pat, src):
+            lineno = src.count("\n", 0, m.start()) + 1
+            offenders.append(f"rpc/server.py:{lineno} {m.group(0)}")
+    assert not offenders, \
+        f"blocking calls in the asyncio server module: {offenders}"
+
+
+def test_serving_knobs_have_cli_flags_with_help():
+    """Each serving tuning knob lands as BOTH an env var and a CLI flag
+    with real help text — an operator reading --help must be able to
+    discover the knob (the docs guard above holds the docs side of the
+    same contract)."""
+    import ast
+    import pathlib
+
+    import ethrex_tpu
+
+    src = (pathlib.Path(ethrex_tpu.__file__).parent
+           / "cli.py").read_text()
+    tree = ast.parse(src)
+    flags = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument" and node.args
+                and isinstance(node.args[0], ast.Constant)):
+            continue
+        helps = [k.value for k in node.keywords if k.arg == "help"]
+        flags[node.args[0].value] = (
+            helps[0].value if helps
+            and isinstance(helps[0], ast.Constant) else None)
+    for flag, env in [("--rpc-executor-workers", "RPC_EXECUTOR_WORKERS"),
+                      ("--rpc-max-batch", "RPC_MAX_BATCH"),
+                      ("--rpc-backlog", "RPC_BACKLOG")]:
+        assert flag in flags, f"missing CLI flag {flag}"
+        assert flags[flag], f"{flag} has no help text"
+        assert f'_env_int("{env}"' in src, \
+            f"{flag} lacks its ETHREX_{env} env mirror"
+
+
 def test_stark_partition_specs_reference_mesh_axis():
     """Every PartitionSpec built under stark/ must name the mesh axis
     through parallel.mesh.AXIS (or be fully replicated) — a
