@@ -127,6 +127,17 @@ def _add_node_flags(parser: argparse.ArgumentParser):
     parser.add_argument("--discovery.port", dest="discovery_port", type=int,
                         default=_env_int("DISCOVERY_PORT", 30303),
                         help="discv4 UDP port")
+    parser.add_argument("--p2p-timeout", dest="p2p_timeout", type=float,
+                        default=_env_float("P2P_TIMEOUT", 10.0),
+                        help="per-request p2p timeout CEILING (s): the "
+                        "adaptive phi-accrual estimator tightens below "
+                        "this per peer, never above it; also bounds the "
+                        "dial/handshake (docs/P2P_RESILIENCE.md)")
+    parser.add_argument("--p2p-retries", dest="p2p_retries", type=int,
+                        default=_env_int("P2P_RETRIES", 2),
+                        help="retries per p2p request after the first "
+                        "attempt, with jittered exponential backoff; "
+                        "0 disables retry (docs/P2P_RESILIENCE.md)")
     parser.add_argument("--bootnodes", default=_env("BOOTNODES", ""),
                         help="comma-separated enode URLs")
     parser.add_argument("--syncmode", choices=("full", "snap"),
@@ -394,7 +405,9 @@ def run_node(args) -> int:
     if args.p2p_enabled:
         from .p2p.connection import P2PServer
 
-        p2p = P2PServer(node, host=args.p2p_addr, port=args.p2p_port)
+        p2p = P2PServer(node, host=args.p2p_addr, port=args.p2p_port,
+                        timeout=args.p2p_timeout,
+                        retries=args.p2p_retries)
         p2p.start()
         from .p2p.rlpx import _pub_bytes
 

@@ -1,0 +1,277 @@
+"""Pure-Python AES fallback for environments without the `cryptography`
+package.
+
+The p2p stack needs exactly three primitives: AES-CTR (ECIES handshake
+payloads + RLPx frame encryption), single-block AES-ECB (the RLPx
+keccak-MAC whitening step), and AES-GCM (discv5 session packets).  This
+module provides them behind the same API shape the `cryptography`
+package exposes (`Cipher(algorithms.AES(key), modes.CTR(iv)).encryptor()
+.update(...)`) so `rlpx.py`/`discv5.py` can fall back transparently:
+
+    try:
+        from cryptography.hazmat.primitives.ciphers import (
+            Cipher, algorithms, modes)
+    except ModuleNotFoundError:
+        from ..crypto.aes import Cipher, algorithms, modes
+
+T-table AES (the classic Te0..Te3 formulation), good for a few MB/s in
+CPython — plenty for handshakes, gossip, and the snap-sync test
+batteries.  Not constant-time: when the real library is installed it
+always wins the import race; this exists so a missing optional native
+dependency degrades to slower crypto instead of a dead p2p stack.
+"""
+
+from __future__ import annotations
+
+# ---- GF(2^8) tables (computed, not transcribed) ---------------------------
+
+def _xtime(a: int) -> int:
+    a <<= 1
+    return (a ^ 0x1B) & 0xFF if a & 0x100 else a
+
+
+_EXP = [0] * 512
+_LOG = [0] * 256
+_x = 1
+for _i in range(255):
+    _EXP[_i] = _x
+    _LOG[_x] = _i
+    _x ^= _xtime(_x)          # multiply by the generator 0x03
+for _i in range(255, 512):
+    _EXP[_i] = _EXP[_i - 255]
+
+
+def _mul(a: int, b: int) -> int:
+    if not a or not b:
+        return 0
+    return _EXP[_LOG[a] + _LOG[b]]
+
+
+SBOX = [0] * 256
+for _i in range(256):
+    _q = 0 if _i == 0 else _EXP[255 - _LOG[_i]]   # multiplicative inverse
+    _s = _q
+    for _ in range(4):
+        _q = ((_q << 1) | (_q >> 7)) & 0xFF
+        _s ^= _q
+    SBOX[_i] = _s ^ 0x63
+
+_T0, _T1, _T2, _T3 = [], [], [], []
+for _i in range(256):
+    _s = SBOX[_i]
+    _t = (_mul(_s, 2) << 24) | (_s << 16) | (_s << 8) | _mul(_s, 3)
+    _T0.append(_t)
+    _T1.append(((_t >> 8) | (_t << 24)) & 0xFFFFFFFF)
+    _T2.append(((_t >> 16) | (_t << 16)) & 0xFFFFFFFF)
+    _T3.append(((_t >> 24) | (_t << 8)) & 0xFFFFFFFF)
+
+
+class _AES:
+    """Key schedule + single-block encryption (AES-128/192/256)."""
+
+    def __init__(self, key: bytes):
+        if len(key) not in (16, 24, 32):
+            raise ValueError(f"bad AES key length {len(key)}")
+        nk = len(key) // 4
+        self.rounds = nk + 6
+        w = [int.from_bytes(key[4 * i:4 * i + 4], "big")
+             for i in range(nk)]
+        rcon = 1
+        for i in range(nk, 4 * (self.rounds + 1)):
+            t = w[i - 1]
+            if i % nk == 0:
+                t = ((t << 8) | (t >> 24)) & 0xFFFFFFFF   # RotWord
+                t = ((SBOX[(t >> 24) & 255] << 24)
+                     | (SBOX[(t >> 16) & 255] << 16)
+                     | (SBOX[(t >> 8) & 255] << 8)
+                     | SBOX[t & 255])                     # SubWord
+                t ^= rcon << 24
+                rcon = _xtime(rcon)
+            elif nk > 6 and i % nk == 4:
+                t = ((SBOX[(t >> 24) & 255] << 24)
+                     | (SBOX[(t >> 16) & 255] << 16)
+                     | (SBOX[(t >> 8) & 255] << 8)
+                     | SBOX[t & 255])
+            w.append(w[i - nk] ^ t)
+        self._w = w
+
+    def encrypt_block(self, block: bytes) -> bytes:
+        w = self._w
+        s0 = int.from_bytes(block[0:4], "big") ^ w[0]
+        s1 = int.from_bytes(block[4:8], "big") ^ w[1]
+        s2 = int.from_bytes(block[8:12], "big") ^ w[2]
+        s3 = int.from_bytes(block[12:16], "big") ^ w[3]
+        k = 4
+        for _ in range(self.rounds - 1):
+            t0 = (_T0[s0 >> 24] ^ _T1[(s1 >> 16) & 255]
+                  ^ _T2[(s2 >> 8) & 255] ^ _T3[s3 & 255] ^ w[k])
+            t1 = (_T0[s1 >> 24] ^ _T1[(s2 >> 16) & 255]
+                  ^ _T2[(s3 >> 8) & 255] ^ _T3[s0 & 255] ^ w[k + 1])
+            t2 = (_T0[s2 >> 24] ^ _T1[(s3 >> 16) & 255]
+                  ^ _T2[(s0 >> 8) & 255] ^ _T3[s1 & 255] ^ w[k + 2])
+            t3 = (_T0[s3 >> 24] ^ _T1[(s0 >> 16) & 255]
+                  ^ _T2[(s1 >> 8) & 255] ^ _T3[s2 & 255] ^ w[k + 3])
+            s0, s1, s2, s3 = t0, t1, t2, t3
+            k += 4
+        out = bytearray(16)
+        for i, (a, b, c, d) in enumerate(((s0, s1, s2, s3),
+                                          (s1, s2, s3, s0),
+                                          (s2, s3, s0, s1),
+                                          (s3, s0, s1, s2))):
+            col = ((SBOX[a >> 24] << 24) | (SBOX[(b >> 16) & 255] << 16)
+                   | (SBOX[(c >> 8) & 255] << 8)
+                   | SBOX[d & 255]) ^ w[k + i]
+            out[4 * i:4 * i + 4] = col.to_bytes(4, "big")
+        return bytes(out)
+
+
+# ---- streaming contexts (the `cryptography` encryptor/decryptor shape) ----
+
+class _CTRStream:
+    """Streaming CTR keystream: position persists across update() calls
+    exactly like the native library's context (RLPx relies on this)."""
+
+    def __init__(self, aes: _AES, iv: bytes):
+        self._aes = aes
+        self._counter = int.from_bytes(iv, "big")
+        self._leftover = b""
+
+    def update(self, data: bytes) -> bytes:
+        n = len(data)
+        ks = bytearray(self._leftover)
+        enc = self._aes.encrypt_block
+        ctr = self._counter
+        while len(ks) < n:
+            ks += enc(ctr.to_bytes(16, "big"))
+            ctr = (ctr + 1) & ((1 << 128) - 1)
+        self._counter = ctr
+        self._leftover = bytes(ks[n:])
+        if n == 0:
+            return b""
+        x = int.from_bytes(data, "big") ^ int.from_bytes(ks[:n], "big")
+        return x.to_bytes(n, "big")
+
+    def finalize(self) -> bytes:
+        return b""
+
+
+class _ECBStream:
+    def __init__(self, aes: _AES):
+        self._aes = aes
+
+    def update(self, data: bytes) -> bytes:
+        if len(data) % 16:
+            raise ValueError("ECB update needs 16-byte multiples")
+        return b"".join(self._aes.encrypt_block(data[i:i + 16])
+                        for i in range(0, len(data), 16))
+
+    def finalize(self) -> bytes:
+        return b""
+
+
+class Cipher:
+    def __init__(self, algorithm, mode):
+        self._aes = _AES(algorithm.key)
+        self._mode = mode
+
+    def _stream(self):
+        if isinstance(self._mode, modes.CTR):
+            return _CTRStream(self._aes, self._mode.nonce)
+        if isinstance(self._mode, modes.ECB):
+            return _ECBStream(self._aes)
+        raise ValueError(f"unsupported mode {self._mode!r}")
+
+    def encryptor(self):
+        return self._stream()
+
+    def decryptor(self):
+        # CTR and the MAC's ECB use are symmetric
+        return self._stream()
+
+
+class algorithms:  # noqa: N801 — mirrors the cryptography API surface
+    class AES:
+        def __init__(self, key: bytes):
+            self.key = bytes(key)
+
+
+class modes:  # noqa: N801 — mirrors the cryptography API surface
+    class CTR:
+        def __init__(self, nonce: bytes):
+            self.nonce = bytes(nonce)
+
+    class ECB:
+        pass
+
+
+# ---- AES-GCM (discv5 session packets) -------------------------------------
+
+class InvalidTag(Exception):
+    """Mirror of cryptography.exceptions.InvalidTag."""
+
+
+_R = 0xE1 << 120
+
+
+def _gmul(x: int, y: int) -> int:
+    """GF(2^128) multiply in GCM bit order."""
+    z = 0
+    v = y
+    for i in range(127, -1, -1):
+        if (x >> i) & 1:
+            z ^= v
+        if v & 1:
+            v = (v >> 1) ^ _R
+        else:
+            v >>= 1
+    return z
+
+
+class AESGCM:
+    def __init__(self, key: bytes):
+        self._aes = _AES(key)
+        self._h = int.from_bytes(self._aes.encrypt_block(b"\x00" * 16),
+                                 "big")
+
+    def _ghash(self, ad: bytes, ct: bytes) -> int:
+        y = 0
+        for buf in (ad, ct):
+            for i in range(0, len(buf), 16):
+                blk = buf[i:i + 16].ljust(16, b"\x00")
+                y = _gmul(y ^ int.from_bytes(blk, "big"), self._h)
+        lengths = ((len(ad) * 8) << 64) | (len(ct) * 8)
+        return _gmul(y ^ lengths, self._h)
+
+    def _j0(self, nonce: bytes) -> int:
+        if len(nonce) != 12:
+            raise ValueError("only 96-bit GCM nonces are supported")
+        return (int.from_bytes(nonce, "big") << 32) | 1
+
+    def _ctr_crypt(self, j0: int, data: bytes) -> bytes:
+        return _CTRStream(self._aes,
+                          ((j0 + 1) & ((1 << 128) - 1))
+                          .to_bytes(16, "big")).update(data)
+
+    def _tag(self, j0: int, ad: bytes, ct: bytes) -> bytes:
+        s = self._ghash(ad, ct)
+        e = int.from_bytes(self._aes.encrypt_block(j0.to_bytes(16, "big")),
+                           "big")
+        return (s ^ e).to_bytes(16, "big")
+
+    def encrypt(self, nonce: bytes, data: bytes,
+                associated_data: bytes | None) -> bytes:
+        ad = associated_data or b""
+        j0 = self._j0(nonce)
+        ct = self._ctr_crypt(j0, data)
+        return ct + self._tag(j0, ad, ct)
+
+    def decrypt(self, nonce: bytes, data: bytes,
+                associated_data: bytes | None) -> bytes:
+        if len(data) < 16:
+            raise InvalidTag("ciphertext shorter than the tag")
+        ad = associated_data or b""
+        j0 = self._j0(nonce)
+        ct, tag = data[:-16], data[-16:]
+        if self._tag(j0, ad, ct) != tag:
+            raise InvalidTag("GCM tag mismatch")
+        return self._ctr_crypt(j0, ct)

@@ -6,22 +6,54 @@ server.rs + sync/full.rs in miniature).
 
 from __future__ import annotations
 
+import logging
 import os
 import socket
 import struct
 import threading
+import time
 
 from ..crypto import secp256k1
 from ..primitives.block import Block
+from ..utils import faults
+from ..utils.metrics import (record_p2p_ban, record_p2p_broadcast_failure,
+                             record_p2p_peer_rtt, record_p2p_retry,
+                             record_p2p_timeout)
 from . import eth_wire, rlpx, snap
+from .failure import Backoff, BanList, PhiAccrualDetector
 
 from ..rpc.eth import CLIENT_NAME, CLIENT_VERSION
 
 CLIENT_ID = f"{CLIENT_NAME}/{CLIENT_VERSION}"
 
+log = logging.getLogger("ethrex_tpu.p2p")
+
+
+def p2p_timeout_ceiling() -> float:
+    """Request/dial timeout ceiling (ETHREX_P2P_TIMEOUT / --p2p-timeout).
+    The phi-accrual estimator adapts per-peer timeouts below this."""
+    try:
+        return float(os.environ.get("ETHREX_P2P_TIMEOUT", "10"))
+    except ValueError:
+        return 10.0
+
+
+def p2p_retries() -> int:
+    """Bounded retry budget per request (ETHREX_P2P_RETRIES)."""
+    try:
+        return max(0, int(os.environ.get("ETHREX_P2P_RETRIES", "2")))
+    except ValueError:
+        return 2
+
 
 class PeerError(Exception):
     pass
+
+
+class RequestTimeout(PeerError):
+    """A request outlived its (adaptive) timeout — transient by
+    classification: costs a small score penalty and is retried with a
+    fresh request id, unlike misbehavior which is penalized hard."""
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -73,6 +105,13 @@ class RlpxPeer:
         self._imported: dict[bytes, None] = {}
         self._fetching: set[bytes] = set()
         self.KNOWN_TX_CAP = 32768
+        # request resilience (docs/P2P_RESILIENCE.md): adaptive per-peer
+        # timeouts from a response-time EWMA, bounded jittered retries
+        self.rtt = PhiAccrualDetector(ceiling=p2p_timeout_ceiling())
+        self.retries = p2p_retries()
+        self.backoff = Backoff()
+        self._sleep = time.sleep      # injectable for fake-clock tests
+        self._clock = time.monotonic
 
     # -- framing over the socket ------------------------------------------
     # Spec wire format: header-ct(16) || header-mac(16) || frame-ct ||
@@ -84,6 +123,7 @@ class RlpxPeer:
     def send_msg(self, msg_id: int, payload: bytes):
         from ..utils import snappy
 
+        payload = faults.inject("net.send", payload)
         with self.lock:
             if self.snappy_active:
                 payload = snappy.compress(payload)
@@ -104,6 +144,7 @@ class RlpxPeer:
                                             self.MAX_DECOMPRESSED)
             except snappy.SnappyError as e:
                 raise PeerError(f"bad snappy payload: {e}")
+        payload = faults.inject("net.recv", payload)
         return msg_id, payload
 
     # -- protocol ----------------------------------------------------------
@@ -215,20 +256,43 @@ class RlpxPeer:
 
     SCORE_MAX = 50
     SCORE_DISCONNECT = -50
+    PENALTY_TIMEOUT = 2        # transient: slow/stalled response
+    PENALTY_MISBEHAVIOR = 25   # protocol violation / tampered proof
+
+    def node_id(self):
+        """Remote node id (64-byte uncompressed pubkey), or None before
+        the handshake identified the peer."""
+        try:
+            return rlpx._pub_bytes(self.remote_pub)
+        except Exception:  # noqa: BLE001 — unidentified peer
+            return None
+
+    def label(self) -> str:
+        nid = self.node_id()
+        return nid.hex()[:12] if nid else "?"
 
     def record_success(self):
         with self._score_lock:
             self.score = min(self.score + 1, self.SCORE_MAX)
 
-    def record_failure(self, penalty: int = 5):
+    def record_failure(self, penalty: int = 5, reason: str = "failure"):
         with self._score_lock:
             self.score -= penalty
             evict = self.score <= self.SCORE_DISCONNECT
         if evict:
+            # eviction is sticky: the server's persisted ban list keeps
+            # this peer out across restarts (decaying TTL)
+            server = getattr(self.node, "p2p_server", None)
+            if server is not None:
+                server.ban_peer(self, reason=reason)
             self.close()
 
     def request(self, msg_id: int, payload: bytes, request_id: int,
-                timeout: float = 10.0):
+                timeout: float | None = None, klass: str = "default"):
+        faults.inject("peer.request", kinds=("drop", "delay", "error"))
+        if timeout is None:
+            timeout = self.rtt.timeout_for(klass)
+        started = self._clock()
         self.send_msg(msg_id, payload)
         with self._pending_cv:
             ok = self._pending_cv.wait_for(
@@ -236,21 +300,49 @@ class RlpxPeer:
             if not ok:
                 # a late response must not leak into _pending forever
                 self._late_ok.add(request_id)
-                self.record_failure()
-                raise PeerError("request timed out")
+                record_p2p_timeout(klass)
+                self.record_failure(self.PENALTY_TIMEOUT,
+                                    reason=f"{klass} timeout")
+                raise RequestTimeout(
+                    f"{klass} request timed out after {timeout:.2f}s")
             result = self._pending.pop(request_id)
+        self.rtt.observe(self._clock() - started)
+        record_p2p_peer_rtt(self.label(), self.rtt.mean)
         self.record_success()
         return result
 
+    def _request_retrying(self, msg_id: int, build, klass: str):
+        """Send with bounded retries + jittered backoff.  Each attempt
+        uses a FRESH request id via `build(rid)` — re-sending a used id
+        would let a late first response resolve the retry with stale
+        data (or leak into _pending forever)."""
+        last = None
+        for attempt in range(self.retries + 1):
+            rid = self._next_request_id()
+            try:
+                return self.request(msg_id, build(rid), rid, klass=klass)
+            except (RequestTimeout, OSError) as e:
+                # transient: timed out, or the frame never left (dropped
+                # connection mid-send).  Anything else propagates.
+                last = e
+                if self._stop.is_set() or attempt >= self.retries:
+                    break
+                record_p2p_retry(klass)
+                self._sleep(self.backoff.delay(attempt))
+        raise last
+
     def get_block_headers(self, start: int, limit: int):
-        rid = self._next_request_id()
-        payload = eth_wire.encode_get_block_headers(rid, start, limit)
-        return self.request(eth_wire.GET_BLOCK_HEADERS, payload, rid)
+        return self._request_retrying(
+            eth_wire.GET_BLOCK_HEADERS,
+            lambda rid: eth_wire.encode_get_block_headers(rid, start,
+                                                          limit),
+            "headers")
 
     def get_block_bodies(self, hashes):
-        rid = self._next_request_id()
-        payload = eth_wire.encode_get_block_bodies(rid, hashes)
-        return self.request(eth_wire.GET_BLOCK_BODIES, payload, rid)
+        return self._request_retrying(
+            eth_wire.GET_BLOCK_BODIES,
+            lambda rid: eth_wire.encode_get_block_bodies(rid, hashes),
+            "bodies")
 
     def get_receipts(self, hashes):
         """Receipts for `hashes`; on eth/70+ (EIP-7975) responses are
@@ -262,11 +354,12 @@ class RlpxPeer:
         out = []          # completed lists, aligned with `hashes`
         partial = []      # receipts so far for hashes[len(out)]
         while len(out) < len(hashes):
-            rid = self._next_request_id()
-            payload = eth_wire.encode_get_receipts70(
-                rid, len(partial), hashes[len(out):])
-            incomplete, lists = self.request(
-                eth_wire.GET_RECEIPTS, payload, rid)
+            done, resume_at = len(out), len(partial)
+            incomplete, lists = self._request_retrying(
+                eth_wire.GET_RECEIPTS,
+                lambda rid: eth_wire.encode_get_receipts70(
+                    rid, resume_at, hashes[done:]),
+                "receipts")
             if not lists or (incomplete
                              and sum(len(x) for x in lists) == 0):
                 break     # peer has nothing / is stalling
@@ -286,18 +379,21 @@ class RlpxPeer:
         return out
 
     def _get_receipts_legacy(self, hashes):
-        rid = self._next_request_id()
-        payload = eth_wire.encode_get_receipts(rid, hashes)
-        return self.request(eth_wire.GET_RECEIPTS, payload, rid)
+        return self._request_retrying(
+            eth_wire.GET_RECEIPTS,
+            lambda rid: eth_wire.encode_get_receipts(rid, hashes),
+            "receipts")
 
     def get_block_access_lists(self, hashes):
         """eth/71 (EIP-8159): fetch per-block BALs; None for blocks the
         peer does not know or cannot derive."""
         if self.eth_version < 71:
             raise PeerError("peer negotiated below eth/71")
-        rid = self._next_request_id()
-        payload = eth_wire.encode_get_block_access_lists(rid, hashes)
-        return self.request(eth_wire.GET_BLOCK_ACCESS_LISTS, payload, rid)
+        return self._request_retrying(
+            eth_wire.GET_BLOCK_ACCESS_LISTS,
+            lambda rid: eth_wire.encode_get_block_access_lists(rid,
+                                                               hashes),
+            "bals")
 
     def _derive_bal(self, block_hash: bytes):
         """Serving seat for BlockAccessLists: derive the canonical
@@ -328,29 +424,34 @@ class RlpxPeer:
     def snap_get_account_range(self, root: bytes, origin: bytes,
                                limit: bytes):
         self._require_snap()
-        rid = self._next_request_id()
-        payload = snap.encode_get_account_range(rid, root, origin, limit)
-        return self.request(self.snap_offset + snap.GET_ACCOUNT_RANGE, payload, rid)
+        return self._request_retrying(
+            self.snap_offset + snap.GET_ACCOUNT_RANGE,
+            lambda rid: snap.encode_get_account_range(rid, root, origin,
+                                                      limit),
+            "ranges")
 
     def snap_get_storage_range(self, root: bytes, account_hash: bytes,
                                origin: bytes = b""):
         self._require_snap()
-        rid = self._next_request_id()
-        payload = snap.encode_get_storage_ranges(rid, root, [account_hash],
-                                                 origin)
-        slots, proofs = self.request(self.snap_offset + snap.GET_STORAGE_RANGES, payload, rid)
+        slots, proofs = self._request_retrying(
+            self.snap_offset + snap.GET_STORAGE_RANGES,
+            lambda rid: snap.encode_get_storage_ranges(
+                rid, root, [account_hash], origin),
+            "ranges")
         return (slots[0] if slots else []), (proofs[0] if proofs else [])
 
     def snap_get_byte_codes(self, hashes):
-        rid = self._next_request_id()
-        payload = snap.encode_get_byte_codes(rid, hashes)
-        return self.request(self.snap_offset + snap.GET_BYTE_CODES, payload, rid)
+        return self._request_retrying(
+            self.snap_offset + snap.GET_BYTE_CODES,
+            lambda rid: snap.encode_get_byte_codes(rid, hashes),
+            "codes")
 
     def snap_get_trie_nodes(self, root: bytes, paths):
         self._require_snap()
-        rid = self._next_request_id()
-        payload = snap.encode_get_trie_nodes(rid, root, paths)
-        return self.request(self.snap_offset + snap.GET_TRIE_NODES, payload, rid)
+        return self._request_retrying(
+            self.snap_offset + snap.GET_TRIE_NODES,
+            lambda rid: snap.encode_get_trie_nodes(rid, root, paths),
+            "trie")
 
     def announce_pooled_txs(self, txs):
         for tx in txs:
@@ -545,7 +646,9 @@ class RlpxPeer:
             accounts, proof = snap.serve_account_range(
                 store, root, origin, limit)
             self.send_msg(self.snap_offset + snap.ACCOUNT_RANGE,
-                          snap.encode_account_range(rid, accounts, proof))
+                          faults.inject("snap.serve",
+                                        snap.encode_account_range(
+                                            rid, accounts, proof)))
         elif msg_id == self.snap_offset + snap.ACCOUNT_RANGE:
             rid, accounts, proof = snap.decode_account_range(payload)
             self._resolve(rid, (accounts, proof))
@@ -558,8 +661,10 @@ class RlpxPeer:
                                                         origin)
                 slots_all.append(slots)
                 proofs_all.append(proof)
-            self.send_msg(self.snap_offset + snap.STORAGE_RANGES, snap.encode_storage_ranges(
-                rid, slots_all, proofs_all))
+            self.send_msg(self.snap_offset + snap.STORAGE_RANGES,
+                          faults.inject("snap.serve",
+                                        snap.encode_storage_ranges(
+                                            rid, slots_all, proofs_all)))
         elif msg_id == self.snap_offset + snap.STORAGE_RANGES:
             rid, slots, proofs = snap.decode_storage_ranges(payload)
             self._resolve(rid, (slots, proofs))
@@ -568,7 +673,9 @@ class RlpxPeer:
             codes = [store.code[h] for h in hashes[:1024]
                      if h in store.code]
             self.send_msg(self.snap_offset + snap.BYTE_CODES,
-                          snap.encode_byte_codes(rid, codes))
+                          faults.inject("snap.serve",
+                                        snap.encode_byte_codes(rid,
+                                                               codes)))
         elif msg_id == self.snap_offset + snap.BYTE_CODES:
             rid, codes = snap.decode_byte_codes(payload)
             self._resolve(rid, codes)
@@ -576,7 +683,9 @@ class RlpxPeer:
             rid, root, paths = snap.decode_get_trie_nodes(payload)
             nodes = snap.serve_trie_nodes(store, root, paths)
             self.send_msg(self.snap_offset + snap.TRIE_NODES,
-                          snap.encode_trie_nodes(rid, nodes))
+                          faults.inject("snap.serve",
+                                        snap.encode_trie_nodes(rid,
+                                                               nodes)))
         elif msg_id == self.snap_offset + snap.TRIE_NODES:
             rid, nodes = snap.decode_trie_nodes(payload)
             self._resolve(rid, nodes)
@@ -674,10 +783,10 @@ class P2PServer:
     """TCP listener + dialer establishing RLPx sessions for a Node."""
 
     def __init__(self, node, secret: int | None = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 timeout: float | None = None,
+                 retries: int | None = None):
         self.node = node
-        node.p2p_server = self
-        node.on_new_block = self.broadcast_block  # producer -> gossip hook
         node.p2p_secret = secret or (
             int.from_bytes(os.urandom(32), "big") % secp256k1.N or 1)
         self.secret = node.p2p_secret
@@ -686,6 +795,29 @@ class P2PServer:
         self.host, self.port = self.listener.getsockname()
         self.peers: list[RlpxPeer] = []
         self._stop = threading.Event()
+        self.timeout = p2p_timeout_ceiling() if timeout is None \
+            else float(timeout)
+        self.retries = p2p_retries() if retries is None else int(retries)
+        # bans persist in store.meta["p2p_bans"]: an evicted peer stays
+        # out across restarts (decaying TTL, docs/P2P_RESILIENCE.md)
+        self.bans = BanList(node.store)
+        # publish only once fully built: peer reader threads reach the
+        # server through node.p2p_server and must never see a half-
+        # constructed one (e.g. during a restart-style re-instantiation)
+        node.p2p_server = self
+        node.on_new_block = self.broadcast_block  # producer -> gossip hook
+
+    def _configure_peer(self, peer: RlpxPeer) -> RlpxPeer:
+        peer.rtt.ceiling = self.timeout
+        peer.retries = self.retries
+        return peer
+
+    def ban_peer(self, peer: RlpxPeer, reason: str = "score") -> None:
+        nid = peer.node_id()
+        if nid is None:
+            return
+        self.bans.ban(nid, reason=reason)
+        record_p2p_ban()
 
     # -- recipient side ----------------------------------------------------
     def _accept_loop(self):
@@ -695,7 +827,10 @@ class P2PServer:
             except OSError:
                 break
             try:
-                peer = self._handshake_recipient(sock)
+                peer = self._configure_peer(self._handshake_recipient(sock))
+                nid = peer.node_id()
+                if nid is not None and self.bans.is_banned(nid):
+                    raise PeerError("peer is banned")
                 peer.exchange_hello()
                 peer.exchange_status()
                 self.peers.append(peer)
@@ -718,7 +853,10 @@ class P2PServer:
 
     # -- initiator side ----------------------------------------------------
     def dial(self, host: str, port: int, remote_pub) -> RlpxPeer:
-        sock = socket.create_connection((host, port), timeout=10)
+        if self.bans.is_banned(rlpx._pub_bytes(remote_pub)):
+            raise PeerError("peer is banned")
+        sock = socket.create_connection((host, port),
+                                        timeout=self.timeout)
         eph = int.from_bytes(os.urandom(32), "big") % secp256k1.N or 1
         nonce = os.urandom(32)
         auth = rlpx.make_auth(self.secret, eph, nonce, remote_pub)
@@ -726,9 +864,13 @@ class P2PServer:
         size = struct.unpack(">H", _recv_exact(sock, 2))[0]
         ack = struct.pack(">H", size) + _recv_exact(sock, size)
         remote_eph_pub, remote_nonce = rlpx.parse_ack(self.secret, ack)
+        # the dial timeout only bounds connect + handshake; an idle
+        # established session must not be killed by a silent 10 seconds
+        sock.settimeout(None)
         secrets = rlpx.derive_secrets(
             True, eph, remote_eph_pub, nonce, remote_nonce, auth, ack)
-        peer = RlpxPeer(sock, secrets, self.node, remote_pub)
+        peer = self._configure_peer(
+            RlpxPeer(sock, secrets, self.node, remote_pub))
         peer.exchange_hello()
         peer.exchange_status()
         self.peers.append(peer)
@@ -758,7 +900,10 @@ class P2PServer:
                 # the head gossip (update.rs)
                 peer.send_block_range_update()
             except (OSError, rlpx.RlpxError):
-                pass
+                # a dead peer must not silently soak up fan-out threads
+                # forever: count it and let scoring evict the peer
+                record_p2p_broadcast_failure()
+                peer.record_failure(reason="broadcast send failed")
 
         for i, p in enumerate(peers):
             threading.Thread(target=send, args=(p, i < full_count),
@@ -770,6 +915,13 @@ class P2PServer:
 
     def stop(self):
         self._stop.set()
+        try:
+            # close() alone does not wake a thread parked in accept():
+            # shutdown the listener first so the accept loop exits now
+            # instead of leaking until the fd number is reused
+            self.listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         self.listener.close()
         for p in list(self.peers):
             p.close()

@@ -33,8 +33,13 @@ import os
 import socket
 import threading
 
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers import (Cipher, algorithms,
+                                                        modes)
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    from cryptography.exceptions import InvalidTag
+except ModuleNotFoundError:   # optional native dep: pure-Python fallback
+    from ..crypto.aes import AESGCM, Cipher, InvalidTag, algorithms, modes
 
 import hashlib
 import hmac as hmac_mod
@@ -224,8 +229,6 @@ def gcm_encrypt(key: bytes, nonce: bytes, plaintext: bytes,
 
 def gcm_decrypt(key: bytes, nonce: bytes, ciphertext: bytes,
                 ad: bytes) -> bytes:
-    from cryptography.exceptions import InvalidTag
-
     try:
         return AESGCM(key).decrypt(nonce, ciphertext, ad)
     except InvalidTag:

@@ -1,0 +1,237 @@
+"""Adaptive failure detection + retry/ban policy for the P2P layer.
+
+Three small, independently-testable pieces (docs/P2P_RESILIENCE.md):
+
+  * `PhiAccrualDetector` — per-peer adaptive request timeouts from a
+    response-time EWMA window (Hayashibara et al., "The φ Accrual
+    Failure Detector", SRDS 2004).  Instead of one hardcoded timeout,
+    the detector keeps an exponentially-weighted mean/variance of the
+    peer's observed RTTs and derives, per request class, the wait after
+    which the suspicion level φ = -log10 P(response still coming)
+    crosses a threshold.  A fast peer is given tight timeouts (stalls
+    detected in tens of milliseconds); a slow-but-alive peer is not
+    falsely evicted.
+
+  * `Backoff` — bounded, jittered exponential backoff for request
+    retries (deterministic under a seeded rng, so chaos drills replay).
+
+  * `BanList` — a persisted (store.meta["p2p_bans"]) ban table with a
+    decaying TTL: a peer evicted at SCORE_DISCONNECT stays banned
+    across restarts, repeat offenders earn exponentially longer bans,
+    and entries expire on their own so a transient misconfiguration is
+    not a life sentence.
+
+Every clock and sleep is injectable so unit tests never sleep for real
+(the fake-clock pattern from tests/test_scheduler_chaos.py).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import random
+import threading
+import time
+
+log = logging.getLogger("ethrex_tpu.p2p")
+
+# Per-request-class timeout floors (seconds): the adaptive timeout never
+# drops below these even for a very fast peer — a trie-node heal batch
+# legitimately takes longer to serve than a header lookup.
+CLASS_FLOORS = {
+    "headers": 0.25,
+    "bodies": 0.5,
+    "receipts": 0.5,
+    "txs": 0.25,
+    "bals": 0.5,
+    "ranges": 0.75,
+    "codes": 0.5,
+    "trie": 0.75,
+    "default": 0.5,
+}
+
+PHI_THRESHOLD = 8.0     # suspicion level at which a request is timed out
+MIN_SAMPLES = 4         # below this, fall back to the ceiling
+MIN_STD = 0.010         # variance floor: a perfectly steady peer still
+                        # gets slack for scheduler jitter
+
+
+class PhiAccrualDetector:
+    """Per-peer φ-accrual suspicion over a response-time EWMA window.
+
+    observe() feeds one RTT sample; timeout_for(klass) answers "how long
+    may a <klass> request stay unanswered before φ >= PHI_THRESHOLD",
+    clamped to [class floor, ceiling].  Cold peers (fewer than
+    MIN_SAMPLES observations) get the ceiling — conservative until the
+    window has data.
+    """
+
+    def __init__(self, ceiling: float = 10.0, alpha: float = 0.2,
+                 phi: float = PHI_THRESHOLD):
+        self.ceiling = float(ceiling)
+        self.alpha = float(alpha)
+        self.phi = float(phi)
+        self.mean = 0.0
+        self.var = 0.0
+        self.samples = 0
+        self.lock = threading.Lock()
+
+    def observe(self, rtt: float) -> None:
+        rtt = max(0.0, float(rtt))
+        with self.lock:
+            if self.samples == 0:
+                self.mean, self.var = rtt, 0.0
+            else:
+                # EWMA mean + EWMA of squared deviation (Riemann-style
+                # running variance; exact enough for a suspicion bound)
+                d = rtt - self.mean
+                self.mean += self.alpha * d
+                self.var = (1 - self.alpha) * (self.var
+                                               + self.alpha * d * d)
+            self.samples += 1
+
+    def std(self) -> float:
+        return max(MIN_STD, math.sqrt(max(0.0, self.var)))
+
+    def phi_at(self, elapsed: float) -> float:
+        """Suspicion level after `elapsed` seconds without a response:
+        -log10 of the normal tail probability P(RTT > elapsed)."""
+        with self.lock:
+            if self.samples < MIN_SAMPLES:
+                return 0.0
+            mean, std = self.mean, self.std()
+        z = (elapsed - mean) / (std * math.sqrt(2.0))
+        tail = 0.5 * math.erfc(z)
+        if tail <= 0.0:
+            return float("inf")
+        return -math.log10(tail)
+
+    def _phi_timeout(self) -> float:
+        """Smallest wait whose suspicion reaches the φ threshold
+        (bisection over the monotone phi_at; a handful of iterations)."""
+        lo, hi = self.mean, self.ceiling
+        if self.phi_at(hi) < self.phi:
+            return self.ceiling
+        for _ in range(32):
+            mid = (lo + hi) / 2.0
+            if self.phi_at(mid) >= self.phi:
+                hi = mid
+            else:
+                lo = mid
+        return hi
+
+    def timeout_for(self, klass: str = "default") -> float:
+        floor = CLASS_FLOORS.get(klass, CLASS_FLOORS["default"])
+        with self.lock:
+            cold = self.samples < MIN_SAMPLES
+        if cold:
+            return self.ceiling
+        return max(floor, min(self.ceiling, self._phi_timeout()))
+
+
+class Backoff:
+    """Jittered exponential retry backoff: delay(i) for attempt i is
+    base * 2^i scaled by a uniform [0.5, 1.0) jitter, capped."""
+
+    def __init__(self, base: float = 0.05, cap: float = 2.0,
+                 rng: random.Random | None = None):
+        self.base = float(base)
+        self.cap = float(cap)
+        self.rng = rng or random.Random()
+
+    def delay(self, attempt: int) -> float:
+        raw = min(self.cap, self.base * (2.0 ** max(0, attempt)))
+        return raw * (0.5 + 0.5 * self.rng.random())
+
+
+BAN_BASE_SECONDS = 15 * 60.0      # first offence: 15 minutes
+BAN_CAP_SECONDS = 24 * 3600.0     # repeat offenders saturate at a day
+BAN_META_KEY = "p2p_bans"
+
+
+class BanList:
+    """Persisted peer bans keyed by node id (store.meta["p2p_bans"]).
+
+    Entries carry an expiry timestamp and an offence count; the ban
+    duration doubles per offence (decaying TTL: once `until` passes the
+    entry is pruned on the next load/check, and the offence count decays
+    with it).  A torn/garbage blob resets to an empty table — bans are a
+    defence, never a reason to refuse to start.
+    """
+
+    def __init__(self, store, base_seconds: float = BAN_BASE_SECONDS,
+                 cap_seconds: float = BAN_CAP_SECONDS, clock=time.time):
+        self.store = store
+        self.base = float(base_seconds)
+        self.cap = float(cap_seconds)
+        self.clock = clock
+        self.lock = threading.Lock()
+
+    # -- persistence -------------------------------------------------------
+    def _load(self) -> dict:
+        raw = self.store.meta.get(BAN_META_KEY)
+        if not raw:
+            return {}
+        try:
+            obj = json.loads(raw if isinstance(raw, str) else raw.decode())
+            if not isinstance(obj, dict):
+                raise ValueError("ban table is not an object")
+            return obj
+        except (ValueError, UnicodeDecodeError) as e:
+            log.warning("discarding corrupt p2p ban table: %s", e)
+            return {}
+
+    def _save(self, table: dict) -> None:
+        group = getattr(self.store, "write_group", None)
+        if group is not None:
+            with group():
+                self.store.meta[BAN_META_KEY] = json.dumps(table)
+        else:
+            self.store.meta[BAN_META_KEY] = json.dumps(table)
+
+    def _pruned(self, table: dict) -> dict:
+        now = self.clock()
+        return {k: v for k, v in table.items()
+                if isinstance(v, dict) and v.get("until", 0) > now}
+
+    # -- API ---------------------------------------------------------------
+    def ban(self, node_id: bytes | str, reason: str = "") -> float:
+        """Ban a peer; returns the ban duration in seconds (doubling per
+        repeat offence, capped)."""
+        key = node_id.hex() if isinstance(node_id, bytes) else str(node_id)
+        with self.lock:
+            table = self._pruned(self._load())
+            prior = table.get(key, {})
+            count = int(prior.get("count", 0)) + 1
+            seconds = min(self.cap, self.base * (2.0 ** (count - 1)))
+            table[key] = {"until": self.clock() + seconds,
+                          "count": count, "reason": reason}
+            self._save(table)
+        log.warning("banned peer %s for %.0fs (offence %d): %s",
+                    key[:16], seconds, count, reason or "score")
+        return seconds
+
+    def is_banned(self, node_id: bytes | str) -> bool:
+        key = node_id.hex() if isinstance(node_id, bytes) else str(node_id)
+        with self.lock:
+            entry = self._load().get(key)
+            return bool(entry and entry.get("until", 0) > self.clock())
+
+    def active(self) -> dict:
+        """Current (unexpired) ban table; also prunes expired entries
+        from the persisted blob as a side effect."""
+        with self.lock:
+            table = self._load()
+            pruned = self._pruned(table)
+            if len(pruned) != len(table):
+                self._save(pruned)
+            return pruned
+
+    def unban(self, node_id: bytes | str) -> None:
+        key = node_id.hex() if isinstance(node_id, bytes) else str(node_id)
+        with self.lock:
+            table = self._load()
+            if key in table:
+                del table[key]
+                self._save(table)

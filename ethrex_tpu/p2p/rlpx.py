@@ -14,7 +14,11 @@ import hmac as hmac_mod
 import os
 import struct
 
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+try:
+    from cryptography.hazmat.primitives.ciphers import (Cipher, algorithms,
+                                                        modes)
+except ModuleNotFoundError:   # optional native dep: pure-Python fallback
+    from ..crypto.aes import Cipher, algorithms, modes
 
 from ..crypto import secp256k1
 from ..crypto.keccak import keccak256

@@ -1,5 +1,5 @@
-"""Snap-sync orchestration: pivot tracking, persisted resume, staleness
-re-pivot, and trie healing.
+"""Snap-sync orchestration: multi-peer scheduling, pivot tracking,
+persisted resume, staleness re-pivot, and trie healing.
 
 Parity target: the reference's snap-sync state machine
 (crates/networking/p2p/sync/snap_sync.rs: pivot + staleness;
@@ -7,9 +7,18 @@ sync/healing/{state,storage}.rs: top-down trie healing), rebuilt on this
 repo's verified range client (p2p/snap.py snap_sync_state did one
 non-resumable pass; this module is the long-running form).
 
-Mechanics:
-  * Progress persists in store.meta["snap_sync"] after every account
-    range / healed batch — a restarted node resumes mid-sync.
+Mechanics (docs/P2P_RESILIENCE.md):
+  * A `PeerPool` partitions the account keyspace into segments and
+    leases them to live snap peers.  A failed or timed-out lease is
+    reassigned to another peer; a bad range proof is a score penalty +
+    re-request elsewhere, never an abort (per-response verification
+    makes peers individually untrusted).  With zero live peers the pool
+    pauses cleanly (partition) and resumes when one returns.
+  * Progress persists atomically (store.write_group) in
+    store.meta["snap_sync"] after every leased unit — crash-only
+    design: a restarted node resumes mid-sync losing at most one range
+    (Candea & Fox, HotOS 2003).  A torn/garbage checkpoint blob falls
+    back to a fresh sync instead of crashing the loader.
   * A stale pivot (the peer answers ranges with empty responses because
     it pruned the root) triggers a re-pivot to the peer's current head;
     already-downloaded ranges are kept.  The resulting state is a mix of
@@ -25,7 +34,10 @@ Mechanics:
 
 from __future__ import annotations
 
+import collections
 import json
+import logging
+import threading
 import time
 
 from ..crypto.keccak import keccak256
@@ -35,46 +47,159 @@ from ..primitives import rlp
 from ..trie.trie import Trie, hp_decode
 from ..trie.trie_sorted import build_from_sorted
 from ..trie.verify_range import RangeProofError, verify_range
+from ..utils.metrics import (record_snap_paused, record_snap_phase,
+                             record_snap_progress_reset, record_snap_range)
 from .snap import MAX_RESPONSE_ITEMS, SnapError
+
+log = logging.getLogger("ethrex_tpu.p2p")
 
 HEAL_BATCH = 64
 PIVOT_DISTANCE = 0  # how far behind the peer head we pivot (0: its head)
+MAX_SEGMENTS = 4    # keyspace partitions leased across the pool
+MAX_FAILOVERS = 8   # distinct lease attempts before giving up a unit
+
+PHASE_IDLE, PHASE_ACCOUNTS, PHASE_HEALING, PHASE_DONE = 0, 1, 2, 3
+_PENALTY_MISBEHAVIOR = 25   # tampered proof / withheld data
+_PENALTY_TRANSIENT = 2      # peer died / timed out mid-lease
+
+
+class _StaleRoot(Exception):
+    """Control flow: a peer stopped serving the pivot root — the driver
+    re-pivots and the account pass restarts from its checkpoints."""
+
+
+class PeerPool:
+    """Live snap-peer set with failover, scoring hooks, and partition
+    pause.  Built from a static peer list or a provider callable (e.g.
+    ``lambda: list(p2p_server.peers)`` so churn is visible live).
+
+    ``failover=False`` (the implicit single-peer mode `SnapSyncer.run`
+    uses for a bare peer) disables lease reassignment: peer exceptions
+    propagate to the caller exactly as the single-peer syncer did.
+    """
+
+    def __init__(self, peers=(), provider=None, failover: bool = True,
+                 partition_timeout: float = 30.0):
+        self._static = list(peers)
+        self._provider = provider
+        self.failover = failover
+        self.partition_timeout = float(partition_timeout)
+        self._paused = False
+        self._lock = threading.Lock()
+        self._clock = time.monotonic   # injectable for fake-clock tests
+        self._sleep = time.sleep
+
+    @classmethod
+    def single(cls, peer) -> "PeerPool":
+        return cls(peers=[peer], failover=False)
+
+    @staticmethod
+    def _alive(peer) -> bool:
+        stop = getattr(peer, "_stop", None)
+        return not (stop is not None and stop.is_set())
+
+    def live(self) -> list:
+        peers = list(self._provider()) if self._provider is not None \
+            else self._static
+        return [p for p in peers if self._alive(p)]
+
+    def width(self) -> int:
+        return len(self.live())
+
+    def penalize(self, peer, misbehavior: bool) -> None:
+        rec = getattr(peer, "record_failure", None)
+        if rec is None:
+            return
+        penalty = _PENALTY_MISBEHAVIOR if misbehavior \
+            else _PENALTY_TRANSIENT
+        rec(penalty, reason="snap misbehavior" if misbehavior
+            else "snap lease failure")
+
+    def acquire(self, exclude=()):
+        """Highest-scored live peer, preferring peers not in `exclude`
+        (identity comparison — wrappers may not define __eq__).  Blocks
+        through a total partition until a peer returns or the partition
+        deadline expires (SnapError)."""
+        excluded = {id(p) for p in exclude}
+        deadline = None
+        while True:
+            live = self.live()
+            if live:
+                if self._paused:
+                    self._paused = False
+                    record_snap_paused(False)
+                    log.info("snap-sync resuming: %d peer(s) live",
+                             len(live))
+                fresh = [p for p in live if id(p) not in excluded]
+                pick = fresh or live   # all excluded: retry the least bad
+                return max(pick, key=lambda p: getattr(p, "score", 0))
+            if not self._paused:
+                self._paused = True
+                record_snap_paused(True)
+                log.warning("snap-sync paused: zero live peers "
+                            "(partition); waiting up to %.0fs",
+                            self.partition_timeout)
+            if deadline is None:
+                deadline = self._clock() + self.partition_timeout
+            if self._clock() >= deadline:
+                raise SnapError("no live snap peers (partition timeout)")
+            self._sleep(0.05)
 
 
 class SnapSyncer:
-    """Drives one node's snap sync against one peer (multi-peer scheduling
-    layers on top; every verification is per-response, so peers are
-    individually untrusted)."""
+    """Drives one node's snap sync against a PeerPool (or a bare peer,
+    which becomes an implicit failover-disabled single-peer pool; every
+    verification is per-response, so peers are individually untrusted)."""
 
     def __init__(self, node):
         self.node = node
         self.store = node.store
         self.progress = self._load()
+        self.pool: PeerPool | None = None
+        self._lock = threading.Lock()
+        self._sleep = time.sleep       # injectable for fake-clock tests
 
     # ---------------- persisted progress ----------------
-    def _load(self) -> dict:
-        raw = self.store.meta.get("snap_sync")
-        if raw:
-            obj = json.loads(raw if isinstance(raw, str)
-                             else raw.decode())
-            return obj
+    def _fresh(self) -> dict:
         return {"phase": "accounts", "pivot_root": None, "pivot_number": 0,
-                "cursor": "00" * 32, "partial_root": EMPTY_TRIE_ROOT.hex(),
+                "segments": None, "partial_root": EMPTY_TRIE_ROOT.hex(),
                 "frontier": None, "healed": 0, "accounts": 0,
                 "repivots": 0, "storage_retry": [], "code_wanted": [],
                 "pivot_fresh": False}
 
+    def _load(self) -> dict:
+        raw = self.store.meta.get("snap_sync")
+        if raw:
+            try:
+                obj = json.loads(raw if isinstance(raw, str)
+                                 else raw.decode())
+                if not isinstance(obj, dict) or "phase" not in obj:
+                    raise ValueError("checkpoint is not a progress object")
+                return obj
+            except (ValueError, UnicodeDecodeError) as e:
+                # crash-only: a torn checkpoint costs a fresh sync, never
+                # a crashed loader
+                log.warning("discarding corrupt snap_sync checkpoint "
+                            "(%s); starting fresh", e)
+                record_snap_progress_reset()
+        return self._fresh()
+
     def _save(self) -> None:
-        self.store.meta["snap_sync"] = json.dumps(self.progress)
+        # write_group => the checkpoint lands atomically in the journal
+        # on persistent backends (no torn blob from a mid-write crash)
+        with self.store.write_group():
+            self.store.meta["snap_sync"] = json.dumps(self.progress)
 
     def _clear(self) -> None:
         if "snap_sync" in self.store.meta:
             del self.store.meta["snap_sync"]
 
     # ---------------- pivot ----------------
-    def _select_pivot(self, peer) -> None:
+    def _select_pivot(self, peer=None) -> None:
         """Pivot on the peer's freshest known head: the last NewBlock
         announcement if any, else its handshake status head."""
+        if peer is None:
+            peer = self.pool.acquire()
         head_hash = getattr(peer, "remote_head_hash", None)
         if head_hash is None:
             status = getattr(peer, "remote_status", None)
@@ -101,46 +226,170 @@ class SnapSyncer:
         return bytes.fromhex(self.progress["pivot_root"])
 
     # ---------------- phase A: account ranges ----------------
-    def _sync_accounts(self, peer) -> None:
+    def _ensure_segments(self) -> None:
+        """Partition the account keyspace into contiguous segments, one
+        lease unit each.  A single-peer pool gets one segment (the exact
+        legacy sweep); wider pools split the keyspace so peers fill
+        disjoint ranges concurrently."""
+        p = self.progress
+        if p.get("segments"):
+            return
+        n = 1
+        if self.pool.failover:
+            n = max(1, min(MAX_SEGMENTS, self.pool.width()))
+        total = 1 << 256
+        step = total // n
+        segments = []
+        for i in range(n):
+            start = i * step
+            end = (total - 1) if i == n - 1 else (i + 1) * step - 1
+            segments.append({"start": "%064x" % start,
+                             "end": "%064x" % end,
+                             "cursor": "%064x" % start,
+                             "done": False})
+        p["segments"] = segments
+        self._save()
+
+    def _sync_accounts(self) -> None:
+        p = self.progress
+        self._ensure_segments()
+        stale_rounds = 0
+        while True:
+            pending = [s for s in p["segments"] if not s["done"]]
+            if not pending:
+                return
+            progressed = self._account_pass(pending)
+            if progressed:
+                stale_rounds = 0
+            if not [s for s in p["segments"] if not s["done"]]:
+                return
+            # only a stale pivot leaves undone segments behind a
+            # completed pass: wait for announcements, then re-pivot
+            stale_rounds += 1
+            if stale_rounds > 5:
+                raise SnapError(
+                    "peer keeps refusing every pivot it announces")
+            self._sleep(0.2 * stale_rounds)
+            self._select_pivot()
+
+    def _account_pass(self, pending) -> bool:
+        """One pass over the unfinished segments: lease each to a pool
+        peer (concurrently when the pool is wide), drain from its
+        checkpointed cursor.  Returns True if any range landed."""
         p = self.progress
         rebuilt = Trie.from_nodes(bytes.fromhex(p["partial_root"]),
                                   self.store.nodes, share=True)
-        top = b"\xff" * 32
-        stale_rounds = 0
-        while True:
-            origin = bytes.fromhex(p["cursor"])
-            accounts, proof = peer.snap_get_account_range(
-                self.pivot_root, origin, top)
-            if not accounts:
-                if self._pivot_is_stale(peer):
-                    stale_rounds += 1
-                    if stale_rounds > 5:
-                        raise SnapError(
-                            "peer keeps refusing every pivot it announces")
-                    time.sleep(0.2 * stale_rounds)  # let announcements land
-                    self._select_pivot(peer)
-                    continue
-                break  # genuinely past the last account
-            stale_rounds = 0
-            keys = [h for h, _ in accounts]
-            values = [body for _, body in accounts]
-            try:
-                if not verify_range(self.pivot_root, keys, values, proof):
-                    raise SnapError("account range root mismatch")
-            except RangeProofError as e:
-                raise SnapError(f"bad account range proof: {e}")
-            for h, body in accounts:
-                self._sync_account_storage(peer, h,
-                                           AccountState.decode(body))
-                rebuilt.insert(h, body)
-                p["accounts"] += 1
-            p["pivot_fresh"] = True  # this pivot answered with real data
-            p["partial_root"] = rebuilt.commit().hex()
-            p["cursor"] = ((int.from_bytes(keys[-1], "big") + 1)
-                           .to_bytes(32, "big").hex())
-            self._save()
-            if len(accounts) < MAX_RESPONSE_ITEMS:
-                break
+        work = collections.deque(pending)
+        state = {"progressed": False, "stale": False, "error": None}
+        retries = {id(s): 0 for s in pending}
+
+        def worker():
+            while True:
+                with self._lock:
+                    if state["stale"] or state["error"] or not work:
+                        return
+                    seg = work.popleft()
+                try:
+                    done = self._drain_segment(seg, rebuilt, state)
+                except _StaleRoot:
+                    with self._lock:
+                        state["stale"] = True
+                    return
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    with self._lock:
+                        state["error"] = e
+                    return
+                if not done:
+                    with self._lock:
+                        retries[id(seg)] += 1
+                        if retries[id(seg)] > MAX_FAILOVERS:
+                            state["error"] = SnapError(
+                                "segment lease failed on every peer")
+                        else:
+                            work.append(seg)
+
+        workers = 1
+        if self.pool.failover:
+            workers = max(1, min(self.pool.width(), len(pending)))
+        if workers <= 1:
+            worker()
+        else:
+            threads = [threading.Thread(target=worker, daemon=True)
+                       for _ in range(workers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        if state["error"] is not None:
+            raise state["error"]
+        return state["progressed"]
+
+    def _drain_segment(self, seg, rebuilt, state) -> bool:
+        """Drain one keyspace segment through one leased peer.  Returns
+        True when the segment completed, False when the lease failed and
+        the segment should be re-leased elsewhere (failover pools only —
+        a single-peer pool propagates the original exception)."""
+        pool = self.pool
+        peer = pool.acquire()
+        try:
+            while True:
+                origin = bytes.fromhex(seg["cursor"])
+                end = bytes.fromhex(seg["end"])
+                if origin > end:
+                    break
+                accounts, proof = peer.snap_get_account_range(
+                    self.pivot_root, origin, end)
+                if not accounts:
+                    if self._pivot_is_stale(peer):
+                        raise _StaleRoot()
+                    break  # genuinely past the segment's last account
+                keys = [h for h, _ in accounts]
+                values = [body for _, body in accounts]
+                try:
+                    if not verify_range(self.pivot_root, keys, values,
+                                        proof):
+                        raise SnapError("account range root mismatch")
+                except RangeProofError as e:
+                    raise SnapError(f"bad account range proof: {e}")
+                # storage + code land before the checkpoint advances: a
+                # kill between here and _save re-fetches this one range
+                for h, body in accounts:
+                    self._sync_account_storage(peer, h,
+                                               AccountState.decode(body))
+                with self._lock:
+                    for h, body in accounts:
+                        rebuilt.insert(h, body)
+                    self.progress["accounts"] += len(accounts)
+                    self.progress["pivot_fresh"] = True
+                    self.progress["partial_root"] = rebuilt.commit().hex()
+                    seg["cursor"] = (
+                        (int.from_bytes(keys[-1], "big") + 1)
+                        .to_bytes(32, "big").hex())
+                    if len(accounts) < MAX_RESPONSE_ITEMS:
+                        seg["done"] = True
+                    state["progressed"] = True
+                    self._save()
+                record_snap_range()
+                if seg["done"]:
+                    return True
+            with self._lock:
+                seg["done"] = True
+                self._save()
+            return True
+        except _StaleRoot:
+            raise
+        except Exception as e:  # noqa: BLE001 — lease failure classified
+            if not pool.failover:
+                raise
+            # bad proof / withheld data = misbehavior (hard penalty);
+            # anything else = a transient peer failure.  Either way the
+            # segment is re-leased from its checkpoint — never an abort.
+            misbehavior = isinstance(e, (SnapError, RangeProofError))
+            pool.penalize(peer, misbehavior)
+            log.warning("snap lease failed on peer %s (%s): %s",
+                        getattr(peer, "label", lambda: "?")(),
+                        "misbehavior" if misbehavior else "transient", e)
+            return False
 
     def _pivot_is_stale(self, peer) -> bool:
         """An empty range answer for origin 0 on a nonempty chain means
@@ -184,8 +433,9 @@ class SnapSyncer:
             # phase re-fetches this account's storage from its root (the
             # account leaf itself is range-proven, so the state-trie walk
             # alone would never revisit it)
-            self.progress["storage_retry"].append(
-                [account_hash.hex(), acct.storage_root.hex()])
+            with self._lock:
+                self.progress["storage_retry"].append(
+                    [account_hash.hex(), acct.storage_root.hex()])
 
     def _fetch_codes(self, peer, hashes) -> None:
         for i in range(0, len(hashes), MAX_RESPONSE_ITEMS):
@@ -201,8 +451,32 @@ class SnapSyncer:
                         f"peer did not return code {h.hex()[:12]}")
                 self.store.code[h] = got[h]
 
+    # ---------------- failover wrapper ----------------
+    def _with_peer(self, fn):
+        """Run fn(peer) against the pool with lease failover: a bad
+        response is a penalty + retry on another peer; a dead peer is a
+        rotation.  Single-peer pools call through directly (original
+        exceptions propagate)."""
+        pool = self.pool
+        if not pool.failover:
+            return fn(pool.acquire())
+        excluded: list = []
+        last = None
+        for _ in range(MAX_FAILOVERS):
+            peer = pool.acquire(exclude=excluded)
+            try:
+                return fn(peer)
+            except _StaleRoot:
+                raise
+            except Exception as e:  # noqa: BLE001 — classified below
+                misbehavior = isinstance(e, (SnapError, RangeProofError))
+                pool.penalize(peer, misbehavior)
+                excluded.append(peer)
+                last = e
+        raise SnapError(f"no peer could serve the request: {last}")
+
     # ---------------- phase B: healing ----------------
-    def _heal(self, peer) -> None:
+    def _heal(self) -> None:
         """Top-down walk of the final pivot trie fetching missing
         subtrees; the frontier persists so healing resumes exactly."""
         p = self.progress
@@ -229,7 +503,9 @@ class SnapSyncer:
                     paths.append([bytes.fromhex(acct_hash),
                                   self._nib(path)])
                 expected.append(bytes.fromhex(path_hex_hash))
-            nodes = peer.snap_get_trie_nodes(self.pivot_root, paths)
+            nodes = self._with_peer(
+                lambda peer: peer.snap_get_trie_nodes(self.pivot_root,
+                                                      paths))
             got = {keccak256(n): n for n in nodes}
             progressed = False
             new_frontier = []
@@ -260,8 +536,9 @@ class SnapSyncer:
             p["frontier"] = new_frontier + p["frontier"][len(batch):]
             self._save()
             if p["code_wanted"]:
-                self._fetch_codes(
-                    peer, [bytes.fromhex(h) for h in p["code_wanted"]])
+                wanted = [bytes.fromhex(h) for h in p["code_wanted"]]
+                self._with_peer(
+                    lambda peer: self._fetch_codes(peer, wanted))
                 p["code_wanted"] = []
                 self._save()
             if progressed:
@@ -273,8 +550,8 @@ class SnapSyncer:
         if p["code_wanted"]:
             # a resumed run can start with a drained frontier but pending
             # bytecode fetches from the interrupted one
-            self._fetch_codes(peer,
-                              [bytes.fromhex(h) for h in p["code_wanted"]])
+            wanted = [bytes.fromhex(h) for h in p["code_wanted"]]
+            self._with_peer(lambda peer: self._fetch_codes(peer, wanted))
             p["code_wanted"] = []
             self._save()
 
@@ -342,27 +619,40 @@ class SnapSyncer:
 
     # ---------------- driver ----------------
     def run(self, peer) -> dict:
-        """Run/resume the state machine to completion against `peer`;
-        returns the progress summary.  After success the pivot block's
-        full state is locally present and verified."""
+        """Run/resume the state machine to completion against `peer` —
+        a PeerPool, or a bare RlpxPeer (implicit single-peer pool with
+        failover disabled: its exceptions propagate unchanged).  Returns
+        the progress summary; after success the pivot block's full state
+        is locally present and verified."""
+        self.pool = peer if isinstance(peer, PeerPool) \
+            else PeerPool.single(peer)
         p = self.progress
-        if p["pivot_root"] is None:
-            self._select_pivot(peer)
-        if p["phase"] == "accounts":
-            self._sync_accounts(peer)
-            # healing always runs: it no-ops instantly when the pivot was
-            # stable (root already present) and no storage retries exist.
-            # Only probe for staleness when this pivot never answered a
-            # range itself (the probe costs a throwaway window).
-            if bytes.fromhex(p["partial_root"]) != self.pivot_root and \
-                    not p.get("pivot_fresh") and self._pivot_is_stale(peer):
-                self._select_pivot(peer)
-            p["phase"] = "healing"
-            self._save()
-        if p["phase"] == "healing":
-            self._heal(peer)
-            p["phase"] = "done"
-            self._save()
-        summary = dict(p)
-        self._clear()
-        return summary
+        try:
+            if p["pivot_root"] is None:
+                self._select_pivot()
+            if p["phase"] == "accounts":
+                record_snap_phase(PHASE_ACCOUNTS)
+                self._sync_accounts()
+                # healing always runs: it no-ops instantly when the pivot
+                # was stable (root already present) and no storage retries
+                # exist.  Only probe for staleness when this pivot never
+                # answered a range itself (the probe costs a throwaway
+                # window).
+                if bytes.fromhex(p["partial_root"]) != self.pivot_root \
+                        and not p.get("pivot_fresh") \
+                        and self._with_peer(self._pivot_is_stale):
+                    self._select_pivot()
+                p["phase"] = "healing"
+                self._save()
+            if p["phase"] == "healing":
+                record_snap_phase(PHASE_HEALING)
+                self._heal()
+                p["phase"] = "done"
+                self._save()
+            record_snap_phase(PHASE_DONE)
+            summary = dict(p)
+            self._clear()
+            return summary
+        except BaseException:
+            record_snap_phase(PHASE_IDLE)
+            raise

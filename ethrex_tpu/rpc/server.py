@@ -1008,6 +1008,37 @@ def _rpc_traffic_json() -> dict:
     }
 
 
+def _p2p_json(node) -> dict:
+    """P2P request-resilience and snap-sync counters for ethrex_health:
+    timeout/retry/ban totals plus the snap phase machine — read straight
+    from the global registry (docs/P2P_RESILIENCE.md)."""
+    with METRICS.lock:
+        c = dict(METRICS.counters)
+        g = dict(METRICS.gauges)
+    out = {
+        "peers": _peer_count(node),
+        "requestTimeouts": int(c.get("p2p_request_timeouts_total", 0)),
+        "requestRetries": int(c.get("p2p_request_retries_total", 0)),
+        "peerBans": int(c.get("p2p_peer_bans_total", 0)),
+        "broadcastFailures":
+            int(c.get("p2p_broadcast_failures_total", 0)),
+        "snap": {
+            "phase": int(g.get("snap_sync_phase", 0)),
+            "rangesSynced": int(c.get("snap_ranges_synced_total", 0)),
+            "paused": bool(g.get("snap_sync_paused", 0)),
+            "partitionPauses":
+                int(c.get("snap_partition_pauses_total", 0)),
+            "progressResets":
+                int(c.get("snap_progress_resets_total", 0)),
+        },
+    }
+    p2p = getattr(node, "p2p_server", None)
+    bans = getattr(p2p, "bans", None)
+    if bans is not None:
+        out["activeBans"] = len(bans.active())
+    return out
+
+
 def _mempool_util(node) -> float | None:
     """Mempool fill fraction for the overload controller's shed-level
     feedback; None (never sheds) when the node has no mempool."""
@@ -1022,6 +1053,7 @@ def _health(node):
         "mempoolFlow": node.mempool.stats_json(),
         "rpc": _rpc_traffic_json(),
         "peers": _peer_count(node),
+        "p2p": _p2p_json(node),
         "tracing": {"bufferedTraces": len(TRACER),
                     "droppedTraces": TRACER.dropped},
     }

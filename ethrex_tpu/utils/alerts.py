@@ -213,6 +213,20 @@ def aggregation_lag_signal(eng, node):
     return latest - aggregated
 
 
+def snap_stall_signal(window: float = 60.0):
+    """Snap-sync progress rate, armed only while a sync is actually
+    running (`snap_sync_phase` gauge is 1=accounts or 2=healing).  Idle
+    nodes and completed syncs return None so they never alert; a running
+    sync whose range throughput collapses to ~0 is stalled — usually a
+    partition (see snap_sync_paused) or every peer refusing the pivot."""
+    def sig(eng, node):
+        phase = eng.gauge("snap_sync_phase")
+        if phase is None or phase not in (1.0, 2.0):
+            return None
+        return eng.rate("snap_ranges_synced_total", window=window)
+    return sig
+
+
 def actor_stall_signal(eng, node):
     """Seconds since the least-recently-successful sequencer actor made
     progress (no-progress watchdog; every healthy actor iteration —
@@ -397,6 +411,23 @@ def default_rules(node=None) -> list:
                    "rpc_queue_wait_seconds against ETHREX_SHED_QUEUE_HIGH "
                    "and check mempool utilization (level>=2 couples "
                    "to it — docs/OVERLOAD.md)."),
+        # snap-sync stall — armed only while a sync runs (phase gauge);
+        # below=True: zero range throughput during an active sync is the
+        # breach (docs/P2P_RESILIENCE.md)
+        mk("snap_sync_stall:page", "page",
+           snap_stall_signal(window=120.0), 0.01,
+           window=120.0, for_count=3, resolve_count=3, below=True,
+           description="Snap sync made no range progress for 3 evals",
+           runbook="Check snap_sync_paused (partition: zero live peers) "
+                   "and p2p_request_timeouts_total in ethrex_health p2p; "
+                   "see docs/P2P_RESILIENCE.md."),
+        mk("snap_sync_stall:warn", "warn",
+           snap_stall_signal(window=300.0), 0.05,
+           window=300.0, for_count=3, resolve_count=3, below=True,
+           description="Snap sync range throughput below 0.05/s over 5m",
+           runbook="Peers are slow or flapping; compare "
+                   "p2p_peer_rtt_seconds per peer and "
+                   "p2p_request_retries_total (docs/P2P_RESILIENCE.md)."),
         # mempool replacement churn — high replacement-by-fee rates are
         # a fee-bidding war or a deliberate repricing spam pattern
         mk("mempool_replacement_churn:page", "page",

@@ -50,6 +50,24 @@ and docs/L1_SETTLEMENT_RESILIENCE.md):
                             losing leg of a hedged assignment): delay = a
                             slow duplicate ack, error/drop = crash while
                             no-op-acking the loser
+    net.send                RlpxPeer.send_msg before framing: drop = the
+                            frame never leaves, corrupt = wire bytes
+                            mangled (the far side fails MAC/decode and
+                            the request times out), delay = a congested
+                            uplink (docs/P2P_RESILIENCE.md)
+    net.recv                RlpxPeer.recv_msg after decode on the reader
+                            thread: drop kills the session exactly like
+                            a peer disconnect mid-read; corrupt hands
+                            the handler a mangled message
+    peer.request            RlpxPeer.request at entry (drop/delay/error
+                            legs): a request that dies before any bytes
+                            move — exercises the retry/backoff path
+                            without touching the shared session
+    snap.serve              the snap/1 serving legs (account-range /
+                            storage-range / byte-codes / trie-nodes
+                            responses) before send: corrupt = a
+                            byzantine snap server (tampered proofs),
+                            drop = the response is lost
 
 Fault kinds:
 
@@ -83,6 +101,10 @@ SITES = frozenset({
     "coordinator.schedule",
     "aggregate.prove",
     "submit.duplicate",
+    "net.send",
+    "net.recv",
+    "peer.request",
+    "snap.serve",
 })
 
 KINDS = frozenset({"drop", "delay", "corrupt", "torn", "error"})

@@ -749,6 +749,69 @@ def record_proof_wall(seconds: float):
                     "end-to-end backend prove wall-clock")
 
 
+# -- p2p request resilience + snap-sync (docs/P2P_RESILIENCE.md) -----------
+
+def record_p2p_timeout(klass: str):
+    METRICS.inc("p2p_request_timeouts_total", 1,
+                "P2P requests that outlived their adaptive (phi-accrual) "
+                "timeout, across all request classes")
+    METRICS.inc_labeled("p2p_request_class_timeouts", {"class": klass}, 1,
+                        help_text="P2P request timeouts by request class "
+                                  "(headers/ranges/trie/...)")
+
+
+def record_p2p_retry(klass: str):
+    METRICS.inc("p2p_request_retries_total", 1,
+                "P2P request retry attempts (fresh request id, jittered "
+                "exponential backoff) after a timeout or dropped frame")
+
+
+def record_p2p_ban():
+    METRICS.inc("p2p_peer_bans_total", 1,
+                "Peers banned after dropping to SCORE_DISCONNECT; bans "
+                "persist in store.meta['p2p_bans'] across restarts")
+
+
+def record_p2p_broadcast_failure():
+    METRICS.inc("p2p_broadcast_failures_total", 1,
+                "Block/hash broadcast sends that failed (dead or stalled "
+                "peer); each also costs the peer a score penalty")
+
+
+def record_p2p_peer_rtt(peer: str, seconds: float):
+    METRICS.set_labeled("p2p_peer_rtt_seconds", {"peer": peer}, seconds,
+                        help_text="EWMA request round-trip time per peer "
+                                  "(the phi-accrual estimator mean)")
+
+
+def record_snap_phase(phase: int):
+    METRICS.set("snap_sync_phase", phase,
+                "Snap-sync phase: 0 idle, 1 accounts, 2 healing, 3 done")
+
+
+def record_snap_range():
+    METRICS.inc("snap_ranges_synced_total", 1,
+                "Account-range windows fetched, proof-verified and "
+                "checkpointed by snap-sync (each is one leased unit; "
+                "kill-restart re-fetches at most one)")
+
+
+def record_snap_paused(paused: bool):
+    METRICS.set("snap_sync_paused", 1 if paused else 0,
+                "1 while snap-sync is paused with zero live peers "
+                "(network partition), 0 otherwise")
+    if paused:
+        METRICS.inc("snap_partition_pauses_total", 1,
+                    "Times snap-sync paused on a total peer partition "
+                    "and waited for a peer to return")
+
+
+def record_snap_progress_reset():
+    METRICS.inc("snap_progress_resets_total", 1,
+                "Torn/garbage snap_sync checkpoint blobs discarded at "
+                "load (sync restarted from scratch instead of crashing)")
+
+
 class MetricsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 9090):
         self.host = host

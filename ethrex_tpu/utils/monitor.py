@@ -251,6 +251,40 @@ def _aggregation_lines(snap: dict, width: int) -> list[str]:
     return lines
 
 
+_SNAP_PHASES = {0: "idle", 1: "accounts", 2: "healing", 3: "done"}
+
+
+def _p2p_lines(snap: dict, width: int) -> list[str]:
+    """P2P resilience panel: request timeout/retry/ban counters and the
+    snap-sync phase machine (ethrex_health `p2p` section).  Defensive
+    like the other panels — an older node without the section simply
+    gets no panel."""
+    health = snap.get("health")
+    p2p = health.get("p2p") if isinstance(health, dict) else None
+    if not isinstance(p2p, dict):
+        return []
+    lines = [
+        "─" * width,
+        " p2p resilience",
+        f"   peers {p2p.get('peers', '?'):<5}"
+        f" timeouts {p2p.get('requestTimeouts', '?'):<6}"
+        f" retries {p2p.get('requestRetries', '?'):<6}"
+        f" bans {p2p.get('peerBans', '?'):<4}"
+        f" (active {p2p.get('activeBans', '—')})"
+        f" bcast fails {p2p.get('broadcastFailures', '?')}",
+    ]
+    sync = p2p.get("snap")
+    if isinstance(sync, dict):
+        phase = _SNAP_PHASES.get(sync.get("phase"), sync.get("phase"))
+        lines.append(
+            f"   snap {phase:<9}"
+            f" ranges {sync.get('rangesSynced', '?'):<7}"
+            f" {'PAUSED (partition)' if sync.get('paused') else 'live':<19}"
+            f" pauses {sync.get('partitionPauses', '?'):<4}"
+            f" ckpt resets {sync.get('progressResets', '?')}")
+    return lines
+
+
 def _alerts_lines(snap: dict, width: int) -> list[str]:
     """Alerts panel: firing SLO rules + most recent transitions.
     Defensive — an L1-only node answers enabled=False (no panel) and an
@@ -391,10 +425,11 @@ def render_lines(snap: dict, width: int = 100) -> list[str]:
         items = hl.items() if isinstance(hl, dict) else enumerate(hl)
         for k, v in items:
             # traffic sections render in their own panel below
-            if k in ("rpc", "mempoolFlow"):
+            if k in ("rpc", "mempoolFlow", "p2p"):
                 continue
             lines.append(f"   {k}: {v}")
     lines.extend(_traffic_lines(snap, width))
+    lines.extend(_p2p_lines(snap, width))
     lines.extend(_aggregation_lines(snap, width))
     lines.extend(_alerts_lines(snap, width))
     lines.extend(_perf_lines(snap, width))

@@ -5,8 +5,14 @@ crates/networking/p2p/rlpx/connection/codec.rs)."""
 import os
 
 import pytest
-from cryptography.hazmat.primitives.ciphers import (Cipher, algorithms,
-                                                    modes)
+
+try:
+    from cryptography.hazmat.primitives.ciphers import (Cipher, algorithms,
+                                                        modes)
+except ModuleNotFoundError:
+    # same fallback the production stack uses; the conformance vectors
+    # below still hold (crypto/aes.py is NIST-vector checked)
+    from ethrex_tpu.crypto.aes import Cipher, algorithms, modes
 
 from ethrex_tpu.crypto.keccak import IncrementalKeccak256
 from ethrex_tpu.p2p import rlpx

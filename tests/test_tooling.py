@@ -164,6 +164,25 @@ def test_scheduler_fault_sites_covered_by_scheduler_battery():
         f"scheduler sites without scheduler-battery coverage: {missing}"
 
 
+def test_p2p_fault_sites_covered_by_p2p_battery():
+    """The p2p-path sites (net.*, peer.*, snap.*) are the p2p battery's
+    contract: each must be exercised in tests/test_p2p_chaos.py
+    specifically, so a new wire fault site cannot land without a drill."""
+    import os
+
+    from ethrex_tpu.utils import faults
+
+    here = os.path.dirname(__file__)
+    with open(os.path.join(here, "test_p2p_chaos.py")) as f:
+        corpus = f.read()
+    p2p_sites = [s for s in sorted(faults.SITES)
+                 if s.startswith(("net.", "peer.", "snap."))]
+    assert p2p_sites, "p2p fault sites missing from faults.SITES"
+    missing = [s for s in p2p_sites if f'"{s}"' not in corpus]
+    assert not missing, \
+        f"p2p sites without p2p-battery coverage: {missing}"
+
+
 def test_no_bare_print_in_library_modules():
     """Library diagnostics go through the structured logger
     (utils/tracing.py setup_logging), never bare print().  Terminal
