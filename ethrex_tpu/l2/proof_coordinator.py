@@ -177,6 +177,28 @@ class ProofCoordinator:
         # quarantine/proof) for the flight recorder: the raw counters say
         # HOW MANY leases churned, this says WHICH and WHEN
         self.events: collections.deque = collections.deque(maxlen=64)
+        # batch -> critical-path summary of its settled lifecycle trace,
+        # written by the sequencer after verify/settle and surfaced in
+        # ethrex_health (`l2.lifecycle`) and the monitor timeline
+        self.batch_lifecycles: "collections.OrderedDict[int, dict]" = \
+            collections.OrderedDict()
+
+    def note_lifecycle(self, batch: int, summary: dict) -> None:
+        """Record one settled batch's critical-path summary (bounded;
+        telemetry, so it never raises into settlement)."""
+        try:
+            with self.lock:
+                self.batch_lifecycles[batch] = summary
+                self.batch_lifecycles.move_to_end(batch)
+                while len(self.batch_lifecycles) > 16:
+                    self.batch_lifecycles.popitem(last=False)
+        except Exception:
+            pass
+
+    def lifecycles_json(self) -> list:
+        """Recent settled batches' lifecycle timeline, oldest first."""
+        with self.lock:
+            return [dict(v) for v in self.batch_lifecycles.values()]
 
     def _note_event(self, event: str, batch: int, prover_type: str,
                     detail: str | None = None):
@@ -522,6 +544,12 @@ class ProofCoordinator:
     def _handle_heartbeat(self, msg: dict) -> dict:
         from ..utils.metrics import record_heartbeat
 
+        # merge any piggybacked span subtree BEFORE lease logic: even a
+        # beat whose lease already lapsed leaves its partial spans, so a
+        # prover that later dies mid-prove still renders in the batch's
+        # merged trace (never raises, deduped, capped per source)
+        tracing.TRACER.ingest(msg.get("spans"),
+                              source=msg.get("prover_id"))
         batch = msg.get("batch_id")
         prover_type = msg.get("prover_type")
         token = msg.get("lease_token")
@@ -563,6 +591,12 @@ class ProofCoordinator:
         return {"type": protocol.HEARTBEAT_ACK, "batch_id": batch, "ok": ok}
 
     def _handle_submit(self, msg: dict) -> dict:
+        # merge the shipped span subtree FIRST: a duplicate submit is the
+        # losing leg of a hedged race, and its subtree still belongs in
+        # the batch's merged trace (two prover subtrees under one trace);
+        # ingestion never raises and is deduped + capped per source
+        tracing.TRACER.ingest(msg.get("spans"),
+                              source=msg.get("prover_id"))
         batch = msg.get("batch_id")
         prover_type = msg.get("prover_type")
         proof = msg.get("proof")
@@ -687,7 +721,10 @@ class ProofCoordinator:
             from ..utils.metrics import record_batch
 
             duration = self._now() - started
-            record_batch(batch, duration)
+            # the exemplar ties this observation's bucket to the batch's
+            # merged trace in the OpenMetrics exposition
+            record_batch(batch, duration,
+                         trace_id=self.batch_traces.get(batch))
             prover_id = msg.get("prover_id")
             with self.lock:
                 # feed the fleet scheduler: the p99 hedging deadline and

@@ -765,7 +765,39 @@ class Sequencer:
         from ..utils.metrics import record_verified_batch
 
         record_verified_batch(last)
+        self._record_lifecycles(first, last)
         return (first, last)
+
+    def _record_lifecycles(self, first: int, last: int) -> None:
+        """Post-settlement critical-path attribution: walk each settled
+        batch's merged lifecycle trace, feed the
+        batch_critical_path_seconds{component} histogram (exemplared
+        with the trace ID) and the coordinator's lifecycle timeline.
+        Telemetry — never raises into settlement."""
+        from ..utils.metrics import observe_critical_path
+
+        try:
+            for n in range(first, last + 1):
+                tid = self.coordinator.batch_traces.get(n)
+                if tid is None:
+                    continue
+                cp = tracing.critical_path(tracing.TRACER.get_trace(tid))
+                if not cp.get("spanCount"):
+                    continue
+                for component, secs in cp.get("components", {}).items():
+                    observe_critical_path(component, secs, trace_id=tid)
+                self.coordinator.note_lifecycle(n, {
+                    "batch": n,
+                    "traceId": tid,
+                    "wallSeconds": round(cp.get("wallSeconds") or 0.0, 6),
+                    "spanCount": cp.get("spanCount"),
+                    "partial": cp.get("partial"),
+                    "sources": cp.get("sources"),
+                    "components": {k: round(v, 6) for k, v in
+                                   cp.get("components", {}).items()},
+                })
+        except Exception:  # noqa: BLE001 — settlement already succeeded
+            log.exception("critical-path attribution failed")
 
     # ------------------------------------------------------------------
     # ProofAggregator actor (docs/AGGREGATION.md)
@@ -776,7 +808,12 @@ class Sequencer:
         aggregation_min_batches (send_proofs remains the fallback)."""
         if not self.cfg.aggregation_enabled:
             return None
-        return self.aggregator.step()
+        settled = self.aggregator.step()
+        if settled is not None:
+            # aggregated runs get the same per-batch lifecycle
+            # attribution as the per-batch settlement path
+            self._record_lifecycles(*settled)
+        return settled
 
     # ------------------------------------------------------------------
     # StateUpdater (reference: state_updater.rs)

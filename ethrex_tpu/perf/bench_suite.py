@@ -246,9 +246,17 @@ def measure() -> None:
 
     # per-stage breakdown from the profiling spans of the timed prove
     stages = {}
+    critical = {}
     if bench_span is not None:
         stages = {k: round(v, 4) for k, v in sorted(
             tracing.TRACER.stage_breakdown(bench_span.trace_id).items())}
+        # critical-path attribution of the same trace: unlike "stages"
+        # (which sums possibly-overlapping stage spans), these components
+        # partition the wall, so they answer WHICH leg dominated
+        cp = tracing.critical_path(
+            tracing.TRACER.get_trace(bench_span.trace_id))
+        critical = {k: round(v, 4) for k, v in sorted(
+            cp.get("components", {}).items())}
 
     cache_stats = exec_cache.runtime_stats()
     gas_per_sec = gas / wall
@@ -265,6 +273,7 @@ def measure() -> None:
         "executable_cache": {k: cache_stats.get(k) for k in
                              ("hits", "misses", "errors", "stores")},
         "stages": stages,
+        "critical_path": critical,
         "config": "BASELINE-1 (10-transfer block, vm mode, 3 STARKs)",
     }))
 

@@ -58,7 +58,13 @@ class ExecBackend(ProverBackend):
     prover_type = protocol.PROVER_EXEC
 
     def prove(self, program_input: ProgramInput, proof_format: str) -> dict:
-        output = self.execute(program_input)
+        from ..utils import tracing
+
+        # a stage span even on the exec path: an exec-backed fleet's
+        # shipped span subtree still carries per-stage attribution for
+        # the merged batch trace (docs/OBSERVABILITY.md)
+        with tracing.span("prover.execute", stage="execute"):
+            output = self.execute(program_input)
         return {
             "backend": self.prover_type,
             "format": proof_format,

@@ -56,27 +56,42 @@ class _HeartbeatThread(threading.Thread):
 
     def __init__(self, host: str, port: int, batch_id: int,
                  prover_type: str, interval: float,
-                 lease_token: str | None = None):
+                 lease_token: str | None = None,
+                 trace_id: str | None = None,
+                 prover_id: str | None = None):
         super().__init__(daemon=True)
         self.host, self.port = host, port
         self.batch_id = batch_id
         self.prover_type = prover_type
         self.interval = interval
         self.lease_token = lease_token
+        self.prover_id = prover_id
+        # when set, each beat piggybacks the spans completed so far for
+        # this trace (stage spans finish while the proof runs), so a
+        # prover that crashes mid-prove still leaves its partial subtree
+        # at the coordinator; the payload is cumulative and the
+        # coordinator deduplicates by span ID
+        self.trace_id = trace_id
         self.acked = 0
         self._stop = threading.Event()
 
     def run(self):
         while not self._stop.wait(self.interval):
             try:
+                msg = {
+                    "type": protocol.HEARTBEAT,
+                    "batch_id": self.batch_id,
+                    "prover_type": self.prover_type,
+                    "lease_token": self.lease_token,
+                    "prover_id": self.prover_id,
+                }
+                if self.trace_id:
+                    spans = tracing.export_wire(self.trace_id)
+                    if spans is not None:
+                        msg["spans"] = spans
                 with socket.create_connection(
                         (self.host, self.port), timeout=5) as sock:
-                    protocol.send_msg(sock, {
-                        "type": protocol.HEARTBEAT,
-                        "batch_id": self.batch_id,
-                        "prover_type": self.prover_type,
-                        "lease_token": self.lease_token,
-                    })
+                    protocol.send_msg(sock, msg)
                     ack = protocol.recv_msg(sock)
                 if ack.get("type") == protocol.HEARTBEAT_ACK \
                         and ack.get("ok"):
@@ -257,9 +272,11 @@ class ProverClient:
             hb = _HeartbeatThread(host, port, batch_id,
                                   self.backend.prover_type,
                                   self.heartbeat_interval,
-                                  lease_token=lease_token)
+                                  lease_token=lease_token,
+                                  trace_id=trace_id,
+                                  prover_id=self.prover_id)
             hb.start()
-        with tracing.trace_context(trace_id, parent_span):
+        with tracing.trace_context(trace_id, parent_span) as tid:
             try:
                 with tracing.span("prover.prove", batch=batch_id,
                                   backend=self.backend.prover_type):
@@ -274,6 +291,9 @@ class ProverClient:
             # connection 2: submit over a fresh socket — the input-request
             # connection may long since have died under the proof
             with tracing.span("prover.submit", batch=batch_id) as sub:
+                # ship the completed span subtree (prove + stage spans)
+                # with the proof; the coordinator merges it into its
+                # ring so the batch renders as one cross-process trace
                 with socket.create_connection((host, port),
                                               timeout=30) as sock:
                     protocol.send_msg(sock, {
@@ -285,6 +305,7 @@ class ProverClient:
                         "prover_id": self.prover_id,
                         "trace_id": trace_id,
                         "span_id": sub.span_id if sub else None,
+                        "spans": tracing.export_wire(tid),
                     })
                     ack = protocol.recv_msg(sock)
         if ack.get("type") == protocol.SUBMIT_ACK:

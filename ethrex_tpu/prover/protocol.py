@@ -26,7 +26,18 @@ from ..utils import faults
 # compile.  Like prover_id it is advisory — the scheduler uses it only
 # to prefer warm provers for the first batches after a restart and to
 # keep a cold prover's compile-inclusive first wall out of its EWMA; a
-# lying prover gains nothing but a worse placement.
+# lying prover gains nothing but a worse placement.  ProofSubmit and
+# Heartbeat MAY additionally carry a `spans` object — the prover's
+# completed span subtree for the batch's trace, produced by
+# tracing.export_wire (bounded + size-capped + version-tagged) and
+# merged by the coordinator with tracing.TRACER.ingest so one batch
+# renders as one cross-process trace.  Also advisory and
+# version-tolerant in both directions: old coordinators ignore the
+# field, new coordinators ignore unknown payload versions, and
+# ingestion never raises into lease handling
+# (docs/OBSERVABILITY.md "Distributed tracing").  The heartbeat copy is
+# cumulative — a prover that dies mid-prove still leaves its partial
+# subtree from the last beat; the coordinator deduplicates by span ID.
 INPUT_REQUEST = "InputRequest"          # {commit_hash, prover_type
 #                                          [, prover_id] [, warm]}
 INPUT_RESPONSE = "InputResponse"        # {batch_id, input, format,
@@ -34,13 +45,15 @@ INPUT_RESPONSE = "InputResponse"        # {batch_id, input, format,
 VERSION_MISMATCH = "VersionMismatch"    # {expected}
 TYPE_NOT_NEEDED = "ProverTypeNotNeeded"
 PROOF_SUBMIT = "ProofSubmit"            # {batch_id, prover_type, proof,
-#                                          lease_token [, prover_id]}
+#                                          lease_token [, prover_id]
+#                                          [, spans]}
 SUBMIT_ACK = "ProofSubmitACK"           # {batch_id}
 ERROR = "Error"                         # {message}
 # lease keep-alive: a prover mid-way through a long TPU proof extends its
 # assignment instead of relying on one fixed coordinator-side timeout
 HEARTBEAT = "Heartbeat"                 # {batch_id, prover_type,
-#                                          lease_token}
+#                                          lease_token [, prover_id]
+#                                          [, spans]}
 HEARTBEAT_ACK = "HeartbeatAck"          # {batch_id, ok}
 
 # proof formats (reference: ProofFormat — Compressed STARK vs Groth16 wrap)

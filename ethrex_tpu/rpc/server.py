@@ -426,6 +426,13 @@ class RpcServer:
                 lambda limit=None: TRACER.recent(_trace_limit(limit)),
             "ethrex_trace_slowest":
                 lambda limit=None: TRACER.slowest(_trace_limit(limit)),
+            # merged-trace analysis (docs/OBSERVABILITY.md "Distributed
+            # tracing"): blocking-chain attribution and Perfetto export;
+            # both degrade to found=False stubs on nodes with nothing in
+            # the ring (L1-only / pre-tracing peers)
+            "ethrex_trace_criticalPath":
+                lambda tid=None: _trace_critical_path(tid),
+            "ethrex_trace_export": lambda tid=None: _trace_export(tid),
             # SLO/alert engine + flight recorder (docs/OBSERVABILITY.md)
             "ethrex_alerts": lambda: _alerts(node),
             "ethrex_debug_snapshot": lambda: _debug_snapshot(node),
@@ -491,8 +498,10 @@ class RpcServer:
             finally:
                 self.overload.release(adm.decision)
                 elapsed = time.perf_counter() - t0
-                # known methods only, so label cardinality stays bounded
-                observe_rpc_request(method, elapsed)
+                # known methods only, so label cardinality stays bounded;
+                # the exemplar links the landing bucket to this request's
+                # trace in the OpenMetrics exposition
+                observe_rpc_request(method, elapsed, trace_id=trace_id)
                 self._track_inflight(method, -1)
                 if elapsed >= SLOW_REQUEST_SECONDS:
                     record_rpc_slow_request()
@@ -914,6 +923,49 @@ def _trace_limit(limit) -> int:
     return int(limit)
 
 
+def _resolve_trace(tid):
+    """Trace dict for an explicit ID, or the slowest buffered trace when
+    the caller passed none.  None means the ring has nothing to offer —
+    pre-tracing / L1-only / idle nodes — and the trace analysis RPCs
+    degrade to a found=False stub rather than an error."""
+    if tid is None:
+        slow = TRACER.slowest(1)
+        if not slow:
+            return None
+        return {"traceId": slow[0]["traceId"], "spans": slow[0]["spans"]}
+    if not isinstance(tid, str):
+        return None
+    return TRACER.get_trace(tid)
+
+
+def _trace_critical_path(tid=None):
+    """ethrex_trace_criticalPath: blocking chain + per-component wall
+    attribution of one merged trace (default: the slowest buffered one).
+    See docs/OBSERVABILITY.md "Distributed tracing"."""
+    from ..utils.tracing import critical_path
+
+    trace = _resolve_trace(tid)
+    if trace is None:
+        return {"found": False, "traceId": tid, "components": {},
+                "chain": []}
+    out = {"found": True}
+    out.update(critical_path(trace))
+    return out
+
+
+def _trace_export(tid=None):
+    """ethrex_trace_export: one merged trace as Chrome trace-event JSON,
+    loadable directly in Perfetto / chrome://tracing."""
+    from ..utils.tracing import to_trace_events
+
+    trace = _resolve_trace(tid)
+    if trace is None:
+        return {"found": False, "traceId": tid, "traceEvents": []}
+    out = {"found": True}
+    out.update(to_trace_events(trace))
+    return out
+
+
 def _alerts(node):
     """ethrex_alerts: alert-engine state, degrading to a disabled stub
     on nodes that never attached an engine (L1-only / older nodes)."""
@@ -1055,7 +1107,11 @@ def _health(node):
         "peers": _peer_count(node),
         "p2p": _p2p_json(node),
         "tracing": {"bufferedTraces": len(TRACER),
-                    "droppedTraces": TRACER.dropped},
+                    "droppedTraces": TRACER.dropped,
+                    # span-shipping ingestion health: remote spans merged
+                    # into (or dropped by) the ring
+                    "spansIngested": TRACER.ingested,
+                    "spanIngestDropped": TRACER.ingest_dropped},
     }
     overload = getattr(node, "rpc_overload", None)
     if overload is not None:
@@ -1129,6 +1185,10 @@ def _health(node):
             # the poison-batch quarantine (docs/PROVER_RESILIENCE.md);
             # the fleet scheduler state rides inside under "scheduler"
             "prover": seq.coordinator.stats_json(),
+            # per-batch lifecycle timeline: critical-path summaries of
+            # recently settled batches' merged traces
+            # (docs/OBSERVABILITY.md "Distributed tracing")
+            "lifecycle": seq.coordinator.lifecycles_json(),
             # recursive aggregation pipeline state (docs/AGGREGATION.md)
             "aggregation": {
                 "enabled": seq.cfg.aggregation_enabled,

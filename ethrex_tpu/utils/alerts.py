@@ -190,6 +190,19 @@ def gauge_signal(gauge: str):
     return lambda eng, node: eng.gauge(gauge)
 
 
+def component_p95_signal(histogram: str, component: str,
+                         window: float = 300.0):
+    """p95 of ONE component series of a labelled histogram — e.g. the
+    queue-wait leg of batch_critical_path_seconds.  None until that
+    component has samples in the window, so nodes that never settle a
+    batch (or predate critical-path attribution) never alert."""
+    def sig(eng, node):
+        p = eng.percentiles(histogram, qs=(0.95,), window=window,
+                            labels={"component": component})
+        return None if p is None else p.get("p95")
+    return sig
+
+
 def settlement_lag_signal(eng, node):
     """Batches committed but not yet verified on the L1."""
     latest = eng.gauge("ethrex_l2_latest_batch")
@@ -324,6 +337,31 @@ def default_rules(node=None) -> list:
            runbook="Aggregation is falling behind proving; check the "
                    "aggregate_proofs actor latency and whether the run "
                    "keeps failing its pre-settlement audit."),
+        # critical-path queue-wait — batches spending their lifecycle
+        # WAITING for a prover while the fleet reports idle capacity is
+        # a scheduler bug, not a capacity problem: cross-check
+        # scheduler_queue_depth and liveAssignments in ethrex_health
+        # (docs/OBSERVABILITY.md "Distributed tracing")
+        mk("batch_queue_wait_p95:page", "page",
+           component_p95_signal("batch_critical_path_seconds",
+                                "queue-wait", window=120.0), 240.0,
+           window=120.0, for_count=2, resolve_count=3,
+           description="Queue-wait leg of the batch critical path p95 "
+                       "over 2m exceeds 240s",
+           runbook="Batches sit unassigned while provers poll: check "
+                   "scheduler_queue_depth vs l2.prover.liveAssignments "
+                   "in ethrex_health, the hedging deadline "
+                   "(docs/AGGREGATION.md), and "
+                   "ethrex_trace_criticalPath for the dominated trace."),
+        mk("batch_queue_wait_p95:warn", "warn",
+           component_p95_signal("batch_critical_path_seconds",
+                                "queue-wait", window=600.0), 60.0,
+           window=600.0, for_count=3, resolve_count=3,
+           description="Queue-wait leg of the batch critical path p95 "
+                       "over 10m exceeds 60s",
+           runbook="Queue time dominating proving time usually means "
+                   "too few provers for the batch rate or a cold fleet "
+                   "being deferred; see prover_cold_deferrals_total."),
         # sequencer actor stall — no-progress watchdog
         mk("sequencer_stall:page", "page",
            actor_stall_signal, 120.0,

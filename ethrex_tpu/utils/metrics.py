@@ -12,6 +12,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 # exposition size bounded.
 DEFAULT_BUCKETS = tuple(0.001 * 2 ** i for i in range(20))
 
+# Label sets one family may hold (mirrors the profiler's MAX_KEYS):
+# adversarial reject reasons or per-air labels cannot grow the
+# exposition unboundedly; overflow series are dropped and counted in
+# metrics_dropped_label_sets_total.
+MAX_LABEL_SETS = 512
+
 
 def _escape_label(value: str) -> str:
     return (str(value).replace("\\", "\\\\").replace('"', '\\"')
@@ -32,23 +38,32 @@ def _fmt_le(le) -> str:
 class _Histogram:
     """One named histogram family: per-labelset bucket counts + sum."""
 
-    __slots__ = ("buckets", "series")
+    __slots__ = ("buckets", "series", "exemplars")
 
     def __init__(self, buckets):
         self.buckets = tuple(sorted(buckets))
         # labels tuple -> [bucket counts..., +Inf count, sum]
         self.series: dict[tuple, list] = {}
+        # (labels tuple, bucket index) -> (trace_id, value): the most
+        # recent exemplar observed into that bucket, rendered in
+        # OpenMetrics exemplar syntax so a tail bucket links straight to
+        # a loadable trace (docs/OBSERVABILITY.md "Distributed tracing")
+        self.exemplars: dict[tuple, tuple] = {}
 
-    def observe(self, value: float, labels: tuple):
+    def observe(self, value: float, labels: tuple, exemplar=None):
         row = self.series.get(labels)
         if row is None:
             row = [0] * (len(self.buckets) + 1) + [0.0]
             self.series[labels] = row
+        landed = len(self.buckets)       # +Inf unless a bucket matches
         for i, le in enumerate(self.buckets):
             if value <= le:
                 row[i] += 1
+                landed = min(landed, i)
         row[len(self.buckets)] += 1      # +Inf == total count
         row[-1] += value                 # running sum
+        if exemplar:
+            self.exemplars[(labels, landed)] = (str(exemplar), float(value))
 
 
 class Metrics:
@@ -78,6 +93,21 @@ class Metrics:
             if help_text:
                 self.help[name] = help_text
 
+    def _clamped(self, fam: dict, key: tuple) -> bool:
+        """Caller holds the lock.  True when a NEW label set would push
+        one family past MAX_LABEL_SETS: the series is dropped (existing
+        series keep updating) and the drop is counted."""
+        if key in fam or len(fam) < MAX_LABEL_SETS:
+            return False
+        self.counters["metrics_dropped_label_sets_total"] = \
+            self.counters.get("metrics_dropped_label_sets_total", 0.0) + 1
+        self.help.setdefault(
+            "metrics_dropped_label_sets_total",
+            "Series dropped by the per-family label-set clamp "
+            "(MAX_LABEL_SETS) — cardinality protection against "
+            "unbounded label values")
+        return True
+
     def inc_labeled(self, name: str, labels: dict, value: float = 1.0,
                     help_text: str = ""):
         """Increment one series of a labelled counter family (e.g.
@@ -85,6 +115,8 @@ class Metrics:
         key = tuple(sorted((labels or {}).items()))
         with self.lock:
             fam = self.lcounters.setdefault(name, {})
+            if self._clamped(fam, key):
+                return
             fam[key] = fam.get(key, 0.0) + float(value)
             if help_text:
                 self.help[name] = help_text
@@ -96,20 +128,28 @@ class Metrics:
         key = tuple(sorted((labels or {}).items()))
         with self.lock:
             fam = self.lgauges.setdefault(name, {})
+            if self._clamped(fam, key):
+                return
             fam[key] = float(value)
             if help_text:
                 self.help[name] = help_text
 
     def observe(self, name: str, value: float,
                 labels: dict | None = None, help_text: str = "",
-                buckets=DEFAULT_BUCKETS):
-        """Record one observation into a labelled histogram."""
+                buckets=DEFAULT_BUCKETS, exemplar: str | None = None):
+        """Record one observation into a labelled histogram.
+
+        ``exemplar`` optionally attaches a trace ID to the bucket this
+        value lands in, surfaced in OpenMetrics exemplar syntax by
+        ``render`` so tail buckets link to a loadable trace."""
         key = tuple(sorted((labels or {}).items()))
         with self.lock:
             hist = self.histograms.get(name)
             if hist is None:
                 hist = self.histograms[name] = _Histogram(buckets)
-            hist.observe(float(value), key)
+            if self._clamped(hist.series, key):
+                return
+            hist.observe(float(value), key, exemplar=exemplar)
             if help_text:
                 self.help[name] = help_text
 
@@ -165,12 +205,23 @@ class Metrics:
             for labels, row in sorted(hist.series.items()):
                 base = _fmt_labels(labels)
                 sep = "," if base else ""
+
+                def _ex(i, labels=labels):
+                    # OpenMetrics exemplar: `... 5 # {trace_id="x"} 0.23`
+                    # (no timestamp — keeps goldens and diffs stable)
+                    ex = hist.exemplars.get((labels, i))
+                    if not ex:
+                        return ""
+                    return (f' # {{trace_id="{_escape_label(ex[0])}"}}'
+                            f" {ex[1]}")
+
                 for i, le in enumerate(hist.buckets):
                     lines.append(
                         f'{name}_bucket{{{base}{sep}le="{_fmt_le(le)}"}} '
-                        f"{row[i]}")
+                        f"{row[i]}{_ex(i)}")
                 lines.append(
-                    f'{name}_bucket{{{base}{sep}le="+Inf"}} {row[nb]}')
+                    f'{name}_bucket{{{base}{sep}le="+Inf"}} '
+                    f"{row[nb]}{_ex(nb)}")
                 brace = f"{{{base}}}" if base else ""
                 lines.append(f"{name}_sum{brace} {row[-1]}")
                 lines.append(f"{name}_count{brace} {row[nb]}")
@@ -359,7 +410,8 @@ def record_shutdown_duration(seconds: float):
                 "Wall-clock of the last coordinated shutdown drain")
 
 
-def record_batch(batch_number: int, proving_time: float | None = None):
+def record_batch(batch_number: int, proving_time: float | None = None,
+                 trace_id: str | None = None):
     METRICS.set("ethrex_l2_latest_batch", batch_number,
                 "Latest committed L2 batch")
     if proving_time is not None:
@@ -367,7 +419,7 @@ def record_batch(batch_number: int, proving_time: float | None = None):
                     "Wall-clock of the last batch proof")
         _observe_safe("batch_proving_seconds", proving_time, None,
                       "Batch proof wall-clock distribution (drives the "
-                      "proving-latency p95 SLO)")
+                      "proving-latency p95 SLO)", exemplar=trace_id)
 
 
 def record_verified_batch(batch_number: int):
@@ -463,17 +515,40 @@ def record_snapshot_written():
                 "Flight-recorder debug snapshots written to disk")
 
 
-def _observe_safe(name, value, labels, help_text):
+def _observe_safe(name, value, labels, help_text, exemplar=None):
     # Telemetry sits inside hot/traced paths; it must never raise there.
     try:
-        METRICS.observe(name, value, labels, help_text)
+        METRICS.observe(name, value, labels, help_text, exemplar=exemplar)
     except Exception:
         pass
 
 
-def observe_rpc_request(method: str, seconds: float):
+def observe_rpc_request(method: str, seconds: float,
+                        trace_id: str | None = None):
     _observe_safe("rpc_request_seconds", seconds, {"method": method},
-                  "JSON-RPC request latency by method")
+                  "JSON-RPC request latency by method", exemplar=trace_id)
+
+
+def observe_critical_path(component: str, seconds: float,
+                          trace_id: str | None = None):
+    _observe_safe("batch_critical_path_seconds", seconds,
+                  {"component": component},
+                  "Per-component critical-path attribution of a settled "
+                  "batch's merged lifecycle trace (queue-wait / assign / "
+                  "prove stages / transport / verify / settle; "
+                  "docs/OBSERVABILITY.md)", exemplar=trace_id)
+
+
+def record_trace_ingest(added: int, dropped: int = 0):
+    if added:
+        METRICS.inc("trace_spans_ingested_total", added,
+                    "Remote spans merged into the local trace ring "
+                    "(span shipping over ProofSubmit/Heartbeat)")
+    if dropped:
+        METRICS.inc("trace_spans_ingest_dropped_total", dropped,
+                    "Shipped spans dropped at ingestion: malformed, "
+                    "over the per-source cap, or over the per-trace "
+                    "span budget")
 
 
 def observe_rpc_queue_wait(seconds: float):

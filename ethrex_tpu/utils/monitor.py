@@ -60,6 +60,11 @@ def snapshot(rpc: RpcSession, blocks: int = 8) -> dict:
     except Exception:
         out["traces"] = None
     try:
+        # older nodes don't serve the critical-path RPC; skip the panel
+        out["criticalPath"] = rpc.call("ethrex_trace_criticalPath", [])
+    except Exception:
+        out["criticalPath"] = None
+    try:
         # older nodes don't serve the alerts namespace; skip the panel
         out["alerts"] = rpc.call("ethrex_alerts", [])
     except Exception:
@@ -391,6 +396,55 @@ def _perf_lines(snap: dict, width: int) -> list[str]:
     return lines if len(lines) > 2 else []
 
 
+def _lifecycle_lines(snap: dict, width: int) -> list[str]:
+    """Batch lifecycle panel: the slowest merged trace's critical-path
+    component attribution (ethrex_trace_criticalPath) and the recently
+    settled batches' timeline (ethrex_health `l2.lifecycle`).
+    Defensive like the other panels — an L1-only or pre-tracing node
+    answers found=False / has no section and simply gets no panel."""
+    lines: list[str] = []
+    cp = snap.get("criticalPath")
+    if isinstance(cp, dict) and cp.get("found") \
+            and isinstance(cp.get("components"), dict):
+        wall = cp.get("wallSeconds")
+        shown = f"{wall:.3f}s" if isinstance(wall, (int, float)) else "—"
+        lines.append("─" * width)
+        lines.append(
+            f" critical path  trace {str(cp.get('traceId', ''))[:16]}"
+            f"  wall {shown}"
+            + ("  (partial)" if cp.get("partial") else ""))
+        comps = [(k, v) for k, v in cp["components"].items()
+                 if isinstance(v, (int, float))]
+        if comps and isinstance(wall, (int, float)) and wall > 0:
+            comps.sort(key=lambda kv: kv[1], reverse=True)
+            lines.append("   " + "  ".join(
+                f"{k} {100 * v / wall:.0f}%" for k, v in comps[:6]))
+    health = snap.get("health")
+    l2 = health.get("l2") if isinstance(health, dict) else None
+    timeline = l2.get("lifecycle") if isinstance(l2, dict) else None
+    if isinstance(timeline, list) and timeline:
+        if not lines:
+            lines.append("─" * width)
+        lines.append(" settled batches (critical path)")
+        for entry in timeline[-4:]:
+            if not isinstance(entry, dict):
+                continue
+            comps = entry.get("components")
+            parts = ""
+            if isinstance(comps, dict):
+                top = sorted(((k, v) for k, v in comps.items()
+                              if isinstance(v, (int, float))),
+                             key=lambda kv: kv[1], reverse=True)[:3]
+                parts = "  ".join(f"{k} {v:.3f}s" for k, v in top)
+            wall = entry.get("wallSeconds")
+            wshown = f"{wall:.3f}s" if isinstance(wall,
+                                                  (int, float)) else "—"
+            lines.append(f"   batch {str(entry.get('batch', '?')):<6}"
+                         f" wall {wshown:>9}  {parts}"
+                         + ("  (partial)" if entry.get("partial") else ""))
+    return lines
+
+
 def render_lines(snap: dict, width: int = 100) -> list[str]:
     """Snapshot -> dashboard lines (pure; the curses loop just blits)."""
     h = snap["head"]
@@ -434,6 +488,7 @@ def render_lines(snap: dict, width: int = 100) -> list[str]:
     lines.extend(_alerts_lines(snap, width))
     lines.extend(_perf_lines(snap, width))
     lines.extend(_latency_lines(snap, width))
+    lines.extend(_lifecycle_lines(snap, width))
     lines.extend(_storage_lines(snap, width))
     lines.append("─" * width)
     lines.append(" q quits · refreshes every interval")

@@ -25,7 +25,7 @@ import time
 
 from . import jax_cache, timeseries
 from .metrics import METRICS, record_snapshot_written
-from .tracing import TRACER
+from .tracing import TRACER, to_trace_events
 
 log = logging.getLogger("ethrex_tpu.snapshot")
 
@@ -53,8 +53,18 @@ def _section(fn):
 
 
 def _traces():
-    return {"slowest": TRACER.slowest(10), "recent": TRACER.recent(10),
-            "dropped": TRACER.dropped}
+    out = {"slowest": TRACER.slowest(10), "recent": TRACER.recent(10),
+           "dropped": TRACER.dropped,
+           "spansIngested": TRACER.ingested,
+           "spanIngestDropped": TRACER.ingest_dropped}
+    slow = out["slowest"]
+    if slow:
+        # the slowest trace ready-to-load in Perfetto / chrome://tracing
+        # (docs/OBSERVABILITY.md "Distributed tracing")
+        out["perfetto"] = to_trace_events(
+            {"traceId": slow[0].get("traceId"),
+             "spans": slow[0].get("spans")})
+    return out
 
 
 def _health(node):

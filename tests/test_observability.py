@@ -327,6 +327,23 @@ def test_single_trace_covers_batch_lifecycle():
                            "method": "ethrex_trace_slowest",
                            "params": ["0x5"]})
         assert len(r["result"]) <= 5
+        # critical-path attribution of the same trace partitions its wall
+        r = server.handle({"jsonrpc": "2.0", "id": 3,
+                           "method": "ethrex_trace_criticalPath",
+                           "params": [tid]})
+        cp = r["result"]
+        assert cp["found"] is True and cp["chain"]
+        assert abs(sum(cp["components"].values()) - cp["wallSeconds"]) \
+            <= 0.05 * max(cp["wallSeconds"], 1e-9)
+        json.dumps(r)
+        # ...and exports as loadable Chrome trace-event JSON
+        r = server.handle({"jsonrpc": "2.0", "id": 4,
+                           "method": "ethrex_trace_export",
+                           "params": [tid]})
+        evs = r["result"]["traceEvents"]
+        assert any(e["ph"] == "X" and e["name"] == "prover.prove"
+                   for e in evs)
+        json.dumps(r)
     finally:
         seq.stop()
 
@@ -372,7 +389,8 @@ def test_monitor_degrades_against_l1_only_node():
     server = RpcServer(node).start()
     # simulate an older / L1-only node: no L2 namespace, no trace RPCs
     for method in ("ethrex_health", "ethrex_latestBatch",
-                   "ethrex_trace_slowest", "ethrex_trace_recentTraces"):
+                   "ethrex_trace_slowest", "ethrex_trace_recentTraces",
+                   "ethrex_trace_criticalPath", "ethrex_trace_export"):
         server.methods.pop(method)
     try:
         node.produce_block()
@@ -381,6 +399,7 @@ def test_monitor_degrades_against_l1_only_node():
         assert snap["batch"] is None
         assert snap["health"] is None
         assert snap["traces"] is None
+        assert snap["criticalPath"] is None
         lines = render_lines(snap, width=80)
         assert any("head #1" in ln for ln in lines)
         assert not any("slowest traces" in ln for ln in lines)

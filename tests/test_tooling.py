@@ -295,9 +295,11 @@ def test_every_metric_helper_has_help_text():
     from ethrex_tpu.perf import bench_suite, loadgen, profiler, roofline
     from ethrex_tpu.utils import exec_cache, metrics, overload
 
+    from ethrex_tpu.utils import tracing
+
     offenders = []
-    for mod in (metrics, profiler, roofline, bench_suite, loadgen, mempool,
-                overload, exec_cache):
+    for mod in (metrics, tracing, profiler, roofline, bench_suite, loadgen,
+                mempool, overload, exec_cache):
         tree = ast.parse(inspect.getsource(mod))
         for fn in ast.walk(tree):
             if not isinstance(fn, ast.FunctionDef):
@@ -334,6 +336,85 @@ def test_every_metric_helper_has_help_text():
                                      f"(line {call.lineno})")
     assert not offenders, \
         f"metric calls without help text: {offenders}"
+
+
+def test_histogram_exemplar_golden_exposition_line():
+    """OpenMetrics exemplar syntax, golden: the bucket an observation
+    lands in carries `# {trace_id="..."} value` (no timestamp — keeps
+    this golden stable), other buckets stay bare."""
+    from ethrex_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    tid = "ab" * 8
+    m.observe("batch_proving_seconds", 0.003, None, "batch proving wall",
+              exemplar=tid)
+    lines = m.render().splitlines()
+    assert ('batch_proving_seconds_bucket{le="0.004"} 1'
+            f' # {{trace_id="{tid}"}} 0.003') in lines
+    # the cumulative buckets above it count the observation WITHOUT
+    # inheriting the exemplar
+    assert 'batch_proving_seconds_bucket{le="0.008"} 1' in lines
+    assert 'batch_proving_seconds_bucket{le="0.002"} 0' in lines
+    # an over-ladder value exemplars the +Inf bucket
+    m.observe("batch_proving_seconds", 10**6, None, "batch proving wall",
+              exemplar="ff" * 8)
+    text = m.render()
+    assert (f'batch_proving_seconds_bucket{{le="+Inf"}} 2'
+            f' # {{trace_id="{"ff" * 8}"}} 1000000.0') in text
+
+
+def test_label_set_cardinality_clamp():
+    """Unbounded label values cannot grow a family past MAX_LABEL_SETS
+    (mirror of the profiler's MAX_KEYS): overflow series are dropped and
+    counted, existing series keep updating."""
+    from ethrex_tpu.utils.metrics import MAX_LABEL_SETS, Metrics
+
+    m = Metrics()
+    for i in range(MAX_LABEL_SETS + 88):
+        m.observe("h_seconds", 0.1, {"k": f"v{i}"}, "h")
+    assert len(m.histograms["h_seconds"].series) == MAX_LABEL_SETS
+    assert m.counters["metrics_dropped_label_sets_total"] == 88
+    # an existing series still updates after the clamp engages
+    m.observe("h_seconds", 0.1, {"k": "v0"}, "h")
+    row = m.histograms["h_seconds"].series[(("k", "v0"),)]
+    assert row[len(m.histograms["h_seconds"].buckets)] == 2
+    # labelled counters and gauges sit behind the same clamp
+    for i in range(MAX_LABEL_SETS + 1):
+        m.inc_labeled("c_total", {"k": f"v{i}"}, 1, "c")
+        m.set_labeled("g", {"k": f"v{i}"}, 1.0, "g")
+    assert len(m.lcounters["c_total"]) == MAX_LABEL_SETS
+    assert len(m.lgauges["g"]) == MAX_LABEL_SETS
+    # the drop counter itself is documented in the exposition
+    assert "# HELP metrics_dropped_label_sets_total" in m.render()
+
+
+def test_trace_analysis_rpcs_degrade_gracefully(monkeypatch):
+    """ethrex_trace_criticalPath / ethrex_trace_export on an unknown
+    trace or an empty ring (L1-only / pre-tracing node) answer with a
+    found=False stub, never an error."""
+    from ethrex_tpu.node import Node
+    from ethrex_tpu.primitives.genesis import Genesis
+    from ethrex_tpu.rpc.server import RpcServer
+    from ethrex_tpu.utils.tracing import Tracer
+
+    node = Node(Genesis.from_json(GENESIS))
+    server = RpcServer(node)
+    r = server.handle({"jsonrpc": "2.0", "id": 1,
+                       "method": "ethrex_trace_criticalPath",
+                       "params": ["ff" * 8]})
+    assert r["result"] == {"found": False, "traceId": "ff" * 8,
+                           "components": {}, "chain": []}
+    r = server.handle({"jsonrpc": "2.0", "id": 2,
+                       "method": "ethrex_trace_export",
+                       "params": ["ff" * 8]})
+    assert r["result"]["found"] is False
+    assert r["result"]["traceEvents"] == []
+    # empty ring + no trace-id argument: nothing to resolve
+    monkeypatch.setattr("ethrex_tpu.rpc.server.TRACER", Tracer())
+    for method in ("ethrex_trace_criticalPath", "ethrex_trace_export"):
+        r = server.handle({"jsonrpc": "2.0", "id": 3, "method": method,
+                           "params": []})
+        assert r["result"]["found"] is False
 
 
 def test_every_bench_config_emits_stages():
