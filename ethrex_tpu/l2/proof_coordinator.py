@@ -157,6 +157,13 @@ class ProofCoordinator:
         # batch's proving wall includes compile time, so _handle_submit
         # keeps it out of the durations deque and the holder's EWMA
         self.lease_warm: dict[tuple[int, str], bool | None] = {}
+        # (batch, prover_type) -> (in-flight phase, transition time on
+        # THIS clock) from heartbeats; the hedging deadline re-anchors on
+        # every phase transition so a proof making phase progress is
+        # never hedged as a straggler (the prover's own phase_started is
+        # advisory/observability only — clock skew never feeds hedging)
+        self.lease_phase: dict[tuple[int, str], tuple[str, float]] = {}
+        self.poison_reports_total = 0
         self.cold_deferrals_total = 0
         # recent completed proving wall-clocks, the p99 hedging source
         self.durations: collections.deque = collections.deque(maxlen=256)
@@ -308,6 +315,12 @@ class ProofCoordinator:
                 or len(unleased) == 1:
             return unleased[0]
         st = self.prover_stats.get(prover_id)
+        if st is not None and st.get("degraded") is not None:
+            # runtime-degraded prover (OOM/device-loss demoted its mesh):
+            # steer it to the lightest waiting batch regardless of EWMA —
+            # its historical speed no longer predicts its capacity
+            weights = {num: self._batch_weight(num) for num in unleased}
+            return min(unleased, key=lambda n: (weights[n], n))
         ewma = st.get("ewma") if st else None
         others = [s["ewma"] for pid, s in self.prover_stats.items()
                   if pid != prover_id and s.get("ewma") is not None]
@@ -481,8 +494,17 @@ class ProofCoordinator:
                     and self.lease_holders.get(key) == prover_id:
                 continue  # never hedge a prover against itself
             reason = None
-            if deadline is not None \
-                    and now - self.assigned_at.get(key, now) > deadline:
+            # straggler clock anchors on the LAST phase transition the
+            # holder reported (stamped with this coordinator's clock at
+            # heartbeat ingestion), not first assignment: a prover
+            # resuming from checkpoints or grinding through a long FRI
+            # phase is making progress, and hedging it would only burn a
+            # second prover on work the first will finish
+            anchor = self.assigned_at.get(key, now)
+            phase_info = self.lease_phase.get(key)
+            if phase_info is not None:
+                anchor = max(anchor, phase_info[1])
+            if deadline is not None and now - anchor > deadline:
                 reason = "straggler"
             elif requester_idle:
                 holder = self.lease_holders.get(key)
@@ -519,6 +541,7 @@ class ProofCoordinator:
         self.lease_tokens.pop(key, None)
         self.lease_holders.pop(key, None)
         self.lease_warm.pop(key, None)
+        self.lease_phase.pop(key, None)
         return self.assigned_at.pop(key, None)
 
     def trace_for_batch(self, batch: int) -> str:
@@ -586,9 +609,54 @@ class ProofCoordinator:
                             min(now + self.lease_timeout, hard)
                         self.heartbeats_total += 1
                         ok = True
+            if ok:
+                self._ingest_runtime_advisory(key, msg, now)
         if ok:
             record_heartbeat()
         return {"type": protocol.HEARTBEAT_ACK, "batch_id": batch, "ok": ok}
+
+    def _ingest_runtime_advisory(self, key: tuple[int, str], msg: dict,
+                                 now: float) -> None:
+        """Consume a token-validated heartbeat's runtime fields: the
+        in-flight phase (stamped with THIS clock on transition — the
+        hedging re-anchor), any mesh downgrade (scheduler steering), and
+        a poison report (immediate quarantine naming the phase).  Caller
+        holds self.lock."""
+        batch, prover_type = key
+        phase = msg.get("phase")
+        if isinstance(phase, str) and phase:
+            prev = self.lease_phase.get(key)
+            if prev is None or prev[0] != phase:
+                self.lease_phase[key] = (phase, now)
+        prover_id = msg.get("prover_id")
+        degraded = msg.get("degraded")
+        if prover_id is not None and isinstance(degraded, dict):
+            st = self.prover_stats.setdefault(
+                prover_id, {"completed": 0, "ewma": None,
+                            "last_seen": now})
+            st["degraded"] = {"from": str(degraded.get("from")),
+                              "to": str(degraded.get("to"))}
+        poison = msg.get("poison")
+        if isinstance(poison, dict):
+            from ..utils.metrics import record_quarantine
+
+            self.poison_reports_total += 1
+            detail = f"nan_poison in phase {poison.get('phase')!r}"
+            self._clear_lease(key)
+            self._note_event("poison-report", batch, prover_type, detail)
+            log.error("batch %d reported poisoned by its %s prover (%s)",
+                      batch, prover_type, detail)
+            if prover_type != self.fallback_type \
+                    and batch not in self.quarantined:
+                # a poisoned batch cannot be proven by ANY amount of
+                # retrying on this backend: quarantine on the FIRST
+                # report instead of burning the failure budget
+                self.quarantined.add(batch)
+                record_quarantine(len(self.quarantined))
+                self._note_event("quarantine", batch, prover_type, detail)
+                log.error("batch %d quarantined off %r on first poison "
+                          "report; falling back to %r", batch,
+                          prover_type, self.fallback_type)
 
     def _handle_submit(self, msg: dict) -> dict:
         # merge the shipped span subtree FIRST: a duplicate submit is the
@@ -810,7 +878,32 @@ class ProofCoordinator:
                              in sorted(self.failures.items())},
                 "recentEvents": list(self.events),
                 "scheduler": self._scheduler_stats_locked(),
+                "runtime": self._runtime_stats_locked(),
             }
+
+    def _runtime_stats_locked(self) -> dict:
+        """This process's prover-runtime counters (resumes, ladder
+        retries, checkpoint traffic) plus what the fleet's heartbeats
+        reported: which provers run degraded and which phase each live
+        lease is in.  Caller holds self.lock."""
+        from ..prover import runtime_errors as rt_mod
+
+        now = self._now()
+        stats = rt_mod.runtime_stats()
+        stats["poisonReports"] = self.poison_reports_total
+        stats["degradedProvers"] = {
+            pid: st["degraded"]
+            for pid, st in sorted(self.prover_stats.items())
+            if st.get("degraded") is not None}
+        stats["livePhases"] = [
+            {"batch": num, "proverType": ptype, "phase": phase,
+             "sincePhaseSeconds": max(0.0, now - since)}
+            for (num, ptype), (phase, since)
+            in sorted(self.lease_phase.items())
+            if self.assignments.get((num, ptype), 0.0) > now
+            or ((num, ptype) in self.hedges
+                and self.hedges[(num, ptype)]["expires"] > now)]
+        return stats
 
     def _scheduler_stats_locked(self) -> dict:
         """Caller holds self.lock."""
@@ -835,7 +928,8 @@ class ProofCoordinator:
                       "liveLeases": self._live_leases_held(pid, now),
                       "idleSeconds": max(0.0, now - st["last_seen"]),
                       "warm": st.get("warm"),
-                      "coldDeferrals": st.get("cold_deferrals", 0)}
+                      "coldDeferrals": st.get("cold_deferrals", 0),
+                      "degraded": st.get("degraded")}
                 for pid, st in sorted(self.prover_stats.items())},
         }
 

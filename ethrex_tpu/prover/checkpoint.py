@@ -1,0 +1,355 @@
+"""Phase-level proof checkpoints: crash-only proving for `TpuBackend`.
+
+A prove is a sequence of device phases (execute -> per-AIR
+commit/quotient/open/fri -> binding/aggregate) stitched together by a
+host Fiat-Shamir transcript.  Each completed phase persists ONE
+content-addressed envelope here — the phase's host-visible artifacts,
+numpy copies of the device intermediates the later phases consume, and
+a snapshot of the transcript sponge — so a restarted `ProverClient`
+holding a *fresh lease for the same batch* replays the transcript from
+the last completed phase instead of re-proving from scratch.  Bounded
+loss is <= 1 phase (the one in flight when the process died) and the
+resumed proof is byte-identical: all arithmetic is exact u32 and the
+sponge snapshot pins every later challenge.
+
+Key schema (docs/PROVER_RESILIENCE.md "Runtime failures"): an entry's
+filename is the SHA-256 over the JSON-canonical key parts — batch id,
+job name, AIR cache key, trace shape, STARK params, phase — joined
+with the environment half (code fingerprint, jax/jaxlib versions,
+shared with utils/exec_cache).  The *mesh layout* and *lease token*
+are deliberately recorded as envelope metadata, NOT key material:
+proofs are bit-identical across mesh layouts, so the degradation
+ladder (prover/runtime_errors) must be able to resume a phase prefix
+written at mesh=2x4 on a single device, and a restarted client always
+holds a fresh token for the same batch.
+
+Records are written atomically (tempfile + os.replace) and framed as
+MAGIC | crc32 | length | pickle-blob; a torn, truncated or garbage
+blob fails the frame check and is discarded for a clean fresh prove
+(`proof_ckpt_discards_total`) — the loader never raises.
+
+Env knobs (documented in docs/PROVER_RESILIENCE.md):
+  ETHREX_PROOF_CKPT_DIR  checkpoint directory (default
+                         /tmp/ethrex_tpu_proof_ckpt_<host fingerprint>)
+  ETHREX_PROOF_CKPT_OFF  "1" disables checkpoint stores and loads
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import pickle
+import tempfile
+import threading
+import time
+import zlib
+
+_SCHEMA = 1
+_MAGIC = b"ETPC"
+_SUFFIX = ".ckpt"
+
+_LOCK = threading.Lock()
+_CONFIGURED_DIR: str | None = None
+STATS = {"stores": 0, "loads": 0, "discards": 0}
+
+# The per-thread prove context: ProverClient activates one around
+# backend.prove; TpuBackend re-activates it on its job worker threads
+# (threading.local does not inherit across ThreadPoolExecutor workers,
+# same re-entry discipline as tracing.trace_context).
+_TLS = threading.local()
+
+
+class BatchContext:
+    """Mutable per-batch prove state shared between the prove thread(s)
+    and the heartbeat thread: identity (batch id + the lease token that
+    granted this attempt), the in-flight phase for heartbeat stamping,
+    and any mesh downgrade the degradation ladder applied."""
+
+    def __init__(self, batch_id, lease_token=None):
+        self.batch_id = batch_id
+        self.lease_token = lease_token
+        self.lock = threading.Lock()
+        self.phase: str | None = None
+        self.phase_started: float | None = None
+        self.degraded: dict | None = None
+        self.resumes = 0
+
+    def set_phase(self, phase: str | None) -> None:
+        with self.lock:
+            if phase != self.phase:
+                self.phase = phase
+                self.phase_started = time.time()
+
+    def note_degraded(self, frm: str, to: str) -> None:
+        with self.lock:
+            if self.degraded is None:
+                self.degraded = {"from": frm, "to": to}
+            else:
+                # ladder walked further down: keep the original rung as
+                # the origin, report the latest rung as the floor
+                self.degraded = {"from": self.degraded["from"], "to": to}
+
+    def snapshot(self) -> dict:
+        """Heartbeat-safe copy of the advisory fields."""
+        with self.lock:
+            out = {"phase": self.phase, "phase_started": self.phase_started}
+            if self.degraded is not None:
+                out["degraded"] = dict(self.degraded)
+            return out
+
+
+def current_context() -> BatchContext | None:
+    return getattr(_TLS, "ctx", None)
+
+
+def current_job() -> str | None:
+    return getattr(_TLS, "job", None)
+
+
+@contextlib.contextmanager
+def activate(ctx: BatchContext | None, job: str | None = None):
+    """Bind a batch context (and optionally a job name) to this thread.
+    `batch_context` uses it on the client thread; TpuBackend's job
+    workers re-enter with the parent's context."""
+    prev_ctx = getattr(_TLS, "ctx", None)
+    prev_job = getattr(_TLS, "job", None)
+    _TLS.ctx = ctx
+    if job is not None:
+        _TLS.job = job
+    try:
+        yield ctx
+    finally:
+        _TLS.ctx = prev_ctx
+        _TLS.job = prev_job
+
+
+@contextlib.contextmanager
+def batch_context(batch_id, lease_token=None):
+    """Open (or reopen, after a restart) the checkpointed prove of one
+    batch.  The yielded context carries the advisory state the
+    heartbeat thread reports (in-flight phase, degradation)."""
+    ctx = BatchContext(batch_id, lease_token=lease_token)
+    with activate(ctx):
+        yield ctx
+
+
+@contextlib.contextmanager
+def job_scope(job: str):
+    """Name the prove job (state_proof / vm_circuits/TransferAir /
+    binding / ...) for every checkpoint written under it."""
+    prev = getattr(_TLS, "job", None)
+    _TLS.job = job
+    try:
+        yield
+    finally:
+        _TLS.job = prev
+
+
+# -- store layout -----------------------------------------------------------
+
+def set_checkpoint_dir(path: str | None) -> None:
+    """Explicit directory override (tests); beats the env knob."""
+    global _CONFIGURED_DIR
+    with _LOCK:
+        _CONFIGURED_DIR = path
+
+
+def checkpoint_dir() -> str:
+    with _LOCK:
+        configured = _CONFIGURED_DIR
+    if configured:
+        return configured
+    env = os.environ.get("ETHREX_PROOF_CKPT_DIR")
+    if env:
+        return env
+    from ..utils.jax_cache import cache_dir as _fingerprinted
+
+    return _fingerprinted(prefix="/tmp/ethrex_tpu_proof_ckpt")
+
+
+def enabled() -> bool:
+    return os.environ.get("ETHREX_PROOF_CKPT_OFF") != "1"
+
+
+def record_ckpt_store() -> None:
+    from ..utils.metrics import METRICS
+
+    METRICS.inc("proof_ckpt_stores_total", 1,
+                "Proof phase checkpoints persisted: completed prove "
+                "phases a restarted prover can resume from")
+
+
+def record_ckpt_load() -> None:
+    from ..utils.metrics import METRICS
+
+    METRICS.inc("proof_ckpt_loads_total", 1,
+                "Proof phase checkpoints loaded on resume: phases "
+                "skipped instead of re-proven after a restart")
+
+
+def record_ckpt_discard() -> None:
+    from ..utils.metrics import METRICS
+
+    METRICS.inc("proof_ckpt_discards_total", 1,
+                "Proof phase checkpoints discarded as torn, truncated "
+                "or garbage: the prove falls back to a fresh run")
+
+
+def _batch_dir(batch_id) -> str:
+    tag = hashlib.sha256(repr(batch_id).encode()).hexdigest()[:16]
+    return os.path.join(checkpoint_dir(), f"batch_{tag}")
+
+
+def _entry_path(batch_id, parts: dict) -> str:
+    from ..utils import exec_cache
+
+    key = {"schema": _SCHEMA, "parts": parts,
+           "env": {"code": exec_cache._code_fingerprint(),
+                   **{k: v for k, v in exec_cache._env_parts().items()
+                      if k in ("jax", "jaxlib")}}}
+    blob = json.dumps(key, sort_keys=True, separators=(",", ":"),
+                      default=str)
+    digest = hashlib.sha256(blob.encode()).hexdigest()
+    return os.path.join(_batch_dir(batch_id), digest + _SUFFIX)
+
+
+def store(batch_id, parts: dict, payload, meta: dict | None = None) -> bool:
+    """Persist one phase envelope; atomic and never raises.  Returns
+    True when the record landed."""
+    if not enabled():
+        return False
+    try:
+        blob = pickle.dumps({"schema": _SCHEMA, "parts": parts,
+                             "meta": dict(meta or {}), "payload": payload},
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        frame = (_MAGIC + zlib.crc32(blob).to_bytes(4, "big")
+                 + len(blob).to_bytes(8, "big") + blob)
+        path = _entry_path(batch_id, parts)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                   suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(frame)
+            os.replace(tmp, path)
+        except BaseException:
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)
+            raise
+        with _LOCK:
+            STATS["stores"] += 1
+        record_ckpt_store()
+        return True
+    except Exception:
+        return False
+
+
+def load(batch_id, parts: dict):
+    """Load one phase envelope's payload, or None.  A torn/garbage blob
+    is unlinked and counted (`proof_ckpt_discards_total`) — the caller
+    simply re-proves the phase; this never raises."""
+    if not enabled():
+        return None
+    path = _entry_path(batch_id, parts)
+    try:
+        with open(path, "rb") as f:
+            frame = f.read()
+    except OSError:
+        return None
+    try:
+        if frame[:4] != _MAGIC or len(frame) < 16:
+            raise ValueError("bad magic")
+        crc = int.from_bytes(frame[4:8], "big")
+        length = int.from_bytes(frame[8:16], "big")
+        blob = frame[16:]
+        if len(blob) != length or zlib.crc32(blob) != crc:
+            raise ValueError("torn record")
+        rec = pickle.loads(blob)
+        if rec.get("schema") != _SCHEMA or rec.get("parts") != parts:
+            raise ValueError("key mismatch")
+        with _LOCK:
+            STATS["loads"] += 1
+        record_ckpt_load()
+        return rec["payload"]
+    except Exception:
+        with contextlib.suppress(OSError):
+            os.unlink(path)
+        with _LOCK:
+            STATS["discards"] += 1
+        record_ckpt_discard()
+        return None
+
+
+def complete(batch_id) -> None:
+    """Drop every checkpoint of a settled batch (proof accepted): the
+    envelope is recovery state, not an artifact."""
+    bdir = _batch_dir(batch_id)
+    try:
+        names = os.listdir(bdir)
+    except OSError:
+        return
+    for name in names:
+        with contextlib.suppress(OSError):
+            os.unlink(os.path.join(bdir, name))
+    with contextlib.suppress(OSError):
+        os.rmdir(bdir)
+
+
+def runtime_stats() -> dict:
+    """Live view for ethrex_health (l2.prover.runtime.checkpoints)."""
+    with _LOCK:
+        out = dict(STATS)
+    out["enabled"] = enabled()
+    try:
+        out["batches"] = sum(
+            1 for n in os.listdir(checkpoint_dir())
+            if n.startswith("batch_"))
+    except OSError:
+        out["batches"] = 0
+    return out
+
+
+class PhaseStore:
+    """Checkpoint handle for one job's phase sequence: fixes the
+    identity parts (batch, job, air, shape, params) so the prover only
+    names the phase.  `meta` (lease token, mesh label) is recorded on
+    every envelope for forensics but never addresses it."""
+
+    def __init__(self, ctx: BatchContext, job: str, air_key, log_n: int,
+                 params_key, mesh_label: str):
+        self.ctx = ctx
+        self.batch_id = ctx.batch_id
+        self.base = {"kind": "proof_ckpt", "job": job,
+                     "air": repr(air_key), "log_n": int(log_n),
+                     "params": repr(params_key)}
+        self.meta = {"lease_token": ctx.lease_token, "mesh": mesh_label}
+
+    def _parts(self, phase: str) -> dict:
+        parts = dict(self.base)
+        parts["phase"] = phase
+        return parts
+
+    def load(self, phase: str):
+        return load(self.batch_id, self._parts(phase))
+
+    def store(self, phase: str, payload, mesh_label: str | None = None):
+        meta = dict(self.meta)
+        if mesh_label is not None:
+            meta["mesh"] = mesh_label
+        return store(self.batch_id, self._parts(phase), payload, meta=meta)
+
+
+def phase_store(air_key, log_n: int, params_key,
+                mesh_label: str = "none") -> PhaseStore | None:
+    """The stark prover's entry point: a PhaseStore bound to the active
+    batch context and job scope, or None when checkpointing is off or
+    the prove runs outside a batch (bench, direct API use)."""
+    if not enabled():
+        return None
+    ctx = current_context()
+    if ctx is None:
+        return None
+    job = current_job() or "-"
+    return PhaseStore(ctx, job, air_key, log_n, params_key, mesh_label)

@@ -33,7 +33,9 @@ import time
 
 from ..guest.execution import ProgramInput
 from ..utils import faults, tracing
+from . import checkpoint as ckpt_mod
 from . import protocol
+from . import runtime_errors as rt_mod
 from .backend import ProverBackend, get_backend
 
 log = logging.getLogger("ethrex_tpu.prover.client")
@@ -58,7 +60,8 @@ class _HeartbeatThread(threading.Thread):
                  prover_type: str, interval: float,
                  lease_token: str | None = None,
                  trace_id: str | None = None,
-                 prover_id: str | None = None):
+                 prover_id: str | None = None,
+                 ctx: "ckpt_mod.BatchContext | None" = None):
         super().__init__(daemon=True)
         self.host, self.port = host, port
         self.batch_id = batch_id
@@ -66,6 +69,11 @@ class _HeartbeatThread(threading.Thread):
         self.interval = interval
         self.lease_token = lease_token
         self.prover_id = prover_id
+        # the batch context stamps each beat with the in-flight phase
+        # (the coordinator re-anchors its hedging deadline on every
+        # phase transition) and any mesh downgrade the degradation
+        # ladder applied (the scheduler steers heavy batches away)
+        self.ctx = ctx
         # when set, each beat piggybacks the spans completed so far for
         # this trace (stage spans finish while the proof runs), so a
         # prover that crashes mid-prove still leaves its partial subtree
@@ -85,6 +93,8 @@ class _HeartbeatThread(threading.Thread):
                     "lease_token": self.lease_token,
                     "prover_id": self.prover_id,
                 }
+                if self.ctx is not None:
+                    msg.update(self.ctx.snapshot())
                 if self.trace_id:
                     spans = tracing.export_wire(self.trace_id)
                     if spans is not None:
@@ -136,6 +146,12 @@ class ProverClient:
         self.proved: list[int] = []   # batch ids proven (observability)
         self.submit_rejections = 0    # application-level rejects (not
         #                               transport; never trips the breaker)
+        self.poisoned: list[int] = []  # batches aborted as nan_poison
+        # sticky mesh downgrade: once the degradation ladder demoted
+        # this process, every later batch's heartbeats keep reporting
+        # the floor until restart (the runtime condition — a sick slice,
+        # leaked device memory — outlives any one batch)
+        self.degraded: dict | None = None
         self.endpoint_states: dict[tuple[str, int], EndpointState] = {
             ep: EndpointState() for ep in endpoints}
         # pre-warm: hydrate the backend's AOT executables from the
@@ -266,49 +282,78 @@ class ProverClient:
         trace_id = resp.get("trace_id")
         parent_span = resp.get("span_id")
         program_input = ProgramInput.from_json(resp["input"])
-        # heartbeats keep the coordinator lease alive through a long proof
-        hb = None
-        if self.heartbeat_interval and self.heartbeat_interval > 0:
-            hb = _HeartbeatThread(host, port, batch_id,
-                                  self.backend.prover_type,
-                                  self.heartbeat_interval,
-                                  lease_token=lease_token,
-                                  trace_id=trace_id,
-                                  prover_id=self.prover_id)
-            hb.start()
-        with tracing.trace_context(trace_id, parent_span) as tid:
-            try:
-                with tracing.span("prover.prove", batch=batch_id,
-                                  backend=self.backend.prover_type):
-                    faults.inject("backend.prove")
-                    proof = self.backend.prove(program_input,
-                                               resp["format"])
-                    proof = faults.inject("backend.prove", proof,
-                                          kinds=("corrupt",))
-            finally:
-                if hb is not None:
-                    hb.stop()
-            # connection 2: submit over a fresh socket — the input-request
-            # connection may long since have died under the proof
-            with tracing.span("prover.submit", batch=batch_id) as sub:
-                # ship the completed span subtree (prove + stage spans)
-                # with the proof; the coordinator merges it into its
-                # ring so the batch renders as one cross-process trace
-                with socket.create_connection((host, port),
-                                              timeout=30) as sock:
-                    protocol.send_msg(sock, {
-                        "type": protocol.PROOF_SUBMIT,
-                        "batch_id": batch_id,
-                        "prover_type": self.backend.prover_type,
-                        "proof": proof,
-                        "lease_token": lease_token,
-                        "prover_id": self.prover_id,
-                        "trace_id": trace_id,
-                        "span_id": sub.span_id if sub else None,
-                        "spans": tracing.export_wire(tid),
-                    })
-                    ack = protocol.recv_msg(sock)
+        # the batch context scopes this attempt's phase checkpoints (a
+        # restart with a fresh lease resumes from the last completed
+        # phase) and carries the advisory state heartbeats report
+        with ckpt_mod.batch_context(batch_id,
+                                    lease_token=lease_token) as ctx:
+            if self.degraded:
+                ctx.degraded = dict(self.degraded)
+            # heartbeats keep the coordinator lease alive through a
+            # long proof
+            hb = None
+            if self.heartbeat_interval and self.heartbeat_interval > 0:
+                hb = _HeartbeatThread(host, port, batch_id,
+                                      self.backend.prover_type,
+                                      self.heartbeat_interval,
+                                      lease_token=lease_token,
+                                      trace_id=trace_id,
+                                      prover_id=self.prover_id,
+                                      ctx=ctx)
+                hb.start()
+            with tracing.trace_context(trace_id, parent_span) as tid:
+                try:
+                    with tracing.span("prover.prove", batch=batch_id,
+                                      backend=self.backend.prover_type):
+                        faults.inject("backend.prove")
+                        proof = self.backend.prove(program_input,
+                                                   resp["format"])
+                        proof = faults.inject("backend.prove", proof,
+                                              kinds=("corrupt",))
+                except rt_mod.NanPoisonError as poison:
+                    # poisoned batch: retrying cannot help — tell the
+                    # coordinator exactly which phase went non-finite so
+                    # it quarantines on the FIRST attempt, and spend
+                    # zero retries here
+                    if hb is not None:
+                        hb.stop()
+                    self.poisoned.append(batch_id)
+                    log.error("batch %d poisoned in phase %s; reporting "
+                              "for quarantine", batch_id, poison.phase)
+                    self._report_poison(host, port, batch_id,
+                                        lease_token, poison)
+                    return 0
+                finally:
+                    if hb is not None:
+                        hb.stop()
+                    if ctx.degraded:
+                        self.degraded = dict(ctx.degraded)
+                # connection 2: submit over a fresh socket — the
+                # input-request connection may long since have died
+                # under the proof
+                with tracing.span("prover.submit", batch=batch_id) as sub:
+                    # ship the completed span subtree (prove + stage
+                    # spans) with the proof; the coordinator merges it
+                    # into its ring so the batch renders as one
+                    # cross-process trace
+                    with socket.create_connection((host, port),
+                                                  timeout=30) as sock:
+                        protocol.send_msg(sock, {
+                            "type": protocol.PROOF_SUBMIT,
+                            "batch_id": batch_id,
+                            "prover_type": self.backend.prover_type,
+                            "proof": proof,
+                            "lease_token": lease_token,
+                            "prover_id": self.prover_id,
+                            "trace_id": trace_id,
+                            "span_id": sub.span_id if sub else None,
+                            "spans": tracing.export_wire(tid),
+                        })
+                        ack = protocol.recv_msg(sock)
         if ack.get("type") == protocol.SUBMIT_ACK:
+            # the proof is accepted: its recovery state has no further
+            # value, drop the batch's checkpoints
+            ckpt_mod.complete(batch_id)
             self.proved.append(batch_id)
             return 1
         # application-level rejection (invalid proof, stale token): the
@@ -324,6 +369,27 @@ class ProverClient:
                     batch_id, host, port,
                     ack.get("message", ack.get("type")))
         return 0
+
+    def _report_poison(self, host: str, port: int, batch_id: int,
+                       lease_token: str | None,
+                       poison: "rt_mod.NanPoisonError") -> None:
+        """Best-effort poison report: a HEARTBEAT carrying the offending
+        phase; the coordinator quarantines the batch immediately instead
+        of burning its failure budget on doomed retries."""
+        try:
+            with socket.create_connection((host, port), timeout=5) as sock:
+                protocol.send_msg(sock, {
+                    "type": protocol.HEARTBEAT,
+                    "batch_id": batch_id,
+                    "prover_type": self.backend.prover_type,
+                    "lease_token": lease_token,
+                    "prover_id": self.prover_id,
+                    "poison": {"phase": str(poison.phase),
+                               "detail": str(poison.detail)},
+                })
+                protocol.recv_msg(sock)
+        except (ConnectionError, OSError, ValueError):
+            pass  # lease expiry is the backstop, as for normal beats
 
     # ------------------------------------------------------------------
     def run_forever(self):

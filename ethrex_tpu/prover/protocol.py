@@ -38,6 +38,18 @@ from ..utils import faults
 # (docs/OBSERVABILITY.md "Distributed tracing").  The heartbeat copy is
 # cumulative — a prover that dies mid-prove still leaves its partial
 # subtree from the last beat; the coordinator deduplicates by span ID.
+# Heartbeat MAY further carry the prover runtime's advisory state
+# (docs/PROVER_RESILIENCE.md "Runtime failures"): `phase` (the job-
+# qualified in-flight phase, e.g. "state_proof.quotient") and
+# `phase_started` (the prover's wall clock) — the coordinator re-anchors
+# its hedging deadline on every observed phase TRANSITION using its own
+# clock, so a proof making phase progress is never hedged as a
+# straggler; `degraded` ({from, to} mesh labels) — the degradation
+# ladder demoted this prover, the scheduler steers heavy batches away
+# until restart; and `poison` ({phase, detail}) — the batch produced
+# non-finite/out-of-field outputs in the named phase, the coordinator
+# quarantines it immediately (token-gated like every lease mutation)
+# instead of burning its failure budget on doomed retries.
 INPUT_REQUEST = "InputRequest"          # {commit_hash, prover_type
 #                                          [, prover_id] [, warm]}
 INPUT_RESPONSE = "InputResponse"        # {batch_id, input, format,
@@ -53,7 +65,9 @@ ERROR = "Error"                         # {message}
 # assignment instead of relying on one fixed coordinator-side timeout
 HEARTBEAT = "Heartbeat"                 # {batch_id, prover_type,
 #                                          lease_token [, prover_id]
-#                                          [, spans]}
+#                                          [, spans] [, phase]
+#                                          [, phase_started] [, degraded]
+#                                          [, poison]}
 HEARTBEAT_ACK = "HeartbeatAck"          # {batch_id, ok}
 
 # proof formats (reference: ProofFormat — Compressed STARK vs Groth16 wrap)

@@ -87,8 +87,10 @@ from ..ops import babybear as bb
 from ..stark import prover as stark_prover
 from ..stark import verifier as stark_verifier
 from ..stark.prover import StarkParams
-from ..utils import tracing
+from ..utils import faults, tracing
+from . import checkpoint as ckpt_mod
 from . import protocol
+from . import runtime_errors as rt
 from .backend import ProverBackend
 
 PARAMS = StarkParams(log_blowup=3, num_queries=40, log_final_size=4)
@@ -421,10 +423,16 @@ def _run_proof_jobs(jobs: list, mesh) -> dict:
     except Exception:
         pass
 
+    ckpt_ctx = ckpt_mod.current_context()
+
     def _run_one(name, group, build, job_mesh):
         stage = name if group == "vm_circuits" else group
-        with tracing.span(f"prove.{name}", stage=stage):
-            return build(job_mesh)
+        # the job name scopes this job's phase checkpoints; activate()
+        # also re-binds the batch context on pool worker threads
+        # (threading.local does not cross ThreadPoolExecutor)
+        with ckpt_mod.activate(ckpt_ctx, job=name):
+            with tracing.span(f"prove.{name}", stage=stage):
+                return build(job_mesh)
 
     results: dict = {}
     vm_jobs = [j for j in jobs if j[1] == "vm_circuits"]
@@ -542,24 +550,52 @@ class TpuBackend(ProverBackend):
         from ..models import token_air as tka
         from ..models import transfer_air as ta
 
+        # -- execute phase, checkpointed.  The envelope stores the
+        # execution artifacts (output bytes, coarse write log, receipts)
+        # so a restarted prover skips the EVM re-execution; the VM-batch
+        # classification below is cheap host work recomputed either way.
+        ckpt_ctx = ckpt_mod.current_context()
+        exe_parts = {"kind": "proof_ckpt", "job": "backend",
+                     "phase": "execute", "format": proof_format}
         blocks_log: list = []
         receipts: list = []
-        with tracing.span("prove.execute", stage="execute"):
-            output = execution_program(program_input,
-                                       write_log=blocks_log,
-                                       receipts_out=receipts)
-            encoded = output.encode()
+        exe_pay = (ckpt_mod.load(ckpt_ctx.batch_id, exe_parts)
+                   if ckpt_ctx is not None else None)
+        if exe_pay is not None:
+            rt.note_resume("execute")
+            with tracing.span("prove.execute", stage="execute",
+                              resumed=True):
+                encoded = exe_pay["encoded"]
+                blocks_log = exe_pay["blocks_log"]
+                receipts = exe_pay["receipts"]
+                initial_root = exe_pay["initial_root"]
+        else:
+            with tracing.span("prove.execute", stage="execute"):
+                output = rt.guard_phase(
+                    "execute", "-",
+                    lambda: execution_program(program_input,
+                                              write_log=blocks_log,
+                                              receipts_out=receipts))
+                encoded = output.encode()
+                initial_root = output.initial_state_root
+            if ckpt_ctx is not None:
+                ckpt_mod.store(ckpt_ctx.batch_id, exe_parts,
+                               {"encoded": encoded,
+                                "blocks_log": blocks_log,
+                                "receipts": receipts,
+                                "initial_root": initial_root},
+                               meta={"lease_token": ckpt_ctx.lease_token})
+            faults.inject("backend.phase", None, kinds=("drop",))
 
-            vm_batch = None
-            try:
-                oracles = WitnessOracles(program_input.witness,
-                                         output.initial_state_root)
-                vm_batch = tl_mod.build_vm_batch(program_input.blocks,
-                                                 blocks_log, receipts,
-                                                 oracles=oracles)
-                blocks_log = vm_batch.blocks_log
-            except tl_mod.NotTransferBatch:
-                pass
+        vm_batch = None
+        try:
+            oracles = WitnessOracles(program_input.witness, initial_root)
+            vm_batch = tl_mod.build_vm_batch(program_input.blocks,
+                                             blocks_log, receipts,
+                                             oracles=oracles)
+            blocks_log = vm_batch.blocks_log
+        except tl_mod.NotTransferBatch:
+            pass
 
         # -- independent STARK jobs: state_proof + the VM-mode circuits.
         # Each job is (name, stage, builder) where builder(mesh) generates
@@ -646,7 +682,8 @@ class TpuBackend(ProverBackend):
                          for i in range(len(bc_airs))]
         digest = pub[16:24]
 
-        with tracing.span("prove.binding", stage="binding"):
+        with tracing.span("prove.binding", stage="binding"), \
+                ckpt_mod.job_scope("binding"):
             limbs = binding_limbs(encoded, r_pre, r_post, digest, vm_pub,
                                   tok_pub, bc_pubs)
             bind_air = pair.Poseidon2SpongeAir(num_chunks=len(limbs) // 8)
@@ -688,7 +725,8 @@ class TpuBackend(ProverBackend):
                 proofs.append(tok_proof)
             airs.extend(bc_airs)
             proofs.extend(bc_proofs)
-            with tracing.span("prove.aggregate", stage="aggregate"):
+            with tracing.span("prove.aggregate", stage="aggregate"), \
+                    ckpt_mod.job_scope("aggregate"):
                 agg = agg_mod.aggregate(airs, proofs, PARAMS,
                                         mesh=self.mesh)
             proof["state_proof"], proof["proof"] = agg.inners[:2]

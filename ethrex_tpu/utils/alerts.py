@@ -281,6 +281,23 @@ def default_rules(node=None) -> list:
            window=600.0, for_count=3, resolve_count=3,
            description="Batch proof p95 over 10m exceeds 120s",
            runbook="Inspect prover_stage_seconds for the regressing stage."),
+        # prover runtime degradation — the mesh ladder demoting provers
+        # (OOM / device loss) trades throughput for liveness; any
+        # sustained rate means the fleet is running under capacity
+        mk("prover_runtime_degraded:page", "page",
+           rate_signal("prover_mesh_degradations_count", window=60.0),
+           0.1, window=60.0, for_count=2, resolve_count=3,
+           description="Mesh degradations above 0.1/s over 1m",
+           runbook="Provers are repeatedly OOMing or losing devices and "
+                   "falling down the ladder; see docs/PROVER_RESILIENCE.md "
+                   "'Runtime failures' and ethrex_health l2.prover.runtime."),
+        mk("prover_runtime_degraded:warn", "warn",
+           rate_signal("prover_mesh_degradations_count", window=600.0),
+           0.002, window=600.0, for_count=2, resolve_count=3,
+           description="Any mesh degradation in the last 10m",
+           runbook="A prover demoted its mesh; check "
+                   "prover_oom_retries_total vs the memory gate headroom "
+                   "(ETHREX_MEM_GATE_HEADROOM, docs/PROVER_RESILIENCE.md)."),
         # prover lease-loss / reassignment rate
         mk("prover_reassignment_rate:page", "page",
            rate_signal("proof_reassignments_total", window=60.0), 0.2,

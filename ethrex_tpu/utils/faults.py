@@ -12,6 +12,25 @@ and docs/L1_SETTLEMENT_RESILIENCE.md):
     proto.send              protocol.send_msg, after framing
     proto.recv              protocol.recv_msg / recv_msg_file, after read
     backend.prove           ProverClient around backend.prove
+    backend.phase           the stark prover around EVERY device phase
+                            (execute / commit / quotient / open / fri /
+                            binding legs).  error+delay fire on entry
+                            (a crashing or slow kernel — an exception
+                            that classifies as oom/device_lost walks
+                            the degradation ladder, see
+                            prover/runtime_errors); corrupt mangles
+                            the phase's host-visible artifacts (a
+                            non-finite / out-of-field value ->
+                            nan_poison quarantine); drop fires at the
+                            phase BOUNDARY, after the checkpoint
+                            store — a preemption between phases, the
+                            kill-at-every-boundary drill's kill point
+    device.lost             fired on entry to every device phase,
+                            dedicated to device/slice-loss simulation:
+                            an error rule here (the raised message
+                            names the site) classifies as device_lost
+                            and the failed phase retries down the
+                            degradation ladder
     coordinator.store_proof ProofCoordinator before rollup.store_proof
     l1.commit               sequencer around L1Client.commit_batch; fires
                             on BOTH legs — before the call (request lost)
@@ -89,6 +108,8 @@ SITES = frozenset({
     "proto.send",
     "proto.recv",
     "backend.prove",
+    "backend.phase",
+    "device.lost",
     "coordinator.store_proof",
     "l1.commit",
     "l1.verify",

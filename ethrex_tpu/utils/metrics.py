@@ -452,6 +452,42 @@ def record_phase_compile(air: str, kernel: str, seconds: float,
                   "from the on-disk executable cache)")
 
 
+def record_phase_resume(phase: str):
+    METRICS.inc("prover_phase_resumes_total", 1,
+                "Completed prove phases skipped on restart: loaded from "
+                "an on-disk phase checkpoint instead of re-proven")
+    METRICS.inc_labeled("prover_phase_resumes_by_phase", {"phase": phase},
+                        1, help_text="Checkpoint-resumed prove phases by "
+                        "phase name (which phase a restarted prover "
+                        "picked up from)")
+
+
+def record_oom_retry(phase: str):
+    METRICS.inc("prover_oom_retries_total", 1,
+                "Prove phases retried after a transient runtime failure "
+                "(XLA RESOURCE_EXHAUSTED or device loss) via the "
+                "degraded-mesh fallback ladder")
+
+
+def record_mesh_degradation(frm: str, to: str):
+    METRICS.inc_labeled("prover_mesh_degradations_total",
+                        {"from": frm, "to": to}, 1,
+                        help_text="Mesh-layout downgrades by from/to "
+                        "shape: the fallback ladder or the pre-prove "
+                        "memory gate moved a prove to a smaller layout")
+    METRICS.inc("prover_mesh_degradations_count", 1,
+                "Mesh-layout downgrades (unlabelled companion of "
+                "prover_mesh_degradations_total, feeds the "
+                "prover_runtime_degraded alert rate)")
+
+
+def record_nan_poison(phase: str):
+    METRICS.inc("prover_nan_poison_total", 1,
+                "Prove phases whose outputs were non-finite or out of "
+                "field: the batch is quarantined immediately, never "
+                "retried")
+
+
 def record_mesh_devices(n: int):
     METRICS.set("prover_mesh_devices", float(n),
                 help_text="Devices in the prover backend's JAX mesh "

@@ -256,6 +256,55 @@ def _aggregation_lines(snap: dict, width: int) -> list[str]:
     return lines
 
 
+def _runtime_lines(snap: dict, width: int) -> list[str]:
+    """Prover-runtime resilience panel: checkpoint resume traffic, the
+    degradation ladder's retry counters, and which phase each live lease
+    is in (ethrex_health `l2.prover.runtime`,
+    docs/PROVER_RESILIENCE.md).  Defensive like the other panels — a
+    node without the section simply has no panel."""
+    health = snap.get("health")
+    l2 = health.get("l2") if isinstance(health, dict) else None
+    prover = l2.get("prover") if isinstance(l2, dict) else None
+    run = prover.get("runtime") if isinstance(prover, dict) else None
+    if not isinstance(run, dict):
+        return []
+    lines = [
+        "─" * width,
+        f" prover runtime  resumes {run.get('phaseResumes', '?'):<5}"
+        f" oom retries {run.get('oomRetries', '?'):<4}"
+        f" dev lost {run.get('deviceLostRetries', '?'):<4}"
+        f" degraded {run.get('degradations', '?'):<4}"
+        f" nan {run.get('nanPoisons', '?'):<3}"
+        f" gate shrinks {run.get('memoryGateShrinks', '?')}",
+    ]
+    ckpt = run.get("checkpoints")
+    if isinstance(ckpt, dict):
+        lines.append(
+            f"   checkpoints {'on' if ckpt.get('enabled') else 'OFF':<4}"
+            f" stores {ckpt.get('stores', '?'):<6}"
+            f" loads {ckpt.get('loads', '?'):<6}"
+            f" discards {ckpt.get('discards', '?'):<5}"
+            f" batches {ckpt.get('batches', '?')}")
+    last = run.get("lastDegradation")
+    if isinstance(last, dict):
+        lines.append(f"   last degradation  {last.get('from', '?')}"
+                     f" -> {last.get('to', '?')}"
+                     f"  ({last.get('reason', '?')})")
+    degraded = run.get("degradedProvers")
+    if isinstance(degraded, dict) and degraded:
+        lines.append("   degraded provers  " + "  ".join(
+            f"{str(pid)[:16]}({d.get('from', '?')}->{d.get('to', '?')})"
+            for pid, d in sorted(degraded.items())[:4]
+            if isinstance(d, dict)))
+    phases = run.get("livePhases")
+    if isinstance(phases, list) and phases:
+        lines.append("   in flight  " + "  ".join(
+            f"#{p.get('batch', '?')}/{p.get('proverType', '?')}"
+            f" {p.get('phase', '?')}"
+            for p in phases[:4] if isinstance(p, dict)))
+    return lines
+
+
 _SNAP_PHASES = {0: "idle", 1: "accounts", 2: "healing", 3: "done"}
 
 
@@ -485,6 +534,7 @@ def render_lines(snap: dict, width: int = 100) -> list[str]:
     lines.extend(_traffic_lines(snap, width))
     lines.extend(_p2p_lines(snap, width))
     lines.extend(_aggregation_lines(snap, width))
+    lines.extend(_runtime_lines(snap, width))
     lines.extend(_alerts_lines(snap, width))
     lines.extend(_perf_lines(snap, width))
     lines.extend(_latency_lines(snap, width))

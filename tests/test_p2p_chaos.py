@@ -599,15 +599,30 @@ def test_torn_checkpoint_blob_falls_back_to_fresh_sync():
 # full-stack soak: snap-sync under faults while the node serves RPC load
 
 @pytest.mark.slow
-def test_p2p_soak_sync_under_faults_while_serving_rpc(monkeypatch):
+def test_p2p_soak_sync_under_faults_while_serving_rpc(monkeypatch,
+                                                      tmp_path):
     import os
 
+    import numpy as np
+
+    from ethrex_tpu.l2.l1_client import InMemoryL1
+    from ethrex_tpu.l2.sequencer import Sequencer, SequencerConfig
+    from ethrex_tpu.models import merkle_air as mair
+    from ethrex_tpu.ops import babybear as bb
+    from ethrex_tpu.ops.merkle import fold_path_canonical
     from ethrex_tpu.perf.loadgen import Harness
+    from ethrex_tpu.prover import protocol
+    from ethrex_tpu.prover import runtime_errors as rt
+    from ethrex_tpu.prover.client import ProverClient
     from ethrex_tpu.rpc.server import RpcServer
+    from ethrex_tpu.stark import prover as stark_prover
+    from ethrex_tpu.stark.prover import StarkParams
+    from ethrex_tpu.utils.tracing import TRACER, critical_path
 
     baseline_threads = threading.active_count()
     baseline_fds = len(os.listdir("/proc/self/fd"))
     _small_windows(monkeypatch)
+    monkeypatch.setenv("ETHREX_PROOF_CKPT_DIR", str(tmp_path / "ckpt"))
     server_a = _chain(Node(Genesis.from_json(GENESIS)))
     server_b = _chain(Node(Genesis.from_json(GENESIS)))
     client = Node(Genesis.from_json(GENESIS))
@@ -615,6 +630,69 @@ def test_p2p_soak_sync_under_faults_while_serving_rpc(monkeypatch):
     srv_b = P2PServer(server_b).start()
     srv_c = P2PServer(client, timeout=2.0, retries=3).start()
     rpc = RpcServer(client, port=0).start()
+
+    # a live prover fleet rides along: one committed L2 batch, one
+    # prover whose backend runs a real (small) STARK prove under phase
+    # checkpoints — a mid-prove preemption must recover by RESUMING,
+    # and the merged batch trace must attribute the recovery
+    l2_node = Node(Genesis.from_json(GENESIS))
+    seq = Sequencer(l2_node, InMemoryL1([protocol.PROVER_TPU]),
+                    SequencerConfig(
+                        needed_prover_types=(protocol.PROVER_TPU,),
+                        prover_lease_timeout=0.3))
+    seq.coordinator.verify_submissions = False   # stub STARK payload
+    seq.coordinator.start()
+    l2_node.submit_transaction(Transaction(
+        tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=0,
+        max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+        gas_limit=21000, to=bytes([0x77]) * 20, value=5).sign(SECRET))
+    seq.produce_block()
+    assert seq.commit_next_batch() is not None
+
+    rng = np.random.default_rng(23)
+    depth = 3
+    leaf = [int(v) for v in rng.integers(0, bb.P, 8)]
+    siblings = [[int(v) for v in rng.integers(0, bb.P, 8)]
+                for _ in range(depth)]
+    index = int(rng.integers(0, 1 << depth))
+    bits = [(index >> j) & 1 for j in range(depth)]
+    root2 = fold_path_canonical(index, leaf, siblings)
+    air = mair.Poseidon2MerkleAir(depth)
+    mtrace = mair.generate_merkle_trace(leaf, siblings, bits)
+    mpub = mair.merkle_public_inputs(leaf, root2)
+    sparams = StarkParams(log_blowup=3, num_queries=12, log_final_size=4)
+
+    class CkptStarkBackend:
+        """A prover whose device work is the real phase-checkpointed
+        STARK pipeline (the L2 plumbing around it is stubbed)."""
+
+        prover_type = protocol.PROVER_TPU
+
+        def prove(self, program_input, proof_format):
+            stark = stark_prover.prove(air, mtrace, mpub, sparams)
+            return {"backend": protocol.PROVER_TPU,
+                    "stark": {"fri_roots": len(stark["fri"]["roots"])},
+                    "output": "0x" + "00" * 176}
+
+    resumes_before = rt.STATS["phase_resumes"]
+    prover_done = {}
+
+    def run_prover():
+        try:
+            pc = ProverClient(CkptStarkBackend(),
+                              [("127.0.0.1", seq.coordinator.port)],
+                              heartbeat_interval=0.1, backoff_base=0.01,
+                              rng_seed=9)
+            deadline = time.time() + 90.0
+            while time.time() < deadline and \
+                    seq.rollup.get_proof(1, protocol.PROVER_TPU) is None:
+                pc.poll_once()
+                time.sleep(0.05)
+            prover_done["proved"] = seq.rollup.get_proof(
+                1, protocol.PROVER_TPU) is not None
+        except Exception as e:  # noqa: BLE001 — surfaced by asserts
+            prover_done["error"] = e
+
     try:
         p1 = srv_c.dial(srv_a.host, srv_a.port, srv_a.pub)
         p2 = srv_c.dial(srv_b.host, srv_b.port, srv_b.pub)
@@ -631,19 +709,26 @@ def test_p2p_soak_sync_under_faults_while_serving_rpc(monkeypatch):
             except Exception as e:  # noqa: BLE001 — surfaced by asserts
                 result["error"] = e
 
+        # the extra "backend.phase" drop (p=1, its own budget) preempts
+        # the prover at its first phase boundary without disturbing the
+        # seeded p2p schedule (p<1 rules alone consume RNG draws)
         plan = (FaultPlan(seed=11)
                 .delay("net.recv", 0.002, p=0.3)
                 .drop("peer.request", p=0.1, times=5)
                 .drop("net.send", times=2, after=4)
-                .corrupt("snap.serve", times=1, after=2))
+                .corrupt("snap.serve", times=1, after=2)
+                .drop("backend.phase", times=1))
         with injected(plan):
             t = threading.Thread(target=run_sync, daemon=True)
             t.start()
+            tp = threading.Thread(target=run_prover, daemon=True)
+            tp.start()
             # the front door keeps answering while the sync churns
             harness = Harness(f"http://127.0.0.1:{rpc.port}",
                               payload="ping", workers=4, timeout=5.0)
             rep = harness.run(20.0, duration=2.0)
             t.join(120.0)
+            tp.join(120.0)
         assert not t.is_alive(), "soak sync wedged"
         assert "error" not in result, result.get("error")
         assert result["summary"]["phase"] == "done"
@@ -651,7 +736,28 @@ def test_p2p_soak_sync_under_faults_while_serving_rpc(monkeypatch):
         assert _state_matches(client, server_a, root) >= 42
         assert rep["delivered"] > 0
         assert rep["errors"] == 0, "RPC served errors during the soak"
+        # the preempted prover recovered by RESUMING, not re-proving
+        assert not tp.is_alive(), "soak prover wedged"
+        assert "error" not in prover_done, prover_done.get("error")
+        assert prover_done.get("proved"), "batch never proven in soak"
+        assert rt.STATS["phase_resumes"] > resumes_before
+        # ...and the merged batch trace attributes the recovery: the
+        # resumed phases' spans carry resumed=True under the batch's
+        # one trace
+        tid = seq.coordinator.batch_traces.get(1)
+        assert tid is not None
+        trace = TRACER.get_trace(tid)
+        spans = trace["spans"]
+        assert any(s.get("attrs", {}).get("resumed") for s in spans), \
+            "no resumed-phase spans in the merged batch trace"
+        # ...and the attribution still adds up: every second of the
+        # batch's wall belongs to exactly one component
+        cp = critical_path(trace)
+        assert cp["spanCount"] > 0
+        assert abs(sum(cp["components"].values()) -
+                   cp["wallSeconds"]) < 1e-6
     finally:
+        seq.stop()
         rpc.stop()
         srv_a.stop()
         srv_b.stop()

@@ -183,6 +183,27 @@ def test_p2p_fault_sites_covered_by_p2p_battery():
         f"p2p sites without p2p-battery coverage: {missing}"
 
 
+def test_runtime_fault_sites_covered_by_runtime_battery():
+    """The prover-runtime sites ("backend.phase", "device.lost") are the
+    runtime battery's contract: each must be exercised in
+    tests/test_runtime_chaos.py specifically — a new phase-level fault
+    site cannot land without a checkpoint/ladder drill."""
+    import os
+
+    from ethrex_tpu.utils import faults
+
+    here = os.path.dirname(__file__)
+    with open(os.path.join(here, "test_runtime_chaos.py")) as f:
+        corpus = f.read()
+    runtime_sites = ["backend.phase", "device.lost"]
+    missing = [s for s in runtime_sites if s not in faults.SITES]
+    assert not missing, \
+        f"runtime fault sites missing from faults.SITES: {missing}"
+    missing = [s for s in runtime_sites if f'"{s}"' not in corpus]
+    assert not missing, \
+        f"runtime sites without runtime-battery coverage: {missing}"
+
+
 def test_no_bare_print_in_library_modules():
     """Library diagnostics go through the structured logger
     (utils/tracing.py setup_logging), never bare print().  Terminal
@@ -293,13 +314,14 @@ def test_every_metric_helper_has_help_text():
 
     from ethrex_tpu.blockchain import mempool
     from ethrex_tpu.perf import bench_suite, loadgen, profiler, roofline
+    from ethrex_tpu.prover import checkpoint, runtime_errors
     from ethrex_tpu.utils import exec_cache, metrics, overload
 
     from ethrex_tpu.utils import tracing
 
     offenders = []
     for mod in (metrics, tracing, profiler, roofline, bench_suite, loadgen,
-                mempool, overload, exec_cache):
+                mempool, overload, exec_cache, checkpoint, runtime_errors):
         tree = ast.parse(inspect.getsource(mod))
         for fn in ast.walk(tree):
             if not isinstance(fn, ast.FunctionDef):
