@@ -547,10 +547,20 @@ def run_l2(args) -> int:
         l1 = InMemoryL1(needed_prover_types=list(prover_types))
         print("l2: using in-process dev L1 (pass --l1.url for a real one)")
 
+    ha_role = getattr(args, "ha_role", None)
+    if ha_role and not l1.supports_leases():
+        # refusing beats running unfenced: without the lease cell a
+        # second sequencer could double-commit (docs/SEQUENCER_HA.md)
+        print("--ha-role requires an L1 client with leader-lease support "
+              "(the RPC L1 client has no lease cell yet)", file=sys.stderr)
+        return 1
     cfg = SequencerConfig(
         block_time=args.block_time or 1.0,
         commit_interval=args.commit_interval,
-        needed_prover_types=prover_types)
+        needed_prover_types=prover_types,
+        ha_role=ha_role,
+        leader_lease=getattr(args, "leader_lease", 3.0),
+        ha_node_id=getattr(args, "ha_node_id", None))
     seq = Sequencer(node, l1, cfg, rollup=rollup)
     node.sequencer = seq
 
@@ -566,9 +576,15 @@ def run_l2(args) -> int:
         print(f"resuming from checkpoint: batch {latest} "
               f"(blocks up to {seq.last_batched_block})")
     seq.start()
-    print(f"sequencer running (block time {cfg.block_time}s, commit "
-          f"interval {cfg.commit_interval}s, proof coordinator on port "
-          f"{seq.coordinator.port})")
+    if seq.leadership is not None:
+        print(f"sequencer in HA mode as {cfg.ha_role} "
+              f"(lease ttl {cfg.leader_lease}s, node id "
+              f"{seq.leadership.node_id}); actors parked until the "
+              f"leader lease is won — watch ethrex_ready")
+    else:
+        print(f"sequencer running (block time {cfg.block_time}s, commit "
+              f"interval {cfg.commit_interval}s, proof coordinator on port "
+              f"{seq.coordinator.port})")
 
     clients = []
     if args.l2_run_prover:
@@ -667,6 +683,20 @@ def main(argv=None):
     p_l2.add_argument("--run-prover", dest="l2_run_prover",
                       action="store_true",
                       help="also run in-process prover client(s)")
+    p_l2.add_argument("--ha-role", dest="ha_role",
+                      choices=("leader", "follower"),
+                      default=_env("HA_ROLE"),
+                      help="run HA leader election against the L1 lease "
+                           "cell: 'leader' bids immediately, 'follower' "
+                           "starts as a hot standby (docs/SEQUENCER_HA.md)")
+    p_l2.add_argument("--leader-lease", dest="leader_lease", type=float,
+                      default=float(_env("HA_LEASE", "3.0")),
+                      help="leader lease TTL in seconds (renewal runs at "
+                           "ttl/3; failover completes within one TTL)")
+    p_l2.add_argument("--ha-node-id", dest="ha_node_id",
+                      default=_env("HA_NODE_ID"),
+                      help="stable node identity for the lease cell "
+                           "(default: derived from role + process)")
     p_repl = sub.add_parser(
         "repl", help="interactive JSON-RPC shell against a running node")
     p_repl.add_argument("--url", default=_env("RPC_URL",

@@ -86,10 +86,14 @@ class ProofAggregator:
                  commit_hash: str = protocol.PROTOCOL_VERSION,
                  min_batches: int = 2, max_batches: int = 16,
                  params=None, outer_params=None,
-                 audit_aggregate: bool = True):
+                 audit_aggregate: bool = True, epoch_source=None):
         self.rollup = rollup
         self.l1 = l1
         self.coordinator = coordinator
+        # sequencer HA: callable returning the leader's fencing epoch
+        # (None = unfenced single-sequencer mode); stamped on the
+        # aggregated settlement tx so a deposed leader cannot settle
+        self.epoch_source = epoch_source or (lambda: None)
         self.needed = list(needed_types or [protocol.PROVER_TPU])
         self.commit_hash = commit_hash
         self.min_batches = max(1, min_batches)
@@ -260,7 +264,8 @@ class ProofAggregator:
         # window is classified (settled vs lost) on the next startup
         self.rollup.set_meta(INFLIGHT_META_KEY,
                              {"first": first, "last": last})
-        self.l1.verify_batches_aggregated(first, last, wire)
+        self.l1.verify_batches_aggregated(first, last, wire,
+                                          epoch=self.epoch_source())
         count = last - first + 1
         for n in range(first, last + 1):
             trace = self.coordinator.batch_traces.get(n) \

@@ -24,12 +24,20 @@ class FetchError(Exception):
 class BlockFetcher:
     """Import committed batches from L1 into a local node."""
 
-    def __init__(self, node, l1, rollup=None):
+    def __init__(self, node, l1, rollup=None, unhealthy_after: int = 5):
         self.node = node
         self.l1 = l1
         self.rollup = rollup
         self.next_batch = 1
         self.fatal: FetchError | None = None
+        # transient-failure accounting: a follower that silently stops
+        # following is a stale hot standby (docs/SEQUENCER_HA.md), so
+        # healthy() flips after `unhealthy_after` CONSECUTIVE failures
+        self.unhealthy_after = unhealthy_after
+        self.fetch_errors = 0
+        self.consecutive_failures = 0
+        self.last_error: str | None = None
+        self.batches_imported = 0
         self._stop = threading.Event()
         self._thread = None
 
@@ -75,12 +83,23 @@ class BlockFetcher:
                 self.rollup.store_blobs_bundle(number, bundle)
             self.next_batch += 1
             imported += 1
+            self.batches_imported += 1
+        self.consecutive_failures = 0
+        self.last_error = None
         return imported
 
     def healthy(self) -> bool:
-        return self.fatal is None
+        """False on a fatal divergence OR when transient fetch failures
+        have run uninterrupted past the unhealthy_after threshold — a
+        standby this stale must not win a promotion race unchecked."""
+        if self.fatal is not None:
+            return False
+        return self.consecutive_failures < self.unhealthy_after
 
     def start(self, interval: float = 1.0):
+        if self._thread is not None and self._thread.is_alive():
+            return  # already fetching
+
         def loop():
             while not self._stop.wait(interval):
                 try:
@@ -93,13 +112,24 @@ class BlockFetcher:
                     self.fatal = exc
                     self._stop.set()
                     return
-                except Exception:
-                    continue  # transient L1 errors: retry next tick
+                except Exception as exc:
+                    # transient L1 errors: retry next tick, but count —
+                    # an unbroken run of these flips healthy()
+                    self.fetch_errors += 1
+                    self.consecutive_failures += 1
+                    self.last_error = f"{type(exc).__name__}: {exc}"
+                    continue
 
+        # restart-after-stop: a stopped fetcher (promotion demoted back
+        # to follower) resumes from next_batch with a fresh stop event
+        self._stop = threading.Event()
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()
 
     def stop(self):
+        """Idempotent: safe to call repeatedly and before start()."""
         self._stop.set()
-        if self._thread:
-            self._thread.join(timeout=5)
+        thread = self._thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=5)
+        self._thread = None

@@ -13,8 +13,10 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 
 from ..crypto.keccak import keccak256
+from .leadership import FencedError, LeaseState
 
 
 class L1Error(Exception):
@@ -56,15 +58,18 @@ class L1Client:
     def commit_batch(self, number: int, new_state_root: bytes,
                      commitment: bytes,
                      privileged_tx_hashes: list[bytes] = (),
-                     messages_root: bytes = b"\x00" * 32) -> bytes:
+                     messages_root: bytes = b"\x00" * 32,
+                     epoch: int | None = None) -> bytes:
         raise NotImplementedError
 
     def verify_batches(self, first: int, last: int,
-                       proofs: dict[str, bytes]) -> bytes:
+                       proofs: dict[str, bytes],
+                       epoch: int | None = None) -> bytes:
         raise NotImplementedError
 
     def verify_batches_aggregated(self, first: int, last: int,
-                                  aggregates: dict[str, bytes]) -> bytes:
+                                  aggregates: dict[str, bytes],
+                                  epoch: int | None = None) -> bytes:
         """Settle a contiguous batch range with ONE aggregated proof per
         prover type instead of one full proof per batch (the recursion
         path, docs/AGGREGATION.md): `aggregates` maps prover type to a
@@ -101,6 +106,35 @@ class L1Client:
         """Current L1 head block number (confirmation-depth anchor)."""
         raise NotImplementedError
 
+    # ---- leader lease cell (sequencer HA, docs/SEQUENCER_HA.md) ----
+    # A compare-and-swap cell holding (holder, epoch, expiry).  Every
+    # successful acquire mints a strictly increasing epoch — the fencing
+    # token that commit/verify transactions carry; the L1 rejects any
+    # write fenced below the highest epoch it has granted.
+    def supports_leases(self) -> bool:
+        """Whether this client exposes the leader-lease cell; HA mode
+        refuses to start against an L1 that cannot fence."""
+        return False
+
+    def acquire_lease(self, node_id: str, ttl: float) -> int | None:
+        """CAS acquire: returns the new epoch, or None while another
+        holder's lease is still live."""
+        raise L1Error("this L1 client does not support leader leases")
+
+    def renew_lease(self, node_id: str, epoch: int, ttl: float) -> bool:
+        """Extend the holder's own live lease; False once the cell has
+        moved on (expired + re-acquired, or released)."""
+        raise L1Error("this L1 client does not support leader leases")
+
+    def release_lease(self, node_id: str, epoch: int) -> bool:
+        """Voluntary release (clean shutdown): expires the lease now so
+        a standby can win without waiting out the ttl."""
+        raise L1Error("this L1 client does not support leader leases")
+
+    def get_lease(self) -> LeaseState | None:
+        """Read-side view of the lease cell (None = never acquired)."""
+        raise L1Error("this L1 client does not support leader leases")
+
 
 class InMemoryL1(L1Client):
     """OnChainProposer/CommonBridge semantics without an actual chain.
@@ -134,6 +168,14 @@ class InMemoryL1(L1Client):
         # aggregated and how many per-batch proofs they amortized away
         self.aggregated_settlements = 0
         self.proofs_settled_aggregated = 0
+        # leader lease cell (sequencer HA).  Deliberately OUTSIDE the
+        # reorg snapshot history: fencing epochs must stay monotonic even
+        # across an L1 reorg — rewinding the cell could re-mint an epoch
+        # and hand two holders the same fencing token.
+        self._lease: dict | None = None
+        self._lease_epoch = 0          # highest epoch ever granted
+        self._lease_clock = time.time  # injectable for deterministic tests
+        self.fenced_writes_total = 0
         self._history: list[tuple[int, dict]] = [(0, self._snapshot())]
 
     # ---- L1 block model ----
@@ -204,11 +246,77 @@ class InMemoryL1(L1Client):
             self.reorgs_total += 1
             return new_head
 
+    # ---- leader lease cell ----
+    def supports_leases(self) -> bool:
+        return True
+
+    def acquire_lease(self, node_id: str, ttl: float) -> int | None:
+        with self.lock:
+            now = self._lease_clock()
+            lease = self._lease
+            if lease is not None and lease["holder"] != node_id \
+                    and lease["expires"] > now:
+                return None    # CAS lost: another holder is still live
+            self._lease_epoch += 1
+            self._lease = {"holder": node_id, "epoch": self._lease_epoch,
+                           "expires": now + ttl}
+            self._mine()
+            return self._lease_epoch
+
+    def renew_lease(self, node_id: str, epoch: int, ttl: float) -> bool:
+        with self.lock:
+            lease = self._lease
+            if lease is None or lease["holder"] != node_id \
+                    or lease["epoch"] != epoch:
+                return False   # the cell moved on: holder is deposed
+            lease["expires"] = self._lease_clock() + ttl
+            return True
+
+    def release_lease(self, node_id: str, epoch: int) -> bool:
+        with self.lock:
+            lease = self._lease
+            if lease is None or lease["holder"] != node_id \
+                    or lease["epoch"] != epoch:
+                return False
+            lease["expires"] = self._lease_clock()
+            self._mine()
+            return True
+
+    def get_lease(self) -> LeaseState | None:
+        with self.lock:
+            if self._lease is None:
+                return None
+            return LeaseState(holder=self._lease["holder"],
+                              epoch=self._lease["epoch"],
+                              expires=self._lease["expires"])
+
+    def expire_lease(self) -> None:
+        """Chaos/test surface: force the current lease to expire NOW —
+        the holder crashed and its renewals stopped, without waiting
+        out the wall-clock ttl."""
+        with self.lock:
+            if self._lease is not None:
+                self._lease["expires"] = self._lease_clock()
+
+    def _check_epoch(self, epoch: int | None):
+        """Fencing discipline (lock held): a write stamped with an epoch
+        below the highest ever granted is a deposed leader's zombie write
+        — reject it.  epoch=None is the non-HA single-sequencer path."""
+        if epoch is None:
+            return
+        if epoch < self._lease_epoch:
+            self.fenced_writes_total += 1
+            raise FencedError(
+                f"write fenced: epoch {epoch} < current lease epoch "
+                f"{self._lease_epoch}", epoch=epoch,
+                current=self._lease_epoch)
+
     # ---- OnChainProposer ----
     def commit_batch(self, number, new_state_root, commitment,
                      privileged_tx_hashes=(),
-                     messages_root=b"\x00" * 32) -> bytes:
+                     messages_root=b"\x00" * 32, epoch=None) -> bytes:
         with self.lock:
+            self._check_epoch(epoch)
             if number != len(self.commitments) + 1:
                 raise L1Error(
                     f"batch {number} out of order "
@@ -258,7 +366,7 @@ class InMemoryL1(L1Client):
             rec = self.commitments.get(number)
             return rec[1] if rec else None
 
-    def verify_batches(self, first, last, proofs) -> bytes:
+    def verify_batches(self, first, last, proofs, epoch=None) -> bytes:
         """proofs: {prover_type: [proof_bytes for each batch first..last]}.
         Each proof's committed ProgramOutput must bind the batch's stored
         state root and messages root (a fabricated commit-time messages
@@ -268,6 +376,7 @@ class InMemoryL1(L1Client):
         from ..guest.execution import ProgramOutput
 
         with self.lock:
+            self._check_epoch(epoch)
             if first != self.verified_up_to + 1:
                 raise L1Error("verification must be contiguous")
             if last > len(self.commitments):
@@ -297,7 +406,8 @@ class InMemoryL1(L1Client):
             return keccak256(b"verify" + first.to_bytes(8, "big")
                              + last.to_bytes(8, "big"))
 
-    def verify_batches_aggregated(self, first, last, aggregates) -> bytes:
+    def verify_batches_aggregated(self, first, last, aggregates,
+                                  epoch=None) -> bytes:
         """aggregates: {prover_type: payload_bytes} — ONE wire payload per
         type for the whole range.  The payload carries a per-batch
         "proofs" list whose entries each commit a ProgramOutput; every
@@ -311,6 +421,7 @@ class InMemoryL1(L1Client):
         from ..guest.execution import ProgramOutput
 
         with self.lock:
+            self._check_epoch(epoch)
             if first != self.verified_up_to + 1:
                 raise L1Error("verification must be contiguous")
             if last > len(self.commitments):
@@ -437,6 +548,11 @@ class PersistentInMemoryL1(InMemoryL1):
                 self.verified_up_to = o["verified_up_to"]
                 self.consumed_deposits = o["consumed_deposits"]
                 self.block_number = o.get("block_number", 0)
+                # the lease cell persists: fencing epochs stay monotonic
+                # across dev-L1 restarts (expiry is wall-clock time)
+                lease = o.get("lease")
+                self._lease = dict(lease) if lease else None
+                self._lease_epoch = o.get("lease_epoch", 0)
                 self.deposits = [
                     Deposit(l1_tx_hash=bytes.fromhex(d["h"]),
                             recipient=bytes.fromhex(d["r"]),
@@ -474,6 +590,8 @@ class PersistentInMemoryL1(InMemoryL1):
             "verified_up_to": self.verified_up_to,
             "consumed_deposits": self.consumed_deposits,
             "block_number": self.block_number,
+            "lease": self._lease,
+            "lease_epoch": self._lease_epoch,
             "deposits": [{"h": d.l1_tx_hash.hex(), "r": d.recipient.hex(),
                           "a": d.amount, "d": d.data.hex(),
                           "g": d.gas_limit, "i": d.index, "b": d.l1_block}
@@ -534,6 +652,24 @@ class PersistentInMemoryL1(InMemoryL1):
 
     def reorg(self, depth: int) -> int:
         out = super().reorg(depth)
+        with self.lock:
+            self._save()
+        return out
+
+    def acquire_lease(self, node_id: str, ttl: float) -> int | None:
+        out = super().acquire_lease(node_id, ttl)
+        with self.lock:
+            self._save()
+        return out
+
+    def renew_lease(self, node_id: str, epoch: int, ttl: float) -> bool:
+        out = super().renew_lease(node_id, epoch, ttl)
+        with self.lock:
+            self._save()
+        return out
+
+    def release_lease(self, node_id: str, epoch: int) -> bool:
+        out = super().release_lease(node_id, epoch)
         with self.lock:
             self._save()
         return out

@@ -227,10 +227,18 @@ class RpcL1Client(L1Client):
         except (RpcError, TransportError) as e:
             raise L1Error(f"L1 view call failed: {e}")
 
+    # ---- leader lease ----
+    def supports_leases(self) -> bool:
+        """The dev contract bytecode carries no lease cell; sequencer HA
+        against a real L1 needs an OnChainProposer with the lease slot
+        (docs/SEQUENCER_HA.md) — until then `--ha-role` refuses this
+        client rather than running unfenced."""
+        return False
+
     # ---- OnChainProposer ----
     def commit_batch(self, number, new_state_root, commitment,
                      privileged_tx_hashes=(),
-                     messages_root=b"\x00" * 32) -> bytes:
+                     messages_root=b"\x00" * 32, epoch=None) -> bytes:
         with self.lock:
             # privileged txs must match the bridge's deposit queue 1:1
             # (client-side mirror of OnChainProposer's digest check)
@@ -265,7 +273,7 @@ class RpcL1Client(L1Client):
             return keccak256(b"commit" + number.to_bytes(8, "big")
                              + commitment)
 
-    def verify_batches(self, first, last, proofs) -> bytes:
+    def verify_batches(self, first, last, proofs, epoch=None) -> bytes:
         import json as _json
 
         from ..guest.execution import ProgramOutput

@@ -27,6 +27,11 @@ from .proposer_evm import (PROPOSER_ADDRESS, SEL_COMMIT, SEL_VERIFY,
 
 OWNER = bytes.fromhex("aa" * 20)
 
+# proposer storage slot mirroring the leader-lease fencing epoch
+# (slots 0-6 belong to the settlement state machine, proposer_evm.py);
+# on a real deployment this is the OnChainProposer's lease cell
+LEASE_EPOCH_SLOT = 7
+
 
 def _word(v) -> bytes:
     if isinstance(v, bytes):
@@ -101,11 +106,26 @@ class EvmL1(InMemoryL1):
     def _slot(self, slot: int) -> int:
         return self.state.get_storage(PROPOSER_ADDRESS, slot)
 
+    # ---- leader lease: epoch mirrored into contract storage -------------
+    def acquire_lease(self, node_id: str, ttl: float) -> int | None:
+        epoch = super().acquire_lease(node_id, ttl)
+        if epoch is not None:
+            with self.lock:
+                self.state.set_storage(PROPOSER_ADDRESS, LEASE_EPOCH_SLOT,
+                                       epoch)
+        return epoch
+
+    def lease_epoch_slot(self) -> int:
+        """The on-contract view of the fencing epoch (test surface)."""
+        with self.lock:
+            return self._slot(LEASE_EPOCH_SLOT)
+
     # ---- OnChainProposer through the bytecode ---------------------------
     def commit_batch(self, number, new_state_root, commitment,
                      privileged_tx_hashes=(),
-                     messages_root=b"\x00" * 32) -> bytes:
+                     messages_root=b"\x00" * 32, epoch=None) -> bytes:
         with self.lock:
+            self._check_epoch(epoch)
             # CommonBridge seat: privileged txs must match the deposit
             # queue (read-only pre-check; python bookkeeping below)
             cursor = self.consumed_deposits
@@ -132,8 +152,9 @@ class EvmL1(InMemoryL1):
             return keccak256(b"commit" + number.to_bytes(8, "big")
                              + commitment)
 
-    def verify_batches(self, first, last, proofs) -> bytes:
+    def verify_batches(self, first, last, proofs, epoch=None) -> bytes:
         with self.lock:
+            self._check_epoch(epoch)
             pending: dict[int, dict] = {}
             for t in self.needed:
                 batch_proofs = proofs.get(t)

@@ -1,0 +1,377 @@
+"""Sequencer leadership: L1-fenced leader leases (docs/SEQUENCER_HA.md).
+
+The design is Chubby's (Burrows, OSDI 2006; PAPERS.md): a single
+coarse-grained lease lives in a compare-and-swap cell on the L1
+(`L1Client.acquire_lease` / `renew_lease` / `release_lease`), and every
+acquisition mints a fresh **epoch** — a monotonically increasing fencing
+token.  Whoever holds the lease is the leader; everything the leader
+writes to shared state (L1 commit/verify transactions, rollup-store
+batch-record write groups) carries its epoch, and both sinks reject
+writes fenced below the highest epoch they have observed with a typed
+:class:`FencedError`.  A zombie leader — paused mid-commit, deposed,
+resumed — therefore cannot corrupt shared state: its delayed write is
+rejected at the sink, it demotes itself, and re-enters candidacy.
+
+Renewal runs on its own daemon thread at ``ttl/3`` with jitter (so two
+standbys never stampede in lock-step); a leader that cannot renew past
+the safety margin steps down *before* its lease can expire under a
+competing candidate.  Promotion is deliberately nothing but the normal
+crash-recovery startup path (Crash-Only Software, Candea & Fox 2003):
+the ``on_promote`` callback runs PR-2 L1 reconciliation + PR-4 journal
+replay and then unparks the actors.
+
+Fault sites (utils/faults.py): ``l1.lease`` fires on both legs of every
+acquire/renew (request lost vs response lost — the second leg leaves the
+lease acquired on L1 while the candidate believes it failed), and
+``seq.fence`` fires at each sequencer-side fence checkpoint.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+from ..utils import faults, metrics
+
+log = logging.getLogger("ethrex_tpu.l2.leadership")
+
+# role strings are part of the ethrex_ready wire format
+ROLE_FOLLOWER = "follower"
+ROLE_CANDIDATE = "candidate"
+ROLE_PROMOTING = "promoting"
+ROLE_LEADER = "leader"
+
+ROLES = (ROLE_FOLLOWER, ROLE_CANDIDATE, ROLE_PROMOTING, ROLE_LEADER)
+
+
+class FencedError(Exception):
+    """A write carried a stale leadership epoch and was refused.
+
+    Raised by the L1 (commit/verify transactions) and by the rollup
+    store (batch-record write groups) when the presented fencing token
+    is below the highest epoch the sink has observed.  The sequencer
+    treats this as "I have been deposed": demote, re-enter candidacy —
+    never retry the write.
+    """
+
+    def __init__(self, message: str, epoch: int | None = None,
+                 current: int | None = None):
+        super().__init__(message)
+        self.epoch = epoch
+        self.current = current
+
+
+@dataclass
+class LeaseState:
+    """One observation of the L1 lease cell (read-side view)."""
+
+    holder: str | None
+    epoch: int
+    expires: float
+
+    def to_json(self) -> dict:
+        return {"holder": self.holder, "epoch": self.epoch,
+                "expires": self.expires}
+
+
+class LeadershipManager:
+    """Drives one node's leadership lifecycle against the L1 lease cell.
+
+    Roles: ``follower`` (parked, not seeking the lease — hot standby
+    before its candidacy delay elapses), ``candidate`` (polling the CAS
+    cell), ``promoting`` (lease won, running the crash-recovery startup
+    path), ``leader`` (renewing at ttl/3).  ``on_promote`` /
+    ``on_demote`` are supplied by the sequencer; exceptions from
+    ``on_promote`` abort the promotion and release the lease so another
+    candidate can win.
+    """
+
+    def __init__(self, l1, node_id: str, ttl: float = 3.0,
+                 on_promote=None, on_demote=None,
+                 safety_margin: float | None = None,
+                 candidacy_delay: float = 0.0,
+                 jitter: float = 0.25, rng_seed: int | None = None,
+                 clock=time.monotonic):
+        if ttl <= 0:
+            raise ValueError("lease ttl must be positive")
+        self.l1 = l1
+        self.node_id = node_id
+        self.ttl = float(ttl)
+        # step down once this much of the ttl has passed without a
+        # successful renewal (default: two missed renewal periods)
+        self.safety_margin = (safety_margin if safety_margin is not None
+                              else 2.0 * self.ttl / 3.0)
+        self.candidacy_delay = float(candidacy_delay)
+        self.jitter = jitter
+        self.on_promote = on_promote
+        self.on_demote = on_demote
+        self.clock = clock
+        self._rng = random.Random(rng_seed)
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._role = ROLE_FOLLOWER
+        self._epoch: int | None = None
+        self._last_renewal: float | None = None
+        self.transitions_total = 0
+        self.fenced_total = 0
+        self.last_error: str | None = None
+        self.promotion_downtime: float | None = None
+        self.promoted_at: float | None = None
+        metrics.record_leadership_role(self._role)
+
+    # ---------------------------------------------------------------- state
+
+    @property
+    def role(self) -> str:
+        return self._role
+
+    @property
+    def epoch(self) -> int | None:
+        """The fencing token to stamp on writes; None while not leader."""
+        with self._lock:
+            return self._epoch if self._role in (ROLE_PROMOTING,
+                                                 ROLE_LEADER) else None
+
+    def is_leader(self) -> bool:
+        return self._role == ROLE_LEADER
+
+    def check(self):
+        """Sequencer-side fence checkpoint: raise FencedError unless this
+        node currently believes it is the (promoting) leader.  The
+        ``seq.fence`` fault site injects deposition exactly here."""
+        faults.inject("seq.fence")
+        with self._lock:
+            if self._role not in (ROLE_PROMOTING, ROLE_LEADER) or \
+                    self._epoch is None:
+                raise FencedError(
+                    f"{self.node_id}: not the leader (role={self._role})",
+                    epoch=self._epoch)
+            return self._epoch
+
+    def status(self) -> dict:
+        """JSON-friendly view for ethrex_ready / health / monitor."""
+        with self._lock:
+            return {
+                "role": self._role,
+                "epoch": self._epoch,
+                "transitions": self.transitions_total,
+                "fenced": self.fenced_total,
+                "leaseTtlSeconds": self.ttl,
+                "promotionDowntimeSeconds": self.promotion_downtime,
+                "lastError": self.last_error,
+            }
+
+    def leaderless(self) -> bool:
+        """True when, from this node's view, nobody holds a live lease.
+        Feeds the sequencer_leaderless alert pair."""
+        if self._role in (ROLE_PROMOTING, ROLE_LEADER):
+            return False
+        try:
+            lease = self.l1.get_lease()
+        except Exception:  # noqa: BLE001 — an unreachable L1 is leaderless
+            return True
+        return lease is None or lease.expires <= self.clock()
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> "LeadershipManager":
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop = threading.Event()
+            self._thread = threading.Thread(
+                target=self._loop, name=f"leadership-{self.node_id}",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0):
+        """Release the lease (if held) and join the lifecycle thread.
+        Idempotent: safe to call repeatedly and before start()."""
+        self._stop.set()
+        thread = self._thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout)
+        with self._lock:
+            epoch = self._epoch
+            was_leader = self._role in (ROLE_PROMOTING, ROLE_LEADER)
+        if was_leader and epoch is not None:
+            try:
+                self.l1.release_lease(self.node_id, epoch)
+            except Exception as e:  # noqa: BLE001 — lease expires anyway
+                log.warning("lease release failed (will expire): %s", e)
+        self._transition(ROLE_FOLLOWER, demote=was_leader)
+
+    def step_down(self, reason: str = "stepped down"):
+        """Voluntary demotion (renewal starvation or a FencedError from a
+        sink): park the actors, drop the epoch, re-enter candidacy."""
+        with self._lock:
+            if self._role not in (ROLE_PROMOTING, ROLE_LEADER):
+                return
+            self.last_error = reason
+        log.warning("%s: stepping down: %s", self.node_id, reason)
+        self._transition(ROLE_CANDIDATE, demote=True)
+
+    def fenced(self, err: FencedError):
+        """A sink rejected our epoch — we are deposed, not failing."""
+        self.fenced_total += 1
+        metrics.record_leadership_fenced()
+        self.step_down(f"fenced: {err}")
+
+    def try_acquire(self) -> bool:
+        """One synchronous candidacy step: attempt the CAS and, on
+        success, run the FULL promotion path before returning.  The
+        chaos battery (and any slow-poll caller) drives failover
+        deterministically through this instead of the timer loop."""
+        with self._lock:
+            if self._role in (ROLE_PROMOTING, ROLE_LEADER):
+                return True
+            if self._role == ROLE_FOLLOWER:
+                pass  # a manual bid skips the candidacy delay
+        self._transition(ROLE_CANDIDATE)
+        try:
+            epoch = self._acquire()
+        except Exception as e:  # noqa: BLE001 — L1 flake: bid again later
+            self.last_error = f"acquire: {e}"
+            return False
+        if epoch is None:
+            return False
+        self._promote(epoch)
+        return self._role == ROLE_LEADER
+
+    # ------------------------------------------------------------- internals
+
+    def _transition(self, role: str, demote: bool = False):
+        with self._lock:
+            prev = self._role
+            if prev == role and not demote:
+                return
+            self._role = role
+            if role not in (ROLE_PROMOTING, ROLE_LEADER):
+                self._epoch = None
+                self._last_renewal = None
+            if prev != role:
+                self.transitions_total += 1
+                metrics.record_leadership_transition(prev, role)
+                metrics.record_leadership_role(role)
+                log.info("%s: %s -> %s", self.node_id, prev, role)
+        if demote and self.on_demote is not None:
+            try:
+                self.on_demote()
+            except Exception:  # noqa: BLE001 — demotion must not wedge
+                log.exception("on_demote callback failed")
+
+    def _acquire(self) -> int | None:
+        """One CAS attempt, with the two-leg l1.lease fault site: leg 1
+        loses the request, leg 2 loses the *response* (the lease is held
+        on L1 but this candidate does not know — it must survive its own
+        orphaned term expiring)."""
+        faults.inject("l1.lease")
+        epoch = self.l1.acquire_lease(self.node_id, self.ttl)
+        faults.inject("l1.lease")
+        return epoch
+
+    def _renew(self, epoch: int) -> bool:
+        faults.inject("l1.lease")
+        ok = self.l1.renew_lease(self.node_id, epoch, self.ttl)
+        faults.inject("l1.lease")
+        return bool(ok)
+
+    def _loop(self):
+        clock = self.clock
+        if self.candidacy_delay > 0:
+            self._stop.wait(self.candidacy_delay)
+        if not self._stop.is_set():
+            self._transition(ROLE_CANDIDATE)
+        while not self._stop.is_set():
+            if self._role == ROLE_CANDIDATE:
+                try:
+                    epoch = self._acquire()
+                except FencedError:
+                    epoch = None
+                except Exception as e:  # noqa: BLE001 — L1 flake: retry
+                    self.last_error = f"acquire: {e}"
+                    epoch = None
+                if epoch is not None:
+                    self._promote(epoch)
+                    if self._role != ROLE_LEADER:
+                        # failed promotion (reconciliation not possible
+                        # yet, or fenced mid-flight): the lease was
+                        # released, but do NOT spin on re-bidding — on a
+                        # real L1 every acquire/release round is a pair
+                        # of transactions.  Wait out a candidacy
+                        # interval; the condition that failed the
+                        # promotion (usually the DA replica lagging the
+                        # committed tip) needs time to clear anyway.
+                        self._stop.wait(self._jittered(self.ttl / 3.0))
+                else:
+                    # poll again before a live lease could expire
+                    self._stop.wait(self._jittered(self.ttl / 3.0))
+            elif self._role == ROLE_LEADER:
+                self._stop.wait(self._jittered(self.ttl / 3.0))
+                if self._stop.is_set() or self._role != ROLE_LEADER:
+                    continue
+                self._renew_or_step_down()
+            else:  # demoted back to follower by an external stop()
+                self._stop.wait(self._jittered(self.ttl / 3.0))
+                if not self._stop.is_set() and self._role == ROLE_FOLLOWER:
+                    self._transition(ROLE_CANDIDATE)
+
+    def _promote(self, epoch: int):
+        with self._lock:
+            self._epoch = epoch
+            self._last_renewal = self.clock()
+        metrics.record_leadership_epoch(epoch)
+        self._transition(ROLE_PROMOTING)
+        t0 = self.clock()
+        try:
+            if self.on_promote is not None:
+                self.on_promote()
+        except FencedError as e:
+            self.fenced(e)
+            return
+        except Exception as e:  # noqa: BLE001 — failed promotion yields
+            log.exception("promotion failed; releasing lease")
+            self.last_error = f"promote: {e}"
+            try:
+                self.l1.release_lease(self.node_id, epoch)
+            except Exception:  # noqa: BLE001 — lease expires anyway
+                pass
+            self._transition(ROLE_CANDIDATE, demote=True)
+            return
+        with self._lock:
+            self.promotion_downtime = self.clock() - t0
+            self.promoted_at = time.time()
+        metrics.record_leadership_promotion(self.promotion_downtime)
+        self._transition(ROLE_LEADER)
+
+    def _renew_or_step_down(self):
+        with self._lock:
+            epoch = self._epoch
+            last = self._last_renewal
+        if epoch is None:
+            return
+        try:
+            ok = self._renew(epoch)
+        except Exception as e:  # noqa: BLE001 — L1 flake counts as a miss
+            self.last_error = f"renew: {e}"
+            ok = False
+        now = self.clock()
+        if ok:
+            with self._lock:
+                self._last_renewal = now
+            return
+        # a single missed renewal is tolerated; past the safety margin
+        # the lease may be expiring under us — step down BEFORE a
+        # competing candidate can win it while we still write
+        if last is not None and (now - last) >= self.safety_margin:
+            self.step_down(
+                f"renewal starved for {now - last:.2f}s "
+                f"(safety margin {self.safety_margin:.2f}s)")
+
+    def _jittered(self, base: float) -> float:
+        return base * (1.0 + self.jitter * self._rng.random())

@@ -978,6 +978,11 @@ class ProofCoordinator:
         if self._server:
             self._server.shutdown()
             self._server.server_close()
+            # allow stop -> start cycles (sequencer HA re-homes the
+            # prover fleet across demote/promote): a later start()
+            # rebinds the SAME port (self.port was pinned at first
+            # bind), so prover endpoint lists stay valid
+            self._server = None
         deadline = time.monotonic() + timeout
         with self._inflight_cv:
             while self._inflight > 0:

@@ -13,6 +13,13 @@ import dataclasses
 import json
 import threading
 
+from .leadership import FencedError
+
+# persisted leadership watermark (sequencer HA, docs/SEQUENCER_HA.md):
+# the highest fencing epoch this store has observed; write groups
+# stamped below it are a deposed leader's zombie writes and are refused
+LEADERSHIP_META_KEY = "leadership"
+
 
 @dataclasses.dataclass
 class Batch:
@@ -137,10 +144,37 @@ class RollupStore:
         with self.lock:
             self._meta[key] = value
 
+    # ---------------- leadership fencing ----------------
+    def leadership_epoch(self) -> int:
+        """Highest fencing epoch this store has observed (0 = never)."""
+        meta = self.get_meta(LEADERSHIP_META_KEY) or {}
+        return int(meta.get("epoch", 0))
+
+    def fence(self, epoch: int):
+        """Raise the persisted leadership watermark (monotonic; a lower
+        epoch never rewinds it).  The promoting leader calls this before
+        resuming actors, so any zombie write stamped with an older epoch
+        is refused from that point on."""
+        with self.lock:
+            if epoch > self.leadership_epoch():
+                self.set_meta(LEADERSHIP_META_KEY, {"epoch": int(epoch)})
+
+    def _check_epoch(self, epoch: int | None):
+        if epoch is None:
+            return
+        current = self.leadership_epoch()
+        if epoch < current:
+            raise FencedError(
+                f"write group fenced: epoch {epoch} < store watermark "
+                f"{current}", epoch=epoch, current=current)
+
     # ---------------- lifecycle ----------------
-    def write_group(self):
+    def write_group(self, epoch: int | None = None):
         """Atomic multi-record write group (batch + blobs + input +
-        settlement flags as one unit); no journal needed in memory."""
+        settlement flags as one unit); no journal needed in memory.
+        `epoch` is the writer's fencing token (sequencer HA) — a stale
+        epoch raises FencedError instead of entering the group."""
+        self._check_epoch(epoch)
         return contextlib.nullcontext(self)
 
     def close(self):
@@ -293,12 +327,14 @@ class PersistentRollupStore(RollupStore):
         self._t_meta[key.encode()] = json.dumps(value).encode()
         self.backend.flush()
 
-    def write_group(self):
+    def write_group(self, epoch: int | None = None):
         """Journaled multi-record commit: the committer's batch-record
         group (store_batch + blobs + prover input + set_committed) lands
         atomically — a crash between the writes reopens to either the
         full record or none of it (startup reconciliation rebuilds the
-        latter from L1; see docs/L1_SETTLEMENT_RESILIENCE.md)."""
+        latter from L1; see docs/L1_SETTLEMENT_RESILIENCE.md).  A stale
+        fencing `epoch` is refused before the journal opens."""
+        self._check_epoch(epoch)
         return self.backend.batch()
 
     def close(self):

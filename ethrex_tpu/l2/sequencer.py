@@ -26,6 +26,7 @@ from ..utils import faults, tracing
 from ..utils.metrics import observe_actor_iteration
 from .eth_client import is_transient
 from .l1_client import L1Client
+from .leadership import FencedError, LeadershipManager
 from .proof_coordinator import ProofCoordinator
 from .rollup_store import Batch, RollupStore
 
@@ -81,6 +82,15 @@ class SequencerConfig:
     aggregation_interval: float = 2.0
     aggregation_min_batches: int = 2
     aggregation_max_batches: int = 16
+    # sequencer HA (docs/SEQUENCER_HA.md): ha_role None keeps the
+    # classic single-sequencer mode (no lease, unfenced writes).
+    # "leader" and "follower" pick the starting posture of an HA pair —
+    # both run the same candidacy loop against the L1 lease cell; the
+    # follower just defers its first bid by one lease ttl so the
+    # configured leader wins the uncontested race
+    ha_role: str | None = None
+    leader_lease: float = 3.0
+    ha_node_id: str | None = None
 
 
 @dataclasses.dataclass
@@ -219,7 +229,35 @@ class Sequencer:
             needed_types=list(self.cfg.needed_prover_types),
             commit_hash=self.cfg.commit_hash,
             min_batches=self.cfg.aggregation_min_batches,
-            max_batches=self.cfg.aggregation_max_batches)
+            max_batches=self.cfg.aggregation_max_batches,
+            epoch_source=self._epoch)
+        # sequencer HA (docs/SEQUENCER_HA.md): the leadership manager
+        # owns the L1 lease; promotion/demotion park and unpark the
+        # actor set through the admin pause surface
+        self.leadership: LeadershipManager | None = None
+        self.promotions_total = 0
+        self.reconciled_at: float | None = time.time()
+        if self.cfg.ha_role:
+            if self.cfg.ha_role not in ("leader", "follower"):
+                raise ValueError(
+                    f"ha_role must be 'leader' or 'follower', "
+                    f"got {self.cfg.ha_role!r}")
+            if not self.l1.supports_leases():
+                raise ValueError(
+                    "sequencer HA requires an L1 client with a leader-"
+                    "lease cell (this one cannot fence a deposed leader)")
+            node_id = self.cfg.ha_node_id or \
+                f"seq-{self.cfg.ha_role}-{id(self):x}"
+            self.leadership = LeadershipManager(
+                self.l1, node_id, ttl=self.cfg.leader_lease,
+                on_promote=self._promote, on_demote=self._demote,
+                candidacy_delay=(0.0 if self.cfg.ha_role == "leader"
+                                 else self.cfg.leader_lease))
+        # terminal-stop guard (idempotent drain; safe in follower mode
+        # where the actor threads were never started)
+        self._stopped = False
+        self._stop_result = True
+        self._stop_guard = threading.Lock()
 
     def _regenerate_chain(self):
         """Re-import committed-batch blocks the chain store lost (crash
@@ -391,7 +429,7 @@ class Sequencer:
         batch = Batch(number=number, first_block=first,
                       last_block=last_block, state_root=art.state_root,
                       commitment=art.commitment, vm_mode=art.vm_mode)
-        with self.rollup.write_group():
+        with self.rollup.write_group(epoch=self._epoch()):
             self.rollup.store_batch(batch)
             self.rollup.store_blobs_bundle(number, art.bundle)
             self.rollup.store_prover_input(number, self.cfg.commit_hash,
@@ -402,6 +440,79 @@ class Sequencer:
         log.warning("rebuilt batch %d (blocks %d..%d) from the canonical "
                     "chain after a commit-crash window", number, first,
                     last_block)
+
+    # ------------------------------------------------------------------
+    # sequencer HA: fencing + promotion/demotion (docs/SEQUENCER_HA.md)
+    # ------------------------------------------------------------------
+    def _epoch(self) -> int | None:
+        """The fencing token stamped on externally-visible writes;
+        None in single-sequencer (non-HA) mode."""
+        leadership = getattr(self, "leadership", None)
+        return leadership.epoch if leadership is not None else None
+
+    def _fence(self) -> int | None:
+        """Fence checkpoint before an externally-visible write: raises
+        FencedError unless this node currently holds the lease (no-op
+        without HA).  The returned epoch is captured ONCE per operation
+        and stamped on every leg — if the lease moves mid-operation the
+        sinks reject the stale token."""
+        leadership = getattr(self, "leadership", None)
+        if leadership is None:
+            faults.inject("seq.fence")
+            return None
+        return leadership.check()
+
+    def _promote(self):
+        """Promotion IS the crash-recovery startup path (Crash-Only
+        Software, PAPERS.md): fence the store at the new epoch, refresh
+        the committer position from the durable checkpoints the follower
+        accumulated while chain-following, run the PR-2 reconciliation
+        (journal replay already happened when the store opened), restart
+        the proof coordinator so the prover fleet re-homes here, then
+        unpark the actors.  At most one uncommitted batch is re-derived
+        — everything settled is adopted, never re-committed."""
+        epoch = self.leadership.epoch
+        if epoch is None:
+            raise FencedError("promotion without a lease epoch")
+        self.rollup.fence(epoch)
+        # the follower's chain advanced via the block fetcher while the
+        # actors were parked: recompute the batch cursor before actors
+        # resume, or the committer would span an already-settled range
+        latest = self.rollup.latest_batch_number()
+        self.last_batched_block = (
+            self.rollup.get_batch(latest).last_block if latest else 0)
+        if self.last_batched_block > self.node.store.latest_number():
+            self._regenerate_chain()
+        self._deposit_cursor = int(self.rollup.get_meta(
+            "deposit_cursor_included", 0))
+        self._last_commit_attempt = None
+        with self._settlement_lock:
+            self._recommit_queue.clear()
+        self._reconcile_with_l1()
+        self.reconciled_at = time.time()
+        # re-home the prover fleet: the coordinator serves assignments
+        # from this node now; prover leases and phase checkpoints
+        # survive the move (docs/PROVER_RESILIENCE.md), so in-flight
+        # proofs resume instead of restarting
+        self.coordinator.start()
+        for name in self.ACTOR_NAMES:
+            self.resume_actor(name)
+        self.promotions_total += 1
+        log.info("promoted to leader at epoch %d", epoch)
+
+    def _demote(self):
+        """Deposed (fenced write, renewal starvation, or clean step-
+        down): park every actor and stop serving the prover fleet.  The
+        process stays alive as a hot standby — caches warm, chain
+        following — and re-enters candidacy through the leadership
+        loop."""
+        for name in self.ACTOR_NAMES:
+            self.pause_actor(name)
+        try:
+            self.coordinator.stop(timeout=2.0)
+        except Exception:  # noqa: BLE001 — may never have started
+            pass
+        log.warning("demoted to follower; actors parked")
 
     # ------------------------------------------------------------------
     # BlockProducer (reference: block_producer.rs produce_block)
@@ -522,13 +633,16 @@ class Sequencer:
 
     def _settle_commit(self, number: int, commitment: bytes,
                        state_root: bytes, privileged_hashes: list,
-                       msgs_root: bytes, bundle) -> None:
+                       msgs_root: bytes, bundle,
+                       epoch: int | None = None) -> None:
         """Idempotent L1 commit: if the L1 already holds batch `number`
         with OUR commitment (a retry after the commit tx landed but the
         acknowledgment was lost), adopt it as success; a different
         commitment is a divergence and fails fast.  The l1.commit fault
         site fires on both legs — before the call (request lost) and
-        after it returns (response lost)."""
+        after it returns (response lost).  `epoch` is the caller's
+        fencing token (sequencer HA): the L1 rejects it when stale, so
+        a deposed leader's delayed commit can never land."""
         faults.inject("l1.commit")
         if self.l1.last_committed_batch() >= number:
             onchain = self.l1.get_committed_commitment(number)
@@ -548,7 +662,8 @@ class Sequencer:
                         "commitment; adopting it as success", number)
         else:
             self.l1.commit_batch(number, state_root, commitment,
-                                 privileged_hashes, msgs_root)
+                                 privileged_hashes, msgs_root,
+                                 epoch=epoch)
             faults.inject("l1.commit")
         try:
             # publish the DA sidecar alongside the commitment (the commit
@@ -561,6 +676,10 @@ class Sequencer:
             pass
 
     def commit_next_batch(self) -> Batch | None:
+        # the fencing token for this WHOLE commit is captured once, up
+        # front: if leadership moves mid-commit, the L1 and the store
+        # reject the stale token on their own legs (zombie protection)
+        epoch = self._fence()
         with self._settlement_lock:
             if self._recommit_queue:
                 # reorged-out commitments take priority over new batches
@@ -598,15 +717,17 @@ class Sequencer:
         self._last_commit_attempt = (number, first, art)
         self._settle_commit(number, art.commitment, art.state_root,
                             art.privileged_hashes, art.msgs_root,
-                            art.bundle)
+                            art.bundle, epoch=epoch)
         batch = Batch(number=number, first_block=first,
                       last_block=head, state_root=art.state_root,
                       commitment=art.commitment, vm_mode=art.vm_mode)
         # the local batch record is one journaled unit: a crash between
         # these writes reopens to either the full record or none (and the
         # none case is exactly the commit-crash window reconciliation
-        # already rebuilds from L1)
-        with self.rollup.write_group():
+        # already rebuilds from L1); the group carries the same fencing
+        # token as the L1 leg, so a leader deposed inside the commit
+        # crash-window cannot write a record the new leader won't own
+        with self.rollup.write_group(epoch=epoch):
             self.rollup.store_batch(batch)
             self.rollup.store_blobs_bundle(number, art.bundle)
             self.rollup.store_prover_input(number, self.cfg.commit_hash,
@@ -646,7 +767,8 @@ class Sequencer:
             return None
         msgs_root = message_root(collect_messages(blocks, receipts))
         self._settle_commit(number, batch.commitment, batch.state_root,
-                            privileged_hashes, msgs_root, bundle)
+                            privileged_hashes, msgs_root, bundle,
+                            epoch=self._epoch())
         self.rollup.set_settlement(number, committed=True)
         with self._settlement_lock:
             self._recommit_queue.discard(number)
@@ -754,8 +876,9 @@ class Sequencer:
                 get_backend(slot_type(n, t)).to_proof_bytes(
                     self.rollup.get_proof(n, slot_type(n, t)))
                 for n in range(first, last + 1)]
+        epoch = self._fence()
         faults.inject("l1.verify")
-        self.l1.verify_batches(first, last, proofs)
+        self.l1.verify_batches(first, last, proofs, epoch=epoch)
         faults.inject("l1.verify")
         for n in range(first, last + 1):
             with tracing.trace_context(
@@ -825,6 +948,9 @@ class Sequencer:
         that regressed last_committed/verified drops the affected flags
         through the write-through setters and queues the batches for
         re-commit, so the committer re-settles them verbatim."""
+        # fence before touching settlement flags: a deposed leader's
+        # state updater must not adopt/rollback flags the new leader owns
+        self._fence()
         committed = self.l1.last_committed_batch()
         verified = self.l1.last_verified_batch()
         with self._settlement_lock:
@@ -871,7 +997,16 @@ class Sequencer:
 
     # ------------------------------------------------------------------
     def start(self):
-        self.coordinator.start()
+        if self.leadership is None:
+            self.coordinator.start()
+        else:
+            # HA mode: actor threads spin up PARKED (follower posture);
+            # the coordinator stays down so this node's rollup view
+            # cannot hand the prover fleet duplicate work.  Promotion —
+            # driven by the leadership manager winning the lease —
+            # starts the coordinator and unparks the actors
+            for name in self.ACTOR_NAMES:
+                self.pause_actor(name)
         self.started_at = time.time()
 
         def loop(interval, fn):
@@ -902,6 +1037,17 @@ class Sequencer:
                         st.consecutive_failures = 0
                         st.consecutive_transient = 0
                         st.last_success = time.time()
+                    except FencedError as e:
+                        # deposed, not failing: a sink refused our stale
+                        # epoch.  Demote (park all actors, re-enter
+                        # candidacy) without burning any failure budget —
+                        # the new leader owns the pipeline now
+                        st.last_error = f"FencedError: {e}"
+                        st.last_error_class = "fenced"
+                        log.warning("sequencer actor %s fenced (deposed "
+                                    "leader): %s", st.name, e)
+                        if self.leadership is not None:
+                            self.leadership.fenced(e)
                     except Exception as e:  # noqa: BLE001 — actors survive
                         # error classification: transient faults (network
                         # flakes, injected drops — an L1 outage) get a far
@@ -973,6 +1119,8 @@ class Sequencer:
         }
         for name in self.ACTOR_NAMES:
             loop(intervals[name], getattr(self, name))
+        if self.leadership is not None:
+            self.leadership.start()
         return self
 
     # ------------------------------------------------------------------
@@ -993,12 +1141,46 @@ class Sequencer:
             self._resume_at.pop(name, None)
         self.paused.discard(name)
 
+    def ready_json(self) -> dict:
+        """The ethrex_ready payload: role + gated-on-reconciliation
+        readiness, distinct from ethrex_health's liveness.  A follower
+        is alive but NOT ready for leader traffic; a promoting node
+        turns ready only once reconciliation finished and its actors
+        unparked (docs/SEQUENCER_HA.md)."""
+        if self.leadership is None:
+            return {"ready": self.fatal is None, "role": "leader",
+                    "ha": False, "reconciledAt": self.reconciled_at,
+                    "promotions": self.promotions_total}
+        status = self.leadership.status()
+        return {
+            "ready": (status["role"] == "leader" and self.fatal is None
+                      and self.reconciled_at is not None),
+            "role": status["role"],
+            "ha": True,
+            "reconciledAt": self.reconciled_at,
+            "promotions": self.promotions_total,
+            "leadership": status,
+        }
+
     def stop(self, timeout: float = 10.0) -> bool:
-        """Drain: signal every actor loop, join the actor threads (each
-        finishes its in-flight iteration — a mid-commit batch lands or
-        rolls back through its write group), then stop the coordinator,
-        which waits for in-flight proof submits to land.  Returns True
-        when every actor stopped within the deadline."""
+        """Drain: release the leadership lease (so a standby can win
+        immediately instead of waiting out the ttl), signal every actor
+        loop, join the actor threads (each finishes its in-flight
+        iteration — a mid-commit batch lands or rolls back through its
+        write group), then stop the coordinator, which waits for
+        in-flight proof submits to land.  Returns True when every actor
+        stopped within the deadline.
+
+        Idempotent and follower-safe: repeated invocations (demote →
+        shutdown races, the shutdown manager re-running a drain) return
+        the first drain's result without re-joining anything, and a
+        follower whose actor threads never started drains cleanly."""
+        with self._stop_guard:
+            if self._stopped:
+                return self._stop_result
+            self._stopped = True
+        if self.leadership is not None:
+            self.leadership.stop()
         self._stop.set()
         deadline = time.monotonic() + timeout
         for t in self._threads:
@@ -1009,4 +1191,5 @@ class Sequencer:
                         "drain deadline", len(stragglers), timeout)
         self.coordinator.stop(
             timeout=max(0.5, deadline - time.monotonic()))
-        return not stragglers
+        self._stop_result = not stragglers
+        return self._stop_result

@@ -206,10 +206,12 @@ class Node:
 
     def stop(self, timeout: float = 30.0) -> bool:
         """Returns True when all writers are stopped (safe to close the
-        backend); False if the producer is still alive after the timeout."""
+        backend); False if the producer is still alive after the timeout.
+        Idempotent: a second call (HA demotion racing the shutdown
+        drain) is a no-op returning the first call's verdict."""
         self._stop.set()
         thread = self._producer_thread
-        if thread is not None:
+        if thread is not None and thread is not threading.current_thread():
             thread.join(timeout=timeout)
             if thread.is_alive():
                 log.warning("block producer did not stop within %.1fs",

@@ -891,6 +891,10 @@ def build_serving_record(sweep: dict, setup_s: float = 0.0,
         "sustained_rate": sustained if sustained is not None else 0.0,
         "shed_rate": (pick or {}).get("shedRate", 0.0),
         "arrivals": sweep.get("arrivals"),
+        # the simulated-sender population the sweep ran with: tail
+        # latency at 16 senders and at 10k senders are different
+        # benchmarks, so the history gate can tell them apart
+        "senders": sweep.get("senders"),
         "rates": [{
             "offeredRate": r.get("offeredRate"),
             "achievedRate": r.get("achievedRate"),

@@ -333,6 +333,7 @@ class Harness:
                  payload: str = "tx", batch_size: int = 8):
         self.url = url
         self.key = key
+        self.senders = senders
         self.token_frac = token_frac
         self.workers = workers
         self.timeout = timeout
@@ -348,12 +349,23 @@ class Harness:
 
     # -- setup (closed-loop, before any clock starts) -------------------
     def setup(self, fund_wei: int = 10 ** 18,
-              produce: bool = True) -> None:
+              produce: bool = True, fund_chunk: int | None = None) -> None:
         """Fund the simulated senders from the root key and deploy the
         token template.  Runs closed-loop: setup cost must never pollute
-        the measured schedule."""
+        the measured schedule.
+
+        Funding is chunked: every `fund_chunk` transfers a block is
+        produced to drain the mempool, so a 10k-sender sweep never
+        piles 10k pending funding txs into admission.  The chunk
+        defaults to the mempool's per-sender slot cap — the ROOT key is
+        one sender, and admission rejects its 65th pending funding tx,
+        which would leave every later sender unfunded."""
         if self.payload != "tx":
             return
+        if fund_chunk is None:
+            from ..blockchain.mempool import MAX_SENDER_SLOTS
+
+            fund_chunk = MAX_SENDER_SLOTS
         rpc = RpcConn(self.url, timeout=30.0)
         try:
             self.chain_id = int(rpc.call("eth_chainId", []), 16)
@@ -361,7 +373,7 @@ class Harness:
                 secp256k1.pubkey_from_secret(self.key))
             nonce = int(rpc.call("eth_getTransactionCount",
                                  ["0x" + root.hex(), "pending"]), 16)
-            for addr in self.addresses:
+            for i, addr in enumerate(self.addresses):
                 tx = Transaction(
                     tx_type=TYPE_DYNAMIC_FEE, chain_id=self.chain_id,
                     nonce=nonce, max_priority_fee_per_gas=1,
@@ -370,6 +382,8 @@ class Harness:
                 rpc.call("eth_sendRawTransaction",
                          ["0x" + tx.encode_canonical().hex()])
                 nonce += 1
+                if produce and fund_chunk and (i + 1) % fund_chunk == 0:
+                    rpc.call("ethrex_produceBlock", [])
             deploy = Transaction(
                 tx_type=TYPE_DYNAMIC_FEE, chain_id=self.chain_id,
                 nonce=nonce, max_priority_fee_per_gas=1,
@@ -475,6 +489,7 @@ class Harness:
             "offeredRate": rate,
             "arrivals": arrivals,
             "durationSeconds": duration,
+            "senders": self.senders if self.payload == "tx" else None,
             "scheduled": len(schedule),
             "sent": sent,
             "missed": missed,
@@ -572,6 +587,7 @@ class Harness:
         return {
             "arrivals": arrivals,
             "durationSeconds": duration,
+            "senders": self.senders if self.payload == "tx" else None,
             "maxSustainableRate": sustainable,
             "maxErrorRate": max_error_rate,
             "minAchievedFrac": min_achieved_frac,

@@ -410,6 +410,7 @@ class RpcServer:
             "ethrex_latestBatch": lambda: _latest_batch(node),
             "ethrex_getBatchByNumber": lambda n: _get_batch(node, n),
             "ethrex_health": lambda: _health(node),
+            "ethrex_ready": lambda: _ready(node),
             "ethrex_getL1MessageProof":
                 lambda h: _l1_message_proof(node, h),
             "ethrex_batchNumberByBlock":
@@ -1214,4 +1215,22 @@ def _health(node):
                 "lastShutdownSeconds": _shutdown.LAST_DURATION,
             },
         }
+        # HA leader election state (docs/SEQUENCER_HA.md): role, epoch,
+        # transition/fence counters and the last promotion's downtime
+        leadership = getattr(seq, "leadership", None)
+        if leadership is not None:
+            out["l2"]["leadership"] = leadership.status()
     return out
+
+
+def _ready(node):
+    """ethrex_ready: readiness (can THIS node serve as sequencer right
+    now?) as opposed to ethrex_health's liveness.  A hot standby is
+    perfectly healthy yet NOT ready — load balancers and failover drills
+    key off this method (docs/SEQUENCER_HA.md)."""
+    seq = getattr(node, "sequencer", None)
+    if seq is None:
+        # an L1-only node is "ready" in the serving sense as soon as it
+        # answers RPC at all; there is no sequencer role to gate on
+        return {"ready": True, "role": None, "ha": False}
+    return seq.ready_json()

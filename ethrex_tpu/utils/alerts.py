@@ -265,6 +265,18 @@ def actor_stall_signal(eng, node):
     return worst
 
 
+def sequencer_leaderless_signal(eng, node):
+    """1.0 when, from this node's view, NO sequencer holds a live leader
+    lease; 0.0 while somebody (us included) does.  None unless this node
+    runs HA leader election (`--ha-role`), so single-sequencer deploys
+    never arm the rule (docs/SEQUENCER_HA.md)."""
+    seq = getattr(node, "sequencer", None)
+    leadership = getattr(seq, "leadership", None)
+    if leadership is None:
+        return None
+    return 1.0 if leadership.leaderless() else 0.0
+
+
 def default_rules(node=None) -> list:
     """The stock SLO set (documented in docs/OBSERVABILITY.md)."""
     mk = AlertRule
@@ -483,6 +495,25 @@ def default_rules(node=None) -> list:
            runbook="Peers are slow or flapping; compare "
                    "p2p_peer_rtt_seconds per peer and "
                    "p2p_request_retries_total (docs/P2P_RESILIENCE.md)."),
+        # sequencer leaderless — HA deploys only (signal is None without
+        # --ha-role, so the pair never arms elsewhere).  The lease cell
+        # on the L1 says nobody leads: nothing is producing blocks
+        mk("sequencer_leaderless:page", "page",
+           sequencer_leaderless_signal, 1.0,
+           window=60.0, for_count=3, resolve_count=2,
+           description="No sequencer holds the leader lease for 3 evals",
+           runbook="Every candidate is failing acquire_lease or dying "
+                   "during promotion; check leadership.lastError in "
+                   "ethrex_ready on each standby and the L1 lease cell "
+                   "(docs/SEQUENCER_HA.md runbook)."),
+        mk("sequencer_leaderless:warn", "warn",
+           sequencer_leaderless_signal, 1.0,
+           window=60.0, for_count=2, resolve_count=2,
+           description="Leader lease momentarily unheld (failover window)",
+           runbook="Expected for up to one lease TTL during a failover; "
+                   "sustained flapping means renewal starvation — check "
+                   "leadership_transitions_total and the lease TTL vs L1 "
+                   "latency (docs/SEQUENCER_HA.md)."),
         # mempool replacement churn — high replacement-by-fee rates are
         # a fee-bidding war or a deliberate repricing spam pattern
         mk("mempool_replacement_churn:page", "page",

@@ -87,6 +87,18 @@ and docs/L1_SETTLEMENT_RESILIENCE.md):
                             responses) before send: corrupt = a
                             byzantine snap server (tampered proofs),
                             drop = the response is lost
+    l1.lease                LeadershipManager around every lease
+                            acquire/renew CAS; fires on BOTH legs —
+                            before the call (request lost) and after it
+                            returns (lease held on L1, response lost:
+                            the candidate must survive its own orphaned
+                            term expiring; pair with after=1 to target
+                            this leg).  docs/SEQUENCER_HA.md
+    seq.fence               every sequencer-side fence checkpoint
+                            (LeadershipManager.check / Sequencer._fence,
+                            at the top of commit_next_batch, send_proofs
+                            and update_state): error = deposition
+                            surfacing exactly at the checkpoint
 
 Fault kinds:
 
@@ -126,6 +138,8 @@ SITES = frozenset({
     "net.recv",
     "peer.request",
     "snap.serve",
+    "l1.lease",
+    "seq.fence",
 })
 
 KINDS = frozenset({"drop", "delay", "corrupt", "torn", "error"})

@@ -428,6 +428,52 @@ def record_verified_batch(batch_number: int):
                 "alert reads latest_batch minus this)")
 
 
+# sequencer HA roles encoded as a numeric gauge (docs/SEQUENCER_HA.md)
+_ROLE_VALUES = {"follower": 0.0, "candidate": 1.0, "promoting": 2.0,
+                "leader": 3.0}
+
+
+def record_leadership_role(role: str):
+    METRICS.set("sequencer_role", _ROLE_VALUES.get(role, -1.0),
+                "Sequencer HA role of this node "
+                "(0=follower 1=candidate 2=promoting 3=leader)")
+
+
+def record_leadership_epoch(epoch: int):
+    METRICS.set("leadership_epoch", float(epoch),
+                "Fencing epoch of this node's current leader lease "
+                "(monotonic across the deployment; stamped on every "
+                "externally-visible sequencer write)")
+
+
+def record_leadership_transition(frm: str, to: str):
+    METRICS.inc_labeled("leadership_transitions_by_edge", {
+                        "from": frm, "to": to}, 1,
+                        help_text="Sequencer HA role transitions by "
+                        "from/to edge (failover forensics)")
+    METRICS.inc("leadership_transitions_total", 1,
+                "Sequencer HA role transitions (unlabelled companion of "
+                "leadership_transitions_by_edge; a churning value means "
+                "the lease is flapping)")
+
+
+def record_leadership_fenced():
+    METRICS.inc("leadership_fenced_writes_total", 1,
+                "Writes refused by the L1 or the rollup store because "
+                "they carried a stale fencing epoch (a deposed zombie "
+                "leader was stopped from corrupting shared state)")
+
+
+def record_leadership_promotion(downtime: float):
+    METRICS.set("leadership_promotion_downtime_seconds", downtime,
+                "Wall-clock of the last follower-to-leader promotion "
+                "(lease win to actors unparked: reconciliation + "
+                "journal replay + prover-fleet re-home)")
+    _observe_safe("leadership_promotion_seconds", downtime, None,
+                  "Promotion wall-clock distribution (failover drill "
+                  "budget: must stay within the lease ttl)")
+
+
 def record_kernel_build(air: str, seconds: float, mesh: str = "none"):
     # labelled by mesh shape ("none", "4", "2x4") so mesh<->no-mesh
     # switches and sub-slice churn show up as distinct retrace series

@@ -55,6 +55,11 @@ def snapshot(rpc: RpcSession, blocks: int = 8) -> dict:
     except Exception:
         out["health"] = None
     try:
+        # older nodes don't serve ethrex_ready; skip the role line
+        out["ready"] = rpc.call("ethrex_ready", [])
+    except Exception:
+        out["ready"] = None
+    try:
         # older nodes don't serve the trace namespace; skip the panel
         out["traces"] = rpc.call("ethrex_trace_slowest", [5])
     except Exception:
@@ -506,6 +511,19 @@ def render_lines(snap: dict, width: int = 100) -> list[str]:
         + (f"  peers {snap['peers']}" if snap.get("peers") is not None
            else ""))
     lines.append(f" {h['hash']}")
+    if isinstance(snap.get("ready"), dict):
+        rd = snap["ready"]
+        role = rd.get("role") or "n/a"
+        line = (f" role {role}  ready {str(rd.get('ready')).lower()}")
+        lead = rd.get("leadership")
+        if isinstance(lead, dict):
+            line += (f"  epoch {lead.get('epoch')}"
+                     f"  transitions {lead.get('transitions')}"
+                     f"  fenced {lead.get('fenced')}")
+            dt = lead.get("promotionDowntimeSeconds")
+            if dt is not None:
+                line += f"  last promotion {dt:.2f}s"
+        lines.append(line)
     lines.append("─" * width)
     lines.append(" recent blocks")
     for b in reversed(snap["recent"]):

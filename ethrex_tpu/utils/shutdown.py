@@ -102,9 +102,10 @@ def build_node_shutdown(node=None, servers=(), sequencer=None,
 
     1. rpc — stop accepting requests (HTTP/WS/metrics servers);
     2. prover-clients — no new proofs enter the pipe;
-    3. sequencer — actors finish their in-flight iteration, the
-       coordinator waits for in-flight submits to land (or their leases
-       expire and reassign on restart);
+    3. sequencer — in HA mode the leader lease is released first (a hot
+       standby starts promoting while we drain); then actors finish
+       their in-flight iteration, the coordinator waits for in-flight
+       submits to land (or their leases expire and reassign on restart);
     4. producer — the dev block producer joins;
     5. flush+close — every store settles pending layers, flushes and
        releases its KV handle (critical: runs even past the deadline).
@@ -140,6 +141,13 @@ def build_node_shutdown(node=None, servers=(), sequencer=None,
             continue
         manager.register("prover-clients", lambda t, c=client: c.stop())
     if sequencer is not None:
+        # release the leader lease FIRST so a hot standby can begin its
+        # promotion while this node drains (planned failover takes one
+        # candidacy poll, not a whole lease TTL — docs/SEQUENCER_HA.md)
+        if getattr(sequencer, "leadership", None) is not None:
+            manager.register(
+                "leadership",
+                lambda t, s=sequencer: s.leadership.stop(timeout=t))
         manager.register(
             "sequencer", lambda t, s=sequencer: s.stop(timeout=t))
     if node is not None:

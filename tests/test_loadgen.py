@@ -246,3 +246,45 @@ def test_build_serving_record_nothing_sustained():
     # a zero-valued sub-metric is excluded from history series, so a
     # collapsed run can never become the gate's baseline
     assert rec["configs"]["serving_rate"]["value"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# many-sender tx mode: the sweep shape behind BENCH_SERVING_SENDERS
+# (ROADMAP item 3 — 10k-sender serving sweeps); funding must chunk
+# below the mempool's per-sender slot cap or the ROOT key evicts its
+# own funding tail and later senders never get funded
+
+def test_many_sender_funding_chunks_below_sender_cap():
+    from ethrex_tpu.blockchain.mempool import MAX_SENDER_SLOTS
+    from ethrex_tpu.node import Node
+    from ethrex_tpu.primitives.genesis import Genesis
+    from ethrex_tpu.rpc.server import RpcServer
+    from tests.test_l2_pipeline import GENESIS
+
+    n_senders = MAX_SENDER_SLOTS * 2 + 17  # forces 2+ funding chunks
+    node = Node(Genesis.from_json(GENESIS))
+    rpc = RpcServer(node, port=0).start()
+    try:
+        h = loadgen.Harness(f"http://127.0.0.1:{rpc.port}",
+                            senders=n_senders, payload="tx",
+                            workers=8, timeout=10.0, seed=4)
+        h.setup(fund_wei=10 ** 15)
+        # chunked funding produced intermediate blocks and funded EVERY
+        # sender, including the tail past the per-sender cap
+        assert node.store.latest_number() >= 2
+        root = node.store.head_header().state_root
+        for addr in h.addresses:
+            acct = node.store.account_state(root, addr)
+            assert acct is not None and acct.balance == 10 ** 15, \
+                f"sender 0x{addr.hex()} left unfunded"
+
+        rep = h.run(100.0, duration=0.5)
+        # the sender spread is part of the record: 16-sender and
+        # 10k-sender serving numbers are different benchmarks
+        assert rep["senders"] == n_senders
+        assert rep["delivered"] > 0
+        assert rep["errors"] == 0
+        sweep = h.sweep([50.0], duration=0.2)
+        assert sweep["senders"] == n_senders
+    finally:
+        rpc.stop()

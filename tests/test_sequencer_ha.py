@@ -1,0 +1,971 @@
+"""Sequencer HA chaos battery (docs/SEQUENCER_HA.md): L1-fenced leader
+leases, hot-standby failover, and leader-kill drills.
+
+Covered here:
+- the L1 lease cell: CAS semantics, epoch monotonicity, persistence
+  across restarts and L1 reorgs, the EvmL1 storage-slot mirror;
+- fencing discipline on both sinks (L1 commit/verify transactions and
+  rollup-store write groups) with the typed FencedError;
+- the "l1.lease" two-leg fault site (request lost vs response lost —
+  the orphaned-term case) and the "seq.fence" checkpoint site;
+- leader-kill drills at EVERY actor boundary: the standby promotes,
+  finishes the pipeline, and converges byte-identically with a
+  no-failover baseline, with zero double-commits;
+- the commit crash-window kill (L1 accepted, leader died before any
+  local persistence) handed to a standby instead of a restart;
+- promotion-within-lease-TTL liveness with real threads;
+- prover-fleet re-homing: an in-flight phase-checkpointed proof
+  RESUMES under the new leader's coordinator (phase_resumes > 0);
+- BlockFetcher failure paths and Sequencer/Node stop idempotency.
+
+Select alone with `-m chaos`; everything but the loadgen soak is in
+the fast tier.
+"""
+
+import threading
+import time
+import types
+
+import pytest
+
+from ethrex_tpu.guest.execution import ProgramInput
+from ethrex_tpu.l2.based import BlockFetcher
+from ethrex_tpu.l2.l1_client import InMemoryL1, PersistentInMemoryL1
+from ethrex_tpu.l2.l1_evm import LEASE_EPOCH_SLOT, EvmL1
+from ethrex_tpu.l2.leadership import (ROLE_CANDIDATE, ROLE_FOLLOWER,
+                                      ROLE_LEADER, FencedError,
+                                      LeadershipManager)
+from ethrex_tpu.l2.rollup_store import PersistentRollupStore, RollupStore
+from ethrex_tpu.l2.sequencer import Sequencer, SequencerConfig
+from ethrex_tpu.node import Node
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.prover import protocol
+from ethrex_tpu.prover.backend import get_backend
+from ethrex_tpu.utils import faults
+from ethrex_tpu.utils.faults import FaultPlan, InjectedFault, injected
+from tests.test_l2_pipeline import GENESIS, _transfer
+
+pytestmark = pytest.mark.chaos
+
+# two blocks / two batches is the canonical drill schedule: batch n
+# covers exactly block n, block n carries txs (2n-2, 2n-1) at
+# timestamp n — fully deterministic, so independent runs produce
+# byte-identical chains
+TOTAL_BLOCKS = 2
+
+
+def _l1():
+    return InMemoryL1([protocol.PROVER_EXEC])
+
+
+def _cfg(role=None, ttl=60.0, node_id=None):
+    return SequencerConfig(needed_prover_types=(protocol.PROVER_EXEC,),
+                           ha_role=role, leader_lease=ttl,
+                           ha_node_id=node_id)
+
+
+def _seq(l1, role=None, ttl=60.0, node_id=None):
+    node = Node(Genesis.from_json(GENESIS))
+    return node, Sequencer(node, l1, _cfg(role, ttl, node_id))
+
+
+def _produce(node, n):
+    """Produce canonical block `n` (txs 2n-2, 2n-1 at timestamp n)."""
+    for k in (2 * (n - 1), 2 * n - 1):
+        node.submit_transaction(_transfer(k))
+    return node.produce_block(timestamp=n)
+
+
+def _prove(seq, number):
+    backend = get_backend(protocol.PROVER_EXEC)
+    stored = seq.rollup.get_prover_input(number, seq.cfg.commit_hash)
+    assert stored is not None, f"batch {number} has no prover input"
+    proof = backend.prove(ProgramInput.from_json(stored),
+                          protocol.FORMAT_STARK)
+    seq.rollup.store_proof(number, protocol.PROVER_EXEC, proof)
+
+
+def _drive(seq, node, l1):
+    """Finish the canonical schedule from wherever this node stands:
+    (re)produce missing blocks, commit missing batches, prove and
+    verify everything, adopt flags.  Work another leader already
+    settled is adopted, never redone — this is exactly what a freshly
+    promoted standby runs."""
+    for n in range(1, TOTAL_BLOCKS + 1):
+        if node.store.latest_number() < n:
+            _produce(node, n)
+        if seq.rollup.latest_batch_number() < n:
+            assert seq.commit_next_batch() is not None
+        if n > l1.last_verified_batch() and \
+                seq.rollup.get_proof(n, protocol.PROVER_EXEC) is None:
+            _prove(seq, n)
+    seq.send_proofs()
+    seq.update_state()
+
+
+def _chain_fingerprint(node, l1):
+    blocks = [node.store.get_canonical_block(n)
+              for n in range(1, TOTAL_BLOCKS + 1)]
+    return {
+        "hashes": [b.hash for b in blocks],
+        "roots": [b.header.state_root for b in blocks],
+        "commitments": [l1.get_committed_commitment(n)
+                        for n in range(1, TOTAL_BLOCKS + 1)],
+        "l1_roots": [l1.get_committed_state_root(n)
+                     for n in range(1, TOTAL_BLOCKS + 1)],
+        "verified": l1.last_verified_batch(),
+    }
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    """The no-failover fingerprint a failover run must converge to."""
+    l1 = _l1()
+    node, seq = _seq(l1)
+    _drive(seq, node, l1)
+    fp = _chain_fingerprint(node, l1)
+    assert fp["verified"] == TOTAL_BLOCKS
+    seq.stop()
+    return fp
+
+
+# ===========================================================================
+# the L1 lease cell
+# ===========================================================================
+
+def test_lease_cas_and_epoch_monotonic():
+    l1 = _l1()
+    assert l1.get_lease() is None
+    assert l1.acquire_lease("a", 60.0) == 1
+    # CAS: a live lease blocks every other candidate
+    assert l1.acquire_lease("b", 60.0) is None
+    assert l1.renew_lease("a", 1, 60.0) is True
+    # renewal binds holder AND epoch
+    assert l1.renew_lease("a", 2, 60.0) is False
+    assert l1.renew_lease("b", 1, 60.0) is False
+    assert l1.release_lease("b", 1) is False
+    assert l1.release_lease("a", 1) is True
+    # epochs never repeat, even across clean release
+    assert l1.acquire_lease("b", 60.0) == 2
+    l1.expire_lease()
+    assert l1.acquire_lease("c", 60.0) == 3
+    lease = l1.get_lease()
+    assert lease.holder == "c" and lease.epoch == 3
+    assert lease.to_json()["epoch"] == 3
+
+
+def test_lease_cell_survives_l1_reorg():
+    """The lease cell is deliberately OUTSIDE the reorg snapshots: a
+    rolled-back L1 must never re-mint an old epoch (that would unfence
+    a deposed leader)."""
+    l1 = _l1()
+    l1.advance_blocks(4)
+    assert l1.acquire_lease("a", 60.0) == 1
+    l1.advance_blocks(3)
+    l1.reorg(2)
+    lease = l1.get_lease()
+    assert lease is not None and lease.epoch == 1 and lease.holder == "a"
+    l1.expire_lease()
+    assert l1.acquire_lease("b", 60.0) == 2
+
+
+def test_lease_persists_across_reopen(tmp_path):
+    path = str(tmp_path / "l1.json")
+    l1 = PersistentInMemoryL1(path, [protocol.PROVER_EXEC])
+    assert l1.acquire_lease("a", 60.0) == 1
+
+    l1b = PersistentInMemoryL1(path, [protocol.PROVER_EXEC])
+    lease = l1b.get_lease()
+    assert lease is not None and lease.holder == "a" and lease.epoch == 1
+    # the epoch watermark survives too: a post-restart takeover mints 2
+    l1b.expire_lease()
+    assert l1b.acquire_lease("b", 60.0) == 2
+    with pytest.raises(FencedError):
+        l1b.commit_batch(1, b"\x11" * 32, b"\x22" * 48, epoch=1)
+
+
+def test_evm_l1_mirrors_epoch_to_contract_slot():
+    l1 = EvmL1([protocol.PROVER_EXEC])
+    assert LEASE_EPOCH_SLOT == 7
+    assert l1.lease_epoch_slot() == 0
+    assert l1.acquire_lease("a", 60.0) == 1
+    assert l1.lease_epoch_slot() == 1
+    l1.expire_lease()
+    assert l1.acquire_lease("b", 60.0) == 2
+    assert l1.lease_epoch_slot() == 2
+
+
+# ===========================================================================
+# fencing discipline on both sinks
+# ===========================================================================
+
+def test_l1_rejects_stale_epoch_on_commit_and_verify():
+    l1 = _l1()
+    assert l1.acquire_lease("a", 60.0) == 1
+    l1.expire_lease()
+    assert l1.acquire_lease("b", 60.0) == 2
+
+    with pytest.raises(FencedError) as exc:
+        l1.commit_batch(1, b"\x11" * 32, b"\x22" * 48, epoch=1)
+    assert exc.value.epoch == 1 and exc.value.current == 2
+    with pytest.raises(FencedError):
+        l1.verify_batches(1, 1, {}, epoch=1)
+    with pytest.raises(FencedError):
+        l1.verify_batches_aggregated(1, 1, {}, epoch=1)
+    assert l1.fenced_writes_total == 3
+    assert l1.last_committed_batch() == 0  # nothing landed
+    # the current epoch and the non-HA None path both pass the fence
+    l1.commit_batch(1, b"\x11" * 32, b"\x22" * 48, epoch=2)
+    l1.commit_batch(2, b"\x33" * 32, b"\x44" * 48, epoch=None)
+    assert l1.last_committed_batch() == 2
+
+
+def test_rollup_store_fences_stale_write_groups(tmp_path):
+    rollup = RollupStore()
+    assert rollup.leadership_epoch() == 0
+    rollup.fence(2)
+    with pytest.raises(FencedError):
+        with rollup.write_group(epoch=1):
+            pass
+    with rollup.write_group(epoch=2):
+        pass
+    with rollup.write_group(epoch=None):  # non-HA path
+        pass
+    rollup.fence(1)  # the watermark never moves backwards
+    assert rollup.leadership_epoch() == 2
+
+    # the persisted watermark fences a restarted zombie too
+    store = PersistentRollupStore(str(tmp_path / "rollup.db"))
+    store.fence(3)
+    store.close()
+    store2 = PersistentRollupStore(str(tmp_path / "rollup.db"))
+    assert store2.leadership_epoch() == 3
+    with pytest.raises(FencedError):
+        with store2.write_group(epoch=2):
+            pass
+    store2.close()
+
+
+# ===========================================================================
+# fault sites: "l1.lease" (two legs) and "seq.fence"
+# ===========================================================================
+
+def test_lease_fault_request_leg_lost():
+    """Leg 1: the acquire request never reaches the L1 — the bid fails
+    cleanly and nothing is held."""
+    l1 = _l1()
+    lm = LeadershipManager(l1, "a", ttl=60.0)
+    with injected(FaultPlan(seed=3).drop("l1.lease", times=1)):
+        assert lm.try_acquire() is False
+    assert l1.get_lease() is None
+    assert lm.role == ROLE_CANDIDATE and lm.epoch is None
+    # clean retry wins
+    assert lm.try_acquire() is True
+    assert lm.role == ROLE_LEADER and lm.epoch == 1
+
+
+def test_lease_fault_response_leg_lost_orphans_a_term():
+    """Leg 2 (`after=1`): the L1 granted the lease but the response was
+    lost.  The candidate believes it failed — the orphaned term simply
+    expires, and the next bid mints a FRESH epoch, so nothing the
+    orphan could have stamped (epoch 1) survives the fence."""
+    l1 = _l1()
+    lm = LeadershipManager(l1, "a", ttl=60.0)
+    with injected(FaultPlan(seed=3).drop("l1.lease", times=1, after=1)):
+        assert lm.try_acquire() is False
+    lease = l1.get_lease()
+    assert lease is not None and lease.holder == "a" and lease.epoch == 1
+    assert lm.role == ROLE_CANDIDATE and lm.epoch is None
+
+    l1.expire_lease()  # the orphaned term runs out
+    assert lm.try_acquire() is True
+    assert lm.epoch == 2
+    with pytest.raises(FencedError):
+        l1.commit_batch(1, b"\x11" * 32, b"\x22" * 48, epoch=1)
+
+
+def test_seq_fence_fault_site_fires_at_checkpoints():
+    # non-HA: the checkpoint in Sequencer._fence
+    l1 = _l1()
+    node, seq = _seq(l1)
+    node.submit_transaction(_transfer(0))
+    node.produce_block(timestamp=1)
+    with injected(FaultPlan(seed=1).drop("seq.fence", times=1)):
+        with pytest.raises(InjectedFault):
+            seq.commit_next_batch()
+    assert seq.commit_next_batch() is not None  # budget exhausted
+    seq.stop()
+
+    # HA: the checkpoint in LeadershipManager.check
+    lm = LeadershipManager(_l1(), "a", ttl=60.0)
+    assert lm.try_acquire() is True
+    with injected(FaultPlan(seed=1).drop("seq.fence", times=1)):
+        with pytest.raises(InjectedFault):
+            lm.check()
+    assert lm.check() == 1
+
+
+def test_ha_fault_sites_registered():
+    assert "l1.lease" in faults.SITES
+    assert "seq.fence" in faults.SITES
+
+
+# ===========================================================================
+# leadership manager lifecycle (threaded renewal loop)
+# ===========================================================================
+
+def test_leader_renews_past_ttl_and_releases_on_stop():
+    l1 = _l1()
+    lm = LeadershipManager(l1, "a", ttl=0.3, rng_seed=7).start()
+    deadline = time.monotonic() + 5.0
+    while not lm.is_leader() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert lm.is_leader()
+    time.sleep(1.0)  # > 3 ttls: only renewal keeps the lease alive
+    assert lm.is_leader()
+    lease = l1.get_lease()
+    assert lease.expires > time.time()
+    lm.stop()
+    assert lm.role == ROLE_FOLLOWER
+    # clean release: the cell expired NOW, a successor needn't wait
+    assert l1.acquire_lease("b", 60.0) == 2
+
+
+def test_renewal_starvation_steps_down_within_safety_margin():
+    l1 = _l1()
+    demotions = []
+    lm = LeadershipManager(l1, "a", ttl=0.3, rng_seed=7,
+                           on_demote=lambda: demotions.append(1)).start()
+    deadline = time.monotonic() + 5.0
+    while not lm.is_leader() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert lm.is_leader()
+
+    class _DeadL1:
+        """An L1 that answers nothing: renewals and bids all fail."""
+
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def renew_lease(self, node_id, epoch, ttl):
+            return False
+
+        def acquire_lease(self, node_id, ttl):
+            return None
+
+    lm.l1 = _DeadL1(l1)
+    deadline = time.monotonic() + 5.0
+    while lm.is_leader() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    # stepped down BEFORE the lease could expire under a rival, and
+    # parked through on_demote
+    assert lm.role == ROLE_CANDIDATE
+    assert demotions, "on_demote never ran"
+    assert "starved" in (lm.last_error or "")
+    lm.stop()
+
+
+# ===========================================================================
+# HA wiring: config validation, readiness, alerts, fenced demotion
+# ===========================================================================
+
+def test_ha_requires_lease_capable_l1():
+    class NoLeases(InMemoryL1):
+        def supports_leases(self):
+            return False
+
+    node = Node(Genesis.from_json(GENESIS))
+    with pytest.raises(ValueError, match="leader-lease"):
+        Sequencer(node, NoLeases([protocol.PROVER_EXEC]),
+                  _cfg(role="leader"))
+    with pytest.raises(ValueError, match="ha_role"):
+        Sequencer(node, _l1(), _cfg(role="primary"))
+
+
+def test_ready_json_and_rpc_ready_payload():
+    from ethrex_tpu.rpc.server import _ready
+
+    # no sequencer at all: plain node, trivially ready, not HA
+    assert _ready(types.SimpleNamespace()) == \
+        {"ready": True, "role": None, "ha": False}
+
+    l1 = _l1()
+    node, seq = _seq(l1, role="follower", node_id="standby")
+    node.sequencer = seq
+    rd = _ready(node)
+    assert rd["ready"] is False and rd["ha"] is True
+    assert rd["role"] == ROLE_FOLLOWER
+    assert rd["leadership"]["epoch"] is None
+
+    assert seq.leadership.try_acquire() is True
+    rd = _ready(node)
+    assert rd["ready"] is True and rd["role"] == ROLE_LEADER
+    assert rd["promotions"] == 1
+    assert rd["leadership"]["epoch"] == 1
+    assert rd["leadership"]["promotionDowntimeSeconds"] is not None
+    seq.stop()
+
+    # non-HA sequencers stay ready without a leadership section
+    node2, seq2 = _seq(_l1())
+    node2.sequencer = seq2
+    rd = _ready(node2)
+    assert rd == {"ready": True, "role": "leader", "ha": False,
+                  "reconciledAt": rd["reconciledAt"], "promotions": 0}
+    seq2.stop()
+
+
+def test_sequencer_leaderless_alert_signal():
+    from ethrex_tpu.utils.alerts import sequencer_leaderless_signal
+
+    # non-HA node: the signal stays disarmed (None), never firing
+    assert sequencer_leaderless_signal(None, types.SimpleNamespace()) \
+        is None
+    node2, seq2 = _seq(_l1())
+    node2.sequencer = seq2
+    assert sequencer_leaderless_signal(None, node2) is None
+
+    l1 = _l1()
+    node, seq = _seq(l1, role="follower")
+    node.sequencer = seq
+    assert sequencer_leaderless_signal(None, node) == 1.0
+    assert seq.leadership.try_acquire() is True
+    assert sequencer_leaderless_signal(None, node) == 0.0
+    seq.stop()
+    seq2.stop()
+
+
+def test_fenced_error_demotes_and_parks_actors():
+    """The zombie path end-to-end: a deposed leader's commit is refused
+    by the L1 with FencedError; handling it demotes the sequencer —
+    every actor parked, coordinator down, epoch dropped — and re-enters
+    candidacy."""
+    l1 = _l1()
+    node_a, a = _seq(l1, role="leader", node_id="a")
+    node_b, b = _seq(l1, role="follower", node_id="b")
+    assert a.leadership.try_acquire() is True
+    _produce(node_a, 1)
+
+    # the leader dies from the cluster's point of view; the standby wins
+    l1.expire_lease()
+    assert b.leadership.try_acquire() is True
+    assert b.leadership.epoch == 2
+
+    # ...but the old process is still running, and tries to commit
+    with pytest.raises(FencedError):
+        a.commit_next_batch()
+    assert l1.fenced_writes_total == 1
+    assert l1.last_committed_batch() == 0
+    assert a.rollup.latest_batch_number() == 0
+
+    # the actor loop's handler: demote without burning failure budget
+    a.leadership.fenced(FencedError("deposed", epoch=1, current=2))
+    assert a.leadership.role == ROLE_CANDIDATE
+    assert a.leadership.epoch is None
+    assert a.paused == set(Sequencer.ACTOR_NAMES)
+    assert a.ready_json()["ready"] is False
+    assert a.leadership.fenced_total == 1
+    a.stop()
+    b.stop()
+
+
+# ===========================================================================
+# the tentpole drill: leader killed at EVERY actor boundary; the
+# standby promotes, finishes the pipeline, and converges byte-
+# identically with the no-failover baseline
+# ===========================================================================
+
+KILL_POINTS = ("watch_l1", "produce_block", "commit_next_batch",
+               "local_proof", "send_proofs", "aggregate_proofs",
+               "update_state")
+
+
+def _leader_steps(seq, node, kill_at):
+    """Run the canonical schedule's first batch on the leader, dying
+    right AFTER the named actor boundary."""
+    seq.watch_l1()
+    if kill_at == "watch_l1":
+        return
+    _produce(node, 1)
+    if kill_at == "produce_block":
+        return
+    assert seq.commit_next_batch() is not None
+    if kill_at == "commit_next_batch":
+        return
+    _prove(seq, 1)
+    if kill_at == "local_proof":
+        return
+    seq.send_proofs()
+    if kill_at == "send_proofs":
+        return
+    seq.aggregate_proofs()
+    if kill_at == "aggregate_proofs":
+        return
+    seq.update_state()
+
+
+@pytest.mark.parametrize("kill_at", KILL_POINTS)
+def test_leader_kill_at_actor_boundary_converges(kill_at, baseline):
+    l1 = _l1()
+    commit_calls = []
+    orig_commit = l1.commit_batch
+
+    def counted(number, *a, **kw):
+        commit_calls.append(number)
+        return orig_commit(number, *a, **kw)
+
+    l1.commit_batch = counted
+
+    node_a, a = _seq(l1, role="leader", node_id="a")
+    node_b, b = _seq(l1, role="follower", node_id="b")
+    fetcher = BlockFetcher(node_b, l1, rollup=b.rollup)
+    assert a.leadership.try_acquire() is True
+    _leader_steps(a, node_a, kill_at)
+
+    # the leader process is gone; its lease runs out
+    l1.expire_lease()
+
+    # hot-standby promotion: catch up from L1 DA, win the lease (which
+    # runs reconciliation + repair as the ONLY startup path), continue
+    fetcher.fetch_once()
+    assert b.leadership.try_acquire() is True
+    assert b.leadership.epoch == 2
+    assert b.promotions_total == 1
+    assert b.ready_json()["ready"] is True
+    _drive(b, node_b, l1)
+
+    # byte-identical convergence with the no-failover baseline: any
+    # work the dead leader hadn't settled was re-derived to the SAME
+    # blocks (deterministic schedule), anything settled was adopted
+    assert _chain_fingerprint(node_b, l1) == baseline
+    # zero double-commits across both leader generations
+    assert sorted(commit_calls) == sorted(set(commit_calls))
+    assert l1.last_committed_batch() == TOTAL_BLOCKS
+    assert l1.last_verified_batch() == TOTAL_BLOCKS
+    a.stop()
+    b.stop()
+
+
+def test_failover_through_commit_crash_window(baseline):
+    """The nastiest kill: the L1 accepted batch 1 (commit tx + blobs
+    mined) but the leader died before ANY local persistence.  The
+    standby — which shares no disk with the dead leader — must adopt
+    the settled batch from L1 data alone and finish the schedule."""
+    l1 = _l1()
+    node_a, a = _seq(l1, role="leader", node_id="a")
+    node_b, b = _seq(l1, role="follower", node_id="b")
+    fetcher = BlockFetcher(node_b, l1, rollup=b.rollup)
+    assert a.leadership.try_acquire() is True
+    _produce(node_a, 1)
+
+    class Killed(RuntimeError):
+        pass
+
+    def dying(*args, **kwargs):
+        raise Killed("process died before the rollup store heard")
+
+    a.rollup.store_batch = dying
+    with pytest.raises(Killed):
+        a.commit_next_batch()
+    assert l1.last_committed_batch() == 1
+    assert l1.get_blob_sidecar(1) is not None
+    assert a.rollup.latest_batch_number() == 0  # nothing persisted
+
+    l1.expire_lease()
+    assert fetcher.fetch_once() == 1
+    assert b.leadership.try_acquire() is True
+    # promotion repaired the prover input for the adopted batch
+    assert b.rollup.get_prover_input(1, b.cfg.commit_hash) is not None
+    _drive(b, node_b, l1)
+    assert _chain_fingerprint(node_b, l1) == baseline
+    a.stop()
+    b.stop()
+
+
+# ===========================================================================
+# liveness: a real standby promotes within the lease TTL
+# ===========================================================================
+
+def test_standby_promotes_within_lease_ttl():
+    ttl = 0.6
+    l1 = _l1()
+    node_a, a = _seq(l1, role="leader", ttl=ttl, node_id="a")
+    node_b, b = _seq(l1, role="follower", ttl=ttl, node_id="b")
+    fetcher = BlockFetcher(node_b, l1, rollup=b.rollup)
+    try:
+        a.start()
+        deadline = time.monotonic() + 5.0
+        while not a.leadership.is_leader() and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert a.leadership.is_leader()
+        assert a.ready_json()["ready"] is True
+
+        b.start()
+        fetcher.start(interval=0.02)
+        assert b.ready_json()["ready"] is False  # standby, parked
+
+        # let the leader run a little, then crash it WITHOUT releasing
+        # the lease: actors first (so the standby's view can catch up),
+        # then the renewal loop
+        time.sleep(max(ttl * 1.5, 1.0))
+        a._stop.set()
+        for t in a._threads:
+            t.join(timeout=5.0)
+        a.leadership._stop.set()
+        a.leadership._thread.join(timeout=5.0)
+        deadline = time.monotonic() + 5.0
+        while fetcher.next_batch <= l1.last_committed_batch() and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+
+        t0 = time.monotonic()
+        l1.expire_lease()  # the unreleased lease runs out
+        deadline = t0 + 10.0
+        while not b.leadership.is_leader() and \
+                time.monotonic() < deadline:
+            time.sleep(0.005)
+        elapsed = time.monotonic() - t0
+        assert b.leadership.is_leader(), "standby never promoted"
+        assert elapsed <= ttl, (
+            f"promotion took {elapsed:.3f}s, over the {ttl}s lease ttl")
+
+        rd = b.ready_json()
+        assert rd["ready"] is True and rd["role"] == ROLE_LEADER
+        assert rd["leadership"]["promotionDowntimeSeconds"] is not None
+        assert rd["leadership"]["epoch"] is not None
+        assert b.promotions_total == 1
+        assert not b.paused  # actors unparked
+        lease = l1.get_lease()
+        assert lease is not None and lease.holder == "b"
+    finally:
+        fetcher.stop()
+        a.stop()
+        b.stop()
+
+
+# ===========================================================================
+# prover-fleet re-homing: an in-flight phase-checkpointed proof
+# resumes under the new leader's coordinator
+# ===========================================================================
+
+def test_prover_resumes_phases_after_coordinator_rehome(monkeypatch,
+                                                        tmp_path):
+    import numpy as np
+
+    from ethrex_tpu.models import merkle_air as mair
+    from ethrex_tpu.ops import babybear as bb
+    from ethrex_tpu.ops.merkle import fold_path_canonical
+    from ethrex_tpu.prover import runtime_errors as rt
+    from ethrex_tpu.prover.client import ProverClient
+    from ethrex_tpu.stark import prover as stark_prover
+    from ethrex_tpu.stark.prover import StarkParams
+
+    monkeypatch.setenv("ETHREX_PROOF_CKPT_DIR", str(tmp_path / "ckpt"))
+    l1 = InMemoryL1([protocol.PROVER_TPU])
+    cfg_a = SequencerConfig(needed_prover_types=(protocol.PROVER_TPU,),
+                            ha_role="leader", leader_lease=60.0,
+                            ha_node_id="a")
+    cfg_b = SequencerConfig(needed_prover_types=(protocol.PROVER_TPU,),
+                            ha_role="follower", leader_lease=60.0,
+                            ha_node_id="b")
+    node_a = Node(Genesis.from_json(GENESIS))
+    a = Sequencer(node_a, l1, cfg_a)
+    node_b = Node(Genesis.from_json(GENESIS))
+    b = Sequencer(node_b, l1, cfg_b)
+    fetcher = BlockFetcher(node_b, l1, rollup=b.rollup)
+
+    assert a.leadership.try_acquire() is True
+    a.coordinator.verify_submissions = False  # stub STARK payload
+    _produce(node_a, 1)
+    assert a.commit_next_batch() is not None
+
+    # a small but REAL phase-checkpointed STARK pipeline as the
+    # prover's device work (same shape as the p2p prover soak)
+    rng = np.random.default_rng(23)
+    depth = 3
+    leaf = [int(v) for v in rng.integers(0, bb.P, 8)]
+    siblings = [[int(v) for v in rng.integers(0, bb.P, 8)]
+                for _ in range(depth)]
+    index = int(rng.integers(0, 1 << depth))
+    bits = [(index >> j) & 1 for j in range(depth)]
+    root = fold_path_canonical(index, leaf, siblings)
+    air = mair.Poseidon2MerkleAir(depth)
+    mtrace = mair.generate_merkle_trace(leaf, siblings, bits)
+    mpub = mair.merkle_public_inputs(leaf, root)
+    sparams = StarkParams(log_blowup=3, num_queries=12, log_final_size=4)
+
+    class CkptStarkBackend:
+        prover_type = protocol.PROVER_TPU
+
+        def prove(self, program_input, proof_format):
+            stark = stark_prover.prove(air, mtrace, mpub, sparams)
+            return {"backend": protocol.PROVER_TPU,
+                    "stark": {"fri_roots": len(stark["fri"]["roots"])},
+                    "output": "0x" + "00" * 176}
+
+    resumes_before = rt.STATS["phase_resumes"]
+    try:
+        # phase 1: the prover starts the proof homed on leader A and is
+        # preempted at its first phase boundary (checkpoints on disk)
+        with injected(FaultPlan(seed=5).drop("backend.phase", times=1)):
+            pc_a = ProverClient(CkptStarkBackend(),
+                                [("127.0.0.1", a.coordinator.port)],
+                                heartbeat_interval=0.1,
+                                backoff_base=0.01, rng_seed=9)
+            try:
+                pc_a.poll_once()
+            except Exception:  # noqa: BLE001 — the preemption itself
+                pass
+        assert a.rollup.get_proof(1, protocol.PROVER_TPU) is None
+
+        # leader A dies; the standby catches up and promotes, which
+        # re-homes the coordinator (same rollup view of batch 1)
+        a.coordinator.stop()
+        l1.expire_lease()
+        assert fetcher.fetch_once() == 1
+        assert b.leadership.try_acquire() is True
+        b.coordinator.verify_submissions = False
+
+        # phase 2: the SAME prover fleet polls the new home; the proof
+        # must RESUME from the phase checkpoints, not restart
+        pc_b = ProverClient(CkptStarkBackend(),
+                            [("127.0.0.1", b.coordinator.port)],
+                            heartbeat_interval=0.1,
+                            backoff_base=0.01, rng_seed=9)
+        deadline = time.time() + 90.0
+        while time.time() < deadline and \
+                b.rollup.get_proof(1, protocol.PROVER_TPU) is None:
+            pc_b.poll_once()
+            time.sleep(0.02)
+        assert b.rollup.get_proof(1, protocol.PROVER_TPU) is not None, \
+            "proof never landed at the new coordinator home"
+        assert rt.STATS["phase_resumes"] > resumes_before, \
+            "the re-homed prover re-proved from scratch instead of " \
+            "resuming its phase checkpoints"
+    finally:
+        a.stop()
+        b.stop()
+
+
+# ===========================================================================
+# BlockFetcher failure paths (the standby's lifeline)
+# ===========================================================================
+
+class _FlakyL1:
+    """Delegating wrapper whose last_committed_batch fails on demand."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.fail = True
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def last_committed_batch(self):
+        if self.fail:
+            raise ConnectionError("l1 unreachable")
+        return self._inner.last_committed_batch()
+
+
+def test_fetcher_counts_errors_and_flips_health():
+    node = Node(Genesis.from_json(GENESIS))
+    flaky = _FlakyL1(_l1())
+    fetcher = BlockFetcher(node, flaky, unhealthy_after=3)
+    assert fetcher.healthy()
+    fetcher.start(interval=0.01)
+    deadline = time.monotonic() + 5.0
+    while fetcher.consecutive_failures < 3 and \
+            time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert fetcher.fetch_errors >= 3
+    assert not fetcher.healthy()
+    assert "ConnectionError" in fetcher.last_error
+
+    # the L1 heals: one clean pass resets the consecutive run (the
+    # cumulative counter keeps the history) and health recovers
+    flaky.fail = False
+    deadline = time.monotonic() + 5.0
+    while not fetcher.healthy() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert fetcher.healthy()
+    assert fetcher.consecutive_failures == 0
+    assert fetcher.last_error is None
+    assert fetcher.fetch_errors >= 3
+    fetcher.stop()
+
+
+def test_fetcher_divergence_is_fatal():
+    l1 = _l1()
+    node_src, seq = _seq(l1)
+    _produce(node_src, 1)
+    seq.commit_next_batch()
+
+    class _LyingL1:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def get_committed_state_root(self, number):
+            return b"\xde" * 32  # disagrees with local execution
+
+    node = Node(Genesis.from_json(GENESIS))
+    fetcher = BlockFetcher(node, _LyingL1(l1))
+    fetcher.start(interval=0.01)
+    deadline = time.monotonic() + 5.0
+    while fetcher.fatal is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert fetcher.fatal is not None
+    assert not fetcher.healthy()
+    assert fetcher._stop.is_set()  # the loop stopped itself
+    fetcher.stop()
+    seq.stop()
+
+
+def test_fetcher_stop_idempotent_and_restartable():
+    l1 = _l1()
+    node_src, seq = _seq(l1)
+    node = Node(Genesis.from_json(GENESIS))
+    fetcher = BlockFetcher(node, l1)
+    fetcher.stop()  # before start(): no-op
+    fetcher.stop()
+
+    _produce(node_src, 1)
+    seq.commit_next_batch()
+    fetcher.start(interval=0.01)
+    fetcher.start(interval=0.01)  # idempotent while running
+    deadline = time.monotonic() + 5.0
+    while fetcher.batches_imported < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert fetcher.batches_imported == 1
+    fetcher.stop()
+    fetcher.stop()  # double-stop
+
+    # restart after stop resumes from next_batch
+    _produce(node_src, 2)
+    seq.commit_next_batch()
+    fetcher.start(interval=0.01)
+    deadline = time.monotonic() + 5.0
+    while fetcher.batches_imported < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert fetcher.batches_imported == 2
+    assert node.store.latest_number() == 2
+    assert fetcher.healthy()
+    fetcher.stop()
+    seq.stop()
+
+
+# ===========================================================================
+# stop idempotency (Sequencer + Node)
+# ===========================================================================
+
+def test_sequencer_stop_is_idempotent():
+    node, seq = _seq(_l1())
+    seq.start()
+    assert seq.stop() is True
+    assert seq.stop() is True  # second drain: cached verdict, no re-join
+
+    # follower-safe: a standby whose actors never started drains clean
+    node_f, follower = _seq(_l1(), role="follower")
+    assert follower.stop() is True
+    assert follower.stop() is True
+
+
+def test_node_stop_is_idempotent():
+    node = Node(Genesis.from_json(GENESIS))
+    assert node.stop() is True  # before any producer started
+    node2 = Node(Genesis.from_json(GENESIS))
+    node2.start_dev_producer(block_time=0.01)
+    assert node2.stop() is True
+    assert node2._producer_thread is None
+    assert node2.stop() is True
+
+
+# ===========================================================================
+# soak: live failover under load, downtime measured at the front door
+# ===========================================================================
+
+@pytest.mark.slow
+def test_ha_failover_soak_keeps_serving(tmp_path):
+    import json
+    import urllib.request
+
+    from ethrex_tpu.perf.loadgen import Harness
+    from ethrex_tpu.rpc.server import RpcServer
+
+    ttl = 0.6
+    l1 = _l1()
+    cfg = dict(block_time=0.05, commit_interval=0.05,
+               proof_send_interval=0.2, aggregation_interval=0.2,
+               watcher_interval=0.1)
+    node_a = Node(Genesis.from_json(GENESIS))
+    a = Sequencer(node_a, l1, SequencerConfig(
+        needed_prover_types=(protocol.PROVER_EXEC,), ha_role="leader",
+        leader_lease=ttl, ha_node_id="a", **cfg))
+    node_b = Node(Genesis.from_json(GENESIS))
+    b = Sequencer(node_b, l1, SequencerConfig(
+        needed_prover_types=(protocol.PROVER_EXEC,), ha_role="follower",
+        leader_lease=ttl, ha_node_id="b", **cfg))
+    node_b.sequencer = b
+    fetcher = BlockFetcher(node_b, l1, rollup=b.rollup)
+    rpc = RpcServer(node_b, port=0).start()
+
+    def ready():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{rpc.port}",
+            data=json.dumps({"jsonrpc": "2.0", "id": 1,
+                             "method": "ethrex_ready",
+                             "params": []}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5.0) as resp:
+            return json.loads(resp.read())["result"]
+
+    try:
+        a.start()
+        deadline = time.monotonic() + 5.0
+        while not a.leadership.is_leader() and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert a.leadership.is_leader()
+        b.start()
+        fetcher.start(interval=0.02)
+        assert ready()["ready"] is False  # the standby is NOT ready
+
+        def kill_leader():
+            time.sleep(1.0)
+            a._stop.set()
+            for t in a._threads:
+                t.join(timeout=5.0)
+            a.leadership._stop.set()
+            a.leadership._thread.join(timeout=5.0)
+            l1.expire_lease()
+
+        killer = threading.Thread(target=kill_leader, daemon=True)
+        killer.start()
+        # the front door keeps answering straight through the failover
+        harness = Harness(f"http://127.0.0.1:{rpc.port}",
+                          payload="ping", workers=2, timeout=5.0)
+        rep = harness.run(20.0, duration=4.0)
+        killer.join(10.0)
+        assert rep["delivered"] > 0
+        assert rep["errors"] == 0, "RPC errored during the failover"
+
+        deadline = time.monotonic() + 10.0
+        while not b.leadership.is_leader() and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert b.leadership.is_leader(), "standby never promoted"
+        rd = ready()
+        assert rd["ready"] is True and rd["role"] == ROLE_LEADER
+        # the measured promotion downtime is on the wire for operators
+        assert rd["leadership"]["promotionDowntimeSeconds"] is not None
+        assert rd["leadership"]["promotionDowntimeSeconds"] < ttl
+    finally:
+        rpc.stop()
+        fetcher.stop()
+        a.stop()
+        b.stop()

@@ -96,11 +96,34 @@ def test_every_fault_site_has_chaos_coverage():
 
     here = os.path.dirname(__file__)
     corpus = ""
-    for path in glob.glob(os.path.join(here, "test_*chaos*.py")):
+    # the HA leader-kill battery is a chaos battery in all but filename
+    paths = glob.glob(os.path.join(here, "test_*chaos*.py"))
+    paths.append(os.path.join(here, "test_sequencer_ha.py"))
+    for path in paths:
         with open(path) as f:
             corpus += f.read()
     missing = [s for s in sorted(faults.SITES) if f'"{s}"' not in corpus]
     assert not missing, f"fault sites without chaos coverage: {missing}"
+
+
+def test_ha_fault_sites_covered_by_ha_battery():
+    """The leadership sites are the HA battery's contract: each must be
+    exercised in tests/test_sequencer_ha.py specifically (not merely
+    mentioned somewhere in another battery)."""
+    import os
+
+    from ethrex_tpu.utils import faults
+
+    here = os.path.dirname(__file__)
+    with open(os.path.join(here, "test_sequencer_ha.py")) as f:
+        corpus = f.read()
+    ha_sites = ["l1.lease", "seq.fence"]
+    missing = [s for s in ha_sites if s not in faults.SITES]
+    assert not missing, \
+        f"HA fault sites missing from faults.SITES: {missing}"
+    missing = [s for s in ha_sites if f'"{s}"' not in corpus]
+    assert not missing, \
+        f"HA sites without HA-battery coverage: {missing}"
 
 
 def test_store_fault_sites_covered_by_storage_battery():
@@ -313,6 +336,7 @@ def test_every_metric_helper_has_help_text():
     import inspect
 
     from ethrex_tpu.blockchain import mempool
+    from ethrex_tpu.l2 import leadership
     from ethrex_tpu.perf import bench_suite, loadgen, profiler, roofline
     from ethrex_tpu.prover import checkpoint, runtime_errors
     from ethrex_tpu.utils import exec_cache, metrics, overload
@@ -321,7 +345,8 @@ def test_every_metric_helper_has_help_text():
 
     offenders = []
     for mod in (metrics, tracing, profiler, roofline, bench_suite, loadgen,
-                mempool, overload, exec_cache, checkpoint, runtime_errors):
+                mempool, overload, exec_cache, checkpoint, runtime_errors,
+                leadership):
         tree = ast.parse(inspect.getsource(mod))
         for fn in ast.walk(tree):
             if not isinstance(fn, ast.FunctionDef):
