@@ -16,6 +16,11 @@ timeseries/SLO substrate:
   root keeps a thin CLI shim), including the forced-CPU fallback for
   hosts whose TPU plugin is present but dead, and the append-only
   ``bench_history.jsonl`` trajectory.
+- ``hlo_introspect`` / ``occupancy`` (PR 18): the scaling autopsy —
+  per-kernel collective/reshard accounting straight from the compiled
+  programs' HLO plus device-occupancy timelines for the parallel
+  prover, consumed by the bench's ``explain_scaling`` diff
+  (docs/PERFORMANCE.md "Reading the scaling autopsy").
 
 Everything here is telemetry and sits behind the never-raise contract:
 a failing hook degrades to missing numbers, never a failed prove or

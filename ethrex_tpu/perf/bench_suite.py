@@ -765,12 +765,92 @@ def measure_warmup() -> None:
     print(json.dumps(record))
 
 
+def _scaling_prove_autopsy(ndev: int, mesh) -> dict:
+    """Per-kernel autopsy for one scaling child: a small FibonacciAir
+    prove on the child's mesh populates per-kernel AOT compile walls
+    (prover_phase_compile_seconds), measured walls (roofline), and HLO
+    collective accounting (perf/hlo_introspect.py); a second,
+    steady-state prove gives the wall the occupancy estimate is read
+    against.  Occupancy here is the single-lane host-idle signal: the
+    fraction of the prove wall spent inside the four device kernels
+    (the rest is host orchestration — Merkle paths, transcript, FRI
+    queries), computed through perf/occupancy.compute so the same
+    interval math the parallel prover uses carries the bench number.
+    BENCH_SCALING_PROVE_ROWS sizes the trace (default 128 rows)."""
+    from ethrex_tpu.models import fibonacci as fib
+    from ethrex_tpu.parallel import mesh as mesh_lib
+    from ethrex_tpu.perf import hlo_introspect
+    from ethrex_tpu.perf import occupancy as occ_mod
+    from ethrex_tpu.perf.roofline import ROOFLINE
+    from ethrex_tpu.stark import prover as stark_prover
+    from ethrex_tpu.stark.prover import StarkParams
+
+    rows = int(os.environ.get("BENCH_SCALING_PROVE_ROWS", "128"))
+    air = fib.FibonacciAir()
+    trace = fib.generate_trace(rows)
+    pub = fib.public_inputs(trace)
+    params = StarkParams(log_blowup=2, num_queries=8, log_final_size=4)
+    t0 = time.perf_counter()
+    stark_prover.prove(air, trace, pub, params, mesh=mesh)
+    warm_s = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    stark_prover.prove(air, trace, pub, params, mesh=mesh)
+    prove_wall = time.perf_counter() - t1
+
+    compile_walls = _phase_compile_walls()
+    mesh_label = mesh_lib.shape_label(mesh)
+    suffix = "" if mesh_label == "none" else "@" + mesh_label
+    intro = {(k["air"], k["kernel"]): k
+             for k in hlo_introspect.REGISTRY.report()["kernels"]}
+    kernels: dict = {}
+    intervals = []
+    acc = 0.0
+    for row in ROOFLINE.report()["kernels"]:
+        if row["air"] != "FibonacciAir":
+            continue
+        k = row["kernel"]
+        wall = row.get("wallLastSeconds") or 0.0
+        ir = intro.get(("FibonacciAir", k), {})
+        kernels[k] = {
+            "wall_s": round(wall, 6),
+            "compile_s": compile_walls.get(f"FibonacciAir/{k}{suffix}"),
+            "collective_ops": ir.get("collectiveOps", 0),
+            "collective_bytes": ir.get("crossDeviceBytes", 0),
+            "copy_ops": ir.get("copyOps", 0),
+            "hbm_bytes": ir.get("hbmPeakBytes"),
+        }
+        if wall > 0:
+            intervals.append((acc, acc + wall))
+            acc += wall
+    occ = occ_mod.compute(
+        {"0": {"intervals": intervals, "devices": ndev}},
+        devices=ndev, window=(0.0, max(prove_wall, acc)))
+    return {
+        "kernels": kernels,
+        "occupancy": {
+            "fraction": round(occ["occupancy"], 4),
+            "idle_gap_s": round(occ["idleGapSeconds"], 4),
+            "busy_device_s": round(occ["busyDeviceSeconds"], 4),
+            "wall_s": round(occ["wallSeconds"], 4),
+            "devices": ndev,
+        },
+        "prove_wall_s": round(prove_wall, 4),
+        "prove_warmup_s": round(warm_s, 4),
+        "prove_rows": rows,
+    }
+
+
 def measure_scaling_one() -> None:
     """One scaling sample: prove-core cells/s with the trace sharded
-    across EVERY visible device.  The parent sweep (--measure-scaling)
-    controls the device count by spawning this in a child process with
+    across EVERY visible device, plus the per-kernel autopsy fields the
+    parent's explain_scaling diff consumes ({wall, compile, collective
+    ops/bytes, HBM bytes} per kernel and a device-occupancy estimate —
+    docs/PERFORMANCE.md "Reading the scaling autopsy").  The parent
+    sweep (--measure-scaling) controls the device count by spawning
+    this in a child process with
     XLA_FLAGS=--xla_force_host_platform_device_count=N; on one device
-    this degrades to exactly the --measure-core configuration."""
+    the headline degrades to exactly the --measure-core configuration.
+    BENCH_SCALING_LOG_N sizes the fused core step (default 2^15 rows)."""
     _guard_backend()
     import jax
 
@@ -779,8 +859,10 @@ def measure_scaling_one() -> None:
 
     ndev = len(jax.devices())
     mesh = mesh_lib.make_mesh() if ndev > 1 else None
+    log_n = int(os.environ.get("BENCH_SCALING_LOG_N", "15"))
     t_c0 = time.perf_counter()
-    fn, args, _cost = compile_prove_step(log_n=15, width=64, log_blowup=2,
+    fn, args, _cost = compile_prove_step(log_n=log_n, width=64,
+                                         log_blowup=2,
                                          log_final_size=5, mesh=mesh)
     jax.block_until_ready(fn(*args))
     t_compile = time.perf_counter() - t_c0
@@ -790,7 +872,13 @@ def measure_scaling_one() -> None:
         jax.block_until_ready(fn(*args))
         runs.append(time.perf_counter() - t0)
     wall = min(runs)
-    value = (1 << 15) * 64 / wall
+    value = (1 << log_n) * 64 / wall
+    # the autopsy prove is additive telemetry: its failure degrades the
+    # child record to the pre-autopsy shape, never kills the sample
+    try:
+        autopsy = _scaling_prove_autopsy(ndev, mesh)
+    except Exception as exc:  # pragma: no cover - degradation path
+        autopsy = {"error": f"{type(exc).__name__}: {exc}"}
     print(json.dumps({
         "metric": "stark_prove_core_trace_cells_per_sec",
         "value": round(value, 1),
@@ -798,17 +886,144 @@ def measure_scaling_one() -> None:
         "devices": ndev,
         "stages": {"compile_and_warmup": round(t_compile, 4),
                    "best_of_5_runs": round(wall, 4)},
+        "kernels": autopsy.get("kernels", {}),
+        "occupancy": autopsy.get("occupancy", {}),
+        "prove_wall_s": autopsy.get("prove_wall_s"),
+        "autopsy_error": autopsy.get("error"),
     }))
+
+
+def _default_ici_gbps() -> float:
+    try:
+        from ethrex_tpu.perf import hlo_introspect
+
+        return hlo_introspect.ici_gbps()
+    except Exception:
+        return 75.0
+
+
+def explain_scaling(sweep: dict, ici_gbps: "float | None" = None) -> dict:
+    """Pure 1-vs-N scaling autopsy over the sweep's child records.
+
+    ``sweep`` maps str(device_count) -> the child JSON from
+    --measure-scaling-one.  The baseline is the smallest device count
+    carrying kernel data, the target the largest; for each kernel the
+    wall delta is attributed across the regressor classes the autopsy
+    can see — estimated collective seconds (collective bytes over the
+    ETHREX_ICI_GBPS interconnect anchor), compile multiplication, and
+    occupancy (host-idle) drop — and the dominant regressor is named
+    per kernel and for the whole target wall.  Unit-testable with
+    synthetic records; returns {"error": ...} when fewer than two
+    samples carry kernels."""
+    gbps = float(ici_gbps) if ici_gbps else _default_ici_gbps()
+
+    usable = {}
+    for key, rec in (sweep or {}).items():
+        try:
+            nd = int(key)
+        except (TypeError, ValueError):
+            continue
+        if isinstance(rec, dict) and isinstance(rec.get("kernels"), dict) \
+                and rec["kernels"]:
+            usable[nd] = rec
+    if len(usable) < 2:
+        return {"error": "need kernel data at >= 2 device counts",
+                "sampled": sorted(usable)}
+    base_n, tgt_n = min(usable), max(usable)
+    base, tgt = usable[base_n], usable[tgt_n]
+
+    kernels: dict = {}
+    total_delta = 0.0
+    total_coll_s = 0.0
+    for k, trow in tgt["kernels"].items():
+        brow = base["kernels"].get(k) or {}
+        bw = brow.get("wall_s") or 0.0
+        tw = trow.get("wall_s") or 0.0
+        delta = tw - bw
+        coll_bytes = float(trow.get("collective_bytes") or 0)
+        coll_s = coll_bytes / (gbps * 1e9)
+        bc, tc = brow.get("compile_s"), trow.get("compile_s")
+        compile_ratio = round(tc / bc, 2) if bc and tc else None
+        coll_share = min(1.0, coll_s / delta) if delta > 0 else 0.0
+        regressor = "collectives" if delta > 0 and coll_share >= 0.5 \
+            else ("wall" if delta > 0 else "none")
+        pct = round(100.0 * delta / bw, 1) if bw > 0 else None
+        bits = []
+        if pct is not None:
+            bits.append(f"{pct:+.0f}% wall")
+        if delta > 0 and coll_bytes:
+            bits.append(f"{100.0 * coll_share:.0f}% of delta is "
+                        "collective bytes")
+        if compile_ratio is not None:
+            bits.append(f"compile x{compile_ratio:.1f}")
+        kernels[k] = {
+            "baselineWallSeconds": bw, "targetWallSeconds": tw,
+            "wallDeltaSeconds": round(delta, 6), "wallDeltaPct": pct,
+            "collectiveOps": trow.get("collective_ops", 0),
+            "collectiveBytes": coll_bytes,
+            "estCollectiveSeconds": round(coll_s, 6),
+            "collectiveShareOfDelta": round(coll_share, 4),
+            "compileRatio": compile_ratio,
+            "regressor": regressor,
+            "summary": f"{k}: " + "; ".join(bits) if bits else k,
+        }
+        if delta > 0:
+            total_delta += delta
+            total_coll_s += min(coll_s, delta)
+
+    base_occ = ((base.get("occupancy") or {}).get("fraction"))
+    tgt_occ = ((tgt.get("occupancy") or {}).get("fraction"))
+    occ_drop = (base_occ - tgt_occ) \
+        if isinstance(base_occ, (int, float)) \
+        and isinstance(tgt_occ, (int, float)) else None
+
+    dominant_kernel = max(
+        kernels, key=lambda k: kernels[k]["wallDeltaSeconds"],
+        default=None)
+    if total_delta > 0 and total_coll_s / total_delta >= 0.5:
+        dom_class = "collectives"
+    elif occ_drop is not None and occ_drop >= 0.3:
+        dom_class = "idle"
+    elif total_delta > 0:
+        dom_class = kernels[dominant_kernel]["regressor"] \
+            if dominant_kernel else "wall"
+    else:
+        dom_class = "none"
+    dom_summary = kernels[dominant_kernel]["summary"] \
+        if dominant_kernel and total_delta > 0 else \
+        f"no kernel wall regressed from {base_n} to {tgt_n} devices"
+
+    bv, tv = base.get("value"), tgt.get("value")
+    ratio = round(tv / bv, 3) \
+        if isinstance(bv, (int, float)) and bv \
+        and isinstance(tv, (int, float)) else None
+    return {
+        "baselineDevices": base_n, "targetDevices": tgt_n,
+        "headline": {"baseline": bv, "target": tv,
+                     "targetOverBaseline": ratio},
+        "kernels": kernels,
+        "occupancy": {"baseline": base_occ, "target": tgt_occ,
+                      "drop": round(occ_drop, 4)
+                      if occ_drop is not None else None},
+        "dominant": {"kernel": dominant_kernel, "regressor": dom_class,
+                     "summary": dom_summary},
+        "iciGbpsAssumed": gbps,
+    }
 
 
 def measure_scaling() -> None:
     """Multi-device scaling sweep: prove-core cells/s at 1/2/4/8
     simulated host devices (BENCH_SCALING_DEVICES overrides the list),
     one child process per count so each run gets a fresh XLA device
-    topology.  Emits — and appends to bench_history.jsonl — ONE record
-    whose top-level `devices` / `scaling` fields exclude it from the
-    same-backend history gates: different device counts are different
-    hardware, not a regression signal."""
+    topology.  Each child also emits the per-kernel autopsy fields and
+    the record carries `autopsy` = explain_scaling(sweep) — the named
+    dominant regressor for the N-device wall (docs/PERFORMANCE.md
+    "Reading the scaling autopsy"); the human-readable summary prints
+    to stderr (stdout stays the one-JSON-line contract).  Emits — and
+    appends to bench_history.jsonl — ONE record whose top-level
+    `devices` / `scaling` fields exclude it from the same-backend
+    history gates: different device counts are different hardware, not
+    a regression signal."""
     counts = [int(c) for c in os.environ.get(
         "BENCH_SCALING_DEVICES", "1,2,4,8").split(",") if c.strip()]
     sweep = {}
@@ -830,6 +1045,10 @@ def measure_scaling() -> None:
         if isinstance(val, (int, float)) and (best is None
                                               or val > best[1]):
             best = (nd, float(val))
+    try:
+        autopsy = explain_scaling(sweep)
+    except Exception as exc:  # pragma: no cover - degradation path
+        autopsy = {"error": f"{type(exc).__name__}: {exc}"}
     record = {
         "metric": "stark_prove_core_trace_cells_per_sec",
         "value": round(best[1], 1) if best else 0.0,
@@ -837,11 +1056,26 @@ def measure_scaling() -> None:
         "devices": best[0] if best else 0,
         "backend": "cpu",
         "scaling": sweep,
+        "autopsy": autopsy,
         "stages": {"sweep_s": round(time.perf_counter() - t0, 4)},
         "config": "scaling sweep (simulated host devices: "
-                  + ",".join(str(c) for c in counts) + ")",
+                  + ",".join(str(c) for c in counts)
+                  + "; core log_n="
+                  + os.environ.get("BENCH_SCALING_LOG_N", "15")
+                  + ", autopsy prove rows="
+                  + os.environ.get("BENCH_SCALING_PROVE_ROWS", "128")
+                  + ")",
     }
     append_history(record)
+    dom = autopsy.get("dominant") if isinstance(autopsy, dict) else None
+    if isinstance(dom, dict):
+        print("scaling autopsy [{}->{} devices] dominant regressor: "
+              "{} — {}".format(autopsy.get("baselineDevices"),
+                               autopsy.get("targetDevices"),
+                               dom.get("regressor"), dom.get("summary")),
+              file=sys.stderr)
+        for k, row in sorted((autopsy.get("kernels") or {}).items()):
+            print("  " + str(row.get("summary")), file=sys.stderr)
     print(json.dumps(record))
 
 

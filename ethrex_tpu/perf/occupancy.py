@@ -1,0 +1,176 @@
+"""Device-occupancy timelines for the parallel prover.
+
+PR 11 taught `_run_proof_jobs` (prover/tpu_backend.py) to carve the
+mesh into slices and run VM proof jobs on them concurrently; the
+critical-path tracer then attributes the *host* wall.  What neither
+answers is ROADMAP item 1c's question: how busy were the devices?  A
+prove that keeps one slice saturated while three sit idle scales
+exactly as badly as the sweep shows, and nothing said so.
+
+This module turns per-lane busy intervals (one lane per mesh slice,
+weighted by the slice's device count) into:
+
+- an **occupancy fraction** per prove: busy-device-seconds divided by
+  devices × wall.  The serial fallback on an N-device mesh is bounded
+  by 1/N — the floor the `prover_occupancy_floor` alert watches.
+- per-lane busy/idle seconds where busy + idle == wall by
+  construction (tested to 5% against the measured wall).
+- **idle gaps**: spans of the wall where *no* lane was busy — the
+  between-phase bubbles cross-batch pipelining (item 1c) would fill.
+
+Interval math collapses overlaps before summing, so re-entrant spans
+on one lane never double-count.  All public entry points follow the
+telemetry never-raise contract.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..utils import metrics as metrics_mod
+
+
+def merge_intervals(intervals) -> list:
+    """Collapse a list of (start, end) pairs into sorted, disjoint
+    intervals.  Malformed entries (end <= start, non-numeric) are
+    dropped rather than raised on."""
+    clean = []
+    for pair in intervals or ():
+        try:
+            t0, t1 = float(pair[0]), float(pair[1])
+        except (TypeError, ValueError, IndexError):
+            continue
+        if t1 > t0:
+            clean.append((t0, t1))
+    clean.sort()
+    merged: list = []
+    for t0, t1 in clean:
+        if merged and t0 <= merged[-1][1]:
+            if t1 > merged[-1][1]:
+                merged[-1] = (merged[-1][0], t1)
+        else:
+            merged.append((t0, t1))
+    return merged
+
+
+def busy_seconds(intervals) -> float:
+    return sum(t1 - t0 for t0, t1 in merge_intervals(intervals))
+
+
+def compute(lanes, devices=None, window=None) -> dict:
+    """Occupancy report for one prove.
+
+    ``lanes`` maps a lane id to either a list of (start, end)
+    intervals or ``{"intervals": [...], "devices": k}`` (k = device
+    count of that mesh slice, default 1).  ``devices`` is the total
+    mesh size (defaults to the summed lane weights); ``window``
+    optionally pins (start, end) — otherwise the wall spans min start
+    to max end across all lanes.
+    """
+    norm = {}
+    for lane, spec in (lanes or {}).items():
+        if isinstance(spec, dict):
+            ivs = merge_intervals(spec.get("intervals"))
+            weight = max(1, int(spec.get("devices", 1) or 1))
+        else:
+            ivs = merge_intervals(spec)
+            weight = 1
+        norm[str(lane)] = (ivs, weight)
+
+    all_points = [t for ivs, _ in norm.values() for iv in ivs for t in iv]
+    if window is not None:
+        start, end = float(window[0]), float(window[1])
+    elif all_points:
+        start, end = min(all_points), max(all_points)
+    else:
+        start = end = 0.0
+    wall = max(0.0, end - start)
+
+    total_devices = devices
+    if not isinstance(total_devices, int) or total_devices < 1:
+        total_devices = sum(w for _, w in norm.values()) or 1
+
+    lane_rows = []
+    busy_device_s = 0.0
+    union: list = []
+    for lane in sorted(norm):
+        ivs, weight = norm[lane]
+        clipped = merge_intervals(
+            [(max(t0, start), min(t1, end)) for t0, t1 in ivs])
+        busy = sum(t1 - t0 for t0, t1 in clipped)
+        busy_device_s += busy * weight
+        union.extend(clipped)
+        lane_rows.append({
+            "lane": lane,
+            "devices": weight,
+            "busySeconds": busy,
+            "idleSeconds": max(0.0, wall - busy),
+            "intervals": len(clipped),
+        })
+
+    covered = merge_intervals(union)
+    covered_s = sum(t1 - t0 for t0, t1 in covered)
+    idle_gap_s = max(0.0, wall - covered_s)
+    denom = total_devices * wall
+    occupancy = (busy_device_s / denom) if denom > 0 else 0.0
+    return {
+        "wallSeconds": wall,
+        "devices": total_devices,
+        "lanes": lane_rows,
+        "busyDeviceSeconds": busy_device_s,
+        "occupancy": min(1.0, occupancy),
+        "idleGapSeconds": idle_gap_s,
+        "idleGapCount": max(0, len(covered) - 1) if wall > 0 else 0,
+    }
+
+
+class OccupancyRegistry:
+    """Recent per-prove occupancy reports, bounded; report() is the
+    ethrex_perf / flight-recorder payload and degrades to a stub on
+    nodes that never proved (L1-only)."""
+
+    MAX_RECORDS = 64
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._records: list = []
+
+    def record(self, report: dict) -> None:
+        with self._lock:
+            self._records.append(report)
+            if len(self._records) > self.MAX_RECORDS:
+                self._records = self._records[-self.MAX_RECORDS:]
+
+    def last(self) -> dict | None:
+        with self._lock:
+            return dict(self._records[-1]) if self._records else None
+
+    def report(self) -> dict:
+        with self._lock:
+            n = len(self._records)
+            last = dict(self._records[-1]) if self._records else None
+            worst = min((r.get("occupancy", 0.0) for r in self._records),
+                        default=None)
+        return {"provesRecorded": n, "lastProve": last,
+                "worstOccupancy": worst}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._records.clear()
+
+
+REGISTRY = OccupancyRegistry()
+
+
+def record_prove(lanes, devices=None, window=None) -> None:
+    """Never-raise hook called by `_run_proof_jobs` after the VM batch:
+    compute one prove's occupancy, stash it, refresh the
+    prover_device_occupancy / idle-gap gauges."""
+    try:
+        report = compute(lanes, devices=devices, window=window)
+        REGISTRY.record(report)
+        metrics_mod.record_device_occupancy(
+            report["occupancy"], report["idleGapSeconds"],
+            report["devices"])
+    except Exception:
+        pass

@@ -58,11 +58,31 @@ def peak_flops_estimate(backend: str | None = None) -> float | None:
     return _PEAK_DEFAULTS.get(backend)
 
 
+def _cost_field(entry, key: str, attr: str):
+    """One cost/memory number from a dict entry (``entry[key]``) or an
+    attribute-style entry (``entry.attr``, newer jaxlib properties);
+    None when absent, non-numeric, or negative."""
+    if isinstance(entry, dict):
+        v = entry.get(key)
+    else:
+        try:
+            v = getattr(entry, attr, None)
+            if callable(v):
+                v = v()
+        except Exception:  # raising properties/accessors -> absent field
+            return None
+    if isinstance(v, bool) or not isinstance(v, (int, float)) or v < 0:
+        return None
+    return float(v)
+
+
 def _parse_cost(cost) -> dict:
     """Normalize any cost_analysis() shape to {'flops', 'bytes'} with
     float-or-None values.  jax 0.4.x returns a list with one dict per
-    computation; older/newer versions return a bare dict; CPU backends
-    may return None or omit keys."""
+    computation; older/newer versions return a bare dict; newer jaxlib
+    AOT surfaces hand back property objects (``.flops`` /
+    ``.bytes_accessed``); CPU backends may return None or omit keys.
+    Every form degrades to partial rows, never an error."""
     out = {"flops": None, "bytes": None}
     if cost is None:
         return out
@@ -71,15 +91,15 @@ def _parse_cost(cost) -> dict:
     nbytes = 0.0
     saw_flops = saw_bytes = False
     for entry in entries:
-        if not isinstance(entry, dict):
+        if entry is None or isinstance(entry, (int, float, str)):
             continue
-        f = entry.get("flops")
-        if isinstance(f, (int, float)) and f >= 0:
-            flops += float(f)
+        f = _cost_field(entry, "flops", "flops")
+        if f is not None:
+            flops += f
             saw_flops = True
-        b = entry.get("bytes accessed")
-        if isinstance(b, (int, float)) and b >= 0:
-            nbytes += float(b)
+        b = _cost_field(entry, "bytes accessed", "bytes_accessed")
+        if b is not None:
+            nbytes += b
             saw_bytes = True
     if saw_flops:
         out["flops"] = flops

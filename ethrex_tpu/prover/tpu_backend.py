@@ -425,14 +425,32 @@ def _run_proof_jobs(jobs: list, mesh) -> dict:
 
     ckpt_ctx = ckpt_mod.current_context()
 
-    def _run_one(name, group, build, job_mesh):
+    def _run_one(name, group, build, job_mesh, lane=0, lane_devices=1):
         stage = name if group == "vm_circuits" else group
         # the job name scopes this job's phase checkpoints; activate()
         # also re-binds the batch context on pool worker threads
-        # (threading.local does not cross ThreadPoolExecutor)
+        # (threading.local does not cross ThreadPoolExecutor).  The
+        # deviceLane attr routes the span onto its mesh slice's lane in
+        # the Perfetto export (tracing.to_trace_events).
         with ckpt_mod.activate(ckpt_ctx, job=name):
-            with tracing.span(f"prove.{name}", stage=stage):
+            with tracing.span(f"prove.{name}", stage=stage,
+                              deviceLane=lane, laneDevices=lane_devices):
                 return build(job_mesh)
+
+    def _record_occupancy(lane_timings, lane_devices):
+        # occupancy telemetry (perf/occupancy.py): busy intervals per
+        # mesh-slice lane, weighted by slice size, against the full
+        # ndev mesh — never-raise
+        try:
+            from ..perf import occupancy as occ_mod
+
+            lanes = {str(i): {"intervals": ivs,
+                              "devices": lane_devices.get(i, 1)}
+                     for i, ivs in lane_timings.items() if ivs}
+            if lanes:
+                occ_mod.record_prove(lanes, devices=ndev)
+        except Exception:
+            pass
 
     results: dict = {}
     vm_jobs = [j for j in jobs if j[1] == "vm_circuits"]
@@ -441,13 +459,24 @@ def _run_proof_jobs(jobs: list, mesh) -> dict:
             metrics_mod.record_vm_parallelism(1)
         except Exception:
             pass
+        serial_ivs: list = []
         for name, group, build in jobs:
             if group != "vm_circuits":
-                results[name] = _run_one(name, group, build, mesh)
+                t0 = _time.perf_counter()
+                results[name] = _run_one(name, group, build, mesh,
+                                         lane=0, lane_devices=ndev)
+                serial_ivs.append((t0, _time.perf_counter()))
         if vm_jobs:
             with tracing.span("prove.vm_proofs", stage="vm_circuits"):
                 for name, group, build in vm_jobs:
-                    results[name] = _run_one(name, group, build, mesh)
+                    t0 = _time.perf_counter()
+                    results[name] = _run_one(name, group, build, mesh,
+                                             lane=0, lane_devices=ndev)
+                    serial_ivs.append((t0, _time.perf_counter()))
+        # one lane carrying the whole mesh: a single-job prove on an
+        # N-device mesh still keeps all N devices (weight = ndev, so
+        # occupancy reflects mesh-sharded, not sliced, execution)
+        _record_occupancy({0: serial_ivs}, {0: ndev})
         return results
 
     slices = mesh_lib.split_mesh(mesh, len(jobs))
@@ -465,19 +494,30 @@ def _run_proof_jobs(jobs: list, mesh) -> dict:
     cur = tracing.current()
     tid, pid = cur if cur else (None, None)
     timings: dict = {}
+    lane_timings: dict = {i: [] for i in range(len(slices))}
+    lane_devices = {}
+    for i, s in enumerate(slices):
+        try:
+            lane_devices[i] = max(1, int(s.devices.size))
+        except Exception:
+            lane_devices[i] = 1
 
-    def _worker(slice_mesh, slice_jobs):
+    def _worker(lane, slice_mesh, slice_jobs):
         # re-enter the prove's trace on this thread so every job span
         # (and its stark child spans) joins the same subtree
         with tracing.trace_context(tid, pid):
             for name, group, build in slice_jobs:
                 t0 = _time.perf_counter()
-                results[name] = _run_one(name, group, build, slice_mesh)
-                timings[name] = (t0, _time.perf_counter())
+                results[name] = _run_one(
+                    name, group, build, slice_mesh, lane=lane,
+                    lane_devices=lane_devices.get(lane, 1))
+                t1 = _time.perf_counter()
+                timings[name] = (t0, t1)
+                lane_timings[lane].append((t0, t1))
 
     with ThreadPoolExecutor(max_workers=len(slices)) as pool:
-        futs = [pool.submit(_worker, s, a)
-                for s, a in zip(slices, assigned) if a]
+        futs = [pool.submit(_worker, i, s, a)
+                for i, (s, a) in enumerate(zip(slices, assigned)) if a]
         for f in futs:
             f.result()
 
@@ -489,6 +529,7 @@ def _run_proof_jobs(jobs: list, mesh) -> dict:
             metrics_mod.observe_prover_stage("vm_circuits", wall)
         except Exception:
             pass
+    _record_occupancy(lane_timings, lane_devices)
     return results
 
 

@@ -1018,6 +1018,20 @@ def _perf(node):
     except Exception as exc:  # noqa: BLE001 — telemetry endpoint
         out["executableCache"] = {
             "error": f"{type(exc).__name__}: {exc}"}
+    # scaling-autopsy sections (PR 18): HLO collective accounting and
+    # device-occupancy timelines.  Both registries answer an empty
+    # stub on L1-only / pre-autopsy nodes — the monitor renders, never
+    # KeyErrors (regression-tested in tests/test_scaling_autopsy.py).
+    try:
+        from ..perf import hlo_introspect
+        out["collectives"] = hlo_introspect.REGISTRY.report()
+    except Exception as exc:  # noqa: BLE001 — telemetry endpoint
+        out["collectives"] = {"error": f"{type(exc).__name__}: {exc}"}
+    try:
+        from ..perf import occupancy
+        out["occupancy"] = occupancy.REGISTRY.report()
+    except Exception as exc:  # noqa: BLE001 — telemetry endpoint
+        out["occupancy"] = {"error": f"{type(exc).__name__}: {exc}"}
     return out
 
 
@@ -1162,6 +1176,18 @@ def _health(node):
         out["perf"]["executableCache"] = {
             k: cache.get(k)
             for k in ("hits", "misses", "errors", "entries", "enabled")}
+        # scaling-autopsy posture (PR 18): kernel rows with collective
+        # accounting and the last prove's device occupancy — None/0 on
+        # L1-only nodes, never an error
+        from ..perf import hlo_introspect, occupancy
+
+        coll = hlo_introspect.REGISTRY.report().get("kernels") or []
+        occ = occupancy.REGISTRY.report()
+        last = occ.get("lastProve") or {}
+        out["perf"]["kernelsIntrospected"] = len(coll)
+        out["perf"]["collectiveOpsTotal"] = sum(
+            k.get("collectiveOps") or 0 for k in coll)
+        out["perf"]["deviceOccupancy"] = last.get("occupancy")
     except Exception:  # noqa: BLE001 — health must answer regardless
         pass
     seq = getattr(node, "sequencer", None)

@@ -173,6 +173,14 @@ def _record_phase_cost(air_name: str, kernel: str, compiled,
                              devices=devices)
     except Exception:
         pass
+    # collective accounting rides the same compiled handle: HLO text +
+    # memory_analysis, per (air, kernel, devices) — never-raise
+    try:
+        from ..perf import hlo_introspect
+
+        hlo_introspect.record(air_name, kernel, compiled, devices=devices)
+    except Exception:
+        pass
 
 
 def _record_phase_wall(air_name: str, kernel: str, seconds: float) -> None:
@@ -180,6 +188,12 @@ def _record_phase_wall(air_name: str, kernel: str, seconds: float) -> None:
         from ..perf import roofline
 
         roofline.record_wall(air_name, kernel, seconds)
+    except Exception:
+        pass
+    try:
+        from ..perf import hlo_introspect
+
+        hlo_introspect.record_collective_share(air_name, kernel, seconds)
     except Exception:
         pass
 

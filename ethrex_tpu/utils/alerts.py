@@ -530,6 +530,30 @@ def default_rules(node=None) -> list:
            runbook="Persistent repricing churn; compare against base-fee "
                    "movement and the dynamic fee floor in "
                    "ethrex_health mempool stats."),
+        # scaling autopsy (PR 18): the two regressor classes the sweep
+        # names — idle devices and collective-dominated kernel walls.
+        # Both gauges only exist after a prove (gauge_signal answers
+        # None before the first sample), so L1-only nodes never fire.
+        mk("prover_occupancy_floor:warn", "warn",
+           gauge_signal("prover_device_occupancy"), 0.5,
+           window=60.0, for_count=3, resolve_count=3, below=True,
+           description="Device occupancy of the last proves below 50%",
+           runbook="Read ethrex_perf's occupancy section (per-lane busy "
+                   "vs idle) and the Perfetto device-lane view; a low "
+                   "fraction with large idleGapSeconds means the mesh "
+                   "slices are starved between jobs — the cross-batch "
+                   "pipelining signal (docs/PERFORMANCE.md \"Reading "
+                   "the scaling autopsy\")."),
+        mk("prover_collective_share:warn", "warn",
+           gauge_signal("prover_collective_wall_share"), 0.4,
+           window=60.0, for_count=3, resolve_count=3,
+           description="Estimated collective share of a kernel wall "
+                       "above 40%",
+           runbook="ethrex_perf's collectives section names the kernel "
+                   "and op mix (all-gather vs all-reduce bytes); "
+                   "re-check _MeshPlan's phase-boundary shardings and "
+                   "the explain_scaling autopsy in the latest "
+                   "bench_history.jsonl scaling record."),
     ]
 
 

@@ -546,6 +546,20 @@ def record_vm_parallelism(n: int):
                 "batch's VM-circuit STARK proofs (1 = serial)")
 
 
+def record_device_occupancy(fraction: float, idle_gap_seconds: float,
+                            devices: int = 1):
+    METRICS.set("prover_device_occupancy", float(fraction),
+                help_text="Device-occupancy fraction of the last prove: "
+                "busy-device-seconds / (mesh devices x wall).  The "
+                "serial fallback on an N-device mesh is bounded by 1/N "
+                "(prover_occupancy_floor alert)")
+    METRICS.set("prover_device_idle_gap_seconds", float(idle_gap_seconds),
+                help_text="Wall-clock of the last prove's VM batch "
+                "during which no mesh slice was busy — the "
+                "between-phase bubbles cross-batch pipelining would "
+                "fill (ROADMAP item 1c)")
+
+
 def record_jax_compile(seconds: float):
     METRICS.inc("jax_backend_compiles_total", 1,
                 "XLA backend compilations observed via jax.monitoring")

@@ -447,6 +447,55 @@ def _perf_lines(snap: dict, width: int) -> list[str]:
             lines.append(f"   {str(k.get('air', '?')):<20}"
                          f" {str(k.get('kernel', '?')):<10}"
                          f" flops {fshown:>10}  util {shown:>7}")
+    # scaling autopsy (PR 18): per-kernel collective accounting and the
+    # last prove's per-lane device occupancy — both sections are stubs
+    # on L1-only / pre-autopsy nodes and simply add no lines
+    coll = perf.get("collectives")
+    ckernels = coll.get("kernels") if isinstance(coll, dict) else None
+    if isinstance(ckernels, list):
+        rows = [k for k in ckernels if isinstance(k, dict)
+                and (k.get("collectiveOps") or k.get("copyOps"))]
+        if rows:
+            lines.append("   collectives (ops / est cross-device bytes)")
+            rows.sort(key=lambda k: k.get("crossDeviceBytes") or 0,
+                      reverse=True)
+            for k in rows[:4]:
+                ops = k.get("collectiveOps")
+                nbytes = k.get("crossDeviceBytes")
+                oshown = f"{ops:.0f}" if isinstance(ops,
+                                                    (int, float)) else "—"
+                bshown = f"{nbytes:.3g}" if isinstance(
+                    nbytes, (int, float)) else "—"
+                lines.append(f"   {str(k.get('air', '?')):<20}"
+                             f" {str(k.get('kernel', '?')):<10}"
+                             f" ops {oshown:>5}  bytes {bshown:>10}"
+                             f"  x{k.get('devices', 1)}dev")
+    occ = perf.get("occupancy")
+    last = occ.get("lastProve") if isinstance(occ, dict) else None
+    if isinstance(last, dict):
+        frac = last.get("occupancy")
+        gap = last.get("idleGapSeconds")
+        fshown = f"{100 * frac:.0f}%" if isinstance(frac,
+                                                    (int, float)) else "—"
+        gshown = f"{gap:.2f}s" if isinstance(gap, (int, float)) else "—"
+        lines.append(f"   occupancy {fshown:>5} of"
+                     f" {last.get('devices', '—')} devices"
+                     f"   idle gaps {gshown}")
+        lanes = last.get("lanes")
+        if isinstance(lanes, list):
+            for lane in lanes[:4]:
+                if not isinstance(lane, dict):
+                    continue
+                busy = lane.get("busySeconds")
+                idle = lane.get("idleSeconds")
+                bs = f"{busy:.2f}s" if isinstance(busy,
+                                                  (int, float)) else "—"
+                is_ = f"{idle:.2f}s" if isinstance(idle,
+                                                   (int, float)) else "—"
+                lines.append(
+                    f"     lane {str(lane.get('lane', '?')):<4}"
+                    f" ({lane.get('devices', 1)} dev)"
+                    f"  busy {bs:>8}  idle {is_:>8}")
     return lines if len(lines) > 2 else []
 
 

@@ -82,10 +82,12 @@ def _store(node):
 
 
 def _perf():
-    from ..perf import profiler, roofline
+    from ..perf import hlo_introspect, occupancy, profiler, roofline
 
     return {"profiler": profiler.PROFILER.tree(),
-            "roofline": roofline.ROOFLINE.report()}
+            "roofline": roofline.ROOFLINE.report(),
+            "collectives": hlo_introspect.REGISTRY.report(),
+            "occupancy": occupancy.REGISTRY.report()}
 
 
 def _traffic(node):

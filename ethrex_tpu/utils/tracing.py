@@ -664,10 +664,14 @@ def to_trace_events(trace: "dict | None") -> dict:
 
     pid 1 is the local process (coordinator/sequencer spans); each
     remote span ``source`` gets its own pid with process_name metadata,
-    so a hedged batch renders as two prover tracks.  Parent->child
-    links that cross a pid — the submit seam — are emitted as flow
-    events ("s"/"f") so the viewer draws the arrow across processes.
-    Never raises; malformed spans are skipped.
+    so a hedged batch renders as two prover tracks.  Spans carrying a
+    ``deviceLane`` attr (the parallel prover's mesh-slice jobs,
+    prover/tpu_backend.py) render on a per-lane thread track
+    ("device-lane N (k dev)") instead of tid 1, so slice concurrency
+    and the idle bubbles between jobs are visible in Perfetto.
+    Parent->child links that cross a pid — the submit seam — are
+    emitted as flow events ("s"/"f") so the viewer draws the arrow
+    across processes.  Never raises; malformed spans are skipped.
     """
     tid = trace.get("traceId") if isinstance(trace, dict) else None
     raw = trace.get("spans") if isinstance(trace, dict) else None
@@ -693,6 +697,33 @@ def to_trace_events(trace: "dict | None") -> dict:
             return pids.get(s.get("source")
                             if isinstance(s.get("source"), str) else None, 1)
 
+        def _lane(s):
+            attrs = s.get("attrs")
+            lane = attrs.get("deviceLane") if isinstance(attrs, dict) \
+                else None
+            if isinstance(lane, (int, float)) and not isinstance(lane, bool) \
+                    and 0 <= int(lane) < 4096:
+                return int(lane)
+            return None
+
+        lane_meta = set()
+        for s in spans:
+            lane = _lane(s)
+            if lane is None:
+                continue
+            key = (_pid(s), lane)
+            if key in lane_meta:
+                continue
+            lane_meta.add(key)
+            attrs = s.get("attrs") or {}
+            ndev = attrs.get("laneDevices")
+            label = f"device-lane {lane}"
+            if isinstance(ndev, (int, float)) and ndev:
+                label += f" ({int(ndev)} dev)"
+            events.append({"ph": "M", "pid": key[0], "tid": 2 + lane,
+                           "ts": 0, "name": "thread_name",
+                           "args": {"name": label}})
+
         ids: "dict[str, dict]" = {}
         for s in spans:
             sid = s.get("spanId")
@@ -704,10 +735,11 @@ def to_trace_events(trace: "dict | None") -> dict:
             attrs = s.get("attrs")
             if isinstance(attrs, dict):
                 args.update({str(k): _jsonable(v) for k, v in attrs.items()})
+            lane = _lane(s)
             events.append({
                 "ph": "X", "cat": "span",
                 "name": str(s.get("name") or "?"),
-                "pid": _pid(s), "tid": 1,
+                "pid": _pid(s), "tid": 1 if lane is None else 2 + lane,
                 "ts": round(s["start"] * 1e6, 3),
                 "dur": max(1.0, round(max(0.0, s["seconds"]) * 1e6, 3)),
                 "args": args,

@@ -337,14 +337,16 @@ def test_every_metric_helper_has_help_text():
 
     from ethrex_tpu.blockchain import mempool
     from ethrex_tpu.l2 import leadership
-    from ethrex_tpu.perf import bench_suite, loadgen, profiler, roofline
+    from ethrex_tpu.perf import (bench_suite, hlo_introspect, loadgen,
+                                 occupancy, profiler, roofline)
     from ethrex_tpu.prover import checkpoint, runtime_errors
     from ethrex_tpu.utils import exec_cache, metrics, overload
 
     from ethrex_tpu.utils import tracing
 
     offenders = []
-    for mod in (metrics, tracing, profiler, roofline, bench_suite, loadgen,
+    for mod in (metrics, tracing, profiler, roofline, hlo_introspect,
+                occupancy, bench_suite, loadgen,
                 mempool, overload, exec_cache, checkpoint, runtime_errors,
                 leadership):
         tree = ast.parse(inspect.getsource(mod))
@@ -490,6 +492,37 @@ def test_every_bench_config_emits_stages():
             offenders.append(fn.name)
     assert not offenders, \
         f"bench configs without a stages breakdown: {offenders}"
+
+
+def test_scaling_bench_emits_autopsy_fields():
+    """The scaling sweep is only useful if it stays self-explaining:
+    statically require measure_scaling to build its record with the
+    "scaling" and "autopsy" keys, and measure_scaling_one to emit the
+    per-kernel "kernels" and "occupancy" fields explain_scaling
+    consumes — dropping any of them silently re-opens the ROADMAP
+    item-1 attribution gap this layer closed."""
+    import ast
+    import inspect
+
+    from ethrex_tpu.perf import bench_suite
+
+    tree = ast.parse(inspect.getsource(bench_suite))
+    required = {"measure_scaling": {"scaling", "autopsy"},
+                "measure_scaling_one": {"kernels", "occupancy"}}
+    offenders = []
+    for fn in tree.body:
+        if not isinstance(fn, ast.FunctionDef) or fn.name not in required:
+            continue
+        keys = {k.value for node in ast.walk(fn)
+                if isinstance(node, ast.Dict)
+                for k in node.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)}
+        missing = required.pop(fn.name) - keys
+        if missing:
+            offenders.append(f"{fn.name} missing {sorted(missing)}")
+    offenders.extend(f"{name} not found" for name in required)
+    assert not offenders, \
+        f"scaling bench lost its autopsy fields: {offenders}"
 
 
 def test_every_env_knob_is_documented():
