@@ -12,6 +12,7 @@ from ..utils.faults import inject
 from ..utils.metrics import (record_mempool_admission,
                              record_mempool_eviction,
                              record_mempool_occupancy,
+                             record_mempool_reinjection,
                              record_mempool_rejection,
                              record_mempool_replacement,
                              observe_time_in_pool)
@@ -116,6 +117,7 @@ class Mempool:
         self.added_at: dict[bytes, float] = {}
         self.admitted = 0
         self.replacements = 0
+        self.reinjections = 0
         self.rejections: dict[str, int] = {}
         self.evictions: dict[str, int] = {}
 
@@ -258,6 +260,81 @@ class Mempool:
             hook(tx.hash)
         return tx.hash
 
+    def reinject(self, tx: Transaction, blobs_bundle=None) -> bool:
+        """Typed reorg re-injection path (docs/CHAIN_RESILIENCE.md): the
+        tx was already admitted once and included on a now-orphaned
+        block, so the fee floor, sender cap and nonce-gap rules do NOT
+        apply — dropping it at admission would silently lose an
+        accepted transaction, breaking the reorg conservation
+        invariant.  Capacity still binds (FIFO eviction keeps the pool
+        bounded) and the ReorgHandler's revalidation pass prunes
+        entries the new canonical state invalidated.  Returns True if
+        the tx entered the pool; False for duplicates, an occupied
+        sender+nonce slot (the pool's entry postdates the orphan and
+        wins), or a blob tx without its bundle."""
+        # chaos seat: the re-injection path crashing mid-reorg (fired
+        # OUTSIDE self.lock, like mempool.add)
+        inject("mempool.reinject")
+        sender = tx.sender()
+        if sender is None:
+            return False
+        if tx.tx_type == TYPE_BLOB and blobs_bundle is None:
+            return False
+        with self.lock:
+            if tx.hash in self.by_hash:
+                return False
+            existing_queue = self.by_sender.get(sender)
+            if existing_queue is not None and \
+                    existing_queue.get(tx.nonce) is not None:
+                return False
+            queue = self.by_sender.setdefault(sender, {})
+            queue[tx.nonce] = tx
+            self.by_hash[tx.hash] = tx
+            self.added_at[tx.hash] = time.monotonic()
+            if blobs_bundle is not None:
+                self.blobs_bundles[tx.hash] = blobs_bundle
+                self._evict_worst_blob()
+            else:
+                self.txs_order.append(tx.hash)
+                self._evict_oldest_regular()
+            self.reinjections += 1
+            record_mempool_reinjection()
+            self._publish_occupancy_locked()
+        # re-injected txs are pending again: the newPendingTransactions
+        # subscription and pending filters must see them
+        for hook in list(self.on_add):
+            hook(tx.hash)
+        return True
+
+    def revalidate(self, get_account) -> dict[str, int]:
+        """Prune entries the new canonical state invalidated (the reorg
+        aftermath): a nonce below the account's (another tx with that
+        nonce landed on the winning branch) or a cost the balance no
+        longer covers.  Returns {reason: count}; each prune is counted
+        in the pool's eviction ledger under its typed reason."""
+        with self.lock:
+            snapshot = list(self.by_hash.values())
+        pruned: dict[str, int] = {}
+        accounts: dict[bytes, tuple[int, int]] = {}
+        for tx in snapshot:
+            sender = tx.sender()
+            if sender is None:
+                continue
+            if sender not in accounts:
+                acct = get_account(sender)
+                accounts[sender] = (acct.nonce if acct else 0,
+                                    acct.balance if acct else 0)
+            nonce, balance = accounts[sender]
+            reason = None
+            if tx.nonce < nonce:
+                reason = "nonce_below_account"
+            elif tx.gas_limit * tx.max_fee() + tx.value > balance:
+                reason = "insufficient_balance"
+            if reason is not None:
+                self.remove_transaction(tx.hash, reason=reason)
+                pruned[reason] = pruned.get(reason, 0) + 1
+        return pruned
+
     def _regular_tx_count(self) -> int:
         return len(self.by_hash) - len(self.blobs_bundles)
 
@@ -354,6 +431,7 @@ class Mempool:
                 "utilization": round(self._utilization(), 6),
                 "admitted": self.admitted,
                 "replacements": self.replacements,
+                "reinjections": self.reinjections,
                 "senderSlotCap": self.max_sender_slots,
                 "nonceGapLimit": self.max_nonce_gap,
                 "rejections": dict(sorted(self.rejections.items())),

@@ -9,7 +9,7 @@ import threading
 import time
 
 from .blockchain.blockchain import Blockchain
-from .blockchain.fork_choice import apply_fork_choice
+from .blockchain.fork_choice import ReorgHandler
 from .blockchain.mempool import Mempool, MempoolError
 from .blockchain.payload import build_payload, create_payload_header
 from .evm.executor import InvalidTransaction
@@ -35,6 +35,18 @@ class Node:
         # new-canonical-block observers (websocket subscriptions etc.);
         # `on_new_block` stays the single p2p gossip hook
         self.block_listeners: list = []
+        # the reorg seam: every head move (producer, p2p import, engine
+        # forkchoiceUpdated) goes through one handler so the mempool is
+        # re-injected/evicted/revalidated and subscribers notified on
+        # every reorg (docs/CHAIN_RESILIENCE.md).  Shares the node lock
+        # so engine-driven reorgs serialize with block production.
+        self.reorg_handler = ReorgHandler(self.store, self.mempool,
+                                          lock=self.lock)
+        self.reorg_listeners = self.reorg_handler.listeners
+        # crash-only restart: if a previous process died between the
+        # canonical rewrite and the mempool settlement, replay the
+        # journaled re-injection now (no transaction silently lost)
+        self.reorg_handler.recover_pending()
         # observability surfaces attached by start_telemetry / the CLI
         self.telemetry = None
         self.alerts = None
@@ -122,7 +134,7 @@ class Node:
             # persistent stores (write groups nest; see write_group)
             with self.store.write_group():
                 self.chain.add_block(result.block)
-                apply_fork_choice(self.store, result.block.hash)
+                self.reorg_handler.apply(result.block.hash)
             for tx in result.block.body.transactions:
                 self.mempool.remove_transaction(tx.hash, reason="included")
             from .utils.metrics import record_block
@@ -151,14 +163,13 @@ class Node:
         """Serialized p2p import: validates + stores + fork-chooses under
         the node lock, then relays.  Returns True if the block was new."""
         from .blockchain.blockchain import InvalidBlock
-        from .blockchain.fork_choice import apply_fork_choice
 
         with self.lock:
             if self.store.get_header(block.hash) is not None:
                 return False
             with self.store.write_group():
                 self.chain.add_block(block)  # raises InvalidBlock
-                apply_fork_choice(self.store, block.hash)
+                self.reorg_handler.apply(block.hash)
         self._gossip(block)  # transitive relay (terminates: peers that
         return True          # already have it import nothing and don't relay
 

@@ -39,6 +39,7 @@ import hashlib
 import http.client
 import json
 import random
+import threading
 import time
 import urllib.request
 from urllib.parse import urlparse
@@ -684,6 +685,96 @@ def run_load(url: str, secret: int, num_txs: int,
 
 
 # ---------------------------------------------------------------------------
+# reorg chaos driver (docs/CHAIN_RESILIENCE.md "The reorg storm")
+
+
+class ReorgDriver:
+    """Periodic depth-k fork-choice flips while open-loop load runs —
+    the reorg-storm half of the chaos harness (tests/test_reorg_chaos.py
+    soak; reusable by future batteries).
+
+    Works over the engine API alone: each flip records the current tip,
+    rolls the head back `depth` blocks with engine_forkchoiceUpdatedV3
+    (orphaning the top of the chain and re-injecting its txs), then
+    re-adopts the recorded tip.  Blocks produced between the two legs
+    turn the rollback into a genuine sibling-branch reorg.  `call` is
+    any `call(method, *params) -> result` reaching an engine-authorized
+    endpoint: tests pass an in-process dispatcher; the CLI builds a
+    JWT-bearing HTTP caller from --engine-url/--jwt-hex."""
+
+    def __init__(self, call, interval: float = 1.0, depth: int = 2):
+        self.call = call
+        self.interval = interval
+        self.depth = max(1, int(depth))
+        self.flips = 0
+        self.errors = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def flip_once(self) -> bool:
+        """One rollback + re-adopt pair; returns False while the chain
+        is still shorter than the flip depth."""
+        head = self.call("eth_getBlockByNumber", "latest", False)
+        number = int(head["number"], 16)
+        if number < self.depth:
+            return False
+        ancestor = self.call("eth_getBlockByNumber",
+                             hex(number - self.depth), False)
+        zero = "0x" + "00" * 32
+        for target in (ancestor["hash"], head["hash"]):
+            self.call("engine_forkchoiceUpdatedV3",
+                      {"headBlockHash": target, "safeBlockHash": zero,
+                       "finalizedBlockHash": zero})
+        self.flips += 1
+        return True
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.flip_once()
+            except Exception:  # noqa: BLE001 — the storm must outlive
+                self.errors += 1  # transient RPC errors under load
+
+    def start(self) -> "ReorgDriver":
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def stats(self) -> dict:
+        return {"flips": self.flips, "errors": self.errors,
+                "intervalSeconds": self.interval, "depth": self.depth}
+
+
+def engine_caller(url: str, jwt_secret: bytes):
+    """call(method, *params) against an engine-authorized endpoint,
+    minting a fresh JWT per request (the iat claim must stay within
+    the server's drift window across a long storm)."""
+    from ..rpc.engine import jwt_encode
+
+    def call(method, *params):
+        payload = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                              "params": list(params)}).encode()
+        req = urllib.request.Request(
+            url, data=payload,
+            headers={"Content-Type": "application/json",
+                     "Authorization": "Bearer " + jwt_encode(jwt_secret)})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+        if "error" in out:
+            raise RuntimeError(f"{method}: {out['error']}")
+        return out["result"]
+
+    return call
+
+
+# ---------------------------------------------------------------------------
 # CLI — open-loop when --rate/--rates given, legacy closed-loop otherwise
 
 
@@ -726,6 +817,21 @@ def main(argv=None):
                         dest="batch_size",
                         help="entries per JSON-RPC batch array when "
                              "--payload batch")
+    # reorg-storm chaos driver (depth-k fork-choice flips during load)
+    parser.add_argument("--reorg-interval", type=float, default=0.0,
+                        dest="reorg_interval",
+                        help="seconds between depth-k fork-choice flips "
+                             "while the load runs (0 = off); needs "
+                             "--engine-url and --jwt-hex")
+    parser.add_argument("--reorg-depth", type=int, default=2,
+                        dest="reorg_depth",
+                        help="blocks rolled back per flip")
+    parser.add_argument("--engine-url", default="",
+                        dest="engine_url",
+                        help="engine-authorized endpoint the reorg "
+                             "driver flips through")
+    parser.add_argument("--jwt-hex", default="", dest="jwt_hex",
+                        help="hex JWT secret for --engine-url")
     # legacy closed-loop flags
     parser.add_argument("--txs", type=int, default=200)
     parser.add_argument("--mode", choices=("transfer", "sstore"),
@@ -735,21 +841,37 @@ def main(argv=None):
     rates = [float(r) for r in args.rates.split(",") if r.strip()]
     if args.rate > 0:
         rates.append(args.rate)
-    if rates:
-        harness = Harness(args.url, key=int(args.key, 16),
-                          senders=args.senders,
-                          token_frac=args.token_frac,
-                          workers=args.workers, timeout=args.timeout,
-                          seed=args.seed, payload=args.payload,
-                          batch_size=args.batch_size)
-        harness.setup()
-        if len(rates) == 1:
-            result = harness.run(rates[0], args.duration, args.arrivals)
+    driver = None
+    if args.reorg_interval > 0:
+        if not args.engine_url or not args.jwt_hex:
+            parser.error("--reorg-interval needs --engine-url and "
+                         "--jwt-hex")
+        driver = ReorgDriver(
+            engine_caller(args.engine_url, bytes.fromhex(args.jwt_hex)),
+            interval=args.reorg_interval, depth=args.reorg_depth).start()
+    try:
+        if rates:
+            harness = Harness(args.url, key=int(args.key, 16),
+                              senders=args.senders,
+                              token_frac=args.token_frac,
+                              workers=args.workers, timeout=args.timeout,
+                              seed=args.seed, payload=args.payload,
+                              batch_size=args.batch_size)
+            harness.setup()
+            if len(rates) == 1:
+                result = harness.run(rates[0], args.duration,
+                                     args.arrivals)
+            else:
+                result = harness.sweep(rates, args.duration,
+                                       args.arrivals)
         else:
-            result = harness.sweep(rates, args.duration, args.arrivals)
-    else:
-        result = run_load(args.url, int(args.key, 16), args.txs,
-                          args.mode)
+            result = run_load(args.url, int(args.key, 16), args.txs,
+                              args.mode)
+    finally:
+        if driver is not None:
+            driver.stop()
+    if driver is not None:
+        result["reorgStorm"] = driver.stats()
     print(json.dumps(result, indent=2))
 
 

@@ -11,7 +11,7 @@ import json
 import time
 
 from ..blockchain.blockchain import InvalidBlock
-from ..blockchain.fork_choice import ForkChoiceError, apply_fork_choice
+from ..blockchain.fork_choice import ForkChoiceError
 from ..blockchain.payload import build_payload, create_payload_header
 from ..primitives.block import (Block, BlockBody, BlockHeader, Withdrawal,
                                 EMPTY_UNCLE_HASH)
@@ -277,11 +277,16 @@ class EngineApi:
                                       "validationError": None},
                     "payloadId": None}
         try:
-            apply_fork_choice(
-                store, head,
+            # the node's reorg handler (not bare apply_fork_choice): a
+            # CL-driven reorg must settle the mempool and notify
+            # subscribers like any other head move
+            self.node.reorg_handler.apply(
+                head,
                 safe if safe != b"\x00" * 32 else b"",
                 final if final != b"\x00" * 32 else b"")
         except ForkChoiceError as e:
+            # covers InvalidForkChoiceState (non-ancestor safe/
+            # finalized) — the spec's invalidForkChoiceState error
             raise RpcError(-38002, f"invalid forkchoice state: {e}")
         payload_id = None
         if attrs:

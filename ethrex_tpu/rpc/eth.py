@@ -157,9 +157,14 @@ class EthApi:
 
     def get_transaction_by_hash(self, tx_hash):
         store = self.node.store
-        loc = store.tx_index.get(parse_bytes(tx_hash))
+        h = parse_bytes(tx_hash)
+        # canonical-verified lookup: a txloc pointing at an orphaned
+        # block (reorg race, stale index) must never be served as an
+        # inclusion — fall back to the pool (a re-injected tx is
+        # pending again) or null (docs/CHAIN_RESILIENCE.md)
+        loc = store.canonical_tx_location(h)
         if loc is None:
-            tx = self.node.mempool.get_transaction(parse_bytes(tx_hash))
+            tx = self.node.mempool.get_transaction(h)
             return tx_to_json(tx) if tx else None
         blk = store.get_block(loc[0])
         return tx_to_json(blk.body.transactions[loc[1]], loc[0],
@@ -167,7 +172,9 @@ class EthApi:
 
     def get_transaction_receipt(self, tx_hash):
         store = self.node.store
-        loc = store.tx_index.get(parse_bytes(tx_hash))
+        # same canonical-verified lookup as get_transaction_by_hash: an
+        # orphaned inclusion's receipt no longer exists on the chain
+        loc = store.canonical_tx_location(parse_bytes(tx_hash))
         if loc is None:
             return None
         blk = store.get_block(loc[0])
@@ -481,7 +488,9 @@ class EthApi:
         if tracer_name not in ("callTracer", "structLogs"):
             raise RpcError(-32602, f"unsupported tracer {tracer_name!r}")
         store = self.node.store
-        loc = store.tx_index.get(parse_bytes(tx_hash))
+        # canonical-verified like get_transaction_by_hash: tracing an
+        # orphaned inclusion would replay state that is no longer chain
+        loc = store.canonical_tx_location(parse_bytes(tx_hash))
         if loc is None:
             raise RpcError(-32602, "transaction not found")
         blk = store.get_block(loc[0])

@@ -839,7 +839,8 @@ def _l1_message_proof(node, tx_hash_hex):
 
     seq = _rollup(node)
     tx_hash = parse_bytes(tx_hash_hex)
-    loc = node.store.tx_index.get(tx_hash)
+    # canonical-verified: an orphaned inclusion has no message proof
+    loc = node.store.canonical_tx_location(tx_hash)
     if loc is None:
         return None
     header = node.store.get_header(loc[0])
@@ -1128,6 +1129,12 @@ def _health(node):
                     "spansIngested": TRACER.ingested,
                     "spanIngestDropped": TRACER.ingest_dropped},
     }
+    reorg_handler = getattr(node, "reorg_handler", None)
+    if reorg_handler is not None:
+        # reorg posture (docs/CHAIN_RESILIENCE.md): totals, depths, the
+        # mempool re-injection/eviction ledger, and whether a pending
+        # reorg journal awaits replay (should only be true mid-crash)
+        out["chain"] = reorg_handler.stats_json()
     overload = getattr(node, "rpc_overload", None)
     if overload is not None:
         out["rpc"]["overload"] = overload.to_json()

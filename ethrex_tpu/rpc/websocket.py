@@ -571,6 +571,9 @@ class WsServer:
         # push hooks
         self.node.block_listeners.append(self._on_block)
         self.node.mempool.on_add.append(self._on_pending_tx)
+        reorg_listeners = getattr(self.node, "reorg_listeners", None)
+        if reorg_listeners is not None:
+            reorg_listeners.append(self._on_reorg)
 
     # -- push paths --------------------------------------------------------
     def _on_block(self, block):
@@ -611,6 +614,26 @@ class WsServer:
                 })
                 log_index += 1
         return out
+
+    def _on_reorg(self, outcome):
+        """Reorg subscription semantics (docs/CHAIN_RESILIENCE.md):
+        first every orphaned block's logs are re-emitted with
+        `removed: true` (oldest first, mirroring their original order),
+        then the new canonical branch is announced like fresh blocks —
+        newHeads for each adopted header plus its logs.  A recovered
+        (crash-replayed) reorg has no adopted list; any connected
+        subscriber still learns its old logs are gone."""
+        for block in outcome.orphaned:
+            for log_json in self._block_logs(block):
+                removed = dict(log_json)
+                removed["removed"] = True
+                for conn in list(self.connections):
+                    for sub in list(conn.subs.values()):
+                        if sub.kind == "logs" \
+                                and _log_matches(removed, sub.params):
+                            conn.notify(sub.sid, removed)
+        for block in outcome.adopted:
+            self._on_block(block)
 
     def _on_pending_tx(self, tx_hash: bytes):
         for conn in list(self.connections):

@@ -167,11 +167,56 @@ class Store:
                 self.bodies[h] = block.body
                 self.receipts[h] = receipts
                 for i, tx in enumerate(block.body.transactions):
+                    # a sibling block may repeat a tx that is already
+                    # canonically included — keep the canonical
+                    # location; fork choice rewrites it if the sibling
+                    # ever wins (docs/CHAIN_RESILIENCE.md)
+                    loc = self.tx_index.get(tx.hash)
+                    if loc is not None and loc[0] != h:
+                        hdr = self.headers.get(loc[0])
+                        if hdr is not None and \
+                                self.canonical_hash(hdr.number) == loc[0]:
+                            continue
                     self.tx_index[tx.hash] = (h, i)
 
     def set_canonical(self, number: int, block_hash: bytes):
         with self.lock:
             self.canonical[number] = block_hash
+
+    def delete_canonical(self, number: int):
+        """Drop a canonical-index entry (fork choice retiring heights
+        above a new, lower head).  Goes through the table's delete path
+        so the drop journals with the rest of the write group — a raw
+        pop on the backing dict would bypass the batch on persistent
+        backends."""
+        with self.lock:
+            self.canonical.pop(number, None)
+
+    def set_tx_location(self, tx_hash: bytes, block_hash: bytes,
+                        index: int):
+        with self.lock:
+            self.tx_index[tx_hash] = (block_hash, index)
+
+    def delete_tx_location(self, tx_hash: bytes):
+        with self.lock:
+            self.tx_index.pop(tx_hash, None)
+
+    def canonical_tx_location(self, tx_hash: bytes):
+        """(block_hash, index) for a tx ONLY if the referenced block is
+        still canonical at its height — the verify-on-read guard: fork
+        choice prunes tx locations inside the reorg write group, but an
+        orphaned inclusion must never be served even if a stale entry
+        survives (docs/CHAIN_RESILIENCE.md)."""
+        loc = self.tx_index.get(tx_hash)
+        if loc is None:
+            return None
+        header = self.headers.get(loc[0])
+        if header is None or self.canonical_hash(header.number) != loc[0]:
+            from ..utils.metrics import record_txloc_stale_read
+
+            record_txloc_stale_read()
+            return None
+        return loc
 
     def set_head(self, block_hash: bytes):
         with self.lock:

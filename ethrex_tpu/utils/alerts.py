@@ -334,6 +334,23 @@ def default_rules(node=None) -> list:
            window=600.0, for_count=2, resolve_count=3,
            description="Any checksum failure in the last 10m",
            runbook="See docs/STORAGE_RESILIENCE.md quarantine flow."),
+        # execution-chain reorg depth — a deep reorg orphans many
+        # blocks at once (consensus trouble or a hostile fork); a
+        # sustained multi-block reorg rate means the chain is churning
+        mk("deep_reorg:page", "page",
+           p95_signal("chain_reorg_depth", window=120.0), 5.0,
+           window=120.0, for_count=2, resolve_count=3,
+           description="Reorg depth p95 over 2m at or above 5 blocks",
+           runbook="A deep reorg just orphaned 5+ blocks; check the "
+                   "chain section of ethrex_health (reinjected/"
+                   "evictions) and docs/CHAIN_RESILIENCE.md."),
+        mk("deep_reorg:warn", "warn",
+           p95_signal("chain_reorg_depth", window=600.0), 2.0,
+           window=600.0, for_count=2, resolve_count=3,
+           description="Reorg depth p95 over 10m at or above 2 blocks",
+           runbook="Multi-block reorgs are recurring; check peer "
+                   "health and mempool_reinjections_total churn "
+                   "(docs/CHAIN_RESILIENCE.md)."),
         # L1 settlement lag (gauge-derived; windows are evaluation-paced)
         mk("l1_settlement_lag:page", "page",
            settlement_lag_signal, 20.0,

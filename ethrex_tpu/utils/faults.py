@@ -99,6 +99,18 @@ and docs/L1_SETTLEMENT_RESILIENCE.md):
                             at the top of commit_next_batch, send_proofs
                             and update_state): error = deposition
                             surfacing exactly at the checkpoint
+    forkchoice.apply        ReorgHandler.apply around the canonical
+                            rewrite; fires on BOTH legs — before the
+                            write group (crash with the old canonical
+                            index fully intact) and after it commits
+                            (index rewritten, mempool re-injection not
+                            yet run: the journaled reorg_pending record
+                            replays it on recovery; pair with after=1
+                            to target this leg).  docs/CHAIN_RESILIENCE.md
+    mempool.reinject        Mempool.reinject at entry: the reorg
+                            re-injection path crashing mid-reorg (the
+                            pending-reorg journal makes the retry
+                            idempotent — see docs/CHAIN_RESILIENCE.md)
 
 Fault kinds:
 
@@ -140,6 +152,8 @@ SITES = frozenset({
     "snap.serve",
     "l1.lease",
     "seq.fence",
+    "forkchoice.apply",
+    "mempool.reinject",
 })
 
 KINDS = frozenset({"drop", "delay", "corrupt", "torn", "error"})

@@ -362,6 +362,43 @@ def record_l1_reorg():
                 "(last_committed/verified moved backwards)")
 
 
+def record_chain_reorg(depth: int):
+    METRICS.inc("chain_reorgs_total", 1,
+                "Execution-chain reorgs applied by fork choice (at "
+                "least one formerly-canonical block was orphaned)")
+    _observe_safe("chain_reorg_depth", float(depth), None,
+                  "Blocks orphaned per execution-chain reorg (the "
+                  "deep_reorg alert pair reads the p95 of this)")
+
+
+def record_mempool_reinjection():
+    METRICS.inc("mempool_reinjections_total", 1,
+                "Transactions re-injected into the mempool from "
+                "orphaned blocks after a reorg (the typed reinjected "
+                "path: admission fee-floor/sender-cap rules bypassed)")
+
+
+def record_mempool_reorg_eviction(reason: str):
+    METRICS.inc("mempool_reorg_evictions_total", 1,
+                "Pool entries dropped by a reorg transition, any reason")
+    METRICS.inc_labeled("mempool_reorg_evictions_by_reason",
+                        {"reason": reason}, 1.0,
+                        help_text="Reorg-driven mempool drops by reason "
+                                  "(adopted = included on the winning "
+                                  "branch, nonce_below_account / "
+                                  "insufficient_balance = revalidation "
+                                  "prunes, blob_unrecoverable = orphaned "
+                                  "blob tx whose sidecar is gone)")
+
+
+def record_txloc_stale_read():
+    METRICS.inc("txloc_stale_reads_total", 1,
+                "Transaction-location lookups that referenced a "
+                "non-canonical block and were refused (verify-on-read "
+                "guard; should stay 0 while fork choice prunes txlocs "
+                "in the same write group)")
+
+
 def record_recommit():
     METRICS.inc("batches_recommitted_total", 1,
                 "Batches re-committed verbatim after an L1 reorg dropped "

@@ -146,6 +146,32 @@ def _storage_lines(snap: dict, width: int) -> list[str]:
     ]
 
 
+def _chain_lines(snap: dict, width: int) -> list[str]:
+    """Reorg-resilience panel (ethrex_health `chain` section): reorg
+    totals/depths and the mempool re-injection ledger.  Defensive like
+    the other panels — an older node without the section gets no
+    panel."""
+    health = snap.get("health")
+    chain = health.get("chain") if isinstance(health, dict) else None
+    if not isinstance(chain, dict) or not chain:
+        return []
+    ev = chain.get("evictions") or {}
+    ev_line = "  ".join(f"{k}: {v}" for k, v in sorted(ev.items())) \
+        if isinstance(ev, dict) and ev else "none"
+    pending = chain.get("pendingJournal")
+    return [
+        "─" * width,
+        " chain reorgs",
+        f"   reorgs {chain.get('reorgs', '?'):<6}"
+        f" last depth {chain.get('lastDepth', '?'):<4}"
+        f" deepest {chain.get('deepestDepth', '?'):<4}"
+        f" reinjected {chain.get('reinjected', '?'):<6}"
+        f" recoveries {chain.get('recoveries', '?'):<4}"
+        + (" PENDING-JOURNAL" if pending else ""),
+        f"   evictions  {ev_line}",
+    ]
+
+
 def _traffic_lines(snap: dict, width: int) -> list[str]:
     """Traffic panel: RPC request-lifecycle counters and mempool flow
     accounting (ethrex_health `rpc` / `mempoolFlow` sections).
@@ -594,10 +620,11 @@ def render_lines(snap: dict, width: int = 100) -> list[str]:
         hl = snap["health"]
         items = hl.items() if isinstance(hl, dict) else enumerate(hl)
         for k, v in items:
-            # traffic sections render in their own panel below
-            if k in ("rpc", "mempoolFlow", "p2p"):
+            # traffic/chain sections render in their own panels below
+            if k in ("rpc", "mempoolFlow", "p2p", "chain"):
                 continue
             lines.append(f"   {k}: {v}")
+    lines.extend(_chain_lines(snap, width))
     lines.extend(_traffic_lines(snap, width))
     lines.extend(_p2p_lines(snap, width))
     lines.extend(_aggregation_lines(snap, width))

@@ -194,3 +194,110 @@ def test_attrs_error_does_not_roll_back_forkchoice(engine):
     assert resp["error"]["code"] == -32602
     # the head/safe/finalized update stuck despite the attrs error
     assert node.store.meta["finalized"] == head_hash
+
+
+def test_engine_reorg_sequence_competing_branches():
+    """Two competing branches driven purely over engine_newPayloadV3 +
+    engine_forkchoiceUpdatedV3 flips: the canonical index, the mempool
+    and the tx-location lookups must agree after every flip, a rollback
+    re-injects the orphaned tx, and a non-ancestor safe/finalized hash
+    is rejected with the spec's invalidForkChoiceState (-38002)."""
+    node = Node(Genesis.from_json(GENESIS))
+    server = RpcServer(node, port=0, jwt_secret=JWT_SECRET,
+                       engine=True).start()
+    url = f"http://127.0.0.1:{server.port}"
+
+    def call(method, *params):
+        payload = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                              "params": list(params)}).encode()
+        req = urllib.request.Request(
+            url, data=payload,
+            headers={"Content-Type": "application/json",
+                     "Authorization": "Bearer " + jwt_encode(JWT_SECRET)})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    zero = "0x" + "00" * 32
+
+    def build_on(parent_hex, ts):
+        fcu = call("engine_forkchoiceUpdatedV3",
+                   {"headBlockHash": parent_hex, "safeBlockHash": zero,
+                    "finalizedBlockHash": zero},
+                   {"timestamp": hex(ts), "prevRandao": "0x" + "11" * 32,
+                    "suggestedFeeRecipient": "0x" + "ee" * 20,
+                    "withdrawals": [],
+                    "parentBeaconBlockRoot": zero})["result"]
+        assert fcu["payloadStatus"]["status"] == "VALID"
+        payload = call("engine_getPayloadV3",
+                       fcu["payloadId"])["result"]["executionPayload"]
+        status = call("engine_newPayloadV3", payload, [], zero)["result"]
+        assert status["status"] == "VALID", status
+        return payload
+
+    def fcu_head(block_hex):
+        return call("engine_forkchoiceUpdatedV3",
+                    {"headBlockHash": block_hex, "safeBlockHash": zero,
+                     "finalizedBlockHash": zero})["result"]
+
+    try:
+        tx = Transaction(
+            tx_type=TYPE_DYNAMIC_FEE, chain_id=1337, nonce=0,
+            max_priority_fee_per_gas=1, max_fee_per_gas=10**10,
+            gas_limit=21000, to=b"\xbb" * 20, value=5).sign(SECRET)
+        node.submit_transaction(tx)
+        genesis_hex = "0x" + node.genesis_header.hash.hex()
+        base_ts = int(time.time())
+
+        # branch A: one payload on genesis carrying the tx
+        pa = build_on(genesis_hex, base_ts + 12)
+        assert fcu_head(pa["blockHash"])["payloadStatus"]["status"] \
+            == "VALID"
+        a_hash = bytes.fromhex(pa["blockHash"][2:])
+        assert node.store.canonical_hash(1) == a_hash
+        assert node.mempool.get_transaction(tx.hash) is None  # adopted
+        assert node.store.canonical_tx_location(tx.hash) == (a_hash, 0)
+
+        # rollback to genesis over the engine API: the tx comes back
+        assert fcu_head(genesis_hex)["payloadStatus"]["status"] == "VALID"
+        assert node.store.latest_number() == 0
+        assert node.mempool.get_transaction(tx.hash) is not None
+        assert node.store.canonical_tx_location(tx.hash) is None
+
+        # branch B: a competing payload on genesis (later timestamp)
+        # picks the re-injected tx up again
+        pb = build_on(genesis_hex, base_ts + 24)
+        b_hash = bytes.fromhex(pb["blockHash"][2:])
+        assert b_hash != a_hash
+        assert pb["transactions"], "re-injected tx missing from rebuild"
+        assert fcu_head(pb["blockHash"])["payloadStatus"]["status"] \
+            == "VALID"
+        assert node.store.canonical_hash(1) == b_hash
+        assert node.mempool.get_transaction(tx.hash) is None
+        assert node.store.canonical_tx_location(tx.hash) == (b_hash, 0)
+
+        # flip A -> B -> A: index, pool and txloc stay consistent
+        for head_hex, expect in ((pa["blockHash"], a_hash),
+                                 (pb["blockHash"], b_hash),
+                                 (pa["blockHash"], a_hash)):
+            assert fcu_head(head_hex)["payloadStatus"]["status"] == "VALID"
+            assert node.store.canonical_hash(1) == expect
+            assert node.store.head_header().hash == expect
+            assert node.mempool.get_transaction(tx.hash) is None
+            assert node.store.canonical_tx_location(tx.hash) == (expect, 0)
+
+        # non-ancestor safe/finalized: invalidForkChoiceState (-38002)
+        resp = call("engine_forkchoiceUpdatedV3",
+                    {"headBlockHash": pa["blockHash"],
+                     "safeBlockHash": pb["blockHash"],
+                     "finalizedBlockHash": zero})
+        assert resp["error"]["code"] == -38002
+        resp = call("engine_forkchoiceUpdatedV3",
+                    {"headBlockHash": pa["blockHash"],
+                     "safeBlockHash": zero,
+                     "finalizedBlockHash": pb["blockHash"]})
+        assert resp["error"]["code"] == -38002
+        # the failed updates did not move the head
+        assert node.store.head_header().hash == a_hash
+    finally:
+        server.stop()
+        node.stop()

@@ -1,0 +1,489 @@
+"""Reorg chaos battery (docs/CHAIN_RESILIENCE.md): the reorg-safe
+transaction lifecycle under adversarial fork-choice sequences — mempool
+re-injection on rollback, A->B->A ping-pong flips at depth 1..8,
+two-leg `forkchoice.apply` and `mempool.reinject` fault drills with
+journal recovery, a kill-at-every-write-point crash loop through the
+fork-choice write group on the persistent backend, the stale-txloc
+verify-on-read regression, and a reorg-storm soak under live load
+asserting the conservation invariant: no transaction accepted by the
+pool is ever silently lost — each is included exactly once on the
+canonical chain or still pending (or typed-pruned, counted).
+
+Select alone with `-m chaos`; the whole battery is in the fast tier.
+"""
+
+import time
+
+import pytest
+
+from ethrex_tpu.blockchain.fork_choice import (REORG_JOURNAL_KEY,
+                                               ForkChoiceError,
+                                               InvalidForkChoiceState)
+from ethrex_tpu.blockchain.payload import build_payload, create_payload_header
+from ethrex_tpu.node import Node
+from ethrex_tpu.perf.loadgen import Harness, ReorgDriver, _rpc
+from ethrex_tpu.primitives.genesis import Genesis
+from ethrex_tpu.rpc.eth import EthApi
+from ethrex_tpu.rpc.server import RpcServer
+from ethrex_tpu.storage.persistent import PersistentBackend
+from ethrex_tpu.storage.store import Store
+from ethrex_tpu.utils import faults
+from ethrex_tpu.utils.faults import FaultPlan
+from tests.test_l2_pipeline import GENESIS, SECRET, _transfer
+
+pytestmark = pytest.mark.chaos
+
+
+def _open_node(tmp_path):
+    store = Store(PersistentBackend(str(tmp_path / "chain.db")))
+    return Node(Genesis.from_json(GENESIS), store=store)
+
+
+def _seal_block(node, parent, txs, *, ts=None, coinbase=b"\x99" * 20):
+    """Build + store a block on an arbitrary parent WITHOUT moving the
+    head — the raw material for competing branches."""
+    header = create_payload_header(
+        parent, node.config,
+        timestamp=ts if ts is not None else parent.timestamp + 1,
+        coinbase=coinbase)
+    result = build_payload(node.chain, parent, header, list(txs), [])
+    node.chain.add_block(result.block)
+    return result.block
+
+
+def _assert_chain_consistent(store):
+    """Walk head -> genesis: every canonical entry, header link and body
+    must agree — the all-or-nothing invariant after any crash."""
+    cursor = store.head_header()
+    while cursor.number > 0:
+        assert store.canonical_hash(cursor.number) == cursor.hash
+        assert store.get_body(cursor.hash) is not None
+        parent = store.get_header(cursor.parent_hash)
+        assert parent is not None and parent.number == cursor.number - 1
+        cursor = parent
+    assert store.canonical_hash(0) == cursor.hash
+
+
+def _canonical_inclusions(store):
+    """{tx_hash: count} over the canonical chain, asserting every
+    canonical inclusion is served by the verified txloc lookup."""
+    included = {}
+    for n in range(1, store.latest_number() + 1):
+        blk = store.get_block(store.canonical_hash(n))
+        assert blk is not None
+        for i, tx in enumerate(blk.body.transactions):
+            included[tx.hash] = included.get(tx.hash, 0) + 1
+            assert store.canonical_tx_location(tx.hash) == (blk.hash, i)
+    return included
+
+
+def _assert_conservation(node, tx_hashes):
+    """Every tracked tx is included exactly once XOR pending — never
+    lost, never duplicated."""
+    included = _canonical_inclusions(node.store)
+    assert all(c == 1 for c in included.values()), "tx included twice"
+    for h in tx_hashes:
+        on_chain = included.get(h, 0) == 1
+        pending = node.mempool.get_transaction(h) is not None
+        assert on_chain != pending, \
+            f"tx 0x{h.hex()[:16]} lost (or double-counted) by the reorg"
+
+
+# ===========================================================================
+# rollback -> re-injection -> re-inclusion
+# ===========================================================================
+
+def test_rollback_reinjects_orphaned_txs():
+    node = Node(Genesis.from_json(GENESIS))
+    txs = [_transfer(0), _transfer(1)]
+    for tx in txs:
+        node.submit_transaction(tx)
+    node.produce_block()
+    assert len(node.mempool) == 0
+    genesis_hash = node.store.canonical_hash(0)
+
+    out = node.reorg_handler.apply(genesis_hash)
+    assert out.depth == 1 and out.reinjected == 2
+    assert node.store.head_header().number == 0
+    assert node.store.canonical_hash(1) is None
+    # both txs are pending again, through the typed reinjected path
+    for tx in txs:
+        assert node.mempool.get_transaction(tx.hash) is not None
+        assert node.store.canonical_tx_location(tx.hash) is None
+    assert node.mempool.stats_json()["reinjections"] == 2
+    stats = node.reorg_handler.stats_json()
+    assert stats["reorgs"] == 1 and stats["lastDepth"] == 1
+    assert stats["reinjected"] == 2 and not stats["pendingJournal"]
+
+    # the RPC surface agrees: pending (null blockHash), no receipt
+    api = EthApi(node)
+    j = api.get_transaction_by_hash("0x" + txs[0].hash.hex())
+    assert j is not None and j.get("blockHash") is None
+    assert api.get_transaction_receipt("0x" + txs[0].hash.hex()) is None
+
+    # production on the new head re-includes both exactly once
+    node.produce_block()
+    _assert_conservation(node, [tx.hash for tx in txs])
+    assert len(node.mempool) == 0
+
+
+def test_reinject_keeps_newer_pool_entry():
+    """An occupied sender+nonce slot wins over the orphaned copy: the
+    pool's entry postdates the orphan."""
+    node = Node(Genesis.from_json(GENESIS))
+    old = _transfer(0, value=100)
+    node.submit_transaction(old)
+    node.produce_block()
+    # a replacement for the same nonce arrives after the inclusion got
+    # orphaned conceptually — seed it directly, then roll back
+    newer = _transfer(0, value=200)
+    assert node.mempool.reinject(newer)  # occupy the slot
+    out = node.reorg_handler.apply(node.store.canonical_hash(0))
+    assert out.depth == 1 and out.reinjected == 0
+    assert node.mempool.get_transaction(newer.hash) is not None
+    assert node.mempool.get_transaction(old.hash) is None
+
+
+# ===========================================================================
+# depth 1..8 A->B->A ping-pong
+# ===========================================================================
+
+@pytest.mark.parametrize("depth", list(range(1, 9)))
+def test_ping_pong_flips(depth):
+    node = Node(Genesis.from_json(GENESIS))
+    txs = []
+    for n in range(depth):
+        tx = _transfer(n)
+        txs.append(tx)
+        node.submit_transaction(tx)
+        node.produce_block()
+    a_hashes = {n: node.store.canonical_hash(n)
+                for n in range(depth + 1)}
+    a_tip = node.store.head_header().hash
+    genesis_hash = a_hashes[0]
+
+    # rollback to genesis: every included tx must come back
+    out = node.reorg_handler.apply(genesis_hash)
+    assert out.depth == depth and out.reinjected == depth
+    assert len(node.mempool) == depth
+
+    # branch B: same txs sealed onto genesis at distinct timestamps
+    parent = node.store.get_header(genesis_hash)
+    b_blocks = []
+    for n in range(depth):
+        blk = _seal_block(node, parent, [txs[n]],
+                          ts=parent.timestamp + 2)
+        assert blk.hash != a_hashes[n + 1]
+        b_blocks.append(blk)
+        parent = blk.header
+    b_hashes = {n + 1: b_blocks[n].hash for n in range(depth)}
+    b_tip = b_blocks[-1].hash
+
+    def assert_on_branch(hashes):
+        for n in range(1, depth + 1):
+            assert node.store.canonical_hash(n) == hashes[n]
+        assert len(node.mempool) == 0, "tx pending AND included"
+        _assert_conservation(node, [tx.hash for tx in txs])
+
+    # adopt B: the pool copies of the adopted txs are dropped
+    node.reorg_handler.apply(b_tip)
+    assert_on_branch(b_hashes)
+    # ping-pong: A -> B -> A, consistent after every flip
+    for tip, hashes in ((a_tip, a_hashes), (b_tip, b_hashes),
+                       (a_tip, a_hashes)):
+        out = node.reorg_handler.apply(tip)
+        assert out.depth == depth and out.reinjected == 0
+        assert_on_branch(hashes)
+    assert node.reorg_handler.deepest == depth
+
+
+# ===========================================================================
+# safe/finalized ancestry validation (engine invalidForkChoiceState)
+# ===========================================================================
+
+def test_safe_finalized_must_be_ancestors():
+    node = Node(Genesis.from_json(GENESIS))
+    node.submit_transaction(_transfer(0))
+    node.produce_block()
+    head = node.store.head_header().hash
+    sibling = _seal_block(node, node.store.get_header(
+        node.store.canonical_hash(0)), [])
+    with pytest.raises(InvalidForkChoiceState):
+        node.reorg_handler.apply(head, safe_hash=sibling.hash)
+    with pytest.raises(InvalidForkChoiceState):
+        node.reorg_handler.apply(head, finalized_hash=sibling.hash)
+    with pytest.raises(ForkChoiceError):
+        node.reorg_handler.apply(head, safe_hash=b"\x42" * 32)
+    # valid ancestors stick
+    genesis_hash = node.store.canonical_hash(0)
+    node.reorg_handler.apply(head, safe_hash=genesis_hash,
+                             finalized_hash=genesis_hash)
+    assert node.store.meta["finalized"] == genesis_hash
+
+
+# ===========================================================================
+# two-leg forkchoice.apply + mempool.reinject fault drills
+# ===========================================================================
+
+def _one_block_and_sibling(node):
+    tx = _transfer(0)
+    node.submit_transaction(tx)
+    node.produce_block()
+    sibling = _seal_block(node, node.store.get_header(
+        node.store.canonical_hash(0)), [])
+    return tx, sibling
+
+
+def test_forkchoice_fault_leg1_leaves_old_chain_intact():
+    node = Node(Genesis.from_json(GENESIS))
+    tx, sibling = _one_block_and_sibling(node)
+    a1 = node.store.head_header().hash
+    plan = faults.install(FaultPlan().error("forkchoice.apply", times=1))
+    try:
+        with pytest.raises(Exception):
+            node.reorg_handler.apply(sibling.hash)
+        assert plan.log
+    finally:
+        faults.clear()
+    # leg 1 fires BEFORE the write group: nothing moved, no journal
+    assert node.store.head_header().hash == a1
+    assert node.store.canonical_tx_location(tx.hash) is not None
+    assert node.mempool.get_transaction(tx.hash) is None
+    assert not node.reorg_handler.stats_json()["pendingJournal"]
+    _assert_conservation(node, [tx.hash])
+
+
+def test_forkchoice_fault_leg2_recovers_from_journal():
+    node = Node(Genesis.from_json(GENESIS))
+    tx, sibling = _one_block_and_sibling(node)
+    plan = faults.install(
+        FaultPlan().error("forkchoice.apply", after=1, times=1))
+    try:
+        with pytest.raises(Exception):
+            node.reorg_handler.apply(sibling.hash)
+        assert plan.log
+    finally:
+        faults.clear()
+    # leg 2 fires AFTER the rewrite committed: canonical index and
+    # txloc already moved, mempool debt journaled but unpaid
+    assert node.store.head_header().hash == sibling.hash
+    assert node.store.canonical_tx_location(tx.hash) is None
+    assert node.mempool.get_transaction(tx.hash) is None
+    assert node.reorg_handler.stats_json()["pendingJournal"]
+    # recovery pays the debt and clears the journal
+    out = node.reorg_handler.recover_pending()
+    assert out is not None and out.recovered and out.reinjected == 1
+    assert node.mempool.get_transaction(tx.hash) is not None
+    assert not node.reorg_handler.stats_json()["pendingJournal"]
+    assert node.reorg_handler.recoveries == 1
+    _assert_conservation(node, [tx.hash])
+
+
+def test_reinject_fault_replayed_by_next_apply():
+    node = Node(Genesis.from_json(GENESIS))
+    tx, sibling = _one_block_and_sibling(node)
+    plan = faults.install(FaultPlan().error("mempool.reinject", times=1))
+    try:
+        with pytest.raises(Exception):
+            node.reorg_handler.apply(sibling.hash)
+        assert plan.log
+    finally:
+        faults.clear()
+    # the crash hit mid-settlement: tx neither pending nor canonical,
+    # but the journal still holds the debt
+    assert node.store.canonical_tx_location(tx.hash) is None
+    assert node.mempool.get_transaction(tx.hash) is None
+    assert node.reorg_handler.stats_json()["pendingJournal"]
+    # the NEXT apply (any head move) replays the journal first
+    node.reorg_handler.apply(sibling.hash)
+    assert node.mempool.get_transaction(tx.hash) is not None
+    assert not node.reorg_handler.stats_json()["pendingJournal"]
+    _assert_conservation(node, [tx.hash])
+
+
+# ===========================================================================
+# stale txloc: verify-on-read + no canonical clobber
+# ===========================================================================
+
+def test_stale_txloc_never_served():
+    node = Node(Genesis.from_json(GENESIS))
+    included = _transfer(0, value=100)
+    node.submit_transaction(included)
+    node.produce_block()
+    a1 = node.store.head_header().hash
+    # hand-build an orphaned inclusion: a sibling carrying a tx that is
+    # NOT on the canonical chain — its txloc entry points off-chain
+    orphan_only = _transfer(0, value=200)
+    sibling = _seal_block(node, node.store.get_header(
+        node.store.canonical_hash(0)), [orphan_only])
+    assert node.store.tx_index.get(orphan_only.hash) is not None
+    assert node.store.canonical_tx_location(orphan_only.hash) is None
+    api = EthApi(node)
+    assert api.get_transaction_by_hash(
+        "0x" + orphan_only.hash.hex()) is None
+    assert api.get_transaction_receipt(
+        "0x" + orphan_only.hash.hex()) is None
+    # a sibling repeating a canonically-included tx must NOT clobber
+    # the canonical location
+    sibling2 = _seal_block(node, node.store.get_header(
+        node.store.canonical_hash(0)), [included], ts=3)
+    assert sibling2.hash != sibling.hash
+    assert node.store.canonical_tx_location(included.hash) == (a1, 0)
+    rec = api.get_transaction_receipt("0x" + included.hash.hex())
+    assert rec is not None and rec["blockHash"] == "0x" + a1.hex()
+
+
+# ===========================================================================
+# kill-at-every-write-point through the fork-choice write group
+# ===========================================================================
+
+def test_kill_at_every_write_point_through_fork_choice(tmp_path):
+    node = _open_node(tmp_path)
+    txs = []
+    for n in range(3):
+        tx = _transfer(n)
+        txs.append(tx)
+        node.submit_transaction(tx)
+        node.produce_block()
+    tip = node.store.head_header().hash
+    a_hashes = [node.store.canonical_hash(n) for n in range(1, 4)]
+    genesis_hash = node.store.canonical_hash(0)
+    tx_hashes = [tx.hash for tx in txs]
+
+    def assert_crash_state(node):
+        """Post-reopen invariant at ANY crash point: consistent index,
+        nothing duplicated, and every tx either canonical, pending
+        (journal replayed), or — only when the settlement had fully
+        committed before the crash (journal cleared) — still durably
+        recoverable from the stored orphaned block the resume below
+        re-adopts.  The pool is volatile; the blocks are not."""
+        from ethrex_tpu.blockchain.fork_choice import REORG_JOURNAL_KEY
+        included = _canonical_inclusions(node.store)
+        assert all(c == 1 for c in included.values())
+        for h in tx_hashes:
+            on_chain = included.get(h, 0) == 1
+            pending = node.mempool.get_transaction(h) is not None
+            assert not (on_chain and pending), "pending AND included"
+            if not on_chain and not pending:
+                assert node.store.meta.get(REORG_JOURNAL_KEY) is None, \
+                    "journal present but tx not replayed: reorg loss"
+        # the orphaned blocks (and their txs) are never deleted
+        for bh in a_hashes:
+            assert node.store.get_body(bh) is not None
+
+    k = 0
+    crashes = 0
+    while True:
+        plan = faults.install(
+            FaultPlan().error("store.put", after=k, times=1))
+        try:
+            try:
+                node.reorg_handler.apply(genesis_hash)
+            except Exception:
+                assert plan.log, "rollback failed without a fault"
+            fired = bool(plan.log)
+        finally:
+            faults.clear()
+        if not fired:
+            break
+        crashes += 1
+        # crash: drop the process state, reopen the same files — the
+        # Node constructor replays any pending reorg journal
+        node.store.close()
+        node = _open_node(tmp_path)
+        _assert_chain_consistent(node.store)
+        assert_crash_state(node)
+        # resume: complete the rollback, then re-adopt the tip
+        node.reorg_handler.apply(genesis_hash)
+        node.reorg_handler.apply(tip)
+        _assert_chain_consistent(node.store)
+        _assert_conservation(node, tx_hashes)
+        assert len(node.mempool) == 0
+        k += 1
+    assert crashes >= 5, f"only {crashes} write points in the reorg group"
+    # the un-faulted rollback completed: all txs pending again
+    assert len(node.mempool) == 3
+    node.reorg_handler.apply(tip)
+    _assert_conservation(node, tx_hashes)
+    node.store.close()
+
+
+def test_leg2_crash_recovers_on_reopen(tmp_path):
+    """Process death between the canonical rewrite and the mempool
+    settlement: the reopened node must pay the journaled debt."""
+    node = _open_node(tmp_path)
+    tx = _transfer(0)
+    node.submit_transaction(tx)
+    node.produce_block()
+    sibling = _seal_block(node, node.store.get_header(
+        node.store.canonical_hash(0)), [])
+    plan = faults.install(
+        FaultPlan().error("forkchoice.apply", after=1, times=1))
+    try:
+        with pytest.raises(Exception):
+            node.reorg_handler.apply(sibling.hash)
+        assert plan.log
+    finally:
+        faults.clear()
+    node.store.close()
+
+    node = _open_node(tmp_path)
+    # Node.__init__ ran recover_pending: the orphaned tx is pending
+    assert node.mempool.get_transaction(tx.hash) is not None
+    assert node.store.meta.get(REORG_JOURNAL_KEY) is None
+    assert node.reorg_handler.recoveries == 1
+    _assert_chain_consistent(node.store)
+    _assert_conservation(node, [tx.hash])
+    node.store.close()
+
+
+# ===========================================================================
+# reorg-storm soak under live load: the conservation invariant
+# ===========================================================================
+
+def test_reorg_storm_soak_conservation():
+    node = Node(Genesis.from_json(GENESIS))
+    server = RpcServer(node, port=0, engine=True).start()
+    url = f"http://127.0.0.1:{server.port}"
+    driver = None
+    try:
+        harness = Harness(url, key=SECRET, senders=4, token_frac=0.0,
+                          workers=8, payload="tx", seed=7)
+        harness.setup(fund_wei=10 ** 17)
+        node.start_dev_producer(block_time=0.05, prewarm=False)
+        driver = ReorgDriver(
+            lambda method, *params: _rpc(url, method, *params),
+            interval=0.2, depth=2).start()
+        harness.run(rate=60, duration=4.0)
+        driver.stop()
+        # quiesce: no more flips; let the producer drain what it can
+        deadline = time.monotonic() + 10.0
+        while len(node.mempool) and time.monotonic() < deadline:
+            time.sleep(0.1)
+    finally:
+        if driver is not None:
+            driver.stop()
+        node.stop()
+        server.stop()
+
+    assert driver.flips >= 2, f"storm never flipped: {driver.stats()}"
+    assert node.reorg_handler.reorgs >= 1
+
+    included = _canonical_inclusions(node.store)
+    assert all(c == 1 for c in included.values()), "tx included twice"
+    pending = len(node.mempool)
+    # conservation: every admitted tx is included exactly once, still
+    # pending, or pruned for a typed counted reason.  The prune ledger
+    # upper-bounds the gap rather than closing it exactly: a pruned
+    # tx's orphaned inclusion can be re-adopted by a later flip (it
+    # ends up included AND in the prune count), so typed prunes must
+    # COVER the gap — an uncovered gap is a silent loss
+    prunes = sum(n for reason, n in node.reorg_handler.evictions.items()
+                 if reason in ("nonce_below_account",
+                               "insufficient_balance"))
+    gap = node.mempool.admitted - (len(included) + pending)
+    assert 0 <= gap <= prunes, (
+        f"conservation broken: included={len(included)} "
+        f"pending={pending} prunes={prunes} "
+        f"admitted={node.mempool.admitted} "
+        f"reorgs={node.reorg_handler.stats_json()}")

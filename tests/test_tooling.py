@@ -157,7 +157,10 @@ def test_serving_fault_sites_covered_by_overload_battery():
     with open(os.path.join(here, "test_overload_chaos.py")) as f:
         corpus = f.read()
     serving_sites = [s for s in sorted(faults.SITES)
-                     if s.startswith(("rpc.", "mempool."))]
+                     if s.startswith(("rpc.", "mempool."))
+                     # the reorg re-injection path belongs to the reorg
+                     # battery's contract, not the serving path's
+                     and s != "mempool.reinject"]
     assert serving_sites, \
         "serving fault sites missing from faults.SITES"
     missing = [s for s in serving_sites if f'"{s}"' not in corpus]
@@ -225,6 +228,28 @@ def test_runtime_fault_sites_covered_by_runtime_battery():
     missing = [s for s in runtime_sites if f'"{s}"' not in corpus]
     assert not missing, \
         f"runtime sites without runtime-battery coverage: {missing}"
+
+
+def test_reorg_fault_sites_covered_by_reorg_battery():
+    """The reorg-lifecycle sites ("forkchoice.apply", "mempool.reinject")
+    are the reorg battery's contract: each must be exercised in
+    tests/test_reorg_chaos.py specifically — the two-leg fork-choice
+    crash window and the mid-settlement re-injection crash cannot lose
+    their drills (docs/CHAIN_RESILIENCE.md)."""
+    import os
+
+    from ethrex_tpu.utils import faults
+
+    here = os.path.dirname(__file__)
+    with open(os.path.join(here, "test_reorg_chaos.py")) as f:
+        corpus = f.read()
+    reorg_sites = ["forkchoice.apply", "mempool.reinject"]
+    missing = [s for s in reorg_sites if s not in faults.SITES]
+    assert not missing, \
+        f"reorg fault sites missing from faults.SITES: {missing}"
+    missing = [s for s in reorg_sites if f'"{s}"' not in corpus]
+    assert not missing, \
+        f"reorg sites without reorg-battery coverage: {missing}"
 
 
 def test_no_bare_print_in_library_modules():
@@ -335,7 +360,7 @@ def test_every_metric_helper_has_help_text():
     import ast
     import inspect
 
-    from ethrex_tpu.blockchain import mempool
+    from ethrex_tpu.blockchain import fork_choice, mempool
     from ethrex_tpu.l2 import leadership
     from ethrex_tpu.perf import (bench_suite, hlo_introspect, loadgen,
                                  occupancy, profiler, roofline)
@@ -347,8 +372,8 @@ def test_every_metric_helper_has_help_text():
     offenders = []
     for mod in (metrics, tracing, profiler, roofline, hlo_introspect,
                 occupancy, bench_suite, loadgen,
-                mempool, overload, exec_cache, checkpoint, runtime_errors,
-                leadership):
+                mempool, fork_choice, overload, exec_cache, checkpoint,
+                runtime_errors, leadership):
         tree = ast.parse(inspect.getsource(mod))
         for fn in ast.walk(tree):
             if not isinstance(fn, ast.FunctionDef):
