@@ -17,6 +17,14 @@ from ..utils.metrics import (record_mempool_admission,
                              record_mempool_replacement,
                              observe_time_in_pool)
 
+# chain-path X-ray (perf/chain_path.py): mempool admission is the first
+# measured stage queue of the tx pipeline.  Guarded import + never-raise
+# hooks — a perf-layer failure must not break the pool.
+try:
+    from ..perf.chain_path import CHAIN_PATH as _CHAIN_PATH
+except Exception:  # pragma: no cover - telemetry only
+    _CHAIN_PATH = None
+
 MIN_REPLACEMENT_BUMP = 10  # percent
 
 # admission-control defaults (docs/OVERLOAD.md "Mempool admission"):
@@ -198,12 +206,18 @@ class Mempool:
                     raise self._reject(
                         ReplacementUnderpricedError(
                             "replacement underpriced"))
+                dwell = self._dwell_locked(existing.hash)
                 self.by_hash.pop(existing.hash, None)
                 self.blobs_bundles.pop(existing.hash, None)
                 self.added_at.pop(existing.hash, None)
                 self.evictions["replaced"] = \
                     self.evictions.get("replaced", 0) + 1
                 record_mempool_eviction("replaced")
+                if dwell is not None:
+                    observe_time_in_pool(dwell, "replaced")
+                if _CHAIN_PATH is not None:
+                    _CHAIN_PATH.tx_removed(existing.hash, "replaced",
+                                           dwell)
                 self.replacements += 1
                 record_mempool_replacement()
             else:
@@ -248,6 +262,7 @@ class Mempool:
             # least-includable eviction victim: admission succeeded
             # (pinned behavior — the hash is returned) but the pool is
             # effectively full for it, so count it truthfully
+            admitted_ok = False
             if tx.hash not in self.by_hash:
                 self.rejections["pool_full"] = \
                     self.rejections.get("pool_full", 0) + 1
@@ -255,7 +270,12 @@ class Mempool:
             else:
                 self.admitted += 1
                 record_mempool_admission()
+                admitted_ok = True
             self._publish_occupancy_locked()
+        # chain-path admission arrival (and a sampled lifecycle record)
+        # fires outside the lock, like the on_add hooks
+        if admitted_ok and _CHAIN_PATH is not None:
+            _CHAIN_PATH.tx_admitted(tx.hash)
         for hook in list(self.on_add):
             hook(tx.hash)
         return tx.hash
@@ -300,6 +320,8 @@ class Mempool:
             self.reinjections += 1
             record_mempool_reinjection()
             self._publish_occupancy_locked()
+        if _CHAIN_PATH is not None:
+            _CHAIN_PATH.tx_admitted(tx.hash)
         # re-injected txs are pending again: the newPendingTransactions
         # subscription and pending filters must see them
         for hook in list(self.on_add):
@@ -344,9 +366,14 @@ class Mempool:
         while self._regular_tx_count() > self.capacity and self.txs_order:
             oldest = self.txs_order.pop(0)
             if oldest in self.by_hash and oldest not in self.blobs_bundles:
+                dwell = self._dwell_locked(oldest)
                 self._remove_locked(oldest)
                 self.evictions["fifo"] = self.evictions.get("fifo", 0) + 1
                 record_mempool_eviction("fifo")
+                if dwell is not None:
+                    observe_time_in_pool(dwell, "fifo")
+                if _CHAIN_PATH is not None:
+                    _CHAIN_PATH.tx_removed(oldest, "fifo", dwell)
 
     def _evict_worst_blob(self) -> None:
         """Evict the LEAST INCLUDABLE blob tx past the blob sub-pool cap:
@@ -375,10 +402,22 @@ class Mempool:
                     worst = h
             if worst is None:
                 break
+            dwell = self._dwell_locked(worst)
             self._remove_locked(worst)
             self.evictions["blob_pool_full"] = \
                 self.evictions.get("blob_pool_full", 0) + 1
             record_mempool_eviction("blob_pool_full")
+            if dwell is not None:
+                observe_time_in_pool(dwell, "blob_pool_full")
+            if _CHAIN_PATH is not None:
+                _CHAIN_PATH.tx_removed(worst, "blob_pool_full", dwell)
+
+    def _dwell_locked(self, tx_hash: bytes) -> float | None:
+        """Seconds since admission — read BEFORE ``_remove_locked``
+        pops ``added_at``; feeds the reason-labelled time-in-pool
+        histogram and the chain-path admission dwell."""
+        t0 = self.added_at.get(tx_hash)
+        return time.monotonic() - t0 if t0 is not None else None
 
     def _remove_locked(self, tx_hash: bytes):
         tx = self.by_hash.pop(tx_hash, None)
@@ -394,25 +433,27 @@ class Mempool:
                 del self.by_sender[sender]
 
     def remove_transaction(self, tx_hash: bytes, reason: str | None = None):
-        """Drop a tx.  ``reason="included"`` (block production) feeds the
-        admission→inclusion time-in-pool histogram; any other reason is
-        counted as a post-admission eviction (e.g. ``invalid_at_build``);
-        None is a silent administrative removal."""
+        """Drop a tx.  Every reasoned removal of a present tx feeds the
+        reason-labelled time-in-pool histogram (``included`` is the
+        admission→inclusion dwell; evictions/prunes/reorg reasons keep
+        their own series so they cannot pollute it) and departs the
+        chain-path admission queue.  ``reason=None`` is a silent
+        administrative removal (no histogram, counted as an untyped
+        drop in the stage queue)."""
         with self.lock:
             present = tx_hash in self.by_hash
-            dwell = None
-            if present and reason == "included":
-                t0 = self.added_at.get(tx_hash)
-                if t0 is not None:
-                    dwell = time.monotonic() - t0
+            dwell = self._dwell_locked(tx_hash) if present else None
             self._remove_locked(tx_hash)
             if present and reason is not None and reason != "included":
                 self.evictions[reason] = self.evictions.get(reason, 0) + 1
                 record_mempool_eviction(reason)
             if present:
                 self._publish_occupancy_locked()
-        if dwell is not None:
-            observe_time_in_pool(dwell)
+        if present:
+            if reason is not None and dwell is not None:
+                observe_time_in_pool(dwell, reason)
+            if _CHAIN_PATH is not None:
+                _CHAIN_PATH.tx_removed(tx_hash, reason or "admin", dwell)
 
     def stats_json(self, top_k: int = 5) -> dict:
         """Flow-accounting summary for ethrex_health: occupancy,

@@ -5,6 +5,7 @@ create_payload/build_payload/fill_transactions/finalize_payload)."""
 from __future__ import annotations
 
 import dataclasses
+import time
 
 from ..primitives.block import (Block, BlockBody, BlockHeader, ZERO_HASH,
                                 ZERO_NONCE)
@@ -62,7 +63,15 @@ def build_payload(chain: Blockchain, parent: BlockHeader,
 
     txs: ordered candidate transactions; invalid ones are skipped (and
     dropped from `mempool` if given) rather than failing the build.
+
+    The build is decomposed into profiler stage spans under component
+    ``payload`` (select / execute / merkleize / seal; drain and prewarm
+    are recorded by the producer around this call) so the producer has
+    the same stage breakdown the prover has — the chain-path X-ray
+    reads it to say where a slow block spent its wall.
     """
+    from ..perf.profiler import record_stage
+
     config = chain.config
     fork = config.fork_at(header.number, header.timestamp)
     env = BlockEnv(
@@ -83,19 +92,29 @@ def build_payload(chain: Blockchain, parent: BlockHeader,
     blob_gas = 0
     fees = 0
     _, max_blob_gas, _ = config.blob_params_at(header.timestamp)
+    select_s = 0.0
+    execute_s = 0.0
+    clock = time.monotonic
     for tx in txs:
+        t_sel = clock()
         if gas_used + tx.gas_limit > header.gas_limit:
+            select_s += clock() - t_sel
             continue
         tx_blob_gas = G.BLOB_GAS_PER_BLOB * len(tx.blob_versioned_hashes)
         if blob_gas + tx_blob_gas > max_blob_gas:
+            select_s += clock() - t_sel
             continue
+        t_exec = clock()
+        select_s += t_exec - t_sel
         try:
             result = execute_tx(tx, state, env, config)
         except InvalidTransaction:
+            execute_s += clock() - t_exec
             if mempool is not None:
                 mempool.remove_transaction(tx.hash,
                                            reason="invalid_at_build")
             continue
+        execute_s += clock() - t_exec
         gas_used += result.gas_used
         blob_gas += tx_blob_gas
         if tx.tx_type != TYPE_PRIVILEGED:
@@ -106,6 +125,7 @@ def build_payload(chain: Blockchain, parent: BlockHeader,
             tx_type=tx.tx_type, succeeded=result.success,
             cumulative_gas_used=gas_used, logs=result.logs))
 
+    t_seal = clock()
     for wd in withdrawals or []:
         if wd.amount:
             state.begin_tx()
@@ -115,9 +135,11 @@ def build_payload(chain: Blockchain, parent: BlockHeader,
 
     header = dataclasses.replace(header)
     header.gas_used = gas_used
+    t_merk = clock()
     header.tx_root = compute_tx_root(included)
     header.receipts_root = compute_receipts_root(receipts)
     header.bloom = logs_bloom([l for r in receipts for l in r.logs])
+    merkleize_s = clock() - t_merk
     if fork >= Fork.SHANGHAI:
         header.withdrawals_root = compute_withdrawals_root(withdrawals or [])
     if fork >= Fork.CANCUN:
@@ -125,12 +147,18 @@ def build_payload(chain: Blockchain, parent: BlockHeader,
         header.parent_beacon_block_root = parent_beacon_block_root
     if fork >= Fork.PRAGUE:
         header.requests_hash = compute_requests_hash(requests)
+    t_merk = clock()
     header.state_root = chain.store.apply_account_updates(
         parent.state_root, state)
+    merkleize_s += clock() - t_merk
     body = BlockBody(
         transactions=included, uncles=[],
         withdrawals=list(withdrawals or [])
         if fork >= Fork.SHANGHAI else None,
     )
+    record_stage("payload", "select", select_s)
+    record_stage("payload", "execute", execute_s)
+    record_stage("payload", "merkleize", merkleize_s)
+    record_stage("payload", "seal", clock() - t_seal - merkleize_s)
     return PayloadBuildResult(block=Block(header, body), receipts=receipts,
                               state_db=state, fees_collected=fees)

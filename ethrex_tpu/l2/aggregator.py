@@ -281,6 +281,12 @@ class ProofAggregator:
             self.last_error = None
         record_aggregation(count, last)
         record_verified_batch(last)
+        try:
+            from ..perf.chain_path import CHAIN_PATH
+
+            CHAIN_PATH.batches_settled(first, last)
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
         log.info("aggregated batches %d..%d into one settlement "
                  "(%d proofs -> 1 L1 tx)", first, last, count)
         return first, last

@@ -782,6 +782,14 @@ class ProofCoordinator:
                 warm_at_grant = hedge.get("warm")
             self._note_event("proof-stored", batch, prover_type,
                              "hedge won" if holds_hedge else None)
+        # chain-path X-ray: sampled lifecycles of this batch's txs get
+        # their proved mark (never raises — telemetry only)
+        try:
+            from ..perf.chain_path import CHAIN_PATH
+
+            CHAIN_PATH.batch_proved(batch)
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
         if started is not None and holds_lease:
             # proving-time metric (reference: set_batch_proving_time,
             # proof_coordinator.rs:286-296) — only meaningful when the

@@ -737,6 +737,17 @@ class Sequencer:
         from ..utils.metrics import record_batch
 
         record_batch(number)
+        # chain-path X-ray: the sealed blocks leave the batching stage;
+        # sampled lifecycles get their batched mark and join the PR-15
+        # batch trace by trace ID.  Telemetry — never fails the commit.
+        try:
+            from ..perf.chain_path import CHAIN_PATH
+
+            CHAIN_PATH.blocks_batched(
+                number, first, head,
+                trace_id=self.coordinator.trace_for_batch(number))
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
         return batch
 
     def _recommit_batch(self, number: int) -> Batch | None:
@@ -888,6 +899,12 @@ class Sequencer:
         from ..utils.metrics import record_verified_batch
 
         record_verified_batch(last)
+        try:
+            from ..perf.chain_path import CHAIN_PATH
+
+            CHAIN_PATH.batches_settled(first, last)
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
         self._record_lifecycles(first, last)
         return (first, last)
 

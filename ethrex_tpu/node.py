@@ -96,7 +96,9 @@ class Node:
             self.mempool.rejections["wrong_chain_id"] = \
                 self.mempool.rejections.get("wrong_chain_id", 0) + 1
             record_mempool_rejection("wrong_chain_id")
-            raise InvalidTransaction("wrong chain id")
+            err = InvalidTransaction("wrong chain id")
+            err.reason = "wrong_chain_id"
+            raise err
         root = self.head_state_root()
         acct = self.store.account_state(root, sender)
         nonce = acct.nonce if acct else 0
@@ -105,7 +107,13 @@ class Node:
         try:
             return self.mempool.add_transaction(tx, nonce, balance, base_fee)
         except MempoolError as e:
-            raise InvalidTransaction(str(e))
+            # carry the typed rejection reason across the exception
+            # translation: the RPC layer forwards it as structured error
+            # data so load generators can account rejections per reason
+            # instead of folding them into a generic error rate
+            err = InvalidTransaction(str(e))
+            err.reason = e.reason
+            raise err
 
     # ------------------------------------------------------------------
     def produce_block(self, timestamp: int | None = None,
@@ -125,9 +133,17 @@ class Node:
                 acct = self.store.account_state(root, sender)
                 return acct.nonce if acct else 0
 
+            from .perf.chain_path import CHAIN_PATH
+            from .perf.profiler import record_stage
+
+            t_drain = time.monotonic()
             txs = list(forced_txs or []) \
                 + self.mempool.pending(base_fee, get_nonce)
             t0 = time.monotonic()
+            # chain-path X-ray: the mempool drain is the first producer
+            # stage span; the candidate set marks sampled lifecycles
+            record_stage("payload", "drain", t0 - t_drain)
+            CHAIN_PATH.txs_selected([tx.hash for tx in txs])
             result = build_payload(self.chain, parent, header, txs, [],
                                    mempool=self.mempool)
             # block records + fork choice commit as one journaled unit on
@@ -139,7 +155,12 @@ class Node:
                 self.mempool.remove_transaction(tx.hash, reason="included")
             from .utils.metrics import record_block
 
-            record_block(result.block, time.monotonic() - t0)
+            build_s = time.monotonic() - t0
+            record_block(result.block, build_s)
+            CHAIN_PATH.block_produced(
+                result.block.header.number,
+                [tx.hash for tx in result.block.body.transactions],
+                build_s)
             block = result.block
         # gossip OUTSIDE the node lock: a stalled peer's socket must never
         # freeze block production or RPC
@@ -204,11 +225,15 @@ class Node:
                             # caches for the NEXT build without delaying
                             # this one (blockchain/prewarm.py)
                             parent = self.store.head_header()
+                            t_warm = time.monotonic()
                             prewarm_transactions(
                                 self.chain, parent,
                                 self.pending_txs(parent),
-                                deadline=time.monotonic()
-                                + block_time / 2)
+                                deadline=t_warm + block_time / 2)
+                            from .perf.profiler import record_stage
+
+                            record_stage("payload", "prewarm",
+                                         time.monotonic() - t_warm)
                 except Exception as e:  # noqa: BLE001 — keep producing
                     log.warning("block production failed: %s", e)
 

@@ -96,6 +96,20 @@ def observe_shed_latency(registry, kind: str, seconds: float):
                                "contract (docs/OVERLOAD.md)")
 
 
+def observe_rejection_latency(registry, kind: str, seconds: float):
+    """Latency of typed mempool rejections (per-sender cap, nonce gap,
+    fee floor, ...), kept apart from both accepted work and sheds: the
+    server answered fast and deliberately — admission control working
+    as designed is neither served work nor an error."""
+    registry.observe("loadgen_rejection_seconds", seconds,
+                     {"kind": kind},
+                     help_text="Latency of typed mempool-rejection "
+                               "responses (error data carries the "
+                               "admission reason) from the scheduled "
+                               "send instant — admission control "
+                               "pushing back, not a failure")
+
+
 def build_schedule(rate: float, duration: float, arrivals: str = "fixed",
                    seed: int = 0) -> list[float]:
     """Arrival offsets (seconds from run start), precomputed so nothing
@@ -287,27 +301,57 @@ class _AsyncConn:
             raise LoadgenError(f"bad response: {exc}") from exc
 
 
-def _classify(out) -> tuple[bool, bool]:
-    """(err, shed) from a decoded response.  A typed server-busy answer
-    is graceful shedding, not a failure — counted apart so sweeps
-    distinguish degradation modes.  A batch response counts as shed
-    only when EVERY entry was shed (partial service delivered work);
-    any non-busy error entry makes the whole request an error."""
+REJECTION_CODE = -32000
+
+
+def rejection_reason(err) -> str | None:
+    """The typed mempool-rejection reason carried in a JSON-RPC error's
+    structured data (rpc/eth.py send_raw_transaction), or None when the
+    error is anything else.  Strict shape check mirrors is_busy_error:
+    an untyped -32000 stays a generic error."""
+    if not isinstance(err, dict) or err.get("code") != REJECTION_CODE:
+        return None
+    data = err.get("data")
+    if not isinstance(data, dict):
+        return None
+    reason = data.get("reason")
+    if isinstance(reason, str) and reason:
+        return reason
+    return None
+
+
+def _classify(out) -> tuple[bool, bool, str | None]:
+    """(err, shed, rejection_reason) from a decoded response.  A typed
+    server-busy answer is graceful shedding and a typed mempool
+    rejection is admission control doing its job — both counted apart
+    from errors so sweeps distinguish degradation modes instead of
+    folding cap pushback into a meaningless error rate.  A batch
+    response counts as shed/rejected only when EVERY entry was typed
+    (partial service delivered work); any untyped error entry makes the
+    whole request an error."""
     if isinstance(out, list):
         if not out:
-            return True, False
+            return True, False, None
         errors = [e["error"] for e in out
                   if isinstance(e, dict) and "error" in e]
-        if any(not is_busy_error(e) for e in errors):
-            return True, False
+        if any(not is_busy_error(e) and rejection_reason(e) is None
+               for e in errors):
+            return True, False, None
         if errors and len(errors) == len(out):
-            return False, True
-        return False, False
+            reason = next((rejection_reason(e) for e in errors
+                           if rejection_reason(e)), None)
+            if reason is not None:
+                return False, False, reason
+            return False, True, None
+        return False, False, None
     if isinstance(out, dict) and "error" in out:
+        reason = rejection_reason(out["error"])
+        if reason is not None:
+            return False, False, reason
         if is_busy_error(out["error"]):
-            return False, True
-        return True, False
-    return False, False
+            return False, True, None
+        return True, False, None
+    return False, False, None
 
 
 class Harness:
@@ -457,10 +501,12 @@ class Harness:
         schedule = build_schedule(rate, duration, arrivals, self.seed)
         requests = self._build_requests(len(schedule))
         registry = Metrics()
-        stats = {"sent": 0, "errors": 0, "shed": 0, "missed": 0}
+        stats = {"sent": 0, "errors": 0, "shed": 0, "missed": 0,
+                 "rejected": 0}
         kinds: dict[str, int] = {}
+        rejections: dict[str, int] = {}
         asyncio.run(self._run_async(schedule, requests, registry,
-                                    stats, kinds))
+                                    stats, kinds, rejections))
         missed = stats["missed"]
 
         snap = registry.snapshot()
@@ -484,8 +530,10 @@ class Harness:
         lat = _lat("loadgen_request_seconds")
         sent = stats["sent"]
         shed = stats["shed"]
+        rejected = stats["rejected"]
         # accounting identity: every scheduled slot ends up in exactly
-        # one of delivered / shed / missed (sent = delivered + shed)
+        # one of delivered / shed / rejected / missed
+        # (sent = delivered + shed + rejected)
         return {
             "offeredRate": rate,
             "arrivals": arrivals,
@@ -496,17 +544,21 @@ class Harness:
             "missed": missed,
             "errors": stats["errors"],
             "shed": shed,
-            "delivered": sent - shed,
+            "rejected": rejected,
+            "rejections": dict(sorted(rejections.items())),
+            "delivered": sent - shed - rejected,
             "achievedRate": round(sent / duration, 3) if duration else 0.0,
             "errorRate": round(stats["errors"] / sent, 6) if sent else 0.0,
             "shedRate": round(shed / sent, 6) if sent else 0.0,
+            "rejectionRate": round(rejected / sent, 6) if sent else 0.0,
             "kinds": dict(sorted(kinds.items())),
             "latency": lat,
             "shedLatency": _lat("loadgen_shed_seconds"),
+            "rejectionLatency": _lat("loadgen_rejection_seconds"),
         }
 
     async def _run_async(self, schedule, requests, registry, stats,
-                         kinds):
+                         kinds, rejections):
         """The open loop on an asyncio client: `workers` persistent
         connections in a free pool, one task per send slot."""
         u = urlparse(self.url)
@@ -524,9 +576,10 @@ class Harness:
 
         async def one(conn, target, kind, body):
             err = shed = False
+            reason = None
             try:
                 out = await conn.post(body)
-                err, shed = _classify(out)
+                err, shed, reason = _classify(out)
             except LoadgenError:
                 err = True
             except Exception:  # noqa: BLE001 — a client bug must not
@@ -534,6 +587,8 @@ class Harness:
             latency = time.monotonic() - target
             if shed:
                 observe_shed_latency(registry, kind, latency)
+            elif reason is not None:
+                observe_rejection_latency(registry, kind, latency)
             else:
                 observe_request_latency(registry, kind, latency)
             stats["sent"] += 1
@@ -542,6 +597,9 @@ class Harness:
                 stats["errors"] += 1
             if shed:
                 stats["shed"] += 1
+            if reason is not None:
+                stats["rejected"] += 1
+                rejections[reason] = rejections.get(reason, 0) + 1
             free.append(conn)
 
         start = time.monotonic() + 0.02
@@ -574,7 +632,10 @@ class Harness:
         the highest rate the server sustained: errors under
         max_error_rate and ≥ min_achieved_frac of the schedule actually
         delivered.  A typed busy response is graceful but still NOT
-        delivered work, so shed slots count against sustainability."""
+        delivered work, so shed slots count against sustainability —
+        and typed mempool rejections are treated exactly the same way
+        (admission control refusing work is not work done), without
+        ever inflating the error rate."""
         results = [self.run(r, duration, arrivals)
                    for r in sorted(rates)]
         sustainable = None

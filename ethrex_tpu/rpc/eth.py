@@ -403,6 +403,14 @@ class EthApi:
         try:
             self.node.submit_transaction(tx)
         except InvalidTransaction as e:
+            # typed mempool rejections carry their machine-readable
+            # reason as structured error data: load generators account
+            # them per reason ("rejections" section) instead of folding
+            # admission-control pushback into a generic error rate
+            reason = getattr(e, "reason", None)
+            if reason:
+                raise RpcError(-32000, str(e),
+                               {"rejected": True, "reason": reason})
             raise RpcError(-32000, str(e))
         return hb(tx.hash)
 

@@ -439,6 +439,11 @@ class RpcServer:
             "ethrex_debug_snapshot": lambda: _debug_snapshot(node),
             # continuous profiler + roofline (docs/PERFORMANCE.md)
             "ethrex_perf": lambda: _perf(node),
+            # chain-path X-ray (docs/OBSERVABILITY.md "Chain-path
+            # telemetry"): stage queues, sampled tx lifecycles and the
+            # bottleneck explainer; degrades to an idle stub on L1-only
+            # nodes that never produce blocks
+            "ethrex_chainPath": lambda: _chain_path(node),
         }
 
     def _track_inflight(self, method: str, delta: int):
@@ -1036,6 +1041,23 @@ def _perf(node):
     return out
 
 
+def _chain_path(node):
+    """ethrex_chainPath: the chain-path X-ray — per-stage queue stats
+    (depth, arrival/service rates, utilization, Little's-law check),
+    sampled per-tx lifecycle records and the bottleneck explainer
+    (docs/OBSERVABILITY.md "Chain-path telemetry").  The instrument is
+    process-global; on an L1-only node that never produces blocks it
+    answers an idle stub (zero queues, bottleneck null), never an
+    error."""
+    try:
+        from ..perf.chain_path import CHAIN_PATH
+
+        return CHAIN_PATH.to_json()
+    except Exception as exc:  # noqa: BLE001 — telemetry endpoint
+        return {"enabled": False,
+                "error": f"{type(exc).__name__}: {exc}"}
+
+
 def _debug_snapshot(node):
     """ethrex_debug_snapshot: return a flight-recorder bundle, and
     persist it when --debug-snapshot-dir configured a destination."""
@@ -1195,6 +1217,16 @@ def _health(node):
         out["perf"]["collectiveOpsTotal"] = sum(
             k.get("collectiveOps") or 0 for k in coll)
         out["perf"]["deviceOccupancy"] = last.get("occupancy")
+    except Exception:  # noqa: BLE001 — health must answer regardless
+        pass
+    try:
+        # chain-path posture (docs/OBSERVABILITY.md "Chain-path
+        # telemetry"): stage depths/utilizations, live inclusion tps and
+        # the named bottleneck.  L1-only nodes (no producer) answer the
+        # idle stub — bottleneck null, zero queues — never an error.
+        from ..perf.chain_path import CHAIN_PATH
+
+        out["chainPath"] = CHAIN_PATH.health_json()
     except Exception:  # noqa: BLE001 — health must answer regardless
         pass
     seq = getattr(node, "sequencer", None)

@@ -265,6 +265,31 @@ def actor_stall_signal(eng, node):
     return worst
 
 
+def inclusion_backlog_signal(eng, node):
+    """Estimated seconds to drain the mempool admission backlog at the
+    chain path's current inclusion rate (perf/chain_path.py).  None
+    while the backlog is empty or on nodes that never produce blocks
+    (L1-only followers) — armed but silent, never false-paging."""
+    try:
+        from ..perf.chain_path import CHAIN_PATH
+
+        return CHAIN_PATH.backlog_seconds()
+    except Exception:  # noqa: BLE001 — a signal must never raise
+        return None
+
+
+def producer_stall_signal(eng, node):
+    """Seconds since the last sealed block while admitted transactions
+    wait in the pool.  None when the pool is empty or before this node's
+    first block (an idle or L1-only node is not a stalled producer)."""
+    try:
+        from ..perf.chain_path import CHAIN_PATH
+
+        return CHAIN_PATH.producer_stall_seconds()
+    except Exception:  # noqa: BLE001 — a signal must never raise
+        return None
+
+
 def sequencer_leaderless_signal(eng, node):
     """1.0 when, from this node's view, NO sequencer holds a live leader
     lease; 0.0 while somebody (us included) does.  None unless this node
@@ -408,6 +433,47 @@ def default_rules(node=None) -> list:
            runbook="Queue time dominating proving time usually means "
                    "too few provers for the batch rate or a cold fleet "
                    "being deferred; see prover_cold_deferrals_total."),
+        # chain-path inclusion backlog — the admission stage queue is
+        # deeper than the producer can drain (perf/chain_path.py);
+        # None on empty pools and L1-only nodes keeps them silent
+        mk("inclusion_backlog:page", "page",
+           inclusion_backlog_signal, 120.0,
+           window=60.0, for_count=2, resolve_count=3,
+           description="Mempool backlog needs 120s+ to drain at the "
+                       "current inclusion rate",
+           runbook="Offered load exceeds chain-path capacity: check "
+                   "ethrex_chainPath (explain.bottleneck) and "
+                   "block_inclusion_tps vs the admission arrivalRate; "
+                   "docs/OBSERVABILITY.md 'Chain-path telemetry'."),
+        mk("inclusion_backlog:warn", "warn",
+           inclusion_backlog_signal, 20.0,
+           window=60.0, for_count=3, resolve_count=3,
+           description="Mempool backlog needs 20s+ to drain at the "
+                       "current inclusion rate",
+           runbook="Sustained arrival/service imbalance; compare the "
+                   "payload stage spans (ethrex_perf) against the "
+                   "inclusion bench baseline (docs/PERFORMANCE.md "
+                   "'Reading the inclusion bench')."),
+        # chain-path producer stall — txs wait but no block seals;
+        # distinct from sequencer_stall (which watches actor loops):
+        # this watches the block producer itself
+        mk("producer_stall:page", "page",
+           producer_stall_signal, 30.0,
+           window=60.0, for_count=2, resolve_count=3,
+           description="No block sealed for 30s while transactions "
+                       "wait in the mempool",
+           runbook="The producer loop is stuck or crashing: check the "
+                   "node log for 'block production failed', the "
+                   "producer stage in ethrex_chainPath, and the "
+                   "payload stage spans in ethrex_perf."),
+        mk("producer_stall:warn", "warn",
+           producer_stall_signal, 10.0,
+           window=60.0, for_count=2, resolve_count=3,
+           description="No block sealed for 10s while transactions "
+                       "wait in the mempool",
+           runbook="Block time is stretching under load; check "
+                   "build_payload execute/merkleize spans and prewarm "
+                   "effectiveness (docs/OBSERVABILITY.md)."),
         # sequencer actor stall — no-progress watchdog
         mk("sequencer_stall:page", "page",
            actor_stall_signal, 120.0,

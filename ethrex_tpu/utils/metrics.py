@@ -863,10 +863,15 @@ def record_mempool_occupancy(size: int, utilization: float):
                 "this)")
 
 
-def observe_time_in_pool(seconds: float):
-    _observe_safe("mempool_time_in_pool_seconds", seconds, None,
-                  "Admission-to-block-inclusion dwell time of mempool "
-                  "transactions (only txs that made it into a block)")
+def observe_time_in_pool(seconds: float, reason: str = "included"):
+    # labelled by removal reason so inclusion dwell is not polluted by
+    # eviction/prune/reorg dwell (they answer different questions:
+    # "how long until a block?" vs "how long do we hold junk?")
+    _observe_safe("mempool_time_in_pool_seconds", seconds,
+                  {"reason": reason},
+                  "Admission-to-removal dwell time of mempool "
+                  "transactions, labelled by removal reason (included "
+                  "vs evicted/pruned/reorg/...)")
 
 
 def observe_prover_stage(stage: str, seconds: float):

@@ -172,6 +172,47 @@ def _chain_lines(snap: dict, width: int) -> list[str]:
     ]
 
 
+def _chain_path_lines(snap: dict, width: int) -> list[str]:
+    """Chain-path X-ray panel (ethrex_health `chainPath` section):
+    per-stage depth/utilization, live inclusion tps and the named
+    bottleneck.  Defensive like the other panels — an older node
+    without the section gets no panel."""
+    health = snap.get("health")
+    cp = health.get("chainPath") if isinstance(health, dict) else None
+    if not isinstance(cp, dict) or not cp or "error" in cp:
+        return []
+    tps = cp.get("inclusionTps")
+    tps_s = f"{tps:.1f}" if isinstance(tps, (int, float)) else "—"
+    backlog = cp.get("backlogSeconds")
+    backlog_s = f"{backlog:.1f}s" if isinstance(backlog,
+                                                (int, float)) else "—"
+    stall = cp.get("producerStallSeconds")
+    stall_s = f"{stall:.1f}s" if isinstance(stall, (int, float)) else "—"
+    lines = [
+        "─" * width,
+        " chain path",
+        f"   inclusion {tps_s} tx/s  backlog {backlog_s}"
+        f"  stall {stall_s}"
+        f"  bottleneck {cp.get('bottleneck') or 'none'}",
+    ]
+    stages = cp.get("stages")
+    if isinstance(stages, dict) and stages:
+        cells = []
+        for name in sorted(stages):
+            st = stages[name] if isinstance(stages[name], dict) else {}
+            rho = st.get("utilization")
+            if isinstance(rho, (int, float)):
+                rho_s = f"{rho:.2f}"
+            else:
+                # the health surface spells a saturated-but-undrained
+                # queue as the string "inf"
+                rho_s = rho if isinstance(rho, str) else "—"
+            cells.append(f"{name} d={st.get('depth', '?')}"
+                         f" ρ={rho_s}")
+        lines.append("   " + "  ".join(cells))
+    return lines
+
+
 def _traffic_lines(snap: dict, width: int) -> list[str]:
     """Traffic panel: RPC request-lifecycle counters and mempool flow
     accounting (ethrex_health `rpc` / `mempoolFlow` sections).
@@ -620,11 +661,13 @@ def render_lines(snap: dict, width: int = 100) -> list[str]:
         hl = snap["health"]
         items = hl.items() if isinstance(hl, dict) else enumerate(hl)
         for k, v in items:
-            # traffic/chain sections render in their own panels below
-            if k in ("rpc", "mempoolFlow", "p2p", "chain"):
+            # traffic/chain/chain-path sections render in their own
+            # panels below
+            if k in ("rpc", "mempoolFlow", "p2p", "chain", "chainPath"):
                 continue
             lines.append(f"   {k}: {v}")
     lines.extend(_chain_lines(snap, width))
+    lines.extend(_chain_path_lines(snap, width))
     lines.extend(_traffic_lines(snap, width))
     lines.extend(_p2p_lines(snap, width))
     lines.extend(_aggregation_lines(snap, width))

@@ -106,6 +106,12 @@ def _traffic(node):
     return out
 
 
+def _chain_path():
+    from ..perf.chain_path import CHAIN_PATH
+
+    return CHAIN_PATH.to_json()
+
+
 def collect(node=None, reason: str = "manual") -> dict:
     """Assemble a snapshot bundle.  Never raises; every section is
     independently guarded."""
@@ -125,6 +131,10 @@ def collect(node=None, reason: str = "manual") -> dict:
         "tpu": _section(jax_cache.runtime_telemetry),
         "perf": _section(_perf),
         "traffic": _section(lambda: _traffic(node)),
+        # chain-path X-ray: stage queues, sampled tx lifecycles and the
+        # bottleneck explainer — the post-mortem view of where the
+        # pipeline was backed up when the snapshot fired
+        "chainPath": _section(_chain_path),
     }
 
 

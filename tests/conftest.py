@@ -86,6 +86,11 @@ def _metrics_isolation():
     profiler.PROFILER.reset()
     profiler.configure(None)
     roofline.ROOFLINE.reset()
+    # the chain-path X-ray singleton accumulates stage-queue and
+    # lifecycle state from any test that produces blocks — reset it so
+    # explain_chain_path() in one test cannot see another's traffic
+    from ethrex_tpu.perf.chain_path import CHAIN_PATH
+    CHAIN_PATH.reset()
     with METRICS.lock:
         METRICS.counters = dict(saved[0])
         METRICS.gauges = dict(saved[1])

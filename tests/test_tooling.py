@@ -362,8 +362,8 @@ def test_every_metric_helper_has_help_text():
 
     from ethrex_tpu.blockchain import fork_choice, mempool
     from ethrex_tpu.l2 import leadership
-    from ethrex_tpu.perf import (bench_suite, hlo_introspect, loadgen,
-                                 occupancy, profiler, roofline)
+    from ethrex_tpu.perf import (bench_suite, chain_path, hlo_introspect,
+                                 loadgen, occupancy, profiler, roofline)
     from ethrex_tpu.prover import checkpoint, runtime_errors
     from ethrex_tpu.utils import exec_cache, metrics, overload
 
@@ -371,7 +371,7 @@ def test_every_metric_helper_has_help_text():
 
     offenders = []
     for mod in (metrics, tracing, profiler, roofline, hlo_introspect,
-                occupancy, bench_suite, loadgen,
+                occupancy, bench_suite, loadgen, chain_path,
                 mempool, fork_choice, overload, exec_cache, checkpoint,
                 runtime_errors, leadership):
         tree = ast.parse(inspect.getsource(mod))
@@ -489,6 +489,50 @@ def test_trace_analysis_rpcs_degrade_gracefully(monkeypatch):
         r = server.handle({"jsonrpc": "2.0", "id": 3, "method": method,
                            "params": []})
         assert r["result"]["found"] is False
+
+
+def test_chain_path_rpc_degrades_on_idle_l1_node():
+    """ethrex_chainPath on a fresh L1-only node (no traffic, no
+    sequencer) answers a truthful idle stub — enabled, all three stage
+    queues present at depth 0, no sampled lifecycles, bottleneck null —
+    never an error.  The ethrex_health chainPath section degrades the
+    same way."""
+    node = Node(Genesis.from_json(GENESIS))
+    server = RpcServer(node)
+    try:
+        r = server.handle({"jsonrpc": "2.0", "id": 1,
+                           "method": "ethrex_chainPath", "params": []})
+        out = r["result"]
+        assert out["enabled"] is True
+        assert "error" not in out
+        assert set(out["stages"]) == {"admission", "producer", "batching"}
+        for st in out["stages"].values():
+            assert st["depth"] == 0 and st["arrivals"] == 0
+        assert out["lifecycle"]["records"] == []
+        assert out["explain"]["bottleneck"] is None
+        h = server.handle({"jsonrpc": "2.0", "id": 2,
+                           "method": "ethrex_health", "params": []})
+        cp = h["result"]["chainPath"]
+        assert cp["bottleneck"] is None
+        assert cp["blocksProduced"] == 0
+        assert cp["backlogSeconds"] is None
+        assert cp["producerStallSeconds"] is None
+    finally:
+        node.stop()
+
+
+def test_inclusion_bench_wired_into_cli_and_gate():
+    """--measure-inclusion must exist as a cli branch and the
+    --check-regression suite must gate block_inclusion_tps (same-backend
+    history comparison, higher is better)."""
+    import inspect
+
+    from ethrex_tpu.perf import bench_suite
+
+    assert callable(bench_suite.measure_inclusion)
+    assert "--measure-inclusion" in inspect.getsource(bench_suite.cli)
+    src = inspect.getsource(bench_suite.check_regression_suite)
+    assert "block_inclusion_tps" in src
 
 
 def test_every_bench_config_emits_stages():
